@@ -1,0 +1,46 @@
+"""sntc_tpu — TPU-native network-traffic classification framework.
+
+A brand-new, TPU-first (JAX/XLA/pjit) framework with the capabilities of
+``biagiom/spark-network-traffic-classifier`` (see SURVEY.md): an
+Estimator/Transformer/Pipeline API over a pyarrow/numpy host data plane whose
+estimator ``.fit()`` inner loops run as JAX/XLA kernels on TPU, with Spark's
+partition-data-parallel ``treeAggregate`` replaced by SPMD ``psum`` reductions
+over the ICI mesh (SURVEY.md §1, §5.8).
+
+Package map (SURVEY.md §7.0):
+  core/        Params system, Frame columnar container, Pipeline/Estimator base
+  parallel/    device mesh, SPMD collectives (the treeAggregate analog)
+  data/        CICIDS2017 ingest + cleaning, synthetic generator, batching
+  feature/     StringIndexer, VectorAssembler, StandardScaler, ChiSqSelector
+  ops/         device kernels: binned histograms, segment reductions
+  models/      LogisticRegression, MLP, RandomForest, GBT, OneVsRest
+  evaluation/  MulticlassMetrics (macro/weighted F1), BinaryClassificationEvaluator
+  tuning/      ParamGridBuilder, CrossValidator
+  mlio/        model save/load manifests, streaming offset/commit logs
+  serve/       jit batched transform bridge, micro-batch streaming inference
+  utils/       structured JSONL metrics logging, profiling hooks
+"""
+
+__version__ = "0.1.0"
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.base import (
+    Estimator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    Transformer,
+)
+from sntc_tpu.core.params import Param, Params
+
+__all__ = [
+    "Frame",
+    "Estimator",
+    "Transformer",
+    "Model",
+    "Pipeline",
+    "PipelineModel",
+    "Param",
+    "Params",
+    "__version__",
+]

@@ -1,0 +1,23 @@
+from sntc_tpu.core.params import Param, Params, validators
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.base import (
+    PipelineStage,
+    Transformer,
+    Estimator,
+    Model,
+    Pipeline,
+    PipelineModel,
+)
+
+__all__ = [
+    "Param",
+    "Params",
+    "validators",
+    "Frame",
+    "PipelineStage",
+    "Transformer",
+    "Estimator",
+    "Model",
+    "Pipeline",
+    "PipelineModel",
+]

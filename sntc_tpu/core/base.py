@@ -1,0 +1,112 @@
+"""Estimator / Transformer / Pipeline — the user-facing capability surface.
+
+Behavioral spec: Spark ML's pipeline abstractions (SURVEY.md §1 L1; upstream
+``python/pyspark/ml/{base,pipeline}.py`` and
+``mllib/.../org/apache/spark/ml/Pipeline.scala`` [U]):
+
+  * ``Transformer.transform(frame) -> frame`` appends columns;
+  * ``Estimator.fit(frame) -> Model`` learns and returns a fitted Transformer;
+  * ``Pipeline`` chains stages: during ``fit``, transformers transform eagerly
+    and estimators fit on the accumulated frame, producing a ``PipelineModel``
+    of fitted stages (call-stack parity: SURVEY.md §3.1).
+
+Unlike Spark there is no Py4J/JVM boundary (deleted per SURVEY.md §1 restack):
+stages are plain Python objects whose numeric inner loops dispatch to JAX/XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import NO_DEFAULT, Param, Params
+
+
+class PipelineStage(Params):
+    """Common base for Transformer and Estimator."""
+
+
+class Transformer(PipelineStage):
+    def transform(self, frame: Frame) -> Frame:
+        raise NotImplementedError
+
+    def __call__(self, frame: Frame) -> Frame:
+        return self.transform(frame)
+
+
+class Estimator(PipelineStage):
+    def fit(self, frame: Frame, params: Optional[Dict[str, Any]] = None) -> "Model":
+        """Fit on ``frame``. ``params`` is a one-shot override map (Spark's
+        ``fit(dataset, paramMap)`` convenience used by tuning)."""
+        if params:
+            return self.copy(params).fit(frame)
+        return self._fit(frame)
+
+    def _fit(self, frame: Frame) -> "Model":
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    """A fitted Transformer produced by ``Estimator.fit``."""
+
+
+class Pipeline(Estimator):
+    """Chain of stages; ``fit`` returns a :class:`PipelineModel`.
+
+    Spark semantics (SURVEY.md §3.1): stages before the last estimator are
+    applied in order — transformers transform the running frame eagerly, each
+    estimator is fit on the running frame and its fitted model then transforms
+    the frame for downstream stages.
+    """
+
+    stages = Param("pipeline stages (Transformers and Estimators), applied in order")
+
+    def __init__(self, stages: Optional[List[PipelineStage]] = None, **kwargs: Any):
+        super().__init__(**kwargs)
+        if stages is not None:
+            self.set("stages", list(stages))
+
+    def _fit(self, frame: Frame) -> "PipelineModel":
+        stages = self.getStages()
+        for stage in stages:
+            if not isinstance(stage, (Transformer, Estimator)):
+                raise TypeError(
+                    f"pipeline stage {stage!r} is neither Transformer nor Estimator"
+                )
+        # Spark parity: only stages BEFORE the last estimator need to feed
+        # transformed data downstream — the last estimator's model transform
+        # over the training set would be discarded, so skip it.
+        last_est = max(
+            (i for i, s in enumerate(stages) if isinstance(s, Estimator)),
+            default=-1,
+        )
+        fitted: List[Transformer] = []
+        current = frame
+        for i, stage in enumerate(stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(current)
+                fitted.append(model)
+                if i < last_est:
+                    current = model.transform(current)
+            else:
+                fitted.append(stage)
+                if i < last_est:
+                    current = stage.transform(current)
+        return PipelineModel(stages=fitted)
+
+
+class PipelineModel(Model):
+    """Fitted pipeline: applies each fitted stage's transform in order."""
+
+    stages = Param("fitted pipeline stages (all Transformers)")
+
+    def __init__(self, stages: Optional[List[Transformer]] = None, **kwargs: Any):
+        super().__init__(**kwargs)
+        if stages is not None:
+            self.set("stages", list(stages))
+
+    def transform(self, frame: Frame) -> Frame:
+        current = frame
+        for stage in self.getStages():
+            current = stage.transform(current)
+        return current

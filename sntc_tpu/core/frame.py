@@ -1,0 +1,199 @@
+"""Frame — the host-side columnar dataset (the DataFrame analog).
+
+Replaces Spark SQL's DataFrame/Catalyst/Tungsten stack (SURVEY.md §1 L4) for
+this framework's needs: an immutable, ordered collection of named numpy
+columns.  Scalar columns are ``(N,)`` arrays; vector columns (the
+``VectorAssembler`` output, Spark's ``VectorUDT`` analog) are ``(N, D)``
+arrays.  pyarrow is the interchange format at the IO boundary (CSV/Parquet
+ingest, Arrow RecordBatch streaming bridge — SURVEY.md §2.7 keeps Arrow C++ as
+the host data plane).
+
+Transformations return new Frames; column data is shared, never copied, unless
+an op requires it — mirroring the immutability contract Spark's RDD model
+provides (SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Union
+
+import numpy as np
+import pyarrow as pa
+
+
+ColumnLike = Union[np.ndarray, Sequence]
+
+
+class Frame:
+    """Immutable ordered mapping of column name -> numpy array.
+
+    All columns share the same leading dimension (row count). 1-D columns are
+    scalars, 2-D columns are fixed-width vectors.
+    """
+
+    __slots__ = ("_columns", "_num_rows")
+
+    def __init__(self, columns: Mapping[str, ColumnLike]):
+        cols: Dict[str, np.ndarray] = {}
+        num_rows: Optional[int] = None
+        for name, value in columns.items():
+            arr = value if isinstance(value, np.ndarray) else np.asarray(value)
+            if arr.ndim not in (1, 2):
+                raise ValueError(
+                    f"column {name!r} must be 1-D or 2-D, got shape {arr.shape}"
+                )
+            if num_rows is None:
+                num_rows = arr.shape[0]
+            elif arr.shape[0] != num_rows:
+                raise ValueError(
+                    f"column {name!r} has {arr.shape[0]} rows, expected {num_rows}"
+                )
+            cols[name] = arr
+        self._columns = cols
+        self._num_rows = 0 if num_rows is None else int(num_rows)
+
+    # -- basic accessors -------------------------------------------------------
+
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    def __len__(self) -> int:
+        return self._num_rows
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._columns)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._columns
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        try:
+            return self._columns[name]
+        except KeyError:
+            raise KeyError(
+                f"no column {name!r}; available: {list(self._columns)}"
+            ) from None
+
+    def column(self, name: str) -> np.ndarray:
+        return self[name]
+
+    @property
+    def schema(self) -> Dict[str, tuple]:
+        return {n: (a.dtype, a.shape[1:]) for n, a in self._columns.items()}
+
+    # -- transformations (each returns a new Frame) ----------------------------
+
+    def with_column(self, name: str, value: ColumnLike) -> "Frame":
+        cols = dict(self._columns)
+        cols[name] = value
+        return Frame(cols)
+
+    def select(self, names: Iterable[str]) -> "Frame":
+        return Frame({n: self[n] for n in names})
+
+    def drop(self, *names: str) -> "Frame":
+        return Frame({n: a for n, a in self._columns.items() if n not in names})
+
+    def rename(self, mapping: Mapping[str, str]) -> "Frame":
+        return Frame(
+            {mapping.get(n, n): a for n, a in self._columns.items()}
+        )
+
+    def filter(self, mask: np.ndarray) -> "Frame":
+        mask = np.asarray(mask)
+        if mask.dtype != np.bool_ or mask.shape != (self._num_rows,):
+            raise ValueError("filter mask must be a boolean (N,) array")
+        return Frame({n: a[mask] for n, a in self._columns.items()})
+
+    def take(self, indices: np.ndarray) -> "Frame":
+        indices = np.asarray(indices)
+        return Frame({n: a[indices] for n, a in self._columns.items()})
+
+    def slice(self, start: int, stop: Optional[int] = None) -> "Frame":
+        return Frame({n: a[start:stop] for n, a in self._columns.items()})
+
+    def concat(self, other: "Frame") -> "Frame":
+        return Frame.concat_all([self, other])
+
+    @classmethod
+    def concat_all(cls, frames: Sequence["Frame"]) -> "Frame":
+        """Concatenate many frames with one allocation per column (the
+        all-days ingest path [B:10] concatenates 8 day files)."""
+        if not frames:
+            raise ValueError("concat_all requires at least one frame")
+        first = frames[0]
+        for f in frames[1:]:
+            if f.columns != first.columns:
+                raise ValueError("concat requires identical column sets/order")
+        return cls(
+            {
+                n: np.concatenate([f._columns[n] for f in frames])
+                for n in first.columns
+            }
+        )
+
+    def random_split(
+        self, weights: Sequence[float], seed: int = 0
+    ) -> List["Frame"]:
+        """Spark ``DataFrame.randomSplit`` analog: shuffled proportional split."""
+        w = np.asarray(weights, dtype=np.float64)
+        w = w / w.sum()
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self._num_rows)
+        edges = np.floor(np.cumsum(w) * self._num_rows).astype(np.int64)
+        edges[-1] = self._num_rows  # cumsum can underflow 1.0; never drop rows
+        out, start = [], 0
+        for stop in edges:
+            out.append(self.take(perm[start:stop]))
+            start = stop
+        return out
+
+    # -- Arrow interchange -----------------------------------------------------
+
+    @classmethod
+    def from_arrow(cls, table: Union[pa.Table, pa.RecordBatch]) -> "Frame":
+        if isinstance(table, pa.RecordBatch):
+            table = pa.Table.from_batches([table])
+        if len(set(table.column_names)) != len(table.column_names):
+            raise ValueError(
+                "duplicate column names in Arrow table (deduplicate first, "
+                f"e.g. at the CSV ingest layer): {table.column_names}"
+            )
+        cols: Dict[str, np.ndarray] = {}
+        for name, col in zip(table.column_names, table.columns):
+            if isinstance(col, pa.ChunkedArray):
+                col = col.combine_chunks()
+            if pa.types.is_fixed_size_list(col.type):
+                width = col.type.list_size
+                values = col.values.to_numpy(zero_copy_only=False)
+                cols[name] = values.reshape(-1, width)
+            else:
+                cols[name] = col.to_numpy(zero_copy_only=False)
+        return cls(cols)
+
+    def to_arrow(self) -> pa.Table:
+        arrays, names = [], []
+        for name, arr in self._columns.items():
+            if arr.ndim == 2:
+                width = arr.shape[1]
+                flat = pa.array(arr.reshape(-1))
+                arrays.append(pa.FixedSizeListArray.from_arrays(flat, width))
+            else:
+                arrays.append(pa.array(arr))
+            names.append(name)
+        return pa.Table.from_arrays(arrays, names=names)
+
+    @classmethod
+    def from_pandas(cls, df) -> "Frame":
+        return cls.from_arrow(pa.Table.from_pandas(df, preserve_index=False))
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    def __repr__(self) -> str:
+        cols = ", ".join(
+            f"{n}:{a.dtype}{list(a.shape[1:])}" for n, a in self._columns.items()
+        )
+        return f"Frame[{self._num_rows} rows]({cols})"

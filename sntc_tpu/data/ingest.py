@@ -1,0 +1,111 @@
+"""CICIDS2017 ingest + cleaning — the Spark CSV-source analog.
+
+Replaces ``spark.read.csv(..., inferSchema)`` + the app's cleaning pass
+(SURVEY.md §2.1): pyarrow's C++ CSV reader is the host data plane (the
+sanctioned native layer, SURVEY.md §2.7), column names are whitespace-
+normalized so real day CSVs load unchanged, ``Infinity``/``NaN`` rows in the
+rate features are dropped (or zero-imputed), and labels are canonicalized.
+A Parquet cache avoids re-parsing CSVs across runs.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.parquet as pq
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.data.schema import (
+    LABEL_COLUMN,
+    normalize_feature_name,
+    normalize_label,
+)
+
+
+def load_csv(path: str) -> Frame:
+    """Read one flow CSV with pyarrow, normalizing column names."""
+    table = pacsv.read_csv(
+        path,
+        convert_options=pacsv.ConvertOptions(
+            # the raw files spell missing/infinite rates several ways
+            null_values=["", "NaN", "nan"],
+        ),
+    )
+    names = [normalize_feature_name(c) for c in table.column_names]
+    # Real MachineLearningCVE day files contain 'Fwd Header Length' TWICE;
+    # pandas-style dedup (second copy -> '.1') matches the schema's
+    # 'Fwd Header Length.1' so real files drop in unchanged.
+    seen: dict = {}
+    deduped = []
+    for n in names:
+        if n in seen:
+            seen[n] += 1
+            deduped.append(f"{n}.{seen[n]}")
+        else:
+            seen[n] = 0
+            deduped.append(n)
+    table = table.rename_columns(deduped)
+    return Frame.from_arrow(table)
+
+
+def load_csv_dir(path: str, pattern: str = "*.csv") -> Frame:
+    """Read and concatenate all day CSVs in a directory (the all-days config
+    [B:10] loads 8 files)."""
+    paths = sorted(glob.glob(os.path.join(path, pattern)))
+    if not paths:
+        raise FileNotFoundError(f"no {pattern} files under {path}")
+    return Frame.concat_all([load_csv(p) for p in paths])
+
+
+def clean_flows(
+    frame: Frame,
+    label_col: str = LABEL_COLUMN,
+    handle_invalid: str = "drop",
+) -> Frame:
+    """Clean a raw flow Frame:
+
+    * coerce every feature column to float32,
+    * ``±Infinity -> NaN``, then drop rows with any NaN (``handle_invalid=
+      "drop"``, the common treatment of CICIDS2017) or zero-impute
+      (``"zero"``),
+    * canonicalize label strings (strip + mojibake aliases).
+    """
+    if handle_invalid not in ("drop", "zero"):
+        raise ValueError("handle_invalid must be 'drop' or 'zero'")
+    feature_cols = [c for c in frame.columns if c != label_col]
+    cleaned = {}
+    bad_mask = np.zeros(frame.num_rows, dtype=bool)
+    for name in feature_cols:
+        col = frame[name].astype(np.float32, copy=True)
+        invalid = ~np.isfinite(col)
+        if invalid.any():
+            if handle_invalid == "drop":
+                bad_mask |= invalid
+            else:
+                col[invalid] = 0.0
+        cleaned[name] = col
+    if label_col in frame:
+        labels = frame[label_col]
+        cleaned[label_col] = np.array(
+            [normalize_label(str(l)) for l in labels], dtype=object
+        )
+    out = Frame(cleaned)
+    if handle_invalid == "drop" and bad_mask.any():
+        out = out.filter(~bad_mask)
+    return out
+
+
+def cache_parquet(frame: Frame, path: str) -> str:
+    """Write a cleaned Frame to Parquet (zstd) — the fast-reload cache."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    pq.write_table(frame.to_arrow(), path, compression="zstd")
+    return path
+
+
+def load_parquet(path: str) -> Frame:
+    return Frame.from_arrow(pq.read_table(path))

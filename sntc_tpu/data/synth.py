@@ -1,0 +1,135 @@
+"""Synthetic CICIDS2017-shaped data generator.
+
+The real dataset is not in-image (SURVEY.md §7.2 item 6), so development and
+benchmarking run against a schema-locked stand-in: 78 nonneg float flow
+features, 15 labels with benign-heavy priors, and injected ``Infinity``/
+``NaN`` values in ``Flow Bytes/s`` / ``Flow Packets/s`` to exercise the
+cleaning pass (SURVEY.md §2.1).  Class-conditional structure is a lognormal
+mixture: separable enough that a correct model reaches high macro-F1, noisy
+enough that a broken one does not — the property the parity tests need.
+
+Real CICIDS2017 CSVs drop in unchanged via ``sntc_tpu.data.ingest`` because
+the column names match (``sntc_tpu/data/schema.py``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.data.schema import (
+    CICIDS2017_FEATURES,
+    CICIDS2017_LABELS,
+    CLASS_PRIORS,
+    LABEL_COLUMN,
+    NUM_FEATURES,
+)
+
+
+def _class_means(n_classes: int, rng: np.random.Generator) -> np.ndarray:
+    """Per-class mean offsets in log-space. Benign (class 0) is the origin;
+    attacks displace along ~12 informative features each."""
+    means = np.zeros((n_classes, NUM_FEATURES), dtype=np.float64)
+    for c in range(1, n_classes):
+        informative = rng.choice(NUM_FEATURES, size=12, replace=False)
+        means[c, informative] = rng.normal(0.0, 2.0, size=12)
+    return means
+
+
+def generate_frame(
+    n_rows: int,
+    seed: int = 0,
+    n_classes: int = 15,
+    dirty: bool = True,
+    class_priors: Optional[List[float]] = None,
+    min_class_fraction: float = 0.0005,
+) -> Frame:
+    """Generate a Frame with the CICIDS2017 schema (78 features + Label).
+
+    ``dirty=True`` injects Inf/NaN into the two rate columns (0.1% of rows)
+    like the real data.  ``min_class_fraction`` floors the rarest-class prior
+    so small synthetic draws still contain every class (the real tail classes
+    are vanishingly rare; tests need all 15 present).
+    """
+    if not 1 <= n_classes <= 15:
+        raise ValueError("n_classes must be in [1, 15]")
+    labels_vocab = CICIDS2017_LABELS[:n_classes]
+    rng = np.random.default_rng(seed)
+
+    if class_priors is None:
+        priors = np.array([CLASS_PRIORS[l] for l in labels_vocab])
+        priors = np.maximum(priors, min_class_fraction)
+    else:
+        priors = np.asarray(class_priors, dtype=np.float64)
+    priors = priors / priors.sum()
+
+    y = rng.choice(n_classes, size=n_rows, p=priors)
+    means = _class_means(n_classes, np.random.default_rng(seed + 1))
+
+    # lognormal flows: exp(class mean + noise), scaled per feature
+    feature_scale = np.random.default_rng(seed + 2).uniform(
+        0.5, 4.0, size=NUM_FEATURES
+    )
+    log_x = means[y] + rng.normal(0.0, 1.0, size=(n_rows, NUM_FEATURES))
+    x = np.exp(log_x * feature_scale * 0.5).astype(np.float32)
+
+    # integer-ish columns (ports, counts, flags) get floored
+    int_like = [0, 2, 3, 43, 44, 45, 46, 47, 48, 49, 50]
+    x[:, int_like] = np.floor(x[:, int_like])
+
+    if dirty:
+        n_bad = max(1, int(n_rows * 0.001))
+        bytes_col = CICIDS2017_FEATURES.index("Flow Bytes/s")
+        pkts_col = CICIDS2017_FEATURES.index("Flow Packets/s")
+        bad_rows = rng.choice(n_rows, size=n_bad, replace=False)
+        half = n_bad // 2
+        x[bad_rows[:half], bytes_col] = np.inf
+        x[bad_rows[half:], pkts_col] = np.nan
+
+    cols = {
+        name: np.ascontiguousarray(x[:, j])
+        for j, name in enumerate(CICIDS2017_FEATURES)
+    }
+    cols[LABEL_COLUMN] = np.array([labels_vocab[c] for c in y], dtype=object)
+    return Frame(cols)
+
+
+def write_day_csvs(
+    out_dir: str,
+    n_rows_per_day: int = 1000,
+    n_days: int = 8,
+    seed: int = 0,
+) -> List[str]:
+    """Emulate the 8 "MachineLearningCVE" day files as CSVs on disk, with the
+    raw files' erratic leading-space column headers, for ingest tests."""
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    for day in range(n_days):
+        frame = generate_frame(n_rows_per_day, seed=seed + day)
+        path = os.path.join(out_dir, f"day{day}.csv")
+        # raw CICIDS2017 headers have leading spaces on most columns, and
+        # 'Fwd Header Length' appears twice (the ingest dedup maps the second
+        # occurrence to 'Fwd Header Length.1')
+        raw_names = [
+            "Fwd Header Length" if c == "Fwd Header Length.1" else c
+            for c in frame.columns
+        ]
+        header = ",".join(
+            (" " + c if i % 2 else c) for i, c in enumerate(raw_names)
+        )
+        with open(path, "w") as f:
+            f.write(header + "\n")
+            cols = [frame[c] for c in frame.columns]
+            for i in range(frame.num_rows):
+                f.write(
+                    ",".join(
+                        str(col[i]) if col.dtype == object else repr(float(col[i]))
+                        for col in cols
+                    )
+                    + "\n"
+                )
+        paths.append(path)
+    return paths

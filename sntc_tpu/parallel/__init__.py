@@ -1,0 +1,27 @@
+from sntc_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_sharding,
+    default_mesh,
+    make_mesh,
+    replicated_sharding,
+)
+from sntc_tpu.parallel.collectives import (
+    make_tree_aggregate,
+    pad_rows,
+    shard_batch,
+    tree_aggregate,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "default_mesh",
+    "make_mesh",
+    "data_sharding",
+    "replicated_sharding",
+    "pad_rows",
+    "shard_batch",
+    "tree_aggregate",
+    "make_tree_aggregate",
+]

@@ -1,0 +1,99 @@
+"""SPMD collectives — the ``treeAggregate`` / ``TorrentBroadcast`` analog.
+
+Spark's per-iteration comm triad (SURVEY.md §3.1, §5.8):
+
+    broadcast(params)  ->  per-partition seqOp  ->  tree-reduce combOp to driver
+
+collapses on TPU into one SPMD program: params are replicated by sharding,
+the seqOp is the per-shard computation, and the combOp is ``jax.lax.psum``
+over the ICI ``"data"`` axis — on-device, no host hop, no serialization
+(netty RPC / shuffle / torrent broadcast all deleted per SURVEY.md §2.5).
+
+``tree_aggregate(fn, mesh, *arrays)`` is the named API estimators use; it
+shards each array's leading axis over the mesh, applies ``fn`` per shard, and
+``psum``s every leaf of the result.  Rows are padded to a shard multiple with
+an explicit weight column so padding contributes zero (callers thread the
+weight through ``fn``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sntc_tpu.parallel.mesh import DATA_AXIS
+
+
+def pad_rows(n: int, n_shards: int) -> int:
+    """Rows after padding ``n`` up to a multiple of ``n_shards``."""
+    return ((n + n_shards - 1) // n_shards) * n_shards
+
+
+def shard_batch(mesh: Mesh, *arrays: np.ndarray, axis_name: str = DATA_AXIS):
+    """Pad + device_put arrays row-sharded over the mesh.
+
+    Returns ``(*sharded_arrays, weights)`` where ``weights`` is f32 (N,) with
+    1.0 on real rows and 0.0 on padding — the masked-row idiom every reduction
+    in this framework uses (SURVEY.md §7.2 mitigation for static shapes).
+    Padding replicates row 0 (not zeros) so padded rows stay numerically
+    benign under ops like log/σ; their weight removes them from results.
+    """
+    n = arrays[0].shape[0]
+    n_shards = mesh.shape[axis_name]
+    n_pad = pad_rows(n, n_shards)
+    out = []
+    for arr in arrays:
+        if arr.shape[0] != n:
+            raise ValueError("all arrays must share the leading dimension")
+        if n_pad != n:
+            pad_block = np.broadcast_to(arr[:1], (n_pad - n,) + arr.shape[1:])
+            arr = np.concatenate([arr, pad_block], axis=0)
+        sharding = NamedSharding(
+            mesh, P(axis_name, *([None] * (arr.ndim - 1)))
+        )
+        out.append(jax.device_put(arr, sharding))
+    weights = np.zeros(n_pad, dtype=np.float32)
+    weights[:n] = 1.0
+    out.append(jax.device_put(weights, NamedSharding(mesh, P(axis_name))))
+    return tuple(out)
+
+
+def make_tree_aggregate(
+    fn: Callable,
+    mesh: Mesh,
+    axis_name: str = DATA_AXIS,
+) -> Callable:
+    """Build a jitted ``agg(*arrays) -> pytree`` that computes
+    ``psum_over_shards(fn(shard_of(*arrays)))``.
+
+    ``fn`` takes row-shards (leading axis = local rows) and returns a pytree
+    of fixed-shape partials; every leaf is summed across the mesh axis.
+    The result is replicated on all devices (the driver-side combOp result,
+    but living on-device).
+    """
+
+    def agg(*arrays):
+        in_specs = tuple(
+            P(axis_name, *([None] * (a.ndim - 1))) for a in arrays
+        )
+
+        def local(*shards):
+            partials = fn(*shards)
+            return jax.tree.map(
+                lambda t: jax.lax.psum(t, axis_name), partials
+            )
+
+        return jax.shard_map(
+            local, mesh=mesh, in_specs=in_specs, out_specs=P()
+        )(*arrays)
+
+    return jax.jit(agg)
+
+
+def tree_aggregate(fn: Callable, mesh: Mesh, *arrays, axis_name: str = DATA_AXIS):
+    """One-shot convenience over :func:`make_tree_aggregate` (recompiles per
+    call site — estimators with iteration loops should build once)."""
+    return make_tree_aggregate(fn, mesh, axis_name)(*arrays)

@@ -1,0 +1,72 @@
+"""Device mesh construction — the executor-topology analog.
+
+Replaces Spark's cluster-manager / executor layer (SURVEY.md §1 L8): instead
+of ``spark-submit --master local[*]`` placing tasks on executor JVMs, we build
+a ``jax.sharding.Mesh`` over the TPU chips of one ICI domain (v5e-8 target)
+and run every estimator SPMD over it.  The leading mesh axis ``"data"`` is the
+RDD-partition analog: batches shard over it, reductions ``psum`` over it
+(SURVEY.md §5.8).  A second ``"model"`` axis is available for wide layers
+(unused by the CICIDS2017 models, which are small — SURVEY.md §2.5 marks TP as
+absent upstream — but the mesh plumbing supports it for the multichip dryrun
+and future growth).
+
+Dev/test: ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` gives 8 fake
+CPU devices — the ``local[2]``/``local-cluster`` analog (SURVEY.md §4.1).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def default_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over (the first ``n_devices``) available devices, axis "data"."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (DATA_AXIS,))
+
+
+def make_mesh(
+    data: int = -1,
+    model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """2-D ``(data, model)`` mesh.  ``data=-1`` means "all remaining devices".
+
+    ``model`` should divide the device count; collectives for gradients ride
+    the ``data`` axis, parameter shards the ``model`` axis.
+    """
+    devs = list(jax.devices() if devices is None else devices)
+    if data == -1:
+        if len(devs) % model:
+            raise ValueError(f"{len(devs)} devices not divisible by model={model}")
+        data = len(devs) // model
+    devs = devs[: data * model]
+    if len(devs) != data * model:
+        raise ValueError(
+            f"need {data * model} devices for mesh ({data},{model}), "
+            f"have {len(devs)}"
+        )
+    arr = np.array(devs).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh: Mesh, rank: int = 1) -> NamedSharding:
+    """Shard the leading (row) axis over "data"; replicate trailing axes."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (rank - 1))))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

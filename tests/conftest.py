@@ -1,0 +1,32 @@
+"""Test harness: fake 8-device CPU mesh (SURVEY.md §4.1).
+
+Must run before jax is imported anywhere: forces the CPU platform with 8
+virtual devices — the ``local[2]``/``local-cluster`` analog — so all
+pmap/psum/shard_map code paths run multi-device without TPU hardware.
+"""
+
+import os
+
+# Force-override: the host environment pins JAX_PLATFORMS to the real TPU and
+# its sitecustomize imports jax at interpreter startup, so the env var alone is
+# ignored — XLA_FLAGS must land before first backend init, the platform via
+# jax.config.update after import.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from sntc_tpu.parallel import default_mesh
+
+    assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
+    return default_mesh()

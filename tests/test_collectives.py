@@ -1,0 +1,57 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sntc_tpu.parallel import (
+    make_tree_aggregate,
+    pad_rows,
+    shard_batch,
+)
+
+
+def test_pad_rows():
+    assert pad_rows(16, 8) == 16
+    assert pad_rows(17, 8) == 24
+    assert pad_rows(1, 8) == 8
+
+
+def test_shard_batch_pads_with_zero_weights(mesh8):
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    (xs, w) = shard_batch(mesh8, x)
+    assert xs.shape == (16, 1)
+    assert w.shape == (16,)
+    np.testing.assert_array_equal(np.asarray(w), [1] * 10 + [0] * 6)
+    # padding replicates row 0, not garbage
+    assert np.asarray(xs)[10:].tolist() == [[0.0]] * 6
+
+
+def test_tree_aggregate_matches_numpy(mesh8):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(100, 4)).astype(np.float32)
+    y = rng.normal(size=(100,)).astype(np.float32)
+    xs, ys, w = shard_batch(mesh8, x, y)
+
+    def weighted_moments(xs, ys, w):
+        return {
+            "sum_x": jnp.einsum("n,nd->d", w, xs),
+            "sum_xy": jnp.einsum("n,nd,n->d", w, xs, ys),
+            "count": jnp.sum(w),
+        }
+
+    agg = make_tree_aggregate(weighted_moments, mesh8)
+    out = agg(xs, ys, w)
+    np.testing.assert_allclose(np.asarray(out["sum_x"]), x.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(out["sum_xy"]), (x * y[:, None]).sum(0), rtol=1e-4
+    )
+    assert float(out["count"]) == 100.0
+
+
+def test_tree_aggregate_result_replicated(mesh8):
+    x = np.ones((8, 2), dtype=np.float32)
+    xs, w = shard_batch(mesh8, x)
+    agg = make_tree_aggregate(lambda xs, w: jnp.sum(xs * w[:, None]), mesh8)
+    out = agg(xs, w)
+    assert float(out) == 16.0
+    # replicated output: every device holds the full value
+    assert out.sharding.is_fully_replicated

@@ -1,0 +1,72 @@
+import numpy as np
+
+from sntc_tpu.data import (
+    CICIDS2017_FEATURES,
+    CICIDS2017_LABELS,
+    clean_flows,
+    generate_frame,
+    load_csv_dir,
+    write_day_csvs,
+)
+from sntc_tpu.data.ingest import cache_parquet, load_parquet
+from sntc_tpu.data.schema import LABEL_COLUMN, normalize_label
+
+
+def test_schema_constants():
+    assert len(CICIDS2017_FEATURES) == 78
+    assert len(CICIDS2017_LABELS) == 15
+    assert len(set(CICIDS2017_FEATURES)) == 78
+
+
+def test_generate_frame_shape_and_labels():
+    f = generate_frame(5000, seed=0)
+    assert f.num_rows == 5000
+    assert set(f.columns) == set(CICIDS2017_FEATURES) | {LABEL_COLUMN}
+    present = set(np.unique(f[LABEL_COLUMN].astype(str)))
+    assert "BENIGN" in present
+    assert present <= set(CICIDS2017_LABELS)
+    # benign-heavy imbalance
+    benign_frac = (f[LABEL_COLUMN].astype(str) == "BENIGN").mean()
+    assert 0.7 < benign_frac < 0.9
+
+
+def test_dirty_values_injected_and_cleaned():
+    f = generate_frame(2000, seed=1, dirty=True)
+    stacked = np.stack([f[c] for c in CICIDS2017_FEATURES], axis=1)
+    assert not np.isfinite(stacked).all()
+    cleaned = clean_flows(f)
+    assert cleaned.num_rows < f.num_rows
+    stacked = np.stack([cleaned[c] for c in CICIDS2017_FEATURES], axis=1)
+    assert np.isfinite(stacked).all()
+    assert stacked.dtype == np.float32
+
+    zeroed = clean_flows(f, handle_invalid="zero")
+    assert zeroed.num_rows == f.num_rows
+
+
+def test_label_normalization():
+    assert normalize_label(" BENIGN ") == "BENIGN"
+    assert normalize_label("Web Attack \x96 XSS") == "Web Attack - XSS"
+
+
+def test_csv_roundtrip_dedups_duplicate_header(tmp_path):
+    # raw day files contain 'Fwd Header Length' twice; ingest must map the
+    # second occurrence to 'Fwd Header Length.1'
+    write_day_csvs(str(tmp_path), n_rows_per_day=50, n_days=2, seed=3)
+    header = open(tmp_path / "day0.csv").readline()
+    assert header.count("Fwd Header Length") == 2
+    assert "Fwd Header Length.1" not in header
+    f = load_csv_dir(str(tmp_path))
+    assert f.num_rows == 100
+    assert set(f.columns) == set(CICIDS2017_FEATURES) | {LABEL_COLUMN}
+    assert "Fwd Header Length.1" in f.columns
+    cleaned = clean_flows(f)
+    assert cleaned.num_rows <= 100
+
+
+def test_parquet_cache_roundtrip(tmp_path):
+    f = clean_flows(generate_frame(100, seed=2))
+    path = cache_parquet(f, str(tmp_path / "cache.parquet"))
+    g = load_parquet(path)
+    assert g.num_rows == f.num_rows
+    np.testing.assert_allclose(g["Flow Duration"], f["Flow Duration"])

@@ -1,0 +1,72 @@
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+
+
+def _frame(n=10):
+    return Frame({
+        "a": np.arange(n, dtype=np.float32),
+        "vec": np.arange(n * 3, dtype=np.float32).reshape(n, 3),
+        "label": np.array([f"c{i % 3}" for i in range(n)], dtype=object),
+    })
+
+
+def test_construction_and_accessors():
+    f = _frame()
+    assert f.num_rows == 10 and len(f) == 10
+    assert f.columns == ["a", "vec", "label"]
+    assert f["vec"].shape == (10, 3)
+    with pytest.raises(KeyError):
+        f["missing"]
+
+
+def test_row_count_mismatch_rejected():
+    with pytest.raises(ValueError):
+        Frame({"a": np.zeros(3), "b": np.zeros(4)})
+
+
+def test_with_column_is_functional():
+    f = _frame()
+    g = f.with_column("b", np.ones(10))
+    assert "b" in g and "b" not in f
+
+
+def test_filter_take_slice_concat():
+    f = _frame()
+    assert f.filter(f["a"] < 5).num_rows == 5
+    assert np.array_equal(f.take(np.array([2, 0]))["a"], [2.0, 0.0])
+    assert f.slice(2, 6).num_rows == 4
+    assert f.concat(f).num_rows == 20
+
+
+def test_random_split_partitions_all_rows():
+    f = _frame(100)
+    a, b = f.random_split([0.8, 0.2], seed=1)
+    assert a.num_rows + b.num_rows == 100
+    assert abs(a.num_rows - 80) <= 1
+    merged = sorted(np.concatenate([a["a"], b["a"]]).tolist())
+    assert merged == sorted(f["a"].tolist())
+
+
+def test_random_split_many_weights_drops_no_rows():
+    f = _frame(1000)
+    parts = f.random_split([0.1] * 10, seed=0)
+    assert sum(p.num_rows for p in parts) == 1000
+
+
+def test_concat_all():
+    f = _frame(10)
+    g = Frame.concat_all([f, f, f])
+    assert g.num_rows == 30
+    with pytest.raises(ValueError):
+        Frame.concat_all([f, Frame({"z": np.zeros(2)})])
+
+
+def test_arrow_roundtrip_with_vector_column():
+    f = _frame()
+    table = f.to_arrow()
+    g = Frame.from_arrow(table)
+    assert g.columns == f.columns
+    assert np.array_equal(g["vec"], f["vec"])
+    assert list(g["label"]) == list(f["label"])

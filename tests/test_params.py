@@ -1,0 +1,78 @@
+import pytest
+
+from sntc_tpu.core.params import NO_DEFAULT, Param, Params, validators
+
+
+class Stage(Params):
+    maxIter = Param("max iterations (> 0)", default=100, validator=validators.gt(0))
+    regParam = Param("regularization (>= 0)", default=0.0, validator=validators.gteq(0))
+    solver = Param("solver name", default="lbfgs", validator=validators.one_of("lbfgs", "owlqn"))
+    labelCol = Param("label column", default="label")
+    required = Param("no default")
+
+
+class SubStage(Stage):
+    maxIter = Param("overridden doc", default=50, validator=validators.gt(0))
+    extra = Param("extra param", default=True, validator=validators.is_bool())
+
+
+def test_defaults_and_generated_accessors():
+    s = Stage()
+    assert s.getMaxIter() == 100
+    assert s.getRegParam() == 0.0
+    assert s.getOrDefault("solver") == "lbfgs"
+    assert s.getOrDefault(Stage.maxIter) == 100
+
+
+def test_constructor_kwargs_and_chained_setters():
+    s = Stage(maxIter=10).setRegParam(0.5).setSolver("owlqn")
+    assert (s.getMaxIter(), s.getRegParam(), s.getSolver()) == (10, 0.5, "owlqn")
+
+
+def test_validator_rejects():
+    with pytest.raises(ValueError):
+        Stage(maxIter=0)
+    with pytest.raises(ValueError):
+        Stage().setSolver("newton")
+
+
+def test_unknown_param_rejected():
+    with pytest.raises(AttributeError):
+        Stage(bogus=1)
+
+
+def test_no_default_raises_until_set():
+    s = Stage()
+    assert not s.isDefined("required")
+    with pytest.raises(KeyError):
+        s.getRequired()
+    s.setRequired(7)
+    assert s.getRequired() == 7
+
+
+def test_inheritance_and_override():
+    s = SubStage()
+    assert s.getMaxIter() == 50
+    assert s.getExtra() is True
+    assert s.getRegParam() == 0.0
+    assert set(SubStage.params()) == {
+        "maxIter", "regParam", "solver", "labelCol", "required", "extra",
+    }
+
+
+def test_copy_with_extra_is_independent():
+    s = Stage(maxIter=10)
+    c = s.copy({"maxIter": 20})
+    assert s.getMaxIter() == 10 and c.getMaxIter() == 20
+    assert c.uid == s.uid  # Spark copy keeps the uid
+    c.setRegParam(1.0)
+    assert not s.isSet("regParam")
+
+
+def test_explain_and_param_values():
+    s = Stage(maxIter=5)
+    text = s.explainParams()
+    assert "maxIter" in text and "current: 5" in text
+    vals = s.paramValues()
+    assert vals["maxIter"] == 5 and vals["solver"] == "lbfgs"
+    assert "required" not in vals  # undefined, no default

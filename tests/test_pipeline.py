@@ -1,0 +1,55 @@
+import numpy as np
+
+from sntc_tpu.core.base import Estimator, Model, Pipeline, PipelineModel, Transformer
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param
+
+
+class AddConst(Transformer):
+    value = Param("constant to add", default=1.0)
+    inputCol = Param("input column", default="x")
+
+    def transform(self, frame):
+        col = self.getInputCol()
+        return frame.with_column(col, frame[col] + self.getValue())
+
+
+class MeanModel(Model):
+    def __init__(self, mean, **kw):
+        super().__init__(**kw)
+        self.mean = mean
+
+    def transform(self, frame):
+        return frame.with_column("centered", frame["x"] - self.mean)
+
+
+class MeanCenter(Estimator):
+    def _fit(self, frame):
+        return MeanModel(float(frame["x"].mean()))
+
+
+def test_pipeline_fit_transform_order():
+    f = Frame({"x": np.array([0.0, 2.0, 4.0])})
+    pipe = Pipeline(stages=[AddConst(value=1.0), MeanCenter()])
+    model = pipe.fit(f)
+    assert isinstance(model, PipelineModel)
+    # estimator saw the transformed column (mean of x+1 = 3)
+    assert model.getStages()[1].mean == 3.0
+    out = model.transform(f)
+    assert np.allclose(out["centered"], [-2.0, 0.0, 2.0])
+
+
+def test_fit_with_param_override_does_not_mutate():
+    f = Frame({"x": np.array([1.0])})
+
+    class Rec(Estimator):
+        value = Param("v", default=0)
+
+        def _fit(self, frame):
+            m = MeanModel(self.getValue())
+            return m
+
+    e = Rec()
+    m = e.fit(f, {"value": 9})
+    assert m.mean == 9
+    assert e.getValue() == 0
