@@ -15,10 +15,9 @@ Package map (SURVEY.md §7.0):
   ops/         device kernels: binned histograms, segment reductions
   models/      LogisticRegression, MLP, RandomForest, GBT, OneVsRest
   evaluation/  MulticlassMetrics (macro/weighted F1), BinaryClassificationEvaluator
-  tuning/      ParamGridBuilder, CrossValidator
-  mlio/        model save/load manifests, streaming offset/commit logs
-  serve/       jit batched transform bridge, micro-batch streaming inference
-  utils/       structured JSONL metrics logging, profiling hooks
+  mlio/        model save/load manifests
+  tuning/, serve/, utils/ — planned: CrossValidator, streaming inference
+  bridge, JSONL metrics (SURVEY.md §7.1 steps 5-6)
 """
 
 __version__ = "0.1.0"

@@ -25,6 +25,23 @@ from sntc_tpu.core.params import NO_DEFAULT, Param, Params
 class PipelineStage(Params):
     """Common base for Transformer and Estimator."""
 
+    def save(self, path: str) -> str:
+        """Persist this stage (SURVEY.md §5.4); see sntc_tpu.mlio."""
+        from sntc_tpu.mlio import save_model
+
+        return save_model(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineStage":
+        from sntc_tpu.mlio import load_model
+
+        obj = load_model(path)
+        if not isinstance(obj, cls):
+            raise TypeError(
+                f"{path} holds a {type(obj).__name__}, not a {cls.__name__}"
+            )
+        return obj
+
 
 class Transformer(PipelineStage):
     def transform(self, frame: Frame) -> Frame:

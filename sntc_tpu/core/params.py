@@ -117,22 +117,32 @@ class Params:
 
     def __init_subclass__(cls, **kwargs: Any) -> None:
         super().__init_subclass__(**kwargs)
-        for name, p in list(cls.__dict__.items()):
-            if not isinstance(p, Param):
-                continue
+        # Walk the full MRO so Param declarations on plain mixin classes
+        # (shared estimator/model param blocks) get accessors too.
+        declared: Dict[str, Param] = {}
+        for klass in reversed(cls.__mro__):
+            for name, p in vars(klass).items():
+                if isinstance(p, Param):
+                    declared[name] = p
+        for name, p in declared.items():
             cap = _capitalize(name)
             getter_name, setter_name = f"get{cap}", f"set{cap}"
-            if getter_name not in cls.__dict__:
+            # generate only when no accessor exists anywhere in the MRO —
+            # hand-written overrides (and inherited generated ones, which
+            # resolve by name) must not be shadowed
+            if not hasattr(cls, getter_name):
                 def _getter(self: "Params", _n: str = name) -> Any:
                     return self.getOrDefault(_n)
                 _getter.__name__ = getter_name
                 _getter.__doc__ = f"Value of param ``{name}``: {p.doc}"
+                _getter._sntc_generated = True
                 setattr(cls, getter_name, _getter)
-            if setter_name not in cls.__dict__:
+            if not hasattr(cls, setter_name):
                 def _setter(self: "Params", value: Any, _n: str = name) -> "Params":
                     return self.set(_n, value)
                 _setter.__name__ = setter_name
                 _setter.__doc__ = f"Set param ``{name}``: {p.doc}"
+                _setter._sntc_generated = True
                 setattr(cls, setter_name, _setter)
 
     def __init__(self, **kwargs: Any):

@@ -1,0 +1,11 @@
+from sntc_tpu.evaluation.multiclass import (
+    MulticlassClassificationEvaluator,
+    MulticlassMetrics,
+)
+from sntc_tpu.evaluation.binary import BinaryClassificationEvaluator
+
+__all__ = [
+    "MulticlassClassificationEvaluator",
+    "MulticlassMetrics",
+    "BinaryClassificationEvaluator",
+]

@@ -1,0 +1,183 @@
+"""Multiclass metrics — EXACT Spark MulticlassMetrics semantics.
+
+Behavioral spec: SURVEY.md §2.4 (upstream
+``ml/evaluation/MulticlassClassificationEvaluator.scala`` +
+``mllib/evaluation/MulticlassMetrics.scala`` [U]).  Parity notes that
+macro-F1 claims die on (SURVEY.md §7.2 item 3):
+
+  * Spark's evaluator ``metricName="f1"`` is the **weighted** F-measure
+    (class-frequency weighted), not macro;
+  * [B:2]'s metric of record is **macro-F1** — the unweighted mean of
+    per-class F1 — exposed here as ``metricName="macroF1"``;
+  * every ratio uses the 0/0 -> 0 convention;
+  * weights are by TRUE-label frequency; per-class stats cover every class
+    seen in labels or predictions.
+
+The confusion matrix reduces on-device (``segment_sum`` + ``psum`` over the
+mesh — SURVEY.md §2.4 "TPU equiv"); the scalar metrics are host arithmetic.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+from sntc_tpu.parallel.collectives import (
+    make_tree_aggregate,
+    shard_batch,
+    shard_weights,
+)
+from sntc_tpu.parallel.context import get_default_mesh
+
+
+class MulticlassMetrics:
+    """Confusion-matrix metrics for (prediction, label) pairs.
+
+    ``confusion[i, j]`` counts rows with true label ``i`` predicted ``j``
+    (Spark's ``confusionMatrix`` orientation).
+    """
+
+    def __init__(
+        self,
+        labels: np.ndarray,
+        predictions: np.ndarray,
+        weights: np.ndarray = None,
+        num_classes: int = None,
+        mesh=None,
+    ):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        y = labels.astype(np.int32)
+        p = predictions.astype(np.int32)
+        if num_classes is None:
+            num_classes = int(max(y.max(initial=0), p.max(initial=0))) + 1
+        k = int(num_classes)
+        w = (
+            np.ones(len(y), np.float32)
+            if weights is None
+            else np.asarray(weights, np.float32)
+        )
+
+        mesh = mesh or get_default_mesh()
+        ys, ps, _ = shard_batch(mesh, y, p)
+        ws = shard_weights(mesh, w, ys.shape[0])
+
+        def conf(ys, ps, ws):
+            return jax.ops.segment_sum(ws, ys * k + ps, num_segments=k * k)
+
+        flat = make_tree_aggregate(conf, mesh)(ys, ps, ws)
+        self.confusion = np.asarray(flat, np.float64).reshape(k, k)
+        self.num_classes = k
+
+    # -- per-class arrays (index = class id) ----------------------------------
+
+    @property
+    def true_positives(self) -> np.ndarray:
+        return np.diag(self.confusion)
+
+    @property
+    def label_counts(self) -> np.ndarray:
+        return self.confusion.sum(axis=1)
+
+    @property
+    def prediction_counts(self) -> np.ndarray:
+        return self.confusion.sum(axis=0)
+
+    @staticmethod
+    def _safe_div(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        return np.divide(a, b, out=np.zeros_like(a, dtype=np.float64), where=b != 0)
+
+    def precision_by_label(self) -> np.ndarray:
+        return self._safe_div(self.true_positives, self.prediction_counts)
+
+    def recall_by_label(self) -> np.ndarray:
+        return self._safe_div(self.true_positives, self.label_counts)
+
+    def f_measure_by_label(self, beta: float = 1.0) -> np.ndarray:
+        p, r = self.precision_by_label(), self.recall_by_label()
+        b2 = beta * beta
+        return self._safe_div((1 + b2) * p * r, b2 * p + r)
+
+    # -- scalar metrics -------------------------------------------------------
+
+    @property
+    def accuracy(self) -> float:
+        total = self.confusion.sum()
+        return float(self.true_positives.sum() / total) if total else 0.0
+
+    def _weights(self) -> np.ndarray:
+        counts = self.label_counts
+        total = counts.sum()
+        return counts / total if total else counts
+
+    def weighted_precision(self) -> float:
+        return float((self._weights() * self.precision_by_label()).sum())
+
+    def weighted_recall(self) -> float:
+        return float((self._weights() * self.recall_by_label()).sum())
+
+    def weighted_f_measure(self, beta: float = 1.0) -> float:
+        return float((self._weights() * self.f_measure_by_label(beta)).sum())
+
+    def macro_f1(self) -> float:
+        """Unweighted mean of per-class F1 over classes present in the TRUE
+        labels ([B:2] metric of record)."""
+        present = self.label_counts > 0
+        f1 = self.f_measure_by_label()
+        return float(f1[present].mean()) if present.any() else 0.0
+
+
+class MulticlassClassificationEvaluator:
+    """Spark-parity evaluator facade over :class:`MulticlassMetrics`."""
+
+    _METRICS = (
+        "f1",
+        "accuracy",
+        "weightedPrecision",
+        "weightedRecall",
+        "weightedFMeasure",
+        "macroF1",
+    )
+
+    def __init__(
+        self,
+        metricName: str = "f1",
+        labelCol: str = "label",
+        predictionCol: str = "prediction",
+        beta: float = 1.0,
+        mesh=None,
+    ):
+        if metricName not in self._METRICS:
+            raise ValueError(
+                f"unknown metricName {metricName!r}; one of {self._METRICS}"
+            )
+        self.metricName = metricName
+        self.labelCol = labelCol
+        self.predictionCol = predictionCol
+        self.beta = beta
+        self._mesh = mesh
+
+    def metrics(self, frame: Frame) -> MulticlassMetrics:
+        return MulticlassMetrics(
+            frame[self.labelCol], frame[self.predictionCol], mesh=self._mesh
+        )
+
+    def evaluate(self, frame: Frame) -> float:
+        m = self.metrics(frame)
+        name = self.metricName
+        if name == "f1":
+            return m.weighted_f_measure()
+        if name == "accuracy":
+            return m.accuracy
+        if name == "weightedPrecision":
+            return m.weighted_precision()
+        if name == "weightedRecall":
+            return m.weighted_recall()
+        if name == "weightedFMeasure":
+            return m.weighted_f_measure(self.beta)
+        return m.macro_f1()
+
+    def isLargerBetter(self) -> bool:
+        return True

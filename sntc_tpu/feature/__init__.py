@@ -1,0 +1,15 @@
+from sntc_tpu.feature.vector_assembler import VectorAssembler
+from sntc_tpu.feature.string_indexer import IndexToString, StringIndexer, StringIndexerModel
+from sntc_tpu.feature.standard_scaler import StandardScaler, StandardScalerModel
+from sntc_tpu.feature.chisq_selector import ChiSqSelector, ChiSqSelectorModel
+
+__all__ = [
+    "VectorAssembler",
+    "StringIndexer",
+    "StringIndexerModel",
+    "IndexToString",
+    "StandardScaler",
+    "StandardScalerModel",
+    "ChiSqSelector",
+    "ChiSqSelectorModel",
+]

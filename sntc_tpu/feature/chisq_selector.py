@@ -1,0 +1,115 @@
+"""ChiSqSelector — χ² flow-feature selection [B:9].
+
+Behavioral spec: SURVEY.md §2.2 (upstream ``ml/feature/ChiSqSelector.scala``
+-> ``mllib/stat/test/ChiSqTest.scala`` [U]): rank features by χ² p-value
+against the label (ascending, i.e. most significant first) and keep the top
+``numTopFeatures`` / ``percentile`` / all below ``fpr``.  Spark's χ² needs
+categorical features; continuous flow features are quantile-binned first
+(SURVEY.md §2.2 rebuild note).
+
+TPU design: binning + the (feature, bin, class) contingency run on-device —
+``bin_features`` + ``binned_contingency`` fused in one ``tree_aggregate``
+SPMD pass over the mesh; the χ² statistics and selection happen on host
+(78×32×15 — trivial).  The same histogram kernel drives the tree growers.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from sntc_tpu.core.base import Estimator, Model
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+from sntc_tpu.ops.binning import bin_features, quantile_bin_edges
+from sntc_tpu.ops.histogram import binned_contingency, chi_square
+from sntc_tpu.parallel.collectives import make_tree_aggregate, shard_batch
+from sntc_tpu.parallel.context import get_default_mesh
+
+
+class _SelectorParams:
+    featuresCol = Param("input vector column", default="features")
+    outputCol = Param("output vector column", default="selectedFeatures")
+    labelCol = Param("label index column", default="label")
+    selectorType = Param(
+        "selection mode: numTopFeatures | percentile | fpr",
+        default="numTopFeatures",
+        validator=validators.one_of("numTopFeatures", "percentile", "fpr"),
+    )
+    numTopFeatures = Param(
+        "number of features to keep", default=50, validator=validators.gt(0)
+    )
+    percentile = Param(
+        "fraction of features to keep", default=0.1, validator=validators.in_range(0, 1)
+    )
+    fpr = Param(
+        "highest p-value to keep", default=0.05, validator=validators.in_range(0, 1)
+    )
+    maxBins = Param(
+        "quantile bins for continuous features (rebuild-specific; Spark "
+        "requires pre-categorical input)",
+        default=32,
+        validator=validators.gt(1),
+    )
+
+
+class ChiSqSelector(_SelectorParams, Estimator):
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+
+    def _fit(self, frame: Frame) -> "ChiSqSelectorModel":
+        mesh = self._mesh or get_default_mesh()
+        X = frame[self.getFeaturesCol()].astype(np.float32)
+        y = frame[self.getLabelCol()].astype(np.int32)
+        n_bins = self.getMaxBins()
+        n_classes = int(y.max()) + 1 if len(y) else 1
+        edges = quantile_bin_edges(X, max_bins=n_bins)
+
+        xs, ys, w = shard_batch(mesh, X, y)
+
+        def contingency(xs, ys, w):
+            binned = bin_features(xs, edges)
+            return binned_contingency(
+                binned, ys, w, n_bins=n_bins, n_classes=n_classes
+            )
+
+        observed = np.asarray(make_tree_aggregate(contingency, mesh)(xs, ys, w))
+        stats, p_values, _ = chi_square(observed)
+
+        order = np.lexsort((np.arange(len(stats)), -stats, p_values))
+        mode = self.getSelectorType()
+        if mode == "numTopFeatures":
+            k = min(self.getNumTopFeatures(), X.shape[1])
+            chosen = order[:k]
+        elif mode == "percentile":
+            k = max(1, int(X.shape[1] * self.getPercentile()))
+            chosen = order[:k]
+        else:  # fpr
+            chosen = np.flatnonzero(p_values < self.getFpr())
+        selected = sorted(int(i) for i in chosen)
+
+        model = ChiSqSelectorModel(selected_features=selected)
+        model.setParams(**self.paramValues())
+        return model
+
+
+class ChiSqSelectorModel(_SelectorParams, Model):
+    def __init__(self, selected_features: List[int], **kwargs):
+        super().__init__(**kwargs)
+        self.selected_features = list(selected_features)
+
+    def _save_extra(self):
+        return {"selected_features": self.selected_features}, {}
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        m = cls(selected_features=extra["selected_features"])
+        m.setParams(**params)
+        return m
+
+    def transform(self, frame: Frame) -> Frame:
+        X = frame[self.getFeaturesCol()]
+        out = np.ascontiguousarray(X[:, self.selected_features])
+        return frame.with_column(self.getOutputCol(), out)

@@ -1,0 +1,88 @@
+"""StandardScaler — feature standardization with mesh-reduced moments.
+
+Behavioral spec: SURVEY.md §2.2 (upstream ``ml/feature/StandardScaler.scala``
+[U]): fit computes per-feature mean and **unbiased** std; transform applies
+``(x - mean) * (1/std)`` per the ``withMean``/``withStd`` flags, with
+constant features (std == 0) mapped to 0, exactly as Spark does.
+
+TPU design: the fit is ONE ``tree_aggregate`` pass — per-shard weighted
+``(Σx, Σx², Σw)`` partials ``psum``-reduced over ICI (the treeAggregate
+summarizer analog, SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from sntc_tpu.core.base import Estimator, Model
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+from sntc_tpu.parallel.collectives import make_tree_aggregate, shard_batch
+from sntc_tpu.parallel.context import get_default_mesh
+
+
+class _ScalerParams:
+    inputCol = Param("input vector column", default="features")
+    outputCol = Param("output vector column", default="scaledFeatures")
+    withMean = Param("center to zero mean", default=False, validator=validators.is_bool())
+    withStd = Param("scale to unit std", default=True, validator=validators.is_bool())
+
+
+class StandardScaler(_ScalerParams, Estimator):
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+
+    def _fit(self, frame: Frame) -> "StandardScalerModel":
+        mesh = self._mesh or get_default_mesh()
+        X = frame[self.getInputCol()]
+        xs, w = shard_batch(mesh, X)
+
+        def moments(xs, w):
+            return {
+                "sum": jnp.einsum("n,nd->d", w, xs),
+                "sumsq": jnp.einsum("n,nd->d", w, xs * xs),
+                "count": jnp.sum(w),
+            }
+
+        out = make_tree_aggregate(moments, mesh)(xs, w)
+        n = float(out["count"])
+        mean = np.asarray(out["sum"], dtype=np.float64) / n
+        # unbiased variance, clamped: f32 sumsq can dip slightly negative
+        var = (np.asarray(out["sumsq"], dtype=np.float64) - n * mean**2) / max(
+            n - 1, 1
+        )
+        std = np.sqrt(np.maximum(var, 0.0))
+        model = StandardScalerModel(
+            mean=mean.astype(np.float32), std=std.astype(np.float32)
+        )
+        model.setParams(**self.paramValues())
+        return model
+
+
+class StandardScalerModel(_ScalerParams, Model):
+    def __init__(self, mean: np.ndarray, std: np.ndarray, **kwargs):
+        super().__init__(**kwargs)
+        self.mean = np.asarray(mean)
+        self.std = np.asarray(std)
+
+    def _save_extra(self):
+        return {}, {"mean": self.mean, "std": self.std}
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        m = cls(mean=arrays["mean"], std=arrays["std"])
+        m.setParams(**params)
+        return m
+
+    def transform(self, frame: Frame) -> Frame:
+        X = frame[self.getInputCol()].astype(np.float32)
+        if self.getWithMean():
+            X = X - self.mean
+        if self.getWithStd():
+            factor = np.divide(
+                1.0, self.std, out=np.zeros_like(self.std), where=self.std > 0
+            ).astype(np.float32)
+            X = X * factor
+        return frame.with_column(self.getOutputCol(), X)

@@ -1,0 +1,116 @@
+"""StringIndexer / IndexToString — label string <-> index encoding.
+
+Behavioral spec: SURVEY.md §2.2 (upstream ``ml/feature/StringIndexer.scala``
+[U]).  Ordering parity matters for macro-F1 parity (SURVEY.md §7.2 item 3):
+the default ``frequencyDesc`` orders labels by descending frequency with ties
+broken by the string ascending — reproduced exactly here.  ``handleInvalid``:
+``error`` | ``skip`` (drop unseen rows) | ``keep`` (unseen -> index
+``len(labels)``).  Output indices are float64, as in Spark.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import List
+
+import numpy as np
+
+from sntc_tpu.core.base import Estimator, Model, Transformer
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+
+
+def _order_labels(values: np.ndarray, order: str) -> List[str]:
+    counts = Counter(str(v) for v in values)
+    if order == "frequencyDesc":
+        return [l for l, _ in sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))]
+    if order == "frequencyAsc":
+        return [l for l, _ in sorted(counts.items(), key=lambda kv: (kv[1], kv[0]))]
+    if order == "alphabetDesc":
+        return sorted(counts, reverse=True)
+    if order == "alphabetAsc":
+        return sorted(counts)
+    raise ValueError(f"unknown stringOrderType {order!r}")
+
+
+class _StringIndexerParams:
+    inputCol = Param("input string column", default="label")
+    outputCol = Param("output index column", default="labelIndex")
+    stringOrderType = Param(
+        "label ordering: frequencyDesc | frequencyAsc | alphabetDesc | alphabetAsc",
+        default="frequencyDesc",
+        validator=validators.one_of(
+            "frequencyDesc", "frequencyAsc", "alphabetDesc", "alphabetAsc"
+        ),
+    )
+    handleInvalid = Param(
+        "unseen labels at transform: error | skip | keep",
+        default="error",
+        validator=validators.one_of("error", "skip", "keep"),
+    )
+
+
+class StringIndexer(_StringIndexerParams, Estimator):
+    def _fit(self, frame: Frame) -> "StringIndexerModel":
+        values = frame[self.getInputCol()]
+        labels = _order_labels(values, self.getStringOrderType())
+        model = StringIndexerModel(labels=labels)
+        model.setParams(**self.paramValues())
+        return model
+
+
+class StringIndexerModel(_StringIndexerParams, Model):
+    def __init__(self, labels: List[str], **kwargs):
+        super().__init__(**kwargs)
+        self.labels = list(labels)
+
+    def _save_extra(self):
+        return {"labels": self.labels}, {}
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        m = cls(labels=extra["labels"])
+        m.setParams(**params)
+        return m
+
+    def transform(self, frame: Frame) -> Frame:
+        index = {l: float(i) for i, l in enumerate(self.labels)}
+        values = frame[self.getInputCol()]
+        mode = self.getHandleInvalid()
+        unseen_idx = float(len(self.labels))
+        out = np.empty(len(values), dtype=np.float64)
+        bad = np.zeros(len(values), dtype=bool)
+        for i, v in enumerate(values):
+            got = index.get(str(v))
+            if got is None:
+                bad[i] = True
+                out[i] = unseen_idx
+            else:
+                out[i] = got
+        if bad.any():
+            if mode == "error":
+                unseen = sorted({str(v) for v in values[bad]})
+                raise ValueError(
+                    f"StringIndexer: unseen labels {unseen} "
+                    "(handleInvalid='error')"
+                )
+            if mode == "skip":
+                frame = frame.filter(~bad)
+                out = out[~bad]
+        return frame.with_column(self.getOutputCol(), out)
+
+
+class IndexToString(Transformer):
+    """Inverse map: index column -> label strings (Spark ``IndexToString``)."""
+
+    inputCol = Param("input index column", default="prediction")
+    outputCol = Param("output string column", default="predictedLabel")
+    labels = Param("label vocabulary, index order")
+
+    def transform(self, frame: Frame) -> Frame:
+        labels = self.getLabels()
+        idx = frame[self.getInputCol()].astype(np.int64)
+        if (idx < 0).any() or (idx >= len(labels)).any():
+            raise ValueError("IndexToString: index out of label range")
+        out = np.array([labels[i] for i in idx], dtype=object)
+        return frame.with_column(self.getOutputCol(), out)

@@ -1,0 +1,53 @@
+"""VectorAssembler — concatenate numeric columns into one feature vector.
+
+Behavioral spec: SURVEY.md §2.2 (upstream ``ml/feature/VectorAssembler.scala``
+[U]): dense concatenation in declared column order; ``handleInvalid`` is
+``error`` (raise on NaN), ``skip`` (drop rows), or ``keep`` (pass NaN
+through).  Output is a ``(N, D)`` float32 vector column — this framework's
+``VectorUDT`` analog (sntc_tpu.core.frame).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from sntc_tpu.core.base import Transformer
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+
+
+class VectorAssembler(Transformer):
+    inputCols = Param("input column names, concatenated in order")
+    outputCol = Param("output vector column", default="features")
+    handleInvalid = Param(
+        "how to handle NaN/Inf rows: error | skip | keep",
+        default="error",
+        validator=validators.one_of("error", "skip", "keep"),
+    )
+
+    def transform(self, frame: Frame) -> Frame:
+        names: List[str] = self.getInputCols()
+        parts = []
+        for name in names:
+            col = frame[name]
+            if col.ndim == 1:
+                parts.append(col.astype(np.float32)[:, None])
+            else:
+                parts.append(col.astype(np.float32))
+        X = np.concatenate(parts, axis=1) if parts else np.zeros((frame.num_rows, 0), np.float32)
+
+        mode = self.getHandleInvalid()
+        if mode != "keep":
+            invalid = ~np.isfinite(X).all(axis=1)
+            if invalid.any():
+                if mode == "error":
+                    raise ValueError(
+                        f"VectorAssembler: {int(invalid.sum())} rows contain "
+                        "NaN/Inf (handleInvalid='error'); clean the data or "
+                        "use handleInvalid='skip'"
+                    )
+                frame = frame.filter(~invalid)
+                X = X[~invalid]
+        return frame.with_column(self.getOutputCol(), X)

@@ -1,0 +1,3 @@
+from sntc_tpu.mlio.save_load import load_model, save_model
+
+__all__ = ["save_model", "load_model"]

@@ -1,0 +1,127 @@
+"""ML persistence — the ``PipelineModel.save/load`` analog.
+
+Behavioral spec: SURVEY.md §5.4 mechanism 2 (upstream
+``ml/util/ReadWrite.scala`` [U]): each stage persists to its own directory
+with JSON metadata (class, uid, params) plus a binary payload; ``load``
+reconstructs the stage reflectively; pipelines recurse over per-stage
+subdirectories.  Payloads here are ``.npz`` (numpy) instead of Parquet —
+the params are small (coefficients, trees, scaler moments), and npz
+round-trips exactly.
+
+Contract (tested per SURVEY.md §4 item 3, the ``DefaultReadWriteTest``
+analog): ``load_model(save_model(m, p))`` produces a stage with identical
+params and identical transform behavior.
+
+Stages opt in by implementing ``_save_extra() -> (json_dict, arrays_dict)``
+and ``_load_from(params, extra, arrays) -> instance``; pure-params stages
+need neither.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from sntc_tpu.core.base import Pipeline, PipelineModel, PipelineStage
+
+_FORMAT_VERSION = 1
+
+
+class _NpEncoder(json.JSONEncoder):
+    def default(self, o: Any) -> Any:
+        if isinstance(o, (np.integer,)):
+            return int(o)
+        if isinstance(o, (np.floating,)):
+            return float(o)
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+        return super().default(o)
+
+
+def _qualname(obj: Any) -> str:
+    cls = type(obj)
+    return f"{cls.__module__}.{cls.__qualname__}"
+
+
+def _resolve(qualname: str) -> type:
+    module, _, name = qualname.rpartition(".")
+    if not module.startswith("sntc_tpu."):
+        raise ValueError(
+            f"refusing to load class {qualname!r} from outside sntc_tpu"
+        )
+    cls = getattr(importlib.import_module(module), name)
+    if not issubclass(cls, PipelineStage):
+        raise ValueError(f"{qualname} is not a PipelineStage")
+    return cls
+
+
+def save_model(stage: PipelineStage, path: str) -> str:
+    """Persist a stage (or whole Pipeline/PipelineModel) to ``path``."""
+    os.makedirs(path, exist_ok=True)
+    params = dict(stage.paramValues())
+    meta: Dict[str, Any] = {
+        "format_version": _FORMAT_VERSION,
+        "class": _qualname(stage),
+        "uid": stage.uid,
+    }
+
+    sub_stages = None
+    if isinstance(stage, (Pipeline, PipelineModel)):
+        sub_stages = params.pop("stages", [])
+    elif hasattr(stage, "_sub_stages"):
+        sub_stages = stage._sub_stages()
+    if sub_stages is not None:
+        meta["stage_dirs"] = []
+        for i, sub in enumerate(sub_stages):
+            sub_dir = f"stage_{i:03d}"
+            save_model(sub, os.path.join(path, sub_dir))
+            meta["stage_dirs"].append(sub_dir)
+    extra, arrays = (
+        stage._save_extra() if hasattr(stage, "_save_extra") else ({}, {})
+    )
+
+    meta["params"] = params
+    meta["extra"] = extra
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f, cls=_NpEncoder, indent=1)
+    if arrays:
+        np.savez(os.path.join(path, "data.npz"), **arrays)
+    return path
+
+
+def load_model(path: str) -> PipelineStage:
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    if meta.get("format_version") != _FORMAT_VERSION:
+        raise ValueError(f"unsupported model format {meta.get('format_version')}")
+    cls = _resolve(meta["class"])
+    params = meta.get("params", {})
+    extra = meta.get("extra", {})
+    npz_path = os.path.join(path, "data.npz")
+    arrays: Dict[str, np.ndarray] = {}
+    if os.path.exists(npz_path):
+        with np.load(npz_path) as z:
+            arrays = {k: z[k] for k in z.files}
+
+    if issubclass(cls, (Pipeline, PipelineModel)):
+        stages = [
+            load_model(os.path.join(path, d)) for d in meta.get("stage_dirs", [])
+        ]
+        obj = cls(stages=stages)
+        obj.setParams(**params)
+    elif hasattr(cls, "_from_sub_stages"):
+        stages = [
+            load_model(os.path.join(path, d)) for d in meta.get("stage_dirs", [])
+        ]
+        obj = cls._from_sub_stages(stages, params)
+    elif hasattr(cls, "_load_from"):
+        obj = cls._load_from(params, extra, arrays)
+    else:
+        obj = cls()
+        obj.setParams(**params)
+    obj.uid = meta.get("uid", obj.uid)
+    return obj

@@ -1,0 +1,28 @@
+from sntc_tpu.models.logistic_regression import (
+    LogisticRegression,
+    LogisticRegressionModel,
+)
+from sntc_tpu.models.mlp import (
+    MultilayerPerceptronClassifier,
+    MultilayerPerceptronClassificationModel,
+)
+from sntc_tpu.models.tree import (
+    GBTClassifier,
+    GBTClassificationModel,
+    RandomForestClassifier,
+    RandomForestClassificationModel,
+)
+from sntc_tpu.models.one_vs_rest import OneVsRest, OneVsRestModel
+
+__all__ = [
+    "RandomForestClassifier",
+    "RandomForestClassificationModel",
+    "GBTClassifier",
+    "GBTClassificationModel",
+    "OneVsRest",
+    "OneVsRestModel",
+    "LogisticRegression",
+    "LogisticRegressionModel",
+    "MultilayerPerceptronClassifier",
+    "MultilayerPerceptronClassificationModel",
+]

@@ -1,0 +1,100 @@
+"""Shared classifier plumbing — the ``ProbabilisticClassifier`` analog.
+
+Behavioral spec: Spark's classifier hierarchy (upstream
+``ml/classification/{Classifier,ProbabilisticClassifier}.scala`` [U],
+SURVEY.md §3.4): every model's ``transform`` appends ``rawPrediction``
+(margins), ``probability`` and ``prediction`` (float64 index) columns; binary
+models honor ``threshold``.
+
+Subclass models implement ``_raw_predict(X) -> [N, K]`` margins (device
+compute, jitted by the subclass) and ``_raw_to_probability``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sntc_tpu.core.base import Estimator, Model
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+
+
+class ClassifierParams:
+    featuresCol = Param("feature vector column", default="features")
+    labelCol = Param("label index column", default="label")
+    predictionCol = Param("output prediction column", default="prediction")
+    rawPredictionCol = Param("output margins column", default="rawPrediction")
+    probabilityCol = Param("output probability column", default="probability")
+
+
+class ClassifierEstimator(ClassifierParams, Estimator):
+    """Base estimator: extracts (X, y, w) from the frame."""
+
+    weightCol = Param("optional row weight column", default=None)
+
+    def _extract(self, frame: Frame):
+        X = frame[self.getFeaturesCol()]
+        if X.ndim != 2:
+            raise ValueError(
+                f"featuresCol {self.getFeaturesCol()!r} must be a vector "
+                "column (use VectorAssembler)"
+            )
+        X = X.astype(np.float32, copy=False)
+        y_raw = frame[self.getLabelCol()].astype(np.float64)
+        y = y_raw.astype(np.int32)
+        if not np.array_equal(y_raw, y.astype(np.float64)) or (y < 0).any():
+            raise ValueError("labelCol must contain non-negative integer indices")
+        wcol = self.getWeightCol()
+        w = (
+            frame[wcol].astype(np.float32)
+            if wcol
+            else np.ones(len(y), dtype=np.float32)
+        )
+        return X, y, w
+
+
+class ClassificationModel(ClassifierParams, Model):
+    """Base fitted model: margins -> probability -> prediction columns."""
+
+    threshold = Param(
+        "binary decision threshold on P(class 1)",
+        default=0.5,
+        validator=validators.in_range(0.0, 1.0),
+    )
+
+    @property
+    def num_classes(self) -> int:
+        raise NotImplementedError
+
+    def _raw_predict(self, X: np.ndarray) -> np.ndarray:
+        """Margins [N, K] (K=2 for binary: [-margin, margin], Spark-style)."""
+        raise NotImplementedError
+
+    def _raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def _prob_to_prediction(self, prob: np.ndarray) -> np.ndarray:
+        if self.num_classes == 2:
+            t = self.getThreshold()
+            return (prob[:, 1] > t).astype(np.float64)
+        return np.argmax(prob, axis=1).astype(np.float64)
+
+    def transform(self, frame: Frame) -> Frame:
+        X = frame[self.getFeaturesCol()].astype(np.float32, copy=False)
+        raw = self._raw_predict(X)
+        prob = self._raw_to_probability(raw)
+        out = frame
+        if self.getRawPredictionCol():
+            out = out.with_column(self.getRawPredictionCol(), raw)
+        if self.getProbabilityCol():
+            out = out.with_column(self.getProbabilityCol(), prob)
+        if self.getPredictionCol():
+            out = out.with_column(
+                self.getPredictionCol(), self._prob_to_prediction(prob)
+            )
+        return out
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Convenience: prediction indices for a raw feature matrix."""
+        prob = self._raw_to_probability(self._raw_predict(X))
+        return self._prob_to_prediction(prob)

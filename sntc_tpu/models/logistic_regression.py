@@ -1,0 +1,337 @@
+"""LogisticRegression — binomial/multinomial elastic-net logit on TPU.
+
+Behavioral spec: SURVEY.md §2.3/§3.1 (upstream
+``ml/classification/LogisticRegression.scala`` + ``LogisticAggregator`` [U]):
+
+  * ``family`` auto/binomial/multinomial; elastic-net via ``regParam`` ×
+    ``elasticNetParam`` (L1 -> OWLQN, else LBFGS), intercepts unpenalized;
+  * internal feature standardization during optimization (coefficients
+    returned in the original space); ``standardization=False`` keeps the
+    scaled optimization but re-weights the penalty so the objective matches
+    penalizing original-space coefficients, as Spark does;
+  * intercept initialized to label-prior log odds;
+  * ``objectiveHistory`` preserved on the training summary (SURVEY.md §5.5).
+
+TPU design: one summarizer ``tree_aggregate`` pass (moments + class counts),
+then the whole LBFGS/OWLQN loop runs as ONE jitted XLA program over
+mesh-sharded data (sntc_tpu.ops.lbfgs) — Spark's per-iteration
+broadcast/treeAggregate/driver-update cycle with zero host round trips.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+from sntc_tpu.models.base import (
+    ClassificationModel,
+    ClassifierEstimator,
+)
+from sntc_tpu.ops.lbfgs import minimize_lbfgs
+from sntc_tpu.parallel.collectives import shard_batch, shard_weights
+from sntc_tpu.parallel.context import get_default_mesh
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _lr_summarize(xs, ys, ws, k):
+    """Moments + class counts in one pass; with mesh-sharded inputs XLA
+    inserts the ICI all-reduce (the summarizer treeAggregate of §3.1)."""
+    return (
+        jnp.einsum("n,nd->d", ws, xs),
+        jnp.einsum("n,nd->d", ws, xs * xs),
+        jnp.sum(ws),
+        jax.ops.segment_sum(ws, ys, num_segments=k),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("binomial", "fit_intercept", "k", "max_iter", "tol", "use_l1"),
+)
+def _lr_optimize(
+    xs, ys, ws, inv_std, l2, pen_l2, l1_vec, theta0,
+    *, binomial, fit_intercept, k, max_iter, tol, use_l1,
+):
+    """The whole LBFGS/OWLQN fit as one cached XLA program.
+
+    Module-level jit with data as (sharded) ARGUMENTS: repeated fits on the
+    same shapes reuse the compiled executable instead of re-tracing a
+    closure (compile once, fit many — the Spark-analog of reusing the same
+    job DAG every iteration).
+    """
+    d = xs.shape[1]
+    n_coef = d if binomial else d * k
+    w_sum = jnp.sum(ws)
+
+    def value_and_grad(theta):
+        def loss_fn(theta):
+            coef = theta[:n_coef]
+            W = coef.reshape(d, 1) if binomial else coef.reshape(d, k)
+            b = (
+                theta[n_coef:]
+                if fit_intercept
+                else jnp.zeros((1 if binomial else k,), theta.dtype)
+            )
+            Wd = W * inv_std[:, None]  # fold scaling into the matmul
+            margins = xs @ Wd + b[None, :]
+            if binomial:
+                z = margins[:, 0]
+                yf = ys.astype(z.dtype)
+                data = jnp.sum(ws * (jnp.logaddexp(0.0, z) - yf * z))
+            else:
+                logp = jax.nn.log_softmax(margins, axis=1)
+                picked = jnp.take_along_axis(
+                    logp, ys[:, None].astype(jnp.int32), axis=1
+                )[:, 0]
+                data = -jnp.sum(ws * picked)
+            data = data / w_sum
+            penalty = 0.5 * l2 * jnp.sum(pen_l2 * theta[:n_coef] ** 2)
+            return data + penalty
+
+        return jax.value_and_grad(loss_fn)(theta)
+
+    return minimize_lbfgs(
+        value_and_grad,
+        theta0,
+        max_iter=max_iter,
+        tol=tol,
+        l1=l1_vec if use_l1 else None,
+    )
+
+
+class LogisticRegressionSummary:
+    """Training summary (the ``LogisticRegressionTrainingSummary`` analog)."""
+
+    def __init__(self, objective_history, total_iterations: int):
+        self.objectiveHistory = [float(v) for v in objective_history]
+        self.totalIterations = int(total_iterations)
+
+
+class _LrParams:
+    maxIter = Param("max LBFGS/OWLQN iterations", default=100, validator=validators.gteq(0))
+    regParam = Param("regularization strength", default=0.0, validator=validators.gteq(0))
+    elasticNetParam = Param(
+        "elastic-net mixing: 0=L2, 1=L1", default=0.0, validator=validators.in_range(0, 1)
+    )
+    tol = Param("relative convergence tolerance", default=1e-6, validator=validators.gt(0))
+    fitIntercept = Param("fit intercept term", default=True, validator=validators.is_bool())
+    standardization = Param(
+        "standardize features during optimization", default=True,
+        validator=validators.is_bool(),
+    )
+    family = Param(
+        "binomial | multinomial | auto", default="auto",
+        validator=validators.one_of("auto", "binomial", "multinomial"),
+    )
+
+
+class LogisticRegression(_LrParams, ClassifierEstimator):
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+
+    def _fit(self, frame: Frame) -> "LogisticRegressionModel":
+        mesh = self._mesh or get_default_mesh()
+        X, y, w = self._extract(frame)
+        n, d = X.shape
+        num_classes = int(y.max()) + 1 if n else 2
+        family = self.getFamily()
+        if family == "auto":
+            family = "binomial" if num_classes <= 2 else "multinomial"
+        if family == "binomial" and num_classes > 2:
+            raise ValueError(
+                f"binomial family with {num_classes} classes; use multinomial"
+            )
+        num_classes = max(num_classes, 2)
+        k = num_classes
+
+        xs, ys, _ = shard_batch(mesh, X, y.astype(np.int32))
+        ws = shard_weights(mesh, w, xs.shape[0])
+
+        # ---- summarizer pass: moments + class counts (one treeAggregate) ----
+        s1, s2, cnt, cc = _lr_summarize(xs, ys, ws, k)
+        w_sum = float(cnt)
+        mean = np.asarray(s1, np.float64) / max(w_sum, 1e-12)
+        var = np.maximum(
+            np.asarray(s2, np.float64) / max(w_sum, 1e-12) - mean**2, 0.0
+        )
+        std = np.sqrt(var)
+        inv_std = np.divide(1.0, std, out=np.zeros_like(std), where=std > 0)
+        class_counts = np.maximum(np.asarray(cc, np.float64), 1e-12)
+
+        reg = self.getRegParam()
+        alpha = self.getElasticNetParam()
+        l2 = reg * (1.0 - alpha)
+        l1 = reg * alpha
+        fit_intercept = self.getFitIntercept()
+        standardize = self.getStandardization()
+        binomial = family == "binomial"
+        n_coef = d if binomial else d * k
+        n_int = (1 if binomial else k) if fit_intercept else 0
+
+        # penalty weights in the SCALED space: standardization=True penalizes
+        # scaled coefs directly; False matches original-space penalties
+        # (coef_orig = coef_scaled * inv_std)
+        pen_scale = np.ones(d) if standardize else inv_std
+        pen_l2 = np.tile(pen_scale**2, 1 if binomial else k).astype(np.float32)
+
+        # init: zero coefficients, prior-log-odds intercepts (Spark parity)
+        theta0 = np.zeros(n_coef + n_int, dtype=np.float32)
+        if fit_intercept:
+            priors = class_counts / class_counts.sum()
+            if binomial:
+                theta0[n_coef] = np.log(priors[1] / priors[0]) if k == 2 else 0.0
+            else:
+                theta0[n_coef:] = np.log(priors)
+
+        use_l1 = l1 > 0
+        pen_l1 = np.tile(
+            np.ones(d) if standardize else inv_std, 1 if binomial else k
+        )
+        l1_vec = np.concatenate([l1 * pen_l1, np.zeros(n_int)]).astype(np.float32)
+
+        res = _lr_optimize(
+            xs, ys, ws,
+            jnp.asarray(inv_std, jnp.float32),
+            jnp.asarray(l2, jnp.float32),
+            jnp.asarray(pen_l2),
+            jnp.asarray(l1_vec),
+            jnp.asarray(theta0),
+            binomial=binomial,
+            fit_intercept=fit_intercept,
+            k=k,
+            max_iter=self.getMaxIter(),
+            tol=self.getTol(),
+            use_l1=use_l1,
+        )
+
+        theta = np.asarray(res.x, np.float64)
+        W_scaled, b = (
+            (theta[:n_coef].reshape(d, 1), theta[n_coef:])
+            if binomial
+            else (theta[:n_coef].reshape(d, k), theta[n_coef:])
+        )
+        coef_orig = W_scaled * inv_std[:, None]  # back to original space
+        if binomial:
+            coefficients = np.zeros((2, d))
+            coefficients[1] = coef_orig[:, 0]
+            intercepts = np.zeros(2)
+            if fit_intercept:
+                intercepts[1] = b[0]
+            # store the natural binary parameterization too
+            coef_matrix = coefficients
+        else:
+            coef_matrix = coef_orig.T  # [K, D]
+            intercepts = np.asarray(b if fit_intercept else np.zeros(k), np.float64)
+            # Spark canonicalization: the softmax is invariant to uniform
+            # shifts; unpenalized intercepts are mean-centered, and with no
+            # regularization the coefficients are too
+            if fit_intercept:
+                intercepts = intercepts - intercepts.mean()
+            if reg == 0.0:
+                coef_matrix = coef_matrix - coef_matrix.mean(axis=0, keepdims=True)
+
+        n_iters = int(res.n_iters)
+        model = LogisticRegressionModel(
+            coefficient_matrix=coef_matrix.astype(np.float32),
+            intercepts=np.asarray(intercepts, np.float32),
+            is_binomial=binomial,
+        )
+        model.setParams(
+            **{
+                name: val
+                for name, val in self.paramValues().items()
+                if model.hasParam(name)
+            }
+        )
+        model.summary = LogisticRegressionSummary(
+            np.asarray(res.history)[: n_iters + 1], n_iters
+        )
+        return model
+
+
+@jax.jit
+def _margins(X, coefT, intercepts):
+    return X @ coefT + intercepts[None, :]
+
+
+class LogisticRegressionModel(_LrParams, ClassificationModel):
+    def __init__(
+        self,
+        coefficient_matrix: np.ndarray,  # [K, D] original space
+        intercepts: np.ndarray,  # [K]
+        is_binomial: bool,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.coefficientMatrix = np.asarray(coefficient_matrix, np.float32)
+        self.interceptVector = np.asarray(intercepts, np.float32)
+        self.is_binomial = bool(is_binomial)
+        self.summary: Optional[LogisticRegressionSummary] = None
+
+    def _save_extra(self):
+        return (
+            {"is_binomial": self.is_binomial},
+            {
+                "coefficientMatrix": self.coefficientMatrix,
+                "interceptVector": self.interceptVector,
+            },
+        )
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        m = cls(
+            coefficient_matrix=arrays["coefficientMatrix"],
+            intercepts=arrays["interceptVector"],
+            is_binomial=extra["is_binomial"],
+        )
+        m.setParams(**params)
+        return m
+
+    # Spark binary-model accessors
+    @property
+    def coefficients(self) -> np.ndarray:
+        if not self.is_binomial:
+            raise AttributeError("use coefficientMatrix for multinomial models")
+        return self.coefficientMatrix[1]
+
+    @property
+    def intercept(self) -> float:
+        if not self.is_binomial:
+            raise AttributeError("use interceptVector for multinomial models")
+        return float(self.interceptVector[1])
+
+    @property
+    def num_classes(self) -> int:
+        return self.coefficientMatrix.shape[0]
+
+    def _raw_predict(self, X: np.ndarray) -> np.ndarray:
+        raw = np.asarray(
+            _margins(
+                jnp.asarray(X),
+                jnp.asarray(self.coefficientMatrix.T),
+                jnp.asarray(self.interceptVector),
+            )
+        )
+        if self.is_binomial:
+            # Spark binary rawPrediction is [-margin, +margin]
+            m = raw[:, 1] - raw[:, 0]
+            raw = np.stack([-m, m], axis=1)
+        return raw
+
+    def _raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
+        if self.is_binomial:
+            p1 = 1.0 / (1.0 + np.exp(-raw[:, 1]))
+            return np.stack([1.0 - p1, p1], axis=1)
+        z = raw - raw.max(axis=1, keepdims=True)
+        e = np.exp(z)
+        return e / e.sum(axis=1, keepdims=True)

@@ -1,0 +1,233 @@
+"""MultilayerPerceptronClassifier — feed-forward ANN on TPU [B:8].
+
+Behavioral spec: SURVEY.md §2.3/§3.3 (upstream
+``ml/classification/MultilayerPerceptronClassifier.scala`` + ``ml/ann/Layer``
+[U]): ``layers=[in, hidden..., out]`` topology, sigmoid hidden activations,
+softmax output with cross-entropy, full-batch LBFGS by default (``solver=
+"l-bfgs"``, ``maxIter=100``) or gradient descent (``solver="gd"``), seeded
+weight init, optional ``initialWeights`` vector.
+
+TPU design: where Spark stacks ``blockSize`` rows per partition to call JNI
+BLAS gemms (§3.3 ⟦JVM→NATIVE⟧), here the whole dataset is device-resident
+and the forward/backward chain is XLA ``dot_general`` on the MXU — the
+"easiest big win" of SURVEY.md §2.3.  The optimizer is the same jitted
+LBFGS as LogisticRegression, data mesh-sharded, gradients all-reduced over
+ICI; ``blockSize`` is accepted for API parity (batching is XLA's concern).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+from sntc_tpu.models.base import ClassificationModel, ClassifierEstimator
+from sntc_tpu.ops.lbfgs import minimize_lbfgs
+from sntc_tpu.parallel.collectives import shard_batch, shard_weights
+from sntc_tpu.parallel.context import get_default_mesh
+
+
+def _layer_sizes(layers: Tuple[int, ...]) -> List[Tuple[int, int]]:
+    return [(layers[i], layers[i + 1]) for i in range(len(layers) - 1)]
+
+
+def _n_weights(layers: Tuple[int, ...]) -> int:
+    return sum(d_in * d_out + d_out for d_in, d_out in _layer_sizes(layers))
+
+
+def _unpack(theta: jnp.ndarray, layers: Tuple[int, ...]):
+    """Flat vector -> [(W, b), ...] (Spark keeps MLP weights as one vector)."""
+    out, off = [], 0
+    for d_in, d_out in _layer_sizes(layers):
+        W = theta[off : off + d_in * d_out].reshape(d_in, d_out)
+        off += d_in * d_out
+        b = theta[off : off + d_out]
+        off += d_out
+        out.append((W, b))
+    return out
+
+
+def _forward(theta: jnp.ndarray, X: jnp.ndarray, layers: Tuple[int, ...]):
+    """Margins (pre-softmax) of the final layer."""
+    h = X
+    wbs = _unpack(theta, layers)
+    for i, (W, b) in enumerate(wbs):
+        z = h @ W + b[None, :]
+        h = jax.nn.sigmoid(z) if i < len(wbs) - 1 else z
+    return h
+
+
+@partial(jax.jit, static_argnames=("layers", "max_iter", "tol", "solver", "step_size"))
+def _mlp_optimize(
+    xs, ys, ws, theta0, *, layers, max_iter, tol, solver, step_size
+):
+    w_sum = jnp.sum(ws)
+
+    def value_and_grad(theta):
+        def loss_fn(theta):
+            margins = _forward(theta, xs, layers)
+            logp = jax.nn.log_softmax(margins, axis=1)
+            picked = jnp.take_along_axis(
+                logp, ys[:, None].astype(jnp.int32), axis=1
+            )[:, 0]
+            return -jnp.sum(ws * picked) / w_sum
+
+        return jax.value_and_grad(loss_fn)(theta)
+
+    if solver == "l-bfgs":
+        return minimize_lbfgs(
+            value_and_grad, theta0, max_iter=max_iter, tol=tol
+        )
+
+    # solver == "gd": full-batch gradient descent with constant step
+    def gd_step(i, carry):
+        theta, hist = carry
+        f, g = value_and_grad(theta)
+        hist = hist.at[i].set(f)
+        return theta - step_size * g, hist
+
+    hist0 = jnp.zeros((max_iter + 1,), theta0.dtype)
+    theta, hist = jax.lax.fori_loop(
+        0, max_iter, gd_step, (theta0, hist0)
+    )
+    f_final, _ = value_and_grad(theta)
+    hist = hist.at[max_iter].set(f_final)
+    from sntc_tpu.ops.lbfgs import LbfgsResult
+
+    return LbfgsResult(
+        x=theta,
+        loss=f_final,
+        n_iters=jnp.asarray(max_iter, jnp.int32),
+        history=hist,
+        converged=jnp.asarray(True),
+    )
+
+
+class _MlpParams:
+    layers = Param(
+        "layer sizes [in, hidden..., out]",
+        validator=validators.list_of(lambda v: isinstance(v, (int, np.integer)) and v > 0),
+    )
+    maxIter = Param("max iterations", default=100, validator=validators.gteq(0))
+    tol = Param("relative convergence tolerance", default=1e-6, validator=validators.gt(0))
+    seed = Param("weight init seed", default=0)
+    solver = Param(
+        "l-bfgs | gd", default="l-bfgs", validator=validators.one_of("l-bfgs", "gd")
+    )
+    stepSize = Param("gd step size", default=0.03, validator=validators.gt(0))
+    blockSize = Param(
+        "row block size (API parity; XLA handles batching)",
+        default=128,
+        validator=validators.gt(0),
+    )
+
+
+class MultilayerPerceptronClassifier(_MlpParams, ClassifierEstimator):
+    def __init__(self, mesh=None, initialWeights: Optional[np.ndarray] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+        self._initial_weights = initialWeights
+
+    def _fit(self, frame: Frame) -> "MultilayerPerceptronClassificationModel":
+        mesh = self._mesh or get_default_mesh()
+        X, y, w = self._extract(frame)
+        layers = tuple(int(v) for v in self.getLayers())
+        if X.shape[1] != layers[0]:
+            raise ValueError(
+                f"layers[0]={layers[0]} but features have {X.shape[1]} columns"
+            )
+        if y.max(initial=0) >= layers[-1]:
+            raise ValueError(
+                f"label index {int(y.max())} >= output layer size {layers[-1]}"
+            )
+
+        xs, ys, _ = shard_batch(mesh, X, y.astype(np.int32))
+        ws = shard_weights(mesh, w, xs.shape[0])
+
+        if self._initial_weights is not None:
+            theta0 = np.asarray(self._initial_weights, np.float32)
+            if theta0.shape != (_n_weights(layers),):
+                raise ValueError(
+                    f"initialWeights must have {_n_weights(layers)} entries"
+                )
+        else:
+            # Glorot-uniform per layer, zero biases, seeded
+            rng = np.random.default_rng(self.getSeed())
+            parts = []
+            for d_in, d_out in _layer_sizes(layers):
+                limit = np.sqrt(6.0 / (d_in + d_out))
+                parts.append(
+                    rng.uniform(-limit, limit, size=d_in * d_out).astype(np.float32)
+                )
+                parts.append(np.zeros(d_out, np.float32))
+            theta0 = np.concatenate(parts)
+
+        res = _mlp_optimize(
+            xs, ys, ws, jnp.asarray(theta0),
+            layers=layers,
+            max_iter=self.getMaxIter(),
+            tol=self.getTol(),
+            solver=self.getSolver(),
+            step_size=self.getStepSize(),
+        )
+
+        model = MultilayerPerceptronClassificationModel(
+            weights=np.asarray(res.x), layers=list(layers)
+        )
+        model.setParams(
+            **{k: v for k, v in self.paramValues().items() if model.hasParam(k)}
+        )
+        from sntc_tpu.models.logistic_regression import LogisticRegressionSummary
+
+        n_iters = int(res.n_iters)
+        model.summary = LogisticRegressionSummary(
+            np.asarray(res.history)[: n_iters + 1], n_iters
+        )
+        return model
+
+
+@partial(jax.jit, static_argnames=("layers",))
+def _mlp_margins(theta, X, layers):
+    return _forward(theta, X, layers)
+
+
+class MultilayerPerceptronClassificationModel(_MlpParams, ClassificationModel):
+    def __init__(self, weights: np.ndarray, layers: List[int], **kwargs):
+        super().__init__(**kwargs)
+        self.weights = np.asarray(weights, np.float32)
+        self.set("layers", list(layers))
+        self.summary = None
+
+    def _save_extra(self):
+        return {}, {"weights": self.weights}
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        layers = params.get("layers")
+        m = cls(weights=arrays["weights"], layers=layers)
+        m.setParams(**params)
+        return m
+
+    @property
+    def num_classes(self) -> int:
+        return int(self.getLayers()[-1])
+
+    def _raw_predict(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            _mlp_margins(
+                jnp.asarray(self.weights),
+                jnp.asarray(X),
+                tuple(int(v) for v in self.getLayers()),
+            )
+        )
+
+    def _raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
+        z = raw - raw.max(axis=1, keepdims=True)
+        e = np.exp(z)
+        return e / e.sum(axis=1, keepdims=True)

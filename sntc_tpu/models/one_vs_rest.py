@@ -1,0 +1,118 @@
+"""OneVsRest — K binary reductions of a multiclass problem [B:10].
+
+Behavioral spec: SURVEY.md §2.3 (upstream ``ml/classification/OneVsRest.
+scala`` [U]): fit one copy of the base classifier per class on relabeled
+{rest=0, class=1} data; prediction = argmax over per-class raw class-1
+scores; ``parallelism`` is accepted for API parity (the fits are sequential
+here — each inner fit already saturates the TPU mesh; Spark's thread pool
+existed to overlap JVM scheduling, not compute).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+from sntc_tpu.models.base import (
+    ClassificationModel,
+    ClassifierEstimator,
+    ClassifierParams,
+)
+
+
+class _OvrParams(ClassifierParams):
+    parallelism = Param(
+        "API parity only; inner fits already saturate the mesh",
+        default=1,
+        validator=validators.gteq(1),
+    )
+
+
+class OneVsRest(_OvrParams, ClassifierEstimator):
+    def __init__(self, classifier=None, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        if classifier is None:
+            raise ValueError("OneVsRest requires a classifier estimator")
+        self.classifier = classifier
+        self._mesh = mesh
+
+    def _fit(self, frame: Frame) -> "OneVsRestModel":
+        X, y, _ = self._extract(frame)
+        k = int(y.max()) + 1
+        models: List[ClassificationModel] = []
+        bin_col = f"ovr_label_{self.uid}"
+        overrides = {
+            "labelCol": bin_col,
+            "featuresCol": self.getFeaturesCol(),
+        }
+        # forward sample weights to every binary sub-fit (Spark parity)
+        if self.getWeightCol() and self.classifier.hasParam("weightCol"):
+            overrides["weightCol"] = self.getWeightCol()
+        for c in range(k):
+            y_c = (y == c).astype(np.float64)
+            sub = frame.with_column(bin_col, y_c)
+            models.append(self.classifier.copy(overrides).fit(sub))
+        model = OneVsRestModel(models=models)
+        model.setParams(
+            **{k2: v for k2, v in self.paramValues().items() if model.hasParam(k2)}
+        )
+        return model
+
+    def _sub_stages(self):
+        return [self.classifier]
+
+    @classmethod
+    def _from_sub_stages(cls, stages, params):
+        obj = cls(classifier=stages[0])
+        obj.setParams(**params)
+        return obj
+
+
+class OneVsRestModel(_OvrParams, ClassificationModel):
+    def __init__(self, models: Optional[List[ClassificationModel]] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.models = list(models or [])
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.models)
+
+    def _sub_stages(self):
+        return self.models
+
+    @classmethod
+    def _from_sub_stages(cls, stages, params):
+        obj = cls(models=stages)
+        obj.setParams(**params)
+        return obj
+
+    def _raw_predict(self, X: np.ndarray) -> np.ndarray:
+        # per-class raw class-1 margin (Spark uses rawPrediction(1))
+        cols = [m._raw_predict(X)[:, 1] for m in self.models]
+        return np.stack(cols, axis=1)
+
+    def _raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
+        # Spark OvR emits no probability column; we provide a normalized
+        # softmax-free score for API convenience (documented extension)
+        shifted = raw - raw.max(axis=1, keepdims=True)
+        e = np.exp(shifted)
+        return e / e.sum(axis=1, keepdims=True)
+
+    def _prob_to_prediction(self, prob: np.ndarray) -> np.ndarray:
+        return np.argmax(prob, axis=1).astype(np.float64)
+
+    def transform(self, frame: Frame) -> Frame:
+        X = frame[self.getFeaturesCol()].astype(np.float32, copy=False)
+        raw = self._raw_predict(X)
+        out = frame
+        if self.getRawPredictionCol():
+            out = out.with_column(self.getRawPredictionCol(), raw)
+        if self.getPredictionCol():
+            out = out.with_column(
+                self.getPredictionCol(),
+                np.argmax(raw, axis=1).astype(np.float64),
+            )
+        return out

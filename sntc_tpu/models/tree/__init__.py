@@ -1,0 +1,12 @@
+from sntc_tpu.models.tree.random_forest import (
+    RandomForestClassifier,
+    RandomForestClassificationModel,
+)
+from sntc_tpu.models.tree.gbt import GBTClassifier, GBTClassificationModel
+
+__all__ = [
+    "RandomForestClassifier",
+    "RandomForestClassificationModel",
+    "GBTClassifier",
+    "GBTClassificationModel",
+]

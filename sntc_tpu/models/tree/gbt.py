@@ -1,0 +1,222 @@
+"""GBTClassifier — gradient-boosted trees, binary logistic loss [B:10].
+
+Behavioral spec: SURVEY.md §2.3 (upstream
+``ml/tree/impl/GradientBoostedTrees.scala`` + ``GBTClassifier`` [U]):
+labels map to {-1, +1}; the first tree is a plain regression fit to the
+signed labels (weight 1.0); each later round fits a variance-impurity
+regression tree to the Friedman pseudo-residuals ``2y / (1 + exp(2·y·F))``
+and adds it with ``stepSize`` (default 0.1) shrinkage; **binary only** —
+the reference wraps OneVsRest for 15 classes.  ``rawPrediction`` is
+``[-2F, 2F]`` and probability the logistic of it, matching Spark's
+loss-based probability.
+
+TPU design: reuses the binned grower (variance stats ``[w, wy, wy²]``);
+per-round residual updates run on-device from the previous margins — the
+dataset never leaves HBM across rounds (SURVEY.md §7.1 step 4).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+from sntc_tpu.models.base import ClassificationModel, ClassifierEstimator
+from sntc_tpu.models.tree.grower import (
+    Forest,
+    forest_leaf_stats,
+    grow_forest,
+    resolve_feature_subset_k,
+)
+from sntc_tpu.models.tree.random_forest import _TreeEnsembleParams
+from sntc_tpu.ops.binning import bin_features, quantile_bin_edges
+from sntc_tpu.parallel.collectives import shard_batch, shard_weights
+from sntc_tpu.parallel.context import get_default_mesh
+
+
+@jax.jit
+def _residual_stats(y_signed, ws, margin):
+    """Friedman pseudo-residuals for logistic loss -> variance stats."""
+    r = 2.0 * y_signed / (1.0 + jnp.exp(2.0 * y_signed * margin))
+    return jnp.stack([ws, ws * r, ws * r * r], axis=1)
+
+
+@jax.jit
+def _label_stats(y_signed, ws):
+    return jnp.stack([ws, ws * y_signed, ws * y_signed**2], axis=1)
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def _tree_margin(X, feature, threshold, leaf_stats, *, max_depth):
+    """Mean-residual leaf value of a single regression tree, per row."""
+    stats = forest_leaf_stats(
+        X, feature, threshold, leaf_stats, max_depth=max_depth
+    )  # [1, N, 3]
+    s = stats[0]
+    return s[:, 1] / jnp.maximum(s[:, 0], 1e-12)
+
+
+class _GbtParams(_TreeEnsembleParams):
+    maxIter = Param("boosting rounds (trees)", default=20, validator=validators.gt(0))
+    stepSize = Param("shrinkage", default=0.1, validator=validators.in_range(0, 1))
+    lossType = Param(
+        "boosting loss", default="logistic", validator=validators.one_of("logistic")
+    )
+    featureSubsetStrategy = Param("feature subset per node", default="all")
+
+
+class GBTClassifier(_GbtParams, ClassifierEstimator):
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+
+    def _fit(self, frame: Frame) -> "GBTClassificationModel":
+        mesh = self._mesh or get_default_mesh()
+        X, y, w = self._extract(frame)
+        n, F = X.shape
+        if int(y.max(initial=0)) > 1:
+            raise ValueError(
+                "GBTClassifier is binary-only (Spark parity); wrap in "
+                "OneVsRest for multiclass [B:10]"
+            )
+        n_bins = self.getMaxBins()
+        n_rounds = self.getMaxIter()
+        step = self.getStepSize()
+
+        edges = quantile_bin_edges(X, max_bins=n_bins, seed=self.getSeed())
+        xs, ys, _ = shard_batch(mesh, X, y.astype(np.int32))
+        ws = shard_weights(mesh, w, xs.shape[0])
+        axis = mesh.axis_names[0]
+
+        binned = bin_features(xs, jnp.asarray(edges))
+        y_signed = (2.0 * ys - 1.0).astype(jnp.float32)
+
+        rng = np.random.default_rng(self.getSeed())
+        rate = self.getSubsamplingRate()
+        subset_k = resolve_feature_subset_k(
+            self.getFeatureSubsetStrategy(), F, 1, is_classification=False
+        )
+        grow_kwargs = dict(
+            n_bins=n_bins,
+            max_depth=self.getMaxDepth(),
+            min_instances_per_node=float(self.getMinInstancesPerNode()),
+            min_info_gain=float(self.getMinInfoGain()),
+            subset_k=subset_k,
+            impurity="variance",
+        )
+
+        def round_weights(i):
+            if rate < 1.0:
+                mask = (rng.random(xs.shape[0]) < rate).astype(np.float32)
+            else:
+                mask = np.ones(xs.shape[0], np.float32)
+            return jax.device_put(
+                mask[None, :], NamedSharding(mesh, P(None, axis))
+            )
+
+        features, thresholds, leaves, weights = [], [], [], []
+        margin = jnp.zeros(xs.shape[0], jnp.float32)
+        for m in range(n_rounds):
+            if m == 0:
+                row_stats = _label_stats(y_signed, ws)
+                tree_weight = 1.0
+            else:
+                row_stats = _residual_stats(y_signed, ws, margin)
+                tree_weight = step
+            forest = grow_forest(
+                binned, row_stats, round_weights(m), edges,
+                seed=self.getSeed() + m, **grow_kwargs,
+            )
+            contrib = _tree_margin(
+                xs,
+                jnp.asarray(forest.feature),
+                jnp.asarray(forest.threshold),
+                jnp.asarray(forest.leaf_stats),
+                max_depth=forest.max_depth,
+            )
+            margin = margin + tree_weight * contrib
+            features.append(forest.feature[0])
+            thresholds.append(forest.threshold[0])
+            leaves.append(forest.leaf_stats[0])
+            weights.append(tree_weight)
+
+        ensemble = Forest(
+            feature=np.stack(features),
+            threshold=np.stack(thresholds),
+            leaf_stats=np.stack(leaves),
+            max_depth=self.getMaxDepth(),
+        )
+        model = GBTClassificationModel(
+            forest=ensemble, tree_weights=np.asarray(weights, np.float32)
+        )
+        model.setParams(
+            **{k2: v for k2, v in self.paramValues().items() if model.hasParam(k2)}
+        )
+        return model
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def _gbt_margin(X, feature, threshold, leaf_stats, tree_weights, *, max_depth):
+    stats = forest_leaf_stats(
+        X, feature, threshold, leaf_stats, max_depth=max_depth
+    )  # [M, N, 3]
+    values = stats[..., 1] / jnp.maximum(stats[..., 0], 1e-12)  # [M, N]
+    return jnp.einsum("m,mn->n", tree_weights, values)
+
+
+class GBTClassificationModel(_GbtParams, ClassificationModel):
+    def __init__(self, forest: Forest, tree_weights: np.ndarray, **kwargs):
+        super().__init__(**kwargs)
+        self.forest = forest
+        self.treeWeights = np.asarray(tree_weights, np.float32)
+
+    @property
+    def num_classes(self) -> int:
+        return 2
+
+    def _save_extra(self):
+        return (
+            {"max_depth": self.forest.max_depth},
+            {
+                "feature": self.forest.feature,
+                "threshold": self.forest.threshold,
+                "leaf_stats": self.forest.leaf_stats,
+                "tree_weights": self.treeWeights,
+            },
+        )
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        forest = Forest(
+            arrays["feature"], arrays["threshold"], arrays["leaf_stats"],
+            int(extra["max_depth"]),
+        )
+        m = cls(forest=forest, tree_weights=arrays["tree_weights"])
+        m.setParams(**params)
+        return m
+
+    def margin(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            _gbt_margin(
+                jnp.asarray(X),
+                jnp.asarray(self.forest.feature),
+                jnp.asarray(self.forest.threshold),
+                jnp.asarray(self.forest.leaf_stats),
+                jnp.asarray(self.treeWeights),
+                max_depth=self.forest.max_depth,
+            )
+        )
+
+    def _raw_predict(self, X: np.ndarray) -> np.ndarray:
+        m = self.margin(X)
+        return np.stack([-2.0 * m, 2.0 * m], axis=1)
+
+    def _raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
+        p1 = 1.0 / (1.0 + np.exp(-raw[:, 1]))
+        return np.stack([1.0 - p1, p1], axis=1)

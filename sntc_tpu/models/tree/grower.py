@@ -1,0 +1,346 @@
+"""Level-wise binned forest grower — the ``RandomForest.run`` analog.
+
+Behavioral spec: SURVEY.md §2.3/§3.2 (upstream ``ml/tree/impl/RandomForest.
+scala`` + ``DTStatsAggregator`` [U]): quantile-binned features, level-wise
+growth with ALL trees' nodes trained per data pass, per-(node,feature,bin)
+sufficient statistics reduced across partitions, split = impurity-gain
+argmax, ``minInstancesPerNode``/``minInfoGain`` pruning.
+
+TPU redesign (SURVEY.md §7.2 item 1 — static shapes over dynamic trees):
+
+  * trees are DENSE heaps of ``2^(maxDepth+1)-1`` node slots (masked, not
+    grown) — no dynamic structure anywhere;
+  * the per-level histogram ``[T, nodes, F, B, S]`` is a ``segment_sum``
+    over mesh-sharded rows (``lax.map`` over trees × ``lax.scan`` over
+    features keeps peak memory at one ``[N]`` id vector); XLA inserts the
+    ICI all-reduce — Spark's shuffle (§3.2 ⟦DRV→EXEC⟧) becomes one psum;
+  * split selection is vectorized argmax on device; children of a split get
+    their stats from the chosen (left, right) cumsums, so the final level
+    needs no extra pass;
+  * a unified stats vector ``S`` serves classification (weighted class
+    counts, gini/entropy) and regression (``[w, wy, wy²]``, variance) — the
+    same kernel grows RF and GBT trees.
+
+Row routing uses bin ids (``bin <= split_bin`` goes left ⟺ ``x < edges[f,
+split_bin]``); serving traverses on raw floats with the stored thresholds.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Forest(NamedTuple):
+    """Dense-heap forest. H = 2^(max_depth+1) - 1 slots per tree.
+
+    ``feature[t, h] >= 0`` marks an internal node (split on that feature at
+    ``threshold``); ``-1`` marks a leaf with ``leaf_stats[t, h]`` (class
+    counts or [w, wy, wy²]); ``-2`` marks a never-created slot.
+    """
+
+    feature: np.ndarray  # [T, H] int32
+    threshold: np.ndarray  # [T, H] f32
+    leaf_stats: np.ndarray  # [T, H, S] f32
+    max_depth: int
+
+
+def heap_offset(depth: int) -> int:
+    return (1 << depth) - 1
+
+
+def resolve_feature_subset_k(strategy, n_features: int, n_trees: int,
+                             is_classification: bool) -> int:
+    """Spark featureSubsetStrategy semantics (SURVEY.md §2.3)."""
+    if isinstance(strategy, (int, np.integer)):
+        k = int(strategy)
+    elif strategy == "auto":
+        if n_trees == 1:
+            k = n_features
+        elif is_classification:
+            k = int(math.ceil(math.sqrt(n_features)))
+        else:
+            k = max(1, n_features // 3)
+    elif strategy == "all":
+        k = n_features
+    elif strategy == "sqrt":
+        k = int(math.ceil(math.sqrt(n_features)))
+    elif strategy == "log2":
+        k = max(1, int(math.floor(math.log2(n_features))))
+    elif strategy == "onethird":
+        k = max(1, n_features // 3)
+    else:
+        try:
+            frac = float(strategy)
+        except (TypeError, ValueError):
+            raise ValueError(f"unknown featureSubsetStrategy {strategy!r}")
+        if not 0 < frac <= 1:
+            raise ValueError(f"featureSubsetStrategy fraction {frac} not in (0,1]")
+        k = max(1, int(math.ceil(frac * n_features)))
+    return min(max(k, 1), n_features)
+
+
+def _weighted_impurity(stats: jnp.ndarray, impurity: str) -> jnp.ndarray:
+    """``weight * impurity`` for a stats vector (last axis S).
+
+    gini:    w - Σ s²/w          entropy: Σ -s·log(s/w)
+    variance: Σwy² - (Σwy)²/w   (stats = [w, wy, wy²])
+    """
+    if impurity in ("gini", "entropy"):
+        w = stats.sum(axis=-1)
+        safe_w = jnp.maximum(w, 1e-12)
+        if impurity == "gini":
+            return w - (stats**2).sum(axis=-1) / safe_w
+        p = stats / safe_w[..., None]
+        return -(jnp.where(stats > 0, stats * jnp.log(jnp.maximum(p, 1e-12)), 0.0)).sum(
+            axis=-1
+        )
+    # variance
+    w = stats[..., 0]
+    safe_w = jnp.maximum(w, 1e-12)
+    return stats[..., 2] - stats[..., 1] ** 2 / safe_w
+
+
+def _stat_count(stats: jnp.ndarray, impurity: str) -> jnp.ndarray:
+    if impurity == "variance":
+        return stats[..., 0]
+    return stats.sum(axis=-1)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_nodes", "n_bins", "impurity", "subset_k", "is_last"),
+)
+def _level_pass(
+    binned,  # [N, F] int32, row-sharded
+    row_stats,  # [N, S] f32, row-sharded (user weight folded in)
+    w_trees,  # [T, N] f32 bagging weights, sharded on N
+    node_idx,  # [T, N] int32 (-1 = inactive), sharded on N
+    key,  # PRNG key for feature subsetting
+    min_instances,  # f32 scalar
+    min_info_gain,  # f32 scalar
+    *,
+    n_nodes: int,
+    n_bins: int,
+    impurity: str,
+    subset_k: int,
+    is_last: bool,
+):
+    n, F = binned.shape
+    S = row_stats.shape[1]
+
+    # ---- histogram: [T, nodes, F, B, S] ------------------------------------
+    def per_tree(args):
+        w_t, node_t = args
+        active = (node_t >= 0).astype(row_stats.dtype)
+        ids = jnp.where(node_t >= 0, node_t, 0)
+        data = row_stats * (w_t * active)[:, None]
+
+        def per_feature(carry, f):
+            seg = ids * n_bins + binned[:, f]
+            h = jax.ops.segment_sum(data, seg, num_segments=n_nodes * n_bins)
+            return carry, h
+
+        _, hists = jax.lax.scan(per_feature, 0, jnp.arange(F))
+        return hists  # [F, nodes*B, S]
+
+    hists = jax.lax.map(per_tree, (w_trees, node_idx))  # [T, F, nodes*B, S]
+    T = w_trees.shape[0]
+    hist = hists.reshape(T, F, n_nodes, n_bins, S).transpose(0, 2, 1, 3, 4)
+
+    # ---- split evaluation --------------------------------------------------
+    cum = jnp.cumsum(hist, axis=3)  # left stats for split at bin b
+    parent = cum[:, :, 0, -1, :]  # [T, nodes, S]
+    left = cum[:, :, :, :-1, :]  # [T, nodes, F, B-1, S]
+    right = parent[:, :, None, None, :] - left
+
+    imp_parent = _weighted_impurity(parent, impurity)  # [T, nodes]
+    gain_w = (
+        imp_parent[:, :, None, None]
+        - _weighted_impurity(left, impurity)
+        - _weighted_impurity(right, impurity)
+    )
+    parent_cnt = _stat_count(parent, impurity)
+    gain = gain_w / jnp.maximum(parent_cnt, 1e-12)[:, :, None, None]
+
+    valid = (
+        (_stat_count(left, impurity) >= min_instances)
+        & (_stat_count(right, impurity) >= min_instances)
+    )
+    # feature subsetting per (tree, node): mask all but k random features
+    if subset_k < F:
+        r = jax.random.uniform(key, (T, n_nodes, F))
+        kth = -jax.lax.top_k(-r, subset_k)[0][..., -1]  # kth smallest
+        fmask = r <= kth[..., None]
+        valid = valid & fmask[:, :, :, None]
+    gain = jnp.where(valid, gain, -jnp.inf)
+
+    flat = gain.reshape(T, n_nodes, F * (n_bins - 1))
+    best = jnp.argmax(flat, axis=2)
+    best_gain = jnp.take_along_axis(flat, best[..., None], axis=2)[..., 0]
+    best_feat = (best // (n_bins - 1)).astype(jnp.int32)
+    best_bin = (best % (n_bins - 1)).astype(jnp.int32)
+
+    has_rows = parent_cnt > 0
+    do_split = has_rows & jnp.isfinite(best_gain) & (best_gain > min_info_gain)
+    # Spark treats minInfoGain=0 as "any strictly positive gain"
+    do_split = do_split & (best_gain > 0)
+
+    # children stats of the chosen split (used directly at the last level)
+    bf = best_feat[..., None, None, None]
+    take_f = jnp.take_along_axis(left, bf.clip(0), axis=2)[:, :, 0]  # [T,nodes,B-1,S]
+    bl = jnp.take_along_axis(
+        take_f, best_bin[..., None, None].clip(0), axis=2
+    )[:, :, 0]  # [T, nodes, S]
+    br = parent - bl
+
+    # ---- route rows to children -------------------------------------------
+    if is_last:
+        new_node_idx = node_idx
+    else:
+        idx = jnp.where(node_idx >= 0, node_idx, 0)  # [T, N]
+        splits = jnp.take_along_axis(do_split, idx, axis=1)  # [T, N]
+        feats = jnp.take_along_axis(best_feat, idx, axis=1)  # [T, N]
+        bins_thr = jnp.take_along_axis(best_bin, idx, axis=1)  # [T, N]
+        row_bins = jax.vmap(
+            lambda f_t: jnp.take_along_axis(binned, f_t[:, None], axis=1)[:, 0]
+        )(feats)  # [T, N]
+        go_right = (row_bins > bins_thr).astype(jnp.int32)
+        child = 2 * idx + go_right
+        new_node_idx = jnp.where(
+            (node_idx >= 0) & splits, child, -1
+        ).astype(jnp.int32)
+
+    return {
+        "best_feat": best_feat,
+        "best_bin": best_bin,
+        "do_split": do_split,
+        "has_rows": has_rows,
+        "parent_stats": parent,
+        "left_stats": bl,
+        "right_stats": br,
+        "new_node_idx": new_node_idx,
+    }
+
+
+@jax.jit
+def _root_stats(row_stats, w_trees):
+    return jnp.einsum("tn,ns->ts", w_trees, row_stats)
+
+
+def grow_forest(
+    binned,  # [N, F] int32 (device, row-sharded)
+    row_stats,  # [N, S] f32 (device, row-sharded)
+    w_trees,  # [T, N] f32 (device, sharded on N axis=1)
+    edges: np.ndarray,  # [F, B-1] host bin thresholds
+    *,
+    n_bins: int,
+    max_depth: int,
+    min_instances_per_node: float,
+    min_info_gain: float,
+    subset_k: int,
+    impurity: str,
+    seed: int,
+) -> Forest:
+    """Grow T trees level-synchronously; returns host-side dense heaps."""
+    T = w_trees.shape[0]
+    n, F = binned.shape
+    S = row_stats.shape[1]
+    H = (1 << (max_depth + 1)) - 1
+
+    feature = np.full((T, H), -2, np.int32)
+    threshold = np.zeros((T, H), np.float32)
+    leaf_stats = np.zeros((T, H, S), np.float32)
+
+    if max_depth == 0:
+        stats = np.asarray(_root_stats(row_stats, w_trees))
+        feature[:, 0] = -1
+        leaf_stats[:, 0] = stats
+        return Forest(feature, threshold, leaf_stats, max_depth)
+
+    node_idx = jnp.zeros((T, n), jnp.int32)
+    # mark root as existing (leaf until proven split)
+    exists = np.zeros((T, H), bool)
+    exists[:, 0] = True
+
+    key = jax.random.PRNGKey(seed)
+    for depth in range(max_depth):
+        n_nodes = 1 << depth
+        off = heap_offset(depth)
+        key, sub = jax.random.split(key)
+        out = _level_pass(
+            binned, row_stats, w_trees, node_idx, sub,
+            jnp.float32(min_instances_per_node), jnp.float32(min_info_gain),
+            n_nodes=n_nodes, n_bins=n_bins, impurity=impurity,
+            subset_k=subset_k, is_last=(depth == max_depth - 1),
+        )
+        do_split = np.asarray(out["do_split"])
+        has_rows = np.asarray(out["has_rows"])
+        best_feat = np.asarray(out["best_feat"])
+        best_bin = np.asarray(out["best_bin"])
+        parent_stats = np.asarray(out["parent_stats"])
+        node_idx = out["new_node_idx"]
+
+        lvl = slice(off, off + n_nodes)
+        lvl_exists = exists[:, lvl]
+        split_mask = do_split & lvl_exists
+        leaf_mask = lvl_exists & ~split_mask
+
+        feature[:, lvl] = np.where(
+            split_mask, best_feat, np.where(lvl_exists, -1, -2)
+        )
+        threshold[:, lvl] = np.where(
+            split_mask, edges[best_feat.clip(0), best_bin.clip(0)], 0.0
+        )
+        leaf_stats[:, lvl] = np.where(leaf_mask[..., None], parent_stats, 0.0)
+
+        # children of split nodes exist at the next level
+        next_off = heap_offset(depth + 1)
+        child_exists = np.zeros((T, 1 << (depth + 1)), bool)
+        child_exists[:, 0::2] = split_mask
+        child_exists[:, 1::2] = split_mask
+        exists[:, next_off : next_off + (1 << (depth + 1))] = child_exists
+
+        if depth == max_depth - 1:
+            # children are leaves with the chosen split's child stats
+            left_stats = np.asarray(out["left_stats"])
+            right_stats = np.asarray(out["right_stats"])
+            lvl2 = slice(next_off, next_off + (1 << (depth + 1)))
+            child_stats = np.zeros((T, 1 << (depth + 1), S), np.float32)
+            child_stats[:, 0::2] = left_stats
+            child_stats[:, 1::2] = right_stats
+            feature[:, lvl2] = np.where(child_exists, -1, -2)
+            leaf_stats[:, lvl2] = np.where(
+                child_exists[..., None], child_stats, 0.0
+            )
+
+    return Forest(feature, threshold, leaf_stats, max_depth)
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def forest_leaf_stats(X, feature, threshold, leaf_stats, *, max_depth: int):
+    """Serve: route each row down each tree, return leaf stats [T, N, S].
+
+    Dense traversal: ``max_depth`` gathers, no data-dependent control flow —
+    XLA-friendly (SURVEY.md §1 restack: "no dynamic DAG").
+    """
+    T = feature.shape[0]
+    N = X.shape[0]
+    node = jnp.zeros((T, N), jnp.int32)
+    for _ in range(max_depth):
+        f = jnp.take_along_axis(feature, node, axis=1)  # [T, N]
+        is_internal = f >= 0
+        fc = jnp.where(is_internal, f, 0)
+        xv = jax.vmap(
+            lambda f_t: jnp.take_along_axis(X, f_t[:, None], axis=1)[:, 0]
+        )(fc)  # [T, N]
+        thr = jnp.take_along_axis(threshold, node, axis=1)
+        go_right = (xv >= thr).astype(jnp.int32)
+        child = 2 * node + 1 + go_right
+        node = jnp.where(is_internal, child, node)
+    return jax.vmap(lambda ls_t, n_t: ls_t[n_t])(leaf_stats, node)  # [T, N, S]

@@ -1,0 +1,9 @@
+from sntc_tpu.ops.binning import bin_features, quantile_bin_edges
+from sntc_tpu.ops.histogram import binned_contingency, chi_square
+
+__all__ = [
+    "quantile_bin_edges",
+    "bin_features",
+    "binned_contingency",
+    "chi_square",
+]

@@ -1,0 +1,55 @@
+"""Quantile binning — the ``findSplits`` analog (SURVEY.md §3.2).
+
+Spark's tree path bins continuous features once into uint8 bin ids
+(``TreePoint.convertToTreePoint`` after ``findSplits`` quantile sampling [U])
+so every later pass is integer histogramming.  We keep that design because it
+is exactly what the TPU wants: the 2.8M×78 dataset becomes a device-resident
+uint8 tensor (~220 MB) and every histogram is a ``segment_sum`` feeding the
+MXU-friendly reductions (SURVEY.md §7.1 step 4).
+
+Edges are computed host-side on a sample (cheap, one pass) with static shape
+``[F, max_bins - 1]``; duplicate edges from low-cardinality features are
+harmless (empty bins).  ``bin_features`` is jitted and runs on device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def quantile_bin_edges(
+    X: np.ndarray,
+    max_bins: int = 32,
+    sample_rows: int = 200_000,
+    seed: int = 0,
+) -> np.ndarray:
+    """Per-feature quantile split thresholds, shape ``[F, max_bins - 1]``.
+
+    Mirrors Spark ``findSplits``: thresholds are quantiles of a row sample.
+    Features with < max_bins distinct sampled values get repeated edges
+    (empty bins) instead of a ragged bin count — static shapes for XLA.
+    """
+    n, f = X.shape
+    if n > sample_rows:
+        idx = np.random.default_rng(seed).choice(n, size=sample_rows, replace=False)
+        sample = X[idx]
+    else:
+        sample = X
+    qs = np.linspace(0.0, 1.0, max_bins + 1)[1:-1]
+    edges = np.quantile(sample, qs, axis=0).T.astype(np.float32)  # [F, B-1]
+    return np.ascontiguousarray(edges)
+
+
+@partial(jax.jit, static_argnames=())
+def bin_features(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
+    """Map ``X [N, F]`` to bin ids ``[N, F]`` (int32 in [0, B-1]) given
+    ``edges [F, B-1]``: ``bin = #edges <= x`` (right-closed, Spark-style)."""
+
+    def one_feature(col: jnp.ndarray, col_edges: jnp.ndarray) -> jnp.ndarray:
+        return jnp.searchsorted(col_edges, col, side="right").astype(jnp.int32)
+
+    return jax.vmap(one_feature, in_axes=(1, 0), out_axes=1)(X, edges)

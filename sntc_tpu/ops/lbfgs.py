@@ -1,0 +1,252 @@
+"""LBFGS / OWLQN minimizer, fully jitted — the Breeze optimizer analog.
+
+Behavioral spec: SURVEY.md §2.3/§3.1: Spark drives every LR/MLP fit through
+Breeze ``LBFGS`` (L2/none) or ``OWLQN`` (elastic-net L1) on the driver, with
+one ``treeAggregate`` gradient pass per iteration.  Here the ENTIRE
+optimization loop lives in one XLA program (``lax.while_loop``): the
+value-and-grad closure reads mesh-sharded data, XLA inserts the ICI
+all-reduce for the gradient sum, and no scalar ever returns to the host
+until convergence — the per-iteration broadcast/reduce/driver-update round
+trip of SURVEY.md §3.1 collapses into on-device compute.
+
+Numerics: f32 (SURVEY.md §7.2 item 2 — v5e-native; the sklearn parity suite
+bounds the difference).  OWLQN follows Andrew & Gao 2007: pseudo-gradient,
+orthant-projected direction and line-search steps, with a per-coordinate
+``l1`` weight vector so intercepts go unpenalized.
+
+Implementation notes: circular history buffers with masked two-loop
+recursion (static ``history_size``); Armijo backtracking line search as an
+inner ``while_loop``; curvature-guarded history updates.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LbfgsResult(NamedTuple):
+    x: jnp.ndarray
+    loss: jnp.ndarray  # final objective (incl. l1 term)
+    n_iters: jnp.ndarray  # iterations actually taken
+    history: jnp.ndarray  # [max_iter + 1] objective per iteration (padded with last)
+    converged: jnp.ndarray
+
+
+def _dot(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.dot(a, b, precision=jax.lax.Precision.HIGHEST)
+
+
+def _pseudo_gradient(x, g, l1):
+    """OWLQN pseudo-gradient of f(x) + sum(l1 * |x|)."""
+    gp = g + l1 * jnp.sign(x)
+    right = g + l1
+    left = g - l1
+    at_zero = jnp.where(right < 0, right, jnp.where(left > 0, left, 0.0))
+    return jnp.where(x != 0, gp, at_zero)
+
+
+def minimize_lbfgs(
+    value_and_grad: Callable[[jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
+    x0: jnp.ndarray,
+    *,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    history_size: int = 10,
+    l1: Optional[jnp.ndarray] = None,
+    max_linesearch: int = 30,
+    c1: float = 1e-4,
+) -> LbfgsResult:
+    """Minimize ``f(x) + sum(l1 * |x|)`` where ``value_and_grad`` gives the
+    smooth part.  ``l1=None`` (or all-zero) is plain LBFGS; otherwise OWLQN.
+
+    Jit-safe: call inside jit with sharded data closed over in
+    ``value_and_grad``.
+    """
+    d = x0.shape[0]
+    m = history_size
+    use_l1 = l1 is not None
+    l1v = jnp.zeros((d,), x0.dtype) if l1 is None else jnp.asarray(l1, x0.dtype)
+
+    def full_obj(x, f_smooth):
+        if use_l1:
+            return f_smooth + jnp.sum(l1v * jnp.abs(x))
+        return f_smooth
+
+    def effective_grad(x, g):
+        """Gradient driving the two-loop: pseudo-gradient under L1."""
+        if use_l1:
+            return _pseudo_gradient(x, g, l1v)
+        return g
+
+    def project_orthant(x_new, xi):
+        if use_l1:
+            keep = jnp.sign(x_new) == xi
+            # unpenalized coords (l1 == 0) are never clipped
+            return jnp.where((l1v == 0) | keep, x_new, 0.0)
+        return x_new
+
+    f0, g0 = value_and_grad(x0)
+    obj0 = full_obj(x0, f0)
+    history0 = jnp.full((max_iter + 1,), obj0, x0.dtype)
+
+    state0 = {
+        "x": x0,
+        "f": f0,  # smooth part
+        "obj": obj0,  # smooth + l1
+        "g": g0,  # smooth gradient
+        "s_hist": jnp.zeros((m, d), x0.dtype),
+        "y_hist": jnp.zeros((m, d), x0.dtype),
+        "rho": jnp.zeros((m,), x0.dtype),
+        "k": jnp.asarray(0, jnp.int32),
+        "n_upd": jnp.asarray(0, jnp.int32),
+        "done": jnp.asarray(False),
+        "history": history0,
+    }
+
+    def two_loop(state, pg):
+        """Standard masked two-loop recursion over the circular history."""
+        n_upd, s_hist, y_hist, rho = (
+            state["n_upd"], state["s_hist"], state["y_hist"], state["rho"],
+        )
+        q = pg
+        idxs = (n_upd - 1 - jnp.arange(m)) % m  # newest -> oldest
+        valid = jnp.arange(m) < jnp.minimum(n_upd, m)
+
+        def fwd(i, carry):
+            q, alphas = carry
+            j = idxs[i]
+            a = jnp.where(valid[i], rho[j] * _dot(s_hist[j], q), 0.0)
+            q = q - a * y_hist[j]
+            return q, alphas.at[i].set(a)
+
+        q, alphas = jax.lax.fori_loop(0, m, fwd, (q, jnp.zeros((m,), x0.dtype)))
+
+        newest = (n_upd - 1) % m
+        sy = _dot(s_hist[newest], y_hist[newest])
+        yy = _dot(y_hist[newest], y_hist[newest])
+        gamma = jnp.where((n_upd > 0) & (yy > 0), sy / yy, 1.0)
+        q = gamma * q
+
+        def bwd(i, q):
+            ii = m - 1 - i  # oldest -> newest
+            j = idxs[ii]
+            b = jnp.where(valid[ii], rho[j] * _dot(y_hist[j], q), 0.0)
+            return q + s_hist[j] * (alphas[ii] - b)
+
+        q = jax.lax.fori_loop(0, m, bwd, q)
+        return -q  # descent direction
+
+    def line_search(state, direction, pg):
+        """Armijo backtracking; under L1, steps are orthant-projected and the
+        sufficient-decrease test uses the actual displacement (OWLQN)."""
+        x, obj = state["x"], state["obj"]
+        xi = jnp.where(x != 0, jnp.sign(x), jnp.sign(-pg))
+        gd = _dot(pg, direction)
+        # first iteration: conservative step (Breeze convention)
+        alpha0 = jnp.where(
+            state["n_upd"] > 0,
+            1.0,
+            jnp.minimum(1.0, 1.0 / jnp.maximum(jnp.sum(jnp.abs(pg)), 1e-12)),
+        ).astype(x0.dtype)
+
+        def ls_cond(carry):
+            it, alpha, ok, *_ = carry
+            return (~ok) & (it < max_linesearch)
+
+        def ls_body(carry):
+            it, alpha, ok, x_new, f_new, obj_new = carry
+            x_cand = project_orthant(x + alpha * direction, xi)
+            f_cand, _ = value_and_grad(x_cand)
+            obj_cand = full_obj(x_cand, f_cand)
+            if use_l1:
+                decrease = c1 * _dot(pg, x_cand - x)
+            else:
+                decrease = c1 * alpha * gd
+            good = obj_cand <= obj + decrease
+            return (
+                it + 1,
+                jnp.where(good, alpha, alpha * 0.5),
+                good,
+                jnp.where(good, x_cand, x_new),
+                jnp.where(good, f_cand, f_new),
+                jnp.where(good, obj_cand, obj_new),
+            )
+
+        init = (
+            jnp.asarray(0, jnp.int32), alpha0, jnp.asarray(False),
+            x, state["f"], obj,
+        )
+        _, _, ok, x_new, f_new, obj_new = jax.lax.while_loop(
+            ls_cond, ls_body, init
+        )
+        return ok, x_new, f_new, obj_new
+
+    def cond(state):
+        return (~state["done"]) & (state["k"] < max_iter)
+
+    def body(state):
+        pg = effective_grad(state["x"], state["g"])
+        direction = two_loop(state, pg)
+        if use_l1:
+            # constrain direction to the descent orthant (Andrew & Gao eq. 4)
+            direction = jnp.where(direction * pg < 0, direction, 0.0)
+        ok, x_new, f_new, obj_new = line_search(state, direction, pg)
+
+        _, g_new = value_and_grad(x_new)
+        s = x_new - state["x"]
+        # curvature pairs always use the SMOOTH gradient difference
+        yv = g_new - state["g"]
+        sy = _dot(s, yv)
+        slot = state["n_upd"] % m
+        good_pair = sy > 1e-10
+
+        s_hist = jnp.where(
+            good_pair, state["s_hist"].at[slot].set(s), state["s_hist"]
+        )
+        y_hist = jnp.where(
+            good_pair, state["y_hist"].at[slot].set(yv), state["y_hist"]
+        )
+        rho = jnp.where(
+            good_pair,
+            state["rho"].at[slot].set(1.0 / jnp.where(good_pair, sy, 1.0)),
+            state["rho"],
+        )
+        n_upd = state["n_upd"] + jnp.where(good_pair, 1, 0)
+
+        k = state["k"] + 1
+        rel_impr = jnp.abs(obj_new - state["obj"]) / jnp.maximum(
+            jnp.maximum(jnp.abs(obj_new), jnp.abs(state["obj"])), 1e-12
+        )
+        converged = ok & (rel_impr < tol)
+        stalled = ~ok
+        return {
+            "x": jnp.where(ok, x_new, state["x"]),
+            "f": jnp.where(ok, f_new, state["f"]),
+            "obj": jnp.where(ok, obj_new, state["obj"]),
+            "g": jnp.where(ok, g_new, state["g"]),
+            "s_hist": s_hist,
+            "y_hist": y_hist,
+            "rho": rho,
+            "k": k,
+            "n_upd": n_upd,
+            "done": converged | stalled,
+            "history": state["history"].at[k].set(
+                jnp.where(ok, obj_new, state["obj"])
+            ),
+        }
+
+    final = jax.lax.while_loop(cond, body, state0)
+    # pad history beyond n_iters with the final objective
+    idx = jnp.arange(max_iter + 1)
+    hist = jnp.where(idx <= final["k"], final["history"], final["obj"])
+    return LbfgsResult(
+        x=final["x"],
+        loss=final["obj"],
+        n_iters=final["k"],
+        history=hist,
+        converged=final["done"],
+    )

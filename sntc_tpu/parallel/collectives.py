@@ -61,6 +61,20 @@ def shard_batch(mesh: Mesh, *arrays: np.ndarray, axis_name: str = DATA_AXIS):
     return tuple(out)
 
 
+def shard_weights(
+    mesh: Mesh,
+    w: np.ndarray,
+    n_padded: int,
+    axis_name: str = DATA_AXIS,
+):
+    """Row weights padded with zeros to ``n_padded`` and sharded over the
+    mesh — the companion of :func:`shard_batch` when callers carry their own
+    weight column (user weights × padding mask in one array)."""
+    w_pad = np.zeros(n_padded, dtype=np.float32)
+    w_pad[: len(w)] = w
+    return jax.device_put(w_pad, NamedSharding(mesh, P(axis_name)))
+
+
 def make_tree_aggregate(
     fn: Callable,
     mesh: Mesh,

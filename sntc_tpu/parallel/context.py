@@ -1,0 +1,29 @@
+"""Process-wide default mesh — the SparkContext analog.
+
+Estimators run SPMD over a mesh; users can pass one explicitly (the
+``mesh=`` constructor argument every estimator takes) or rely on this
+process-wide default, built lazily over all visible devices — like an app
+inheriting the active ``SparkContext`` (SURVEY.md §1 L8).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax.sharding import Mesh
+
+from sntc_tpu.parallel.mesh import default_mesh
+
+_default: Optional[Mesh] = None
+
+
+def get_default_mesh() -> Mesh:
+    global _default
+    if _default is None:
+        _default = default_mesh()
+    return _default
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> None:
+    global _default
+    _default = mesh

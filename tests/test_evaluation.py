@@ -1,0 +1,101 @@
+"""Oracle: sklearn.metrics reproduces Spark's definitions for these cases."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    accuracy_score,
+    confusion_matrix,
+    f1_score,
+    precision_score,
+    recall_score,
+    roc_auc_score,
+)
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.evaluation import (
+    BinaryClassificationEvaluator,
+    MulticlassClassificationEvaluator,
+    MulticlassMetrics,
+)
+from sntc_tpu.evaluation.binary import area_under_pr, area_under_roc
+
+
+def _pairs(n=3000, k=5, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, k, size=n).astype(np.float64)
+    p = np.where(rng.random(n) < 0.7, y, rng.integers(0, k, size=n)).astype(np.float64)
+    return y, p
+
+
+def test_confusion_matrix_matches_sklearn(mesh8):
+    y, p = _pairs()
+    m = MulticlassMetrics(y, p, mesh=mesh8)
+    np.testing.assert_array_equal(m.confusion, confusion_matrix(y, p))
+
+
+def test_scalar_metrics_match_sklearn(mesh8):
+    y, p = _pairs(seed=1)
+    m = MulticlassMetrics(y, p, mesh=mesh8)
+    assert m.accuracy == pytest.approx(accuracy_score(y, p))
+    assert m.weighted_f_measure() == pytest.approx(
+        f1_score(y, p, average="weighted"), abs=1e-12
+    )
+    assert m.macro_f1() == pytest.approx(f1_score(y, p, average="macro"), abs=1e-12)
+    assert m.weighted_precision() == pytest.approx(
+        precision_score(y, p, average="weighted", zero_division=0), abs=1e-12
+    )
+    assert m.weighted_recall() == pytest.approx(
+        recall_score(y, p, average="weighted", zero_division=0), abs=1e-12
+    )
+
+
+def test_zero_division_convention(mesh8):
+    # class 2 never predicted, class 3 never true -> 0/0 -> 0 (Spark)
+    y = np.array([0, 0, 1, 2.0])
+    p = np.array([0, 1, 1, 3.0])
+    m = MulticlassMetrics(y, p, mesh=mesh8)
+    assert m.precision_by_label()[2] == 0.0
+    assert m.recall_by_label()[3] == 0.0
+    assert m.f_measure_by_label()[2] == 0.0
+    # macroF1 averages only over classes present in TRUE labels
+    present_f1 = m.f_measure_by_label()[:3]
+    assert m.macro_f1() == pytest.approx(present_f1.mean())
+
+
+def test_evaluator_facade(mesh8):
+    y, p = _pairs(seed=2)
+    f = Frame({"label": y, "prediction": p})
+    ev = MulticlassClassificationEvaluator(metricName="f1", mesh=mesh8)
+    assert ev.evaluate(f) == pytest.approx(f1_score(y, p, average="weighted"))
+    ev2 = MulticlassClassificationEvaluator(metricName="macroF1", mesh=mesh8)
+    assert ev2.evaluate(f) == pytest.approx(f1_score(y, p, average="macro"))
+    with pytest.raises(ValueError):
+        MulticlassClassificationEvaluator(metricName="bogus")
+
+
+def test_auc_matches_sklearn():
+    rng = np.random.default_rng(3)
+    y = rng.integers(0, 2, size=500).astype(np.float64)
+    s = rng.normal(size=500) + y * 1.5
+    assert area_under_roc(y, s) == pytest.approx(roc_auc_score(y, s), abs=1e-12)
+    # with heavy score ties (grouped thresholds)
+    s_tied = np.round(s)
+    assert area_under_roc(y, s_tied) == pytest.approx(
+        roc_auc_score(y, s_tied), abs=1e-12
+    )
+
+
+def test_auc_pr_known_value():
+    # perfect ranking -> AUPR 1.0; random-ish score sanity bounds
+    y = np.array([0, 0, 1, 1.0])
+    s = np.array([0.1, 0.2, 0.8, 0.9])
+    assert area_under_pr(y, s) == pytest.approx(1.0)
+    assert area_under_roc(y, s) == pytest.approx(1.0)
+
+
+def test_binary_evaluator_uses_raw_column():
+    y = np.array([0, 1, 1, 0.0])
+    raw = np.array([[0.6, -0.6], [-2.0, 2.0], [-1.0, 1.0], [0.5, -0.5]])
+    f = Frame({"label": y, "rawPrediction": raw})
+    ev = BinaryClassificationEvaluator()
+    assert ev.evaluate(f) == pytest.approx(1.0)

@@ -1,0 +1,153 @@
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.feature import (
+    ChiSqSelector,
+    IndexToString,
+    StandardScaler,
+    StringIndexer,
+    VectorAssembler,
+)
+
+
+# ---------------- StringIndexer ----------------
+
+def _label_frame():
+    labels = ["b"] * 5 + ["a"] * 5 + ["c"] * 3 + ["d"] * 1
+    return Frame({"label": np.array(labels, dtype=object)})
+
+
+def test_string_indexer_frequency_desc_tiebreak_alpha():
+    # b and a tie at 5 -> alphabetical ascending breaks the tie (Spark parity)
+    model = StringIndexer(inputCol="label", outputCol="idx").fit(_label_frame())
+    assert model.labels == ["a", "b", "c", "d"]
+    out = model.transform(_label_frame())
+    assert out["idx"].dtype == np.float64
+    assert out["idx"][0] == 1.0  # "b"
+
+
+def test_string_indexer_order_types():
+    f = _label_frame()
+    assert StringIndexer(stringOrderType="alphabetAsc").fit(f).labels == ["a", "b", "c", "d"]
+    assert StringIndexer(stringOrderType="alphabetDesc").fit(f).labels == ["d", "c", "b", "a"]
+    assert StringIndexer(stringOrderType="frequencyAsc").fit(f).labels == ["d", "c", "a", "b"]
+
+
+def test_string_indexer_handle_invalid():
+    model = StringIndexer(inputCol="label", outputCol="idx").fit(_label_frame())
+    unseen = Frame({"label": np.array(["a", "zz"], dtype=object)})
+    with pytest.raises(ValueError, match="unseen"):
+        model.transform(unseen)
+    skipped = model.copy({"handleInvalid": "skip"}).transform(unseen)
+    assert skipped.num_rows == 1
+    kept = model.copy({"handleInvalid": "keep"}).transform(unseen)
+    assert kept["idx"].tolist() == [0.0, 4.0]
+
+
+def test_index_to_string_roundtrip():
+    f = _label_frame()
+    model = StringIndexer(inputCol="label", outputCol="idx").fit(f)
+    out = model.transform(f)
+    back = IndexToString(inputCol="idx", outputCol="orig", labels=model.labels).transform(out)
+    assert list(back["orig"]) == list(f["label"])
+
+
+# ---------------- VectorAssembler ----------------
+
+def test_vector_assembler_stacks_in_order():
+    f = Frame({
+        "a": np.array([1.0, 2.0]),
+        "b": np.array([[10.0, 20.0], [30.0, 40.0]]),
+        "c": np.array([5.0, 6.0]),
+    })
+    out = VectorAssembler(inputCols=["a", "b", "c"]).transform(f)
+    assert out["features"].dtype == np.float32
+    np.testing.assert_array_equal(
+        out["features"], [[1, 10, 20, 5], [2, 30, 40, 6]]
+    )
+
+
+def test_vector_assembler_handle_invalid():
+    f = Frame({"a": np.array([1.0, np.nan, 3.0])})
+    with pytest.raises(ValueError, match="NaN/Inf"):
+        VectorAssembler(inputCols=["a"]).transform(f)
+    out = VectorAssembler(inputCols=["a"], handleInvalid="skip").transform(f)
+    assert out.num_rows == 2
+    out = VectorAssembler(inputCols=["a"], handleInvalid="keep").transform(f)
+    assert out.num_rows == 3 and np.isnan(out["features"][1, 0])
+
+
+# ---------------- StandardScaler ----------------
+
+def test_standard_scaler_matches_numpy_unbiased(mesh8):
+    rng = np.random.default_rng(0)
+    X = rng.normal(3.0, 2.0, size=(500, 6)).astype(np.float32)
+    X[:, 5] = 7.0  # constant feature -> std 0 -> output 0 (Spark semantics)
+    f = Frame({"features": X})
+    model = StandardScaler(
+        mesh=mesh8, inputCol="features", outputCol="scaled", withMean=True
+    ).fit(f)
+    np.testing.assert_allclose(model.mean, X.mean(0), rtol=1e-4)
+    np.testing.assert_allclose(model.std[:5], X.std(0, ddof=1)[:5], rtol=1e-3)
+    out = model.transform(f)["scaled"]
+    np.testing.assert_allclose(out[:, :5].mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(out[:, :5].std(0, ddof=1), 1.0, rtol=1e-3)
+    assert np.all(out[:, 5] == 0.0)
+
+
+def test_standard_scaler_no_mean_default():
+    X = np.array([[2.0], [4.0]], dtype=np.float32)
+    model = StandardScaler(inputCol="features", outputCol="s").fit(
+        Frame({"features": X})
+    )
+    out = model.transform(Frame({"features": X}))["s"]
+    # withMean=False: scaled but not centered
+    np.testing.assert_allclose(out.ravel(), X.ravel() / X.std(ddof=1), rtol=1e-5)
+
+
+# ---------------- ChiSqSelector ----------------
+
+def test_chi_square_matches_scipy():
+    from scipy.stats import chi2_contingency
+
+    from sntc_tpu.ops.histogram import chi_square
+
+    rng = np.random.default_rng(1)
+    observed = rng.integers(1, 50, size=(3, 4, 5)).astype(np.float64)
+    stats, pvals, dofs = chi_square(observed)
+    for j in range(3):
+        ref = chi2_contingency(observed[j], correction=False)
+        assert stats[j] == pytest.approx(ref.statistic, rel=1e-9)
+        assert pvals[j] == pytest.approx(ref.pvalue, rel=1e-9)
+        assert dofs[j] == ref.dof
+
+
+def test_chisq_selector_picks_informative_features(mesh8):
+    rng = np.random.default_rng(2)
+    n = 2000
+    y = rng.integers(0, 3, size=n)
+    X = rng.normal(size=(n, 10)).astype(np.float32)
+    # features 2 and 7 carry the label signal
+    X[:, 2] += y * 2.0
+    X[:, 7] -= y * 1.5
+    f = Frame({"features": X, "label": y.astype(np.float64)})
+    model = ChiSqSelector(
+        mesh=mesh8, numTopFeatures=2, labelCol="label"
+    ).fit(f)
+    assert model.selected_features == [2, 7]
+    out = model.transform(f)
+    assert out["selectedFeatures"].shape == (n, 2)
+    np.testing.assert_array_equal(out["selectedFeatures"][:, 0], X[:, 2])
+
+
+def test_chisq_selector_fpr_mode(mesh8):
+    rng = np.random.default_rng(3)
+    n = 3000
+    y = rng.integers(0, 2, size=n)
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    X[:, 0] += y * 3.0
+    model = ChiSqSelector(
+        mesh=mesh8, selectorType="fpr", fpr=1e-6, labelCol="label"
+    ).fit(Frame({"features": X, "label": y.astype(np.float64)}))
+    assert model.selected_features == [0]

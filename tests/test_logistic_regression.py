@@ -1,0 +1,135 @@
+"""Parity oracle: sklearn (SURVEY.md §4.2) — coefficients within f32 slack."""
+
+import numpy as np
+import pytest
+from sklearn.linear_model import LogisticRegression as SkLR
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.models import LogisticRegression
+
+
+def _binary_data(n=4000, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32) * rng.uniform(0.5, 3.0, size=d)
+    w = rng.normal(size=d)
+    logits = X @ w - 0.5
+    y = (logits + rng.logistic(size=n) > 0).astype(np.float64)
+    return Frame({"features": X.astype(np.float32), "label": y}), X, y
+
+
+def _multi_data(n=6000, d=6, k=4, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    W = rng.normal(size=(d, k)) * 1.5
+    logits = X @ W
+    probs = np.exp(logits - logits.max(1, keepdims=True))
+    probs /= probs.sum(1, keepdims=True)
+    y = np.array([rng.choice(k, p=p) for p in probs], dtype=np.float64)
+    return Frame({"features": X, "label": y}), X, y
+
+
+def test_binomial_no_reg_matches_sklearn(mesh8):
+    f, X, y = _binary_data()
+    model = LogisticRegression(mesh=mesh8, maxIter=200, tol=1e-9).fit(f)
+    sk = SkLR(penalty=None, max_iter=2000, tol=1e-10).fit(X, y)
+    np.testing.assert_allclose(model.coefficients, sk.coef_[0], rtol=2e-3, atol=2e-3)
+    assert model.intercept == pytest.approx(sk.intercept_[0], abs=5e-3)
+    assert model.summary.totalIterations > 0
+    # objectiveHistory decreases
+    h = model.summary.objectiveHistory
+    assert h[0] > h[-1]
+
+
+def test_binomial_l2_matches_sklearn(mesh8):
+    f, X, y = _binary_data(seed=2)
+    reg = 0.1
+    model = LogisticRegression(
+        mesh=mesh8, regParam=reg, standardization=False, maxIter=200, tol=1e-9
+    ).fit(f)
+    sk = SkLR(C=1.0 / (len(y) * reg), max_iter=2000, tol=1e-10).fit(X, y)
+    np.testing.assert_allclose(model.coefficients, sk.coef_[0], rtol=2e-3, atol=2e-3)
+
+
+def test_binomial_l1_sparsity_matches_sklearn(mesh8):
+    f, X, y = _binary_data(n=2000, seed=3)
+    reg = 0.05
+    model = LogisticRegression(
+        mesh=mesh8, regParam=reg, elasticNetParam=1.0, standardization=False,
+        maxIter=300, tol=1e-9,
+    ).fit(f)
+    sk = SkLR(
+        penalty="l1", solver="liblinear", C=1.0 / (len(y) * reg),
+        max_iter=5000, tol=1e-10,
+    ).fit(X, y)
+    np.testing.assert_allclose(model.coefficients, sk.coef_[0], atol=2e-2)
+    # same sparsity pattern
+    assert np.array_equal(
+        np.abs(model.coefficients) < 1e-4, np.abs(sk.coef_[0]) < 1e-4
+    )
+
+
+def test_multinomial_matches_sklearn(mesh8):
+    f, X, y = _multi_data()
+    reg = 0.01
+    model = LogisticRegression(
+        mesh=mesh8, regParam=reg, standardization=False, maxIter=300, tol=1e-10
+    ).fit(f)
+    sk = SkLR(C=1.0 / (len(y) * reg), max_iter=3000, tol=1e-12).fit(X, y)
+    assert model.num_classes == 4
+    # f32 leaves ~3e-2 slack in the softmax's weakly-determined directions
+    # (SURVEY.md §7.2 item 2); behavioral parity is what matters:
+    np.testing.assert_allclose(
+        model.coefficientMatrix, sk.coef_, rtol=6e-2, atol=6e-2
+    )
+    # both solutions are unique only up to a uniform intercept shift
+    np.testing.assert_allclose(
+        model.interceptVector - model.interceptVector.mean(),
+        sk.intercept_ - sk.intercept_.mean(),
+        atol=6e-2,
+    )
+    out = model.transform(f)
+    agree = (out["prediction"] == sk.predict(X)).mean()
+    assert agree > 0.995
+    np.testing.assert_allclose(
+        out["probability"], sk.predict_proba(X), atol=2e-2
+    )
+
+
+def test_transform_columns_and_threshold(mesh8):
+    f, X, y = _binary_data(n=500, seed=4)
+    model = LogisticRegression(mesh=mesh8, maxIter=50).fit(f)
+    out = model.transform(f)
+    prob = out["probability"]
+    raw = out["rawPrediction"]
+    assert prob.shape == (500, 2) and raw.shape == (500, 2)
+    np.testing.assert_allclose(prob.sum(1), 1.0, rtol=1e-5)
+    # Spark binary raw margins are [-m, m]
+    np.testing.assert_allclose(raw[:, 0], -raw[:, 1], rtol=1e-5)
+    acc = (out["prediction"] == y).mean()
+    assert acc > 0.85
+    # threshold=1.0 -> everything class 0
+    all0 = model.copy({"threshold": 1.0}).transform(f)["prediction"]
+    assert (all0 == 0.0).all()
+
+
+def test_weighted_rows_equal_duplication(mesh8):
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(200, 3)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    dup = np.concatenate([X, X[:50]]), np.concatenate([y, y[:50]])
+    w = np.ones(200, np.float32)
+    w[:50] = 2.0
+    # small L2 keeps the (separable) solution finite and well-conditioned
+    m_w = LogisticRegression(
+        mesh=mesh8, weightCol="w", regParam=0.01, maxIter=100, tol=1e-9
+    ).fit(Frame({"features": X, "label": y, "w": w}))
+    m_d = LogisticRegression(mesh=mesh8, regParam=0.01, maxIter=100, tol=1e-9).fit(
+        Frame({"features": dup[0], "label": dup[1]})
+    )
+    np.testing.assert_allclose(m_w.coefficients, m_d.coefficients, rtol=1e-3, atol=1e-3)
+
+
+def test_family_validation(mesh8):
+    f, _, _ = _multi_data(n=300)
+    with pytest.raises(ValueError, match="binomial"):
+        LogisticRegression(mesh=mesh8, family="binomial").fit(f)

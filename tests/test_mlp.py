@@ -1,0 +1,90 @@
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.mlio import load_model, save_model
+from sntc_tpu.models import MultilayerPerceptronClassifier
+
+
+def _xor_data(n=2000, seed=0):
+    """Nonlinearly separable data a linear model cannot fit."""
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-1, 1, size=(n, 2)).astype(np.float32)
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.float64)
+    return Frame({"features": X, "label": y})
+
+
+def _multi_blobs(n=3000, k=4, seed=1):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, 6)) * 3
+    y = rng.integers(0, k, size=n)
+    X = (centers[y] + rng.normal(size=(n, 6))).astype(np.float32)
+    return Frame({"features": X, "label": y.astype(np.float64)}), y
+
+
+def test_mlp_learns_xor(mesh8):
+    f = _xor_data()
+    model = MultilayerPerceptronClassifier(
+        mesh=mesh8, layers=[2, 16, 2], maxIter=200, seed=3
+    ).fit(f)
+    out = model.transform(f)
+    acc = (out["prediction"] == f["label"]).mean()
+    assert acc > 0.95, acc
+    # objective decreased
+    h = model.summary.objectiveHistory
+    assert h[-1] < h[0] * 0.5
+
+
+def test_mlp_multiclass_and_columns(mesh8):
+    f, y = _multi_blobs()
+    model = MultilayerPerceptronClassifier(
+        mesh=mesh8, layers=[6, 12, 4], maxIter=150, seed=0
+    ).fit(f)
+    out = model.transform(f)
+    assert out["probability"].shape == (3000, 4)
+    np.testing.assert_allclose(out["probability"].sum(1), 1.0, rtol=1e-5)
+    assert (out["prediction"] == y).mean() > 0.97
+
+
+def test_mlp_seed_determinism(mesh8):
+    f = _xor_data(500)
+    kw = dict(mesh=mesh8, layers=[2, 8, 2], maxIter=30, seed=7)
+    m1 = MultilayerPerceptronClassifier(**kw).fit(f)
+    m2 = MultilayerPerceptronClassifier(**kw).fit(f)
+    np.testing.assert_array_equal(m1.weights, m2.weights)
+
+
+def test_mlp_initial_weights_and_validation(mesh8):
+    f = _xor_data(200)
+    with pytest.raises(ValueError, match="layers\\[0\\]"):
+        MultilayerPerceptronClassifier(mesh=mesh8, layers=[3, 2], maxIter=1).fit(f)
+    with pytest.raises(ValueError, match="output layer"):
+        MultilayerPerceptronClassifier(mesh=mesh8, layers=[2, 1], maxIter=1).fit(f)
+    n_w = 2 * 8 + 8 + 8 * 2 + 2
+    m = MultilayerPerceptronClassifier(
+        mesh=mesh8, layers=[2, 8, 2], maxIter=0,
+        initialWeights=np.arange(n_w, dtype=np.float32) / n_w,
+    ).fit(f)
+    np.testing.assert_allclose(m.weights, np.arange(n_w) / n_w, rtol=1e-6)
+
+
+def test_mlp_gd_solver(mesh8):
+    f = _xor_data(800)
+    model = MultilayerPerceptronClassifier(
+        mesh=mesh8, layers=[2, 8, 2], maxIter=300, solver="gd", stepSize=0.5, seed=1
+    ).fit(f)
+    h = model.summary.objectiveHistory
+    assert h[-1] < h[0]
+
+
+def test_mlp_save_load(tmp_path, mesh8):
+    f = _xor_data(300)
+    m = MultilayerPerceptronClassifier(
+        mesh=mesh8, layers=[2, 6, 2], maxIter=20
+    ).fit(f)
+    save_model(m, str(tmp_path / "mlp"))
+    loaded = load_model(str(tmp_path / "mlp"))
+    np.testing.assert_array_equal(loaded.weights, m.weights)
+    np.testing.assert_array_equal(
+        loaded.transform(f)["prediction"], m.transform(f)["prediction"]
+    )

@@ -1,0 +1,101 @@
+"""Save/load round-trip contract for every stage (the DefaultReadWriteTest
+analog, SURVEY.md §4 item 3)."""
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.base import Pipeline, PipelineModel
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.feature import (
+    ChiSqSelector,
+    ChiSqSelectorModel,
+    IndexToString,
+    StandardScaler,
+    StringIndexer,
+    VectorAssembler,
+)
+from sntc_tpu.mlio import load_model, save_model
+from sntc_tpu.models import LogisticRegression
+from sntc_tpu.models.logistic_regression import LogisticRegressionModel
+
+
+def _roundtrip(stage, tmp_path, name):
+    path = str(tmp_path / name)
+    save_model(stage, path)
+    loaded = load_model(path)
+    assert type(loaded) is type(stage)
+    got, want = loaded.paramValues(), stage.paramValues()
+    got.pop("stages", None), want.pop("stages", None)  # objects; checked by caller
+    assert got == want
+    assert loaded.uid == stage.uid
+    return loaded
+
+
+def _frame(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (X[:, 0] + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+    labels = np.where(y > 0, "attack", "benign").astype(object)
+    return Frame({"features": X, "label": y, "labelStr": labels})
+
+
+def test_transformer_roundtrips(tmp_path):
+    _roundtrip(
+        VectorAssembler(inputCols=["a", "b"], handleInvalid="skip"),
+        tmp_path, "va",
+    )
+    _roundtrip(
+        IndexToString(inputCol="p", outputCol="s", labels=["x", "y"]),
+        tmp_path, "its",
+    )
+
+
+def test_fitted_model_roundtrips(tmp_path, mesh8):
+    f = _frame()
+    si = StringIndexer(inputCol="labelStr", outputCol="idx").fit(f)
+    si2 = _roundtrip(si, tmp_path, "si")
+    assert si2.labels == si.labels
+
+    sc = StandardScaler(mesh=mesh8, inputCol="features", outputCol="sf").fit(f)
+    sc2 = _roundtrip(sc, tmp_path, "sc")
+    np.testing.assert_array_equal(sc2.mean, sc.mean)
+    np.testing.assert_array_equal(sc2.std, sc.std)
+
+    cs = ChiSqSelector(mesh=mesh8, numTopFeatures=2, labelCol="label").fit(f)
+    cs2 = _roundtrip(cs, tmp_path, "cs")
+    assert cs2.selected_features == cs.selected_features
+
+    lr = LogisticRegression(mesh=mesh8, maxIter=20).fit(f)
+    lr2 = _roundtrip(lr, tmp_path, "lr")
+    assert isinstance(lr2, LogisticRegressionModel)
+    np.testing.assert_array_equal(lr2.coefficientMatrix, lr.coefficientMatrix)
+    out1, out2 = lr.transform(f), lr2.transform(f)
+    np.testing.assert_array_equal(out1["prediction"], out2["prediction"])
+
+
+def test_pipeline_model_roundtrip(tmp_path, mesh8):
+    f = _frame(seed=1)
+    pipe = Pipeline(stages=[
+        StandardScaler(mesh=mesh8, inputCol="features", outputCol="scaled"),
+        LogisticRegression(mesh=mesh8, featuresCol="scaled", maxIter=20),
+    ])
+    model = pipe.fit(f)
+    path = str(tmp_path / "pm")
+    model.save(path)
+    loaded = PipelineModel.load(path)
+    np.testing.assert_allclose(
+        loaded.transform(f)["prediction"], model.transform(f)["prediction"]
+    )
+    # unfitted Pipeline round-trips too
+    p2 = _roundtrip(Pipeline(stages=[VectorAssembler(inputCols=["a"])]), tmp_path, "p")
+    assert len(p2.getStages()) == 1
+
+
+def test_load_rejects_foreign_class(tmp_path):
+    import json, os
+    path = str(tmp_path / "evil")
+    os.makedirs(path)
+    with open(os.path.join(path, "metadata.json"), "w") as fh:
+        json.dump({"format_version": 1, "class": "os.path.join", "params": {}}, fh)
+    with pytest.raises(ValueError, match="outside sntc_tpu"):
+        load_model(path)

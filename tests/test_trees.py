@@ -1,0 +1,151 @@
+"""Tree oracle tests (SURVEY.md §4.2): split-for-split vs sklearn on tiny
+data with bins forced equal; behavioral (accuracy/AUC) parity on blobs."""
+
+import numpy as np
+import pytest
+from sklearn.ensemble import GradientBoostingClassifier as SkGBT
+from sklearn.tree import DecisionTreeClassifier as SkTree
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.mlio import load_model, save_model
+from sntc_tpu.models import (
+    GBTClassifier,
+    OneVsRest,
+    RandomForestClassifier,
+)
+from sntc_tpu.models.tree.grower import resolve_feature_subset_k
+
+
+def _blobs(n=4000, k=3, d=6, seed=0, scale=2.5):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, d)) * scale
+    y = rng.integers(0, k, size=n)
+    X = (centers[y] + rng.normal(size=(n, d))).astype(np.float32)
+    return Frame({"features": X, "label": y.astype(np.float64)}), X, y
+
+
+def test_feature_subset_strategy_resolution():
+    assert resolve_feature_subset_k("auto", 78, 20, True) == 9  # ceil(sqrt(78))
+    assert resolve_feature_subset_k("auto", 78, 1, True) == 78
+    assert resolve_feature_subset_k("auto", 78, 20, False) == 26
+    assert resolve_feature_subset_k("all", 78, 20, True) == 78
+    assert resolve_feature_subset_k("log2", 78, 20, True) == 6
+    assert resolve_feature_subset_k("0.5", 78, 20, True) == 39
+    assert resolve_feature_subset_k(10, 78, 20, True) == 10
+    with pytest.raises(ValueError):
+        resolve_feature_subset_k("bogus", 78, 20, True)
+
+
+def test_single_tree_matches_sklearn_splits(mesh8):
+    """One tree, all features, no bagging, fine bins -> same structure as a
+    depth-2 sklearn tree on well-separated data."""
+    f, X, y = _blobs(n=800, k=2, d=3, seed=1, scale=4.0)
+    rf = RandomForestClassifier(
+        mesh=mesh8, numTrees=1, maxDepth=2, maxBins=128, bootstrap=False,
+        featureSubsetStrategy="all", seed=0,
+    ).fit(f)
+    sk = SkTree(max_depth=2, criterion="gini").fit(X, y)
+    # root split feature must agree
+    assert rf.forest.feature[0, 0] == sk.tree_.feature[0]
+    # both thresholds cut in the same inter-cluster gap: the row partitions
+    # agree (exact threshold placement inside an empty gap is arbitrary)
+    ours_left = X[:, rf.forest.feature[0, 0]] < rf.forest.threshold[0, 0]
+    sk_left = X[:, sk.tree_.feature[0]] <= sk.tree_.threshold[0]
+    assert (ours_left == sk_left).mean() > 0.99
+    out = rf.transform(f)
+    sk_acc = (sk.predict(X) == y).mean()
+    our_acc = (out["prediction"] == y).mean()
+    assert abs(our_acc - sk_acc) < 0.02
+
+
+def test_rf_multiclass_accuracy(mesh8):
+    f, X, y = _blobs(n=5000, k=4, d=8, seed=2)
+    rf = RandomForestClassifier(
+        mesh=mesh8, numTrees=10, maxDepth=5, seed=3
+    ).fit(f)
+    out = rf.transform(f)
+    assert (out["prediction"] == y).mean() > 0.93
+    prob = out["probability"]
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, rtol=1e-5)
+    raw = out["rawPrediction"]
+    # raw = summed per-tree votes: rows sum to numTrees
+    np.testing.assert_allclose(raw.sum(axis=1), 10.0, rtol=1e-4)
+
+
+def test_rf_determinism_and_bagging_variation(mesh8):
+    f, X, y = _blobs(n=1000, k=3, seed=4)
+    kw = dict(mesh=mesh8, numTrees=5, maxDepth=3, seed=9)
+    m1 = RandomForestClassifier(**kw).fit(f)
+    m2 = RandomForestClassifier(**kw).fit(f)
+    np.testing.assert_array_equal(m1.forest.feature, m2.forest.feature)
+    # bootstrap trees differ from each other (bagging works)
+    assert not np.array_equal(m1.forest.feature[0], m1.forest.feature[1])
+
+
+def test_min_instances_and_gain_pruning(mesh8):
+    f, X, y = _blobs(n=300, k=2, d=3, seed=5)
+    deep = RandomForestClassifier(
+        mesh=mesh8, numTrees=1, maxDepth=6, bootstrap=False,
+        featureSubsetStrategy="all", minInstancesPerNode=100, seed=0,
+    ).fit(f)
+    # severe min-instances -> shallow effective tree: most slots never created
+    created = (deep.forest.feature[0] != -2).sum()
+    assert created < 15
+
+
+def test_gbt_binary_beats_baseline_and_matches_sklearn_behaviorally(mesh8):
+    f, X, y = _blobs(n=3000, k=2, d=6, seed=6, scale=1.5)
+    gbt = GBTClassifier(
+        mesh=mesh8, maxIter=15, maxDepth=3, stepSize=0.3, seed=1
+    ).fit(f)
+    out = gbt.transform(f)
+    our_acc = (out["prediction"] == y).mean()
+    sk = SkGBT(n_estimators=15, max_depth=3, learning_rate=0.3).fit(X, y)
+    sk_acc = (sk.predict(X) == y).mean()
+    assert our_acc > 0.93
+    assert abs(our_acc - sk_acc) < 0.03
+    prob = out["probability"]
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_gbt_rejects_multiclass(mesh8):
+    f, _, _ = _blobs(n=200, k=3)
+    with pytest.raises(ValueError, match="binary-only"):
+        GBTClassifier(mesh=mesh8, maxIter=2).fit(f)
+
+
+def test_ovr_gbt_multiclass(mesh8):
+    f, X, y = _blobs(n=2500, k=3, d=6, seed=7)
+    ovr = OneVsRest(
+        classifier=GBTClassifier(mesh=mesh8, maxIter=8, maxDepth=3, stepSize=0.3),
+    ).fit(f)
+    out = ovr.transform(f)
+    assert out["rawPrediction"].shape == (2500, 3)
+    assert (out["prediction"] == y).mean() > 0.9
+
+
+def test_tree_models_save_load(tmp_path, mesh8):
+    f, X, y = _blobs(n=600, k=3, seed=8)
+    rf = RandomForestClassifier(mesh=mesh8, numTrees=3, maxDepth=3, seed=0).fit(f)
+    save_model(rf, str(tmp_path / "rf"))
+    rf2 = load_model(str(tmp_path / "rf"))
+    np.testing.assert_array_equal(
+        rf2.transform(f)["prediction"], rf.transform(f)["prediction"]
+    )
+
+    f2, _, _ = _blobs(n=600, k=2, seed=9)
+    gbt = GBTClassifier(mesh=mesh8, maxIter=4, maxDepth=2, seed=0).fit(f2)
+    save_model(gbt, str(tmp_path / "gbt"))
+    gbt2 = load_model(str(tmp_path / "gbt"))
+    np.testing.assert_array_equal(
+        gbt2.transform(f2)["prediction"], gbt.transform(f2)["prediction"]
+    )
+
+    ovr = OneVsRest(
+        classifier=GBTClassifier(mesh=mesh8, maxIter=3, maxDepth=2)
+    ).fit(f)
+    save_model(ovr, str(tmp_path / "ovr"))
+    ovr2 = load_model(str(tmp_path / "ovr"))
+    np.testing.assert_array_equal(
+        ovr2.transform(f)["prediction"], ovr.transform(f)["prediction"]
+    )
