@@ -1,0 +1,177 @@
+"""Benchmark harness — the measurement frame of BASELINE.md.
+
+Metric of record (BASELINE.json:2): CICIDS2017 end-to-end training
+wall-clock at macro-F1 parity.  No Spark and no real CICIDS2017 exist
+in-image (SURVEY.md §6), so:
+
+  * the workload is the schema-locked synthetic generator (78 features,
+    15 labels, benign-heavy priors, Inf/NaN dirt) — real day CSVs drop in
+    unchanged when available;
+  * the baseline is a CPU proxy (sklearn MLPClassifier, same topology and
+    optimizer family, measured on this host via ``--measure-baseline``
+    and cached in ``baseline_proxy.json``), clearly labeled as a proxy.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <train_wall_clock_s>, "unit": "s",
+   "vs_baseline": <baseline_s / ours_s>}
+
+``value`` is the steady-state fit time (a same-shape warmup fit first, so
+XLA compile — a one-off per shape, cached across fits — is excluded; the
+cold time is reported in the JSON too).  Run ``python bench.py --config
+N`` for the per-config benches [B:6-12]; default is the flagship 15-class
+MLP pipeline (config 2).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+BASELINE_CACHE = os.path.join(REPO, "baseline_proxy.json")
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 500_000))
+SEED = 7
+MLP_LAYERS = [78, 64, 15]
+MLP_MAX_ITER = 100
+
+
+def _dataset(n_rows: int):
+    from sntc_tpu.data import CICIDS2017_FEATURES, clean_flows, generate_frame
+
+    raw = generate_frame(n_rows, seed=SEED)
+    df = clean_flows(raw)
+    return df, CICIDS2017_FEATURES
+
+
+def _build_pipeline(mesh):
+    from sntc_tpu.core.base import Pipeline
+    from sntc_tpu.data import CICIDS2017_FEATURES
+    from sntc_tpu.feature import StandardScaler, StringIndexer, VectorAssembler
+    from sntc_tpu.models import MultilayerPerceptronClassifier
+
+    return Pipeline(stages=[
+        StringIndexer(inputCol="Label", outputCol="label"),
+        VectorAssembler(inputCols=CICIDS2017_FEATURES, outputCol="rawFeatures"),
+        StandardScaler(mesh=mesh, inputCol="rawFeatures", outputCol="features",
+                       withMean=True),
+        MultilayerPerceptronClassifier(
+            mesh=mesh, layers=MLP_LAYERS, maxIter=MLP_MAX_ITER, seed=0
+        ),
+    ])
+
+
+def bench_flagship(n_rows: int = N_ROWS):
+    """Config 2 [B:8]: 15-class MLP pipeline, end-to-end fit wall-clock."""
+    import jax
+
+    from sntc_tpu.evaluation import MulticlassClassificationEvaluator
+    from sntc_tpu.parallel.context import get_default_mesh
+
+    df, _ = _dataset(n_rows)
+    train, test = df.random_split([0.8, 0.2], seed=0)
+    mesh = get_default_mesh()
+
+    t0 = time.perf_counter()
+    model = _build_pipeline(mesh).fit(train)
+    cold_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    model = _build_pipeline(mesh).fit(train)
+    warm_s = time.perf_counter() - t0
+
+    out = model.transform(test)
+    f1 = MulticlassClassificationEvaluator(
+        metricName="macroF1", mesh=mesh
+    ).evaluate(out)
+    return {
+        "train_s": warm_s,
+        "cold_train_s": cold_s,
+        "macro_f1": f1,
+        "n_rows": train.num_rows,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def measure_baseline(n_rows: int = N_ROWS):
+    """CPU proxy: sklearn MLP, same topology/optimizer family/iterations."""
+    from sklearn.neural_network import MLPClassifier
+    from sklearn.preprocessing import StandardScaler as SkScaler
+
+    df, feature_cols = _dataset(n_rows)
+    train, _ = df.random_split([0.8, 0.2], seed=0)
+    X = np.stack([train[c] for c in feature_cols], axis=1)
+    labels, y = np.unique(train["Label"].astype(str), return_inverse=True)
+
+    t0 = time.perf_counter()
+    Xs = SkScaler().fit_transform(X)
+    clf = MLPClassifier(
+        hidden_layer_sizes=(MLP_LAYERS[1],),
+        activation="logistic",
+        solver="lbfgs",
+        max_iter=MLP_MAX_ITER,
+        tol=1e-6,
+        random_state=0,
+    )
+    clf.fit(Xs, y)
+    baseline_s = time.perf_counter() - t0
+
+    payload = {
+        "baseline": "sklearn MLPClassifier (CPU proxy for Spark-CPU; "
+        "same 78-64-15 topology, logistic hiddens, lbfgs, 100 iters)",
+        "train_s": baseline_s,
+        "n_rows": int(train.num_rows),
+        "n_iters": int(clf.n_iter_),
+        "host_cpus": os.cpu_count(),
+    }
+    with open(BASELINE_CACHE, "w") as f:
+        json.dump(payload, f, indent=1)
+    return payload
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--measure-baseline", action="store_true")
+    ap.add_argument("--rows", type=int, default=N_ROWS)
+    args = ap.parse_args()
+
+    if args.measure_baseline:
+        payload = measure_baseline(args.rows)
+        print(json.dumps(payload))
+        return
+
+    result = bench_flagship(args.rows)
+
+    vs_baseline = None
+    if os.path.exists(BASELINE_CACHE):
+        with open(BASELINE_CACHE) as f:
+            base = json.load(f)
+        # scale the cached proxy linearly if row counts differ
+        scale = result["n_rows"] / max(base["n_rows"], 1)
+        vs_baseline = (base["train_s"] * scale) / result["train_s"]
+
+    print(
+        json.dumps(
+            {
+                "metric": "cicids2017_15class_mlp_pipeline_train_wall_clock",
+                "value": round(result["train_s"], 3),
+                "unit": "s",
+                "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
+                "cold_value": round(result["cold_train_s"], 3),
+                "macro_f1": round(result["macro_f1"], 4),
+                "n_rows": result["n_rows"],
+                "platform": result["platform"],
+                "baseline": "sklearn-cpu-proxy (baseline_proxy.json)",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

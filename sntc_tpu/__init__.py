@@ -15,9 +15,11 @@ Package map (SURVEY.md §7.0):
   ops/         device kernels: binned histograms, segment reductions
   models/      LogisticRegression, MLP, RandomForest, GBT, OneVsRest
   evaluation/  MulticlassMetrics (macro/weighted F1), BinaryClassificationEvaluator
+  tuning/      ParamGridBuilder, CrossValidator, TrainValidationSplit
   mlio/        model save/load manifests
-  tuning/, serve/, utils/ — planned: CrossValidator, streaming inference
-  bridge, JSONL metrics (SURVEY.md §7.1 steps 5-6)
+  serve/       Arrow batch-predict bridge, micro-batch streaming inference
+               with offset/commit exactly-once resume
+  utils/       structured JSONL metrics logging, profiling hooks
 """
 
 __version__ = "0.1.0"

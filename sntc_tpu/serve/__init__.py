@@ -1,0 +1,19 @@
+from sntc_tpu.serve.transform import BatchPredictor
+from sntc_tpu.serve.streaming import (
+    ConsoleSink,
+    CsvDirSink,
+    FileStreamSource,
+    MemorySink,
+    MemorySource,
+    StreamingQuery,
+)
+
+__all__ = [
+    "BatchPredictor",
+    "StreamingQuery",
+    "FileStreamSource",
+    "MemorySource",
+    "MemorySink",
+    "CsvDirSink",
+    "ConsoleSink",
+]

@@ -1,0 +1,239 @@
+"""Micro-batch streaming inference — the Structured-Streaming analog [B:11].
+
+Behavioral spec: SURVEY.md §3.5/§5.4 mechanism 3 (upstream
+``MicroBatchExecution`` + ``OffsetSeqLog``/``CommitLog`` [U]): the engine
+loop resolves the source's latest offset, write-ahead-logs the intended
+batch range (``offsets/<id>.json``), runs the batch through the model,
+hands it to the sink, then commits (``commits/<id>.json``).  On restart
+with the same checkpoint dir, an uncommitted intent is REPLAYED with its
+logged range, giving exactly-once batches w.r.t. the offset log — Spark's
+recovery contract.
+
+Sources implement ``latest_offset()`` and ``get_batch(start, end)`` over a
+monotonic integer offset (file count / row count — Spark's file-source
+model).  ``process_available()`` steps the engine deterministically (the
+``StreamTest`` harness analog, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from sntc_tpu.core.base import Transformer
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.data.ingest import load_csv
+from sntc_tpu.serve.transform import BatchPredictor
+
+
+# ---------------------------------------------------------------------------
+# sources
+# ---------------------------------------------------------------------------
+
+
+class StreamSource:
+    def latest_offset(self) -> int:
+        raise NotImplementedError
+
+    def get_batch(self, start: int, end: int) -> Frame:
+        raise NotImplementedError
+
+
+class FileStreamSource(StreamSource):
+    """Directory of flow CSVs; offset = count of files in sorted order
+    (the ``readStream`` file-source analog: new files are new data)."""
+
+    def __init__(self, path: str, pattern: str = "*.csv"):
+        self.path = path
+        self.pattern = pattern
+
+    def _files(self) -> List[str]:
+        return sorted(glob.glob(os.path.join(self.path, self.pattern)))
+
+    def latest_offset(self) -> int:
+        return len(self._files())
+
+    def get_batch(self, start: int, end: int) -> Frame:
+        files = self._files()[start:end]
+        if not files:
+            raise ValueError(f"empty batch range [{start}, {end})")
+        return Frame.concat_all([load_csv(p) for p in files])
+
+
+class MemorySource(StreamSource):
+    """In-memory list of Frames — the ``MemoryStream`` test analog."""
+
+    def __init__(self, frames: Optional[List[Frame]] = None):
+        self._frames: List[Frame] = list(frames or [])
+
+    def add(self, frame: Frame) -> None:
+        self._frames.append(frame)
+
+    def latest_offset(self) -> int:
+        return len(self._frames)
+
+    def get_batch(self, start: int, end: int) -> Frame:
+        return Frame.concat_all(self._frames[start:end])
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+
+class StreamSink:
+    def add_batch(self, batch_id: int, frame: Frame) -> None:
+        raise NotImplementedError
+
+
+class MemorySink(StreamSink):
+    def __init__(self):
+        self.batches: List[tuple] = []
+
+    def add_batch(self, batch_id: int, frame: Frame) -> None:
+        self.batches.append((batch_id, frame))
+
+    @property
+    def frames(self) -> List[Frame]:
+        return [f for _, f in self.batches]
+
+
+class CsvDirSink(StreamSink):
+    """One CSV per batch (append output mode)."""
+
+    def __init__(self, path: str, columns: Optional[List[str]] = None):
+        self.path = path
+        self.columns = columns
+        os.makedirs(path, exist_ok=True)
+
+    def add_batch(self, batch_id: int, frame: Frame) -> None:
+        import pyarrow.csv as pacsv
+
+        cols = self.columns or [
+            c for c in frame.columns if frame[c].ndim == 1
+        ]
+        pacsv.write_csv(
+            frame.select(cols).to_arrow(),
+            os.path.join(self.path, f"batch_{batch_id:06d}.csv"),
+        )
+
+
+class ConsoleSink(StreamSink):
+    def add_batch(self, batch_id: int, frame: Frame) -> None:
+        print(f"[batch {batch_id}] {frame}")
+
+
+# ---------------------------------------------------------------------------
+# the micro-batch engine
+# ---------------------------------------------------------------------------
+
+
+class StreamingQuery:
+    def __init__(
+        self,
+        model: Transformer,
+        source: StreamSource,
+        sink: StreamSink,
+        checkpoint_dir: str,
+        max_batch_offsets: Optional[int] = None,
+    ):
+        self.predictor = BatchPredictor(model)
+        self.source = source
+        self.sink = sink
+        self.checkpoint_dir = checkpoint_dir
+        self.max_batch_offsets = max_batch_offsets
+        self._stopped = False
+        self._offsets_dir = os.path.join(checkpoint_dir, "offsets")
+        self._commits_dir = os.path.join(checkpoint_dir, "commits")
+        os.makedirs(self._offsets_dir, exist_ok=True)
+        os.makedirs(self._commits_dir, exist_ok=True)
+
+    # -- checkpoint bookkeeping -------------------------------------------
+
+    def _log_ids(self, d: str) -> List[int]:
+        return sorted(
+            int(os.path.splitext(os.path.basename(p))[0])
+            for p in glob.glob(os.path.join(d, "*.json"))
+        )
+
+    def last_committed(self) -> int:
+        ids = self._log_ids(self._commits_dir)
+        return ids[-1] if ids else -1
+
+    def _committed_end(self) -> int:
+        last = self.last_committed()
+        if last < 0:
+            return 0
+        with open(os.path.join(self._commits_dir, f"{last}.json")) as f:
+            return json.load(f)["end"]
+
+    def _pending_intent(self, batch_id: int):
+        path = os.path.join(self._offsets_dir, f"{batch_id}.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                return json.load(f)
+        return None
+
+    # -- engine ------------------------------------------------------------
+
+    def _run_one_batch(self) -> bool:
+        """Run the next micro-batch; returns False if no new data."""
+        batch_id = self.last_committed() + 1
+        intent = self._pending_intent(batch_id)
+        if intent is None:
+            start = self._committed_end()
+            latest = self.source.latest_offset()
+            if latest <= start:
+                return False
+            end = latest
+            if self.max_batch_offsets is not None:
+                end = min(end, start + self.max_batch_offsets)
+            intent = {"batch_id": batch_id, "start": start, "end": end}
+            # intent WAL before any processing (OffsetSeqLog)
+            with open(
+                os.path.join(self._offsets_dir, f"{batch_id}.json"), "w"
+            ) as f:
+                json.dump(intent, f)
+
+        frame = self.source.get_batch(intent["start"], intent["end"])
+        out = self.predictor.predict_frame(frame)
+        self.sink.add_batch(batch_id, out)
+        with open(
+            os.path.join(self._commits_dir, f"{batch_id}.json"), "w"
+        ) as f:
+            json.dump(intent, f)
+        return True
+
+    def process_available(self) -> int:
+        """Deterministically drain all currently-available data; returns the
+        number of batches run (test/step API)."""
+        n = 0
+        while not self._stopped and self._run_one_batch():
+            n += 1
+        return n
+
+    def run(
+        self,
+        poll_interval: float = 1.0,
+        max_batches: Optional[int] = None,
+    ) -> int:
+        """Continuous micro-batch loop (the ``writeStream.start()`` analog,
+        in the foreground)."""
+        done = 0
+        while not self._stopped:
+            ran = self._run_one_batch()
+            if ran:
+                done += 1
+                if max_batches is not None and done >= max_batches:
+                    break
+            else:
+                time.sleep(poll_interval)
+        return done
+
+    def stop(self) -> None:
+        self._stopped = True

@@ -1,0 +1,48 @@
+"""BatchPredictor — the pandas_udf-style Arrow inference bridge [B:5].
+
+Behavioral spec: SURVEY.md §2.6/§3.4: Spark serves ``model.transform`` row
+batches through the executor→Python-worker Arrow socket protocol
+(``ArrowPythonRunner``).  Here the bridge is direct: Arrow RecordBatch →
+numpy → jitted predict (the model's device compute) → Arrow, chunked to
+bound device memory.  No sockets, no serialization boundary — the
+"pandas_udf-shaped bridge" of SURVEY.md §5.8 collapsed to a function call.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Union
+
+import pyarrow as pa
+
+from sntc_tpu.core.base import Transformer
+from sntc_tpu.core.frame import Frame
+
+
+class BatchPredictor:
+    """Wrap a fitted model/pipeline for Arrow-batch inference."""
+
+    def __init__(self, model: Transformer, chunk_rows: int = 131_072):
+        self.model = model
+        self.chunk_rows = int(chunk_rows)
+
+    def predict_frame(self, frame: Frame) -> Frame:
+        if frame.num_rows <= self.chunk_rows:
+            return self.model.transform(frame)
+        parts = [
+            self.model.transform(frame.slice(s, min(s + self.chunk_rows, frame.num_rows)))
+            for s in range(0, frame.num_rows, self.chunk_rows)
+        ]
+        return Frame.concat_all(parts)
+
+    def predict_batch(
+        self, batch: Union[pa.RecordBatch, pa.Table]
+    ) -> pa.Table:
+        return self.predict_frame(Frame.from_arrow(batch)).to_arrow()
+
+    def predict_batches(
+        self, batches: Iterator[pa.RecordBatch]
+    ) -> Iterator[pa.Table]:
+        for batch in batches:
+            yield self.predict_batch(batch)
+
+    __call__ = predict_frame

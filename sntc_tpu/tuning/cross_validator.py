@@ -1,0 +1,194 @@
+"""Model tuning — ParamGridBuilder / CrossValidator / TrainValidationSplit.
+
+Behavioral spec: SURVEY.md §2.4 (upstream ``ml/tuning/CrossValidator.scala``
+[U]): k-fold × param-grid search, metric averaged over folds per grid
+point, best point refit on the full data; ``TrainValidationSplit`` is the
+single-split variant.  ``parallelism`` is accepted for API parity — each
+fit already saturates the mesh, so grid points run sequentially (the
+thread-pool existed to overlap Spark job scheduling, SURVEY.md §2.5 "task
+parallelism").
+"""
+
+from __future__ import annotations
+
+from itertools import product
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from sntc_tpu.core.base import Estimator, Model
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+
+
+class ParamGridBuilder:
+    def __init__(self):
+        self._grid: Dict[str, List[Any]] = {}
+
+    def addGrid(self, param, values) -> "ParamGridBuilder":
+        name = param if isinstance(param, str) else param.name
+        self._grid[name] = list(values)
+        return self
+
+    def baseOn(self, **fixed) -> "ParamGridBuilder":
+        for k, v in fixed.items():
+            self._grid[k] = [v]
+        return self
+
+    def build(self) -> List[Dict[str, Any]]:
+        if not self._grid:
+            return [{}]
+        names = list(self._grid)
+        return [
+            dict(zip(names, combo))
+            for combo in product(*(self._grid[n] for n in names))
+        ]
+
+
+class _TuningParams:
+    numFolds = Param("cross-validation folds", default=3, validator=validators.gteq(2))
+    seed = Param("fold split seed", default=0)
+    parallelism = Param(
+        "API parity only; fits already saturate the mesh", default=1,
+        validator=validators.gteq(1),
+    )
+    collectSubModels = Param("keep every (fold, grid) sub-model", default=False,
+                             validator=validators.is_bool())
+
+
+class CrossValidator(_TuningParams, Estimator):
+    def __init__(self, estimator=None, estimatorParamMaps=None, evaluator=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if estimator is None or evaluator is None:
+            raise ValueError("CrossValidator requires estimator and evaluator")
+        self.estimator = estimator
+        self.estimatorParamMaps = estimatorParamMaps or [{}]
+        self.evaluator = evaluator
+
+    def _fit(self, frame: Frame) -> "CrossValidatorModel":
+        k = self.getNumFolds()
+        rng = np.random.default_rng(self.getSeed())
+        fold_of = rng.integers(0, k, size=frame.num_rows)
+        grid = self.estimatorParamMaps
+        metrics = np.zeros((len(grid), k))
+        sub_models: Optional[List[List[Model]]] = (
+            [[] for _ in grid] if self.getCollectSubModels() else None
+        )
+
+        for fold in range(k):
+            train = frame.filter(fold_of != fold)
+            valid = frame.filter(fold_of == fold)
+            for gi, params in enumerate(grid):
+                model = self.estimator.copy(params).fit(train)
+                metrics[gi, fold] = self.evaluator.evaluate(
+                    model.transform(valid)
+                )
+                if sub_models is not None:
+                    sub_models[gi].append(model)
+
+        avg = metrics.mean(axis=1)
+        best_idx = (
+            int(np.argmax(avg))
+            if self.evaluator.isLargerBetter()
+            else int(np.argmin(avg))
+        )
+        best_model = self.estimator.copy(grid[best_idx]).fit(frame)
+        return CrossValidatorModel(
+            bestModel=best_model,
+            avgMetrics=avg.tolist(),
+            bestIndex=best_idx,
+            subModels=sub_models,
+        )
+
+
+class CrossValidatorModel(Model):
+    def __init__(self, bestModel: Model = None, avgMetrics: List[float] = None,
+                 bestIndex: int = 0, subModels=None, **kwargs):
+        super().__init__(**kwargs)
+        self.bestModel = bestModel
+        self.avgMetrics = avgMetrics or []
+        self.bestIndex = bestIndex
+        self.subModels = subModels
+
+    def transform(self, frame: Frame) -> Frame:
+        return self.bestModel.transform(frame)
+
+    def _sub_stages(self):
+        return [self.bestModel]
+
+    def _save_extra(self):
+        return {"avgMetrics": self.avgMetrics, "bestIndex": self.bestIndex}, {}
+
+    @classmethod
+    def _from_sub_stages(cls, stages, params):
+        obj = cls(bestModel=stages[0])
+        obj.setParams(**params)
+        return obj
+
+
+class _TvsParams:
+    trainRatio = Param("train fraction", default=0.75, validator=validators.in_range(0, 1))
+    seed = Param("split seed", default=0)
+    parallelism = Param("API parity only", default=1, validator=validators.gteq(1))
+
+
+class TrainValidationSplit(_TvsParams, Estimator):
+    def __init__(self, estimator=None, estimatorParamMaps=None, evaluator=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if estimator is None or evaluator is None:
+            raise ValueError(
+                "TrainValidationSplit requires estimator and evaluator"
+            )
+        self.estimator = estimator
+        self.estimatorParamMaps = estimatorParamMaps or [{}]
+        self.evaluator = evaluator
+
+    def _fit(self, frame: Frame) -> "TrainValidationSplitModel":
+        ratio = self.getTrainRatio()
+        train, valid = frame.random_split(
+            [ratio, 1 - ratio], seed=self.getSeed()
+        )
+        grid = self.estimatorParamMaps
+        metrics = []
+        for params in grid:
+            model = self.estimator.copy(params).fit(train)
+            metrics.append(self.evaluator.evaluate(model.transform(valid)))
+        arr = np.asarray(metrics)
+        best_idx = (
+            int(np.argmax(arr))
+            if self.evaluator.isLargerBetter()
+            else int(np.argmin(arr))
+        )
+        best_model = self.estimator.copy(grid[best_idx]).fit(frame)
+        return TrainValidationSplitModel(
+            bestModel=best_model, validationMetrics=metrics, bestIndex=best_idx
+        )
+
+
+class TrainValidationSplitModel(Model):
+    def __init__(self, bestModel: Model = None, validationMetrics=None,
+                 bestIndex: int = 0, **kwargs):
+        super().__init__(**kwargs)
+        self.bestModel = bestModel
+        self.validationMetrics = validationMetrics or []
+        self.bestIndex = bestIndex
+
+    def transform(self, frame: Frame) -> Frame:
+        return self.bestModel.transform(frame)
+
+    def _sub_stages(self):
+        return [self.bestModel]
+
+    def _save_extra(self):
+        return {
+            "validationMetrics": self.validationMetrics,
+            "bestIndex": self.bestIndex,
+        }, {}
+
+    @classmethod
+    def _from_sub_stages(cls, stages, params):
+        obj = cls(bestModel=stages[0])
+        obj.setParams(**params)
+        return obj

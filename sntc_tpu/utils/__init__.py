@@ -1,0 +1,4 @@
+from sntc_tpu.utils.logging import MetricsLogger
+from sntc_tpu.utils.profiling import profile_trace, StepTimer
+
+__all__ = ["MetricsLogger", "profile_trace", "StepTimer"]

@@ -1,0 +1,47 @@
+"""Structured JSONL metrics logging — the MetricsSystem/event-log analog.
+
+Behavioral spec: SURVEY.md §5.5: Spark exposes Codahale metrics sinks and
+JSON event logs; MLlib models keep ``objectiveHistory``.  Here: an
+append-only JSONL event stream (one object per line: monotonic step,
+wall-clock, arbitrary scalar fields) that tooling can tail — plus the
+models' ``summary.objectiveHistory`` (API parity, implemented on each
+estimator).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+
+class MetricsLogger:
+    """Append-only JSONL logger: ``logger.log(event="fit", loss=0.3)``."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._step = 0
+        self._t0 = time.perf_counter()
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            # truncate: one run per file
+            open(path, "w").close()
+
+    def log(self, **fields: Any) -> Dict[str, Any]:
+        record = {
+            "step": self._step,
+            "elapsed_s": round(time.perf_counter() - self._t0, 6),
+            **fields,
+        }
+        self._step += 1
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        return record
+
+    def read_all(self):
+        if not self.path or not os.path.exists(self.path):
+            return []
+        with open(self.path) as f:
+            return [json.loads(line) for line in f if line.strip()]

@@ -1,0 +1,48 @@
+"""Profiling hooks — the SparkListener/Web-UI timeline analog.
+
+Behavioral spec: SURVEY.md §5.1: Spark's per-stage timelines come from the
+listener bus; the TPU-native equivalents are (a) ``jax.profiler`` traces
+viewable in TensorBoard/Perfetto (XLA op-level — far deeper than Spark's
+stage view) and (b) a lightweight wall-clock step timer for the
+host-visible phases (ingest, fit, transform).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """``with profile_trace("/tmp/trace"):`` — captures an XLA profiler
+    trace for TensorBoard/Perfetto."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Named wall-clock phases: ``with timer.phase("fit"): ...``."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self) -> Dict[str, float]:
+        return dict(sorted(self.totals.items(), key=lambda kv: -kv[1]))

@@ -1,0 +1,147 @@
+"""Streaming engine tests — the StreamTest/MemoryStream analog (SURVEY.md §4
+item 4): deterministic stepping, stop/restart with the same checkpoint dir,
+exactly-once delivery, crash-after-intent replay."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.data import generate_frame, write_day_csvs
+from sntc_tpu.models import LogisticRegression
+from sntc_tpu.serve import (
+    BatchPredictor,
+    CsvDirSink,
+    FileStreamSource,
+    MemorySink,
+    MemorySource,
+    StreamingQuery,
+)
+
+
+@pytest.fixture(scope="module")
+def model(mesh8):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(800, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    return LogisticRegression(mesh=mesh8, maxIter=30).fit(
+        Frame({"features": X, "label": y})
+    )
+
+
+def _batch(n, seed):
+    rng = np.random.default_rng(seed)
+    return Frame({"features": rng.normal(size=(n, 4)).astype(np.float32)})
+
+
+def test_batch_predictor_chunks(model):
+    f = _batch(1000, 1)
+    out = BatchPredictor(model, chunk_rows=128).predict_frame(f)
+    ref = model.transform(f)
+    np.testing.assert_array_equal(out["prediction"], ref["prediction"])
+    # arrow roundtrip path
+    table = BatchPredictor(model).predict_batch(f.to_arrow())
+    assert "prediction" in table.column_names
+
+
+def test_streaming_processes_available_batches(model, tmp_path):
+    src = MemorySource([_batch(50, 1), _batch(60, 2)])
+    sink = MemorySink()
+    q = StreamingQuery(model, src, sink, str(tmp_path / "ckpt"))
+    assert q.process_available() == 1  # both frames drained in one batch
+    assert sink.frames[0].num_rows == 110
+    # new data arrives -> next batch only covers the delta
+    src.add(_batch(30, 3))
+    assert q.process_available() == 1
+    assert sink.frames[1].num_rows == 30
+    assert q.process_available() == 0
+
+
+def test_streaming_resume_no_duplicates(model, tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    src = MemorySource([_batch(40, 1)])
+    sink1 = MemorySink()
+    q1 = StreamingQuery(model, src, sink1, ckpt)
+    q1.process_available()
+    q1.stop()
+
+    # restart with same checkpoint: already-committed data is NOT reprocessed
+    sink2 = MemorySink()
+    q2 = StreamingQuery(model, src, sink2, ckpt)
+    assert q2.process_available() == 0
+    src.add(_batch(25, 2))
+    assert q2.process_available() == 1
+    assert [f.num_rows for f in sink2.frames] == [25]
+
+
+def test_streaming_crash_after_intent_replays_exact_range(model, tmp_path):
+    """Intent logged but uncommitted (crash between WAL and commit) -> the
+    restarted query replays EXACTLY the logged range, even though more data
+    arrived meanwhile (Spark's OffsetSeqLog recovery contract)."""
+    ckpt = str(tmp_path / "ckpt")
+    src = MemorySource([_batch(10, 1), _batch(20, 2)])
+    os.makedirs(os.path.join(ckpt, "offsets"))
+    os.makedirs(os.path.join(ckpt, "commits"))
+    with open(os.path.join(ckpt, "offsets", "0.json"), "w") as f:
+        json.dump({"batch_id": 0, "start": 0, "end": 1}, f)
+    src.add(_batch(30, 3))  # late arrival
+
+    sink = MemorySink()
+    q = StreamingQuery(model, src, sink, ckpt)
+    assert q.process_available() == 2
+    # batch 0 replayed with the OLD range (first frame only), batch 1 gets the rest
+    assert [f.num_rows for f in sink.frames] == [10, 50]
+
+
+def test_streaming_max_batch_offsets(model, tmp_path):
+    src = MemorySource([_batch(5, i) for i in range(4)])
+    sink = MemorySink()
+    q = StreamingQuery(
+        model, src, sink, str(tmp_path / "ckpt"), max_batch_offsets=1
+    )
+    assert q.process_available() == 4  # one source offset per micro-batch
+    assert [f.num_rows for f in sink.frames] == [5, 5, 5, 5]
+
+
+def test_file_source_and_csv_sink(model, tmp_path, mesh8):
+    """End-to-end config-5: CSV files stream in, predictions stream out,
+    with offset/commit resume across query restarts [B:11]."""
+    from sntc_tpu.data import CICIDS2017_FEATURES, clean_flows
+    from sntc_tpu.core.base import Pipeline
+    from sntc_tpu.feature import StandardScaler, StringIndexer, VectorAssembler
+
+    train = clean_flows(generate_frame(3000, seed=5))
+    train = train.with_column(
+        "binLabel",
+        np.where(train["Label"].astype(str) == "BENIGN", "benign", "attack").astype(object),
+    )
+    pipe_model = Pipeline(stages=[
+        StringIndexer(inputCol="binLabel", outputCol="label"),
+        VectorAssembler(inputCols=CICIDS2017_FEATURES, outputCol="features",
+                        handleInvalid="skip"),
+        LogisticRegression(mesh=mesh8, maxIter=30),
+    ]).fit(train)
+    # serving pipeline: drop the indexer (no labels on live flows)
+    from sntc_tpu.core.base import PipelineModel
+    serve_model = PipelineModel(stages=pipe_model.getStages()[1:])
+
+    in_dir, out_dir = str(tmp_path / "in"), str(tmp_path / "out")
+    write_day_csvs(in_dir, n_rows_per_day=40, n_days=2, seed=6)
+    q = StreamingQuery(
+        serve_model,
+        FileStreamSource(in_dir),
+        CsvDirSink(out_dir, columns=["prediction"]),
+        str(tmp_path / "ckpt"),
+    )
+    assert q.process_available() == 1
+    # two more day files land -> one more batch after "restart"
+    write_day_csvs(in_dir, n_rows_per_day=40, n_days=4, seed=6)
+    q2 = StreamingQuery(
+        serve_model, FileStreamSource(in_dir),
+        CsvDirSink(out_dir, columns=["prediction"]), str(tmp_path / "ckpt"),
+    )
+    assert q2.process_available() == 1
+    outs = sorted(os.listdir(out_dir))
+    assert outs == ["batch_000000.csv", "batch_000001.csv"]
