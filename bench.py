@@ -1,25 +1,28 @@
 """Benchmark harness — the measurement frame of BASELINE.md.
 
 Metric of record (BASELINE.json:2): CICIDS2017 end-to-end training
-wall-clock at macro-F1 parity.  No Spark and no real CICIDS2017 exist
-in-image (SURVEY.md §6), so:
+wall-clock at macro-F1 parity, over the five reference configs [B:6-12]:
 
-  * the workload is the schema-locked synthetic generator (78 features,
-    15 labels, benign-heavy priors, Inf/NaN dirt) — real day CSVs drop in
-    unchanged when available;
-  * the baseline is a CPU proxy (sklearn MLPClassifier, same topology and
-    optimizer family, measured on this host via ``--measure-baseline``
-    and cached in ``baseline_proxy.json``), clearly labeled as a proxy.
+  1  LogisticRegression binary (benign vs attack, 2-day subset)
+  2  MultilayerPerceptronClassifier 15-class  (the flagship / default)
+  3  RandomForestClassifier + ChiSqSelector
+  4  GBTClassifier one-vs-rest, all days (15-class)
+  5  Structured-streaming inference micro-batches (rows/s)
 
-Prints ONE JSON line:
+No Spark and no real CICIDS2017 exist in-image (SURVEY.md §6), so the
+workload is the schema-locked synthetic generator (real day CSVs drop in
+unchanged) and the baseline is a CPU proxy (sklearn, same algorithm family
+and budget, measured on this host with ``--measure-baseline`` and cached
+in ``baseline_proxy.json`` — labeled as a proxy).
+
+stdout is ONE JSON line for the selected config (default: 2):
   {"metric": ..., "value": <train_wall_clock_s>, "unit": "s",
-   "vs_baseline": <baseline_s / ours_s>}
+   "vs_baseline": <baseline_s / ours_s>, ...}
 
-``value`` is the steady-state fit time (a same-shape warmup fit first, so
-XLA compile — a one-off per shape, cached across fits — is excluded; the
-cold time is reported in the JSON too).  Run ``python bench.py --config
-N`` for the per-config benches [B:6-12]; default is the flagship 15-class
-MLP pipeline (config 2).
+``value`` is steady-state fit time (a same-shape warmup fit first: XLA
+compile is one-off per shape and cached across fits; the cold time is also
+reported).  ``--config all`` prints every config, one JSON line each, the
+flagship line LAST (so the driver's one-line contract still reads config 2).
 """
 
 from __future__ import annotations
@@ -36,141 +39,415 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 BASELINE_CACHE = os.path.join(REPO, "baseline_proxy.json")
 
-N_ROWS = int(os.environ.get("BENCH_ROWS", 500_000))
 SEED = 7
 MLP_LAYERS = [78, 64, 15]
 MLP_MAX_ITER = 100
+LR_MAX_ITER = 100
+RF_TREES, RF_DEPTH = 20, 5
+CHISQ_TOP = 40
+GBT_ROUNDS, GBT_DEPTH = 10, 4
+
+DEFAULT_ROWS = {
+    "1": int(os.environ.get("BENCH_ROWS", 500_000)) // 2,
+    "2": int(os.environ.get("BENCH_ROWS", 500_000)),
+    "3": int(os.environ.get("BENCH_ROWS", 500_000)) // 2,
+    "4": int(os.environ.get("BENCH_ROWS", 500_000)) // 4,
+    "5": int(os.environ.get("BENCH_ROWS", 500_000)) // 4,
+}
 
 
-def _dataset(n_rows: int):
-    from sntc_tpu.data import CICIDS2017_FEATURES, clean_flows, generate_frame
+def _dataset(n_rows: int, binary: bool = False):
+    from sntc_tpu.data import clean_flows, generate_frame
 
-    raw = generate_frame(n_rows, seed=SEED)
-    df = clean_flows(raw)
-    return df, CICIDS2017_FEATURES
+    df = clean_flows(generate_frame(n_rows, seed=SEED))
+    if binary:
+        df = df.with_column(
+            "Label",
+            np.where(
+                df["Label"].astype(str) == "BENIGN", "benign", "attack"
+            ).astype(object),
+        )
+    return df.random_split([0.8, 0.2], seed=0)
 
 
-def _build_pipeline(mesh):
-    from sntc_tpu.core.base import Pipeline
+def _feature_stages(mesh, with_scaler=True):
     from sntc_tpu.data import CICIDS2017_FEATURES
     from sntc_tpu.feature import StandardScaler, StringIndexer, VectorAssembler
-    from sntc_tpu.models import MultilayerPerceptronClassifier
 
-    return Pipeline(stages=[
+    stages = [
         StringIndexer(inputCol="Label", outputCol="label"),
         VectorAssembler(inputCols=CICIDS2017_FEATURES, outputCol="rawFeatures"),
-        StandardScaler(mesh=mesh, inputCol="rawFeatures", outputCol="features",
-                       withMean=True),
-        MultilayerPerceptronClassifier(
-            mesh=mesh, layers=MLP_LAYERS, maxIter=MLP_MAX_ITER, seed=0
-        ),
-    ])
+    ]
+    if with_scaler:
+        stages.append(
+            StandardScaler(mesh=mesh, inputCol="rawFeatures",
+                           outputCol="features", withMean=True)
+        )
+    return stages
 
 
-def bench_flagship(n_rows: int = N_ROWS):
-    """Config 2 [B:8]: 15-class MLP pipeline, end-to-end fit wall-clock."""
+def _timed_fit(build_pipeline, train):
+    t0 = time.perf_counter()
+    build_pipeline().fit(train)
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    model = build_pipeline().fit(train)
+    warm = time.perf_counter() - t0
+    return model, warm, cold
+
+
+def _evaluate(model, test, mesh, metric="macroF1"):
+    from sntc_tpu.evaluation import MulticlassClassificationEvaluator
+
+    return MulticlassClassificationEvaluator(
+        metricName=metric, mesh=mesh
+    ).evaluate(model.transform(test))
+
+
+# ---------------------------------------------------------------------------
+# per-config benches: each returns {metric, value(s), quality, n_rows}
+# ---------------------------------------------------------------------------
+
+
+def bench_config1(n_rows, mesh):
+    from sntc_tpu.core.base import Pipeline
+    from sntc_tpu.evaluation import BinaryClassificationEvaluator
+    from sntc_tpu.models import LogisticRegression
+
+    train, test = _dataset(n_rows, binary=True)
+
+    def build():
+        return Pipeline(stages=_feature_stages(mesh) + [
+            LogisticRegression(mesh=mesh, maxIter=LR_MAX_ITER, regParam=1e-4)
+        ])
+
+    model, warm, cold = _timed_fit(build, train)
+    auc = BinaryClassificationEvaluator().evaluate(model.transform(test))
+    return {
+        "metric": "cicids2017_binary_lr_train_wall_clock",
+        "value": warm, "cold_value": cold,
+        "quality": {"areaUnderROC": auc},
+        "n_rows": train.num_rows,
+    }
+
+
+def bench_config2(n_rows, mesh):
+    from sntc_tpu.core.base import Pipeline
+    from sntc_tpu.models import MultilayerPerceptronClassifier
+
+    train, test = _dataset(n_rows)
+
+    def build():
+        return Pipeline(stages=_feature_stages(mesh) + [
+            MultilayerPerceptronClassifier(
+                mesh=mesh, layers=MLP_LAYERS, maxIter=MLP_MAX_ITER, seed=0
+            )
+        ])
+
+    model, warm, cold = _timed_fit(build, train)
+    f1 = _evaluate(model, test, mesh)
+    return {
+        "metric": "cicids2017_15class_mlp_pipeline_train_wall_clock",
+        "value": warm, "cold_value": cold,
+        "quality": {"macro_f1": f1},
+        "n_rows": train.num_rows,
+    }
+
+
+def bench_config3(n_rows, mesh):
+    from sntc_tpu.core.base import Pipeline
+    from sntc_tpu.feature import ChiSqSelector
+    from sntc_tpu.models import RandomForestClassifier
+
+    train, test = _dataset(n_rows)
+
+    def build():
+        return Pipeline(stages=_feature_stages(mesh, with_scaler=False) + [
+            ChiSqSelector(mesh=mesh, numTopFeatures=CHISQ_TOP,
+                          featuresCol="rawFeatures", labelCol="label",
+                          outputCol="features"),
+            RandomForestClassifier(mesh=mesh, numTrees=RF_TREES,
+                                   maxDepth=RF_DEPTH, seed=0),
+        ])
+
+    model, warm, cold = _timed_fit(build, train)
+    f1 = _evaluate(model, test, mesh)
+    return {
+        "metric": "cicids2017_rf_chisq_train_wall_clock",
+        "value": warm, "cold_value": cold,
+        "quality": {"macro_f1": f1},
+        "n_rows": train.num_rows,
+    }
+
+
+def bench_config4(n_rows, mesh):
+    from sntc_tpu.core.base import Pipeline
+    from sntc_tpu.models import GBTClassifier, OneVsRest
+
+    train, test = _dataset(n_rows)
+
+    def build():
+        return Pipeline(stages=_feature_stages(mesh, with_scaler=False) + [
+            OneVsRest(
+                classifier=GBTClassifier(
+                    mesh=mesh, maxIter=GBT_ROUNDS, maxDepth=GBT_DEPTH,
+                    stepSize=0.1, seed=0,
+                ),
+                featuresCol="rawFeatures",
+            )
+        ])
+
+    model, warm, cold = _timed_fit(build, train)
+    f1 = _evaluate(model, test, mesh)
+    return {
+        "metric": "cicids2017_gbt_ovr_train_wall_clock",
+        "value": warm, "cold_value": cold,
+        "quality": {"macro_f1": f1},
+        "n_rows": train.num_rows,
+    }
+
+
+def bench_config5(n_rows, mesh):
+    """Streaming inference throughput: rows/s through the micro-batch
+    engine (model fit excluded — serving is the workload [B:11])."""
+    import shutil
+    import tempfile
+
+    from sntc_tpu.core.base import Pipeline, PipelineModel
+    from sntc_tpu.models import LogisticRegression
+    from sntc_tpu.serve import MemorySink, MemorySource, StreamingQuery
+
+    train, test = _dataset(n_rows, binary=True)
+    pipe = Pipeline(stages=_feature_stages(mesh) + [
+        LogisticRegression(mesh=mesh, maxIter=20)
+    ]).fit(train)
+    serve_model = PipelineModel(stages=pipe.getStages()[1:])  # no indexer
+
+    n_batches = 20
+    per = max(test.num_rows // n_batches, 1)
+    batches = [
+        test.slice(i * per, min((i + 1) * per, test.num_rows))
+        for i in range(n_batches)
+    ]
+    tmp = tempfile.mkdtemp()
+    try:
+        # warmup (compile) on one batch
+        q0 = StreamingQuery(
+            serve_model, MemorySource(batches[:1]), MemorySink(),
+            os.path.join(tmp, "warm"),
+        )
+        q0.process_available()
+        src = MemorySource(batches)
+        sink = MemorySink()
+        q = StreamingQuery(
+            serve_model, src, sink, os.path.join(tmp, "ckpt"),
+            max_batch_offsets=1,
+        )
+        t0 = time.perf_counter()
+        n_done = q.process_available()
+        dt = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    rows = sum(f.num_rows for f in sink.frames)
+    return {
+        "metric": "cicids2017_streaming_inference_rows_per_s",
+        "value": rows / dt, "unit": "rows/s",
+        "quality": {"micro_batches": n_done},
+        "n_rows": rows,
+    }
+
+
+BENCHES = {
+    "1": bench_config1,
+    "2": bench_config2,
+    "3": bench_config3,
+    "4": bench_config4,
+    "5": bench_config5,
+}
+
+
+# ---------------------------------------------------------------------------
+# CPU proxy baselines (sklearn) — measured once, cached
+# ---------------------------------------------------------------------------
+
+
+def _proxy_xy(train):
+    from sntc_tpu.data import CICIDS2017_FEATURES
+
+    X = np.stack([train[c] for c in CICIDS2017_FEATURES], axis=1)
+    _, y = np.unique(train["Label"].astype(str), return_inverse=True)
+    return X, y
+
+
+def measure_baseline(configs, rows):
+    from sklearn.ensemble import (
+        GradientBoostingClassifier,
+        RandomForestClassifier as SkRF,
+    )
+    from sklearn.feature_selection import SelectKBest, chi2
+    from sklearn.linear_model import LogisticRegression as SkLR
+    from sklearn.multiclass import OneVsRestClassifier
+    from sklearn.neural_network import MLPClassifier
+    from sklearn.preprocessing import MinMaxScaler, StandardScaler as SkScaler
+
+    cache = {}
+    if os.path.exists(BASELINE_CACHE):
+        with open(BASELINE_CACHE) as f:
+            cache = json.load(f)
+
+    def record(cfg, desc, fn, train):
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        cache[cfg] = {
+            "baseline": f"sklearn CPU proxy: {desc}",
+            "train_s": dt,
+            "n_rows": int(train.num_rows),
+            "host_cpus": os.cpu_count(),
+        }
+        print(f"baseline config {cfg}: {dt:.1f}s", file=sys.stderr)
+
+    for cfg in configs:
+        n = rows or DEFAULT_ROWS[cfg]
+        if cfg == "1":
+            train, _ = _dataset(n, binary=True)
+            X, y = _proxy_xy(train)
+            record(
+                "1", "LogisticRegression lbfgs, standardized",
+                lambda: SkLR(max_iter=LR_MAX_ITER, tol=1e-6).fit(
+                    SkScaler().fit_transform(X), y
+                ),
+                train,
+            )
+        elif cfg == "2":
+            train, _ = _dataset(n)
+            X, y = _proxy_xy(train)
+            record(
+                "2", "MLPClassifier 78-64-15 logistic lbfgs 100 iters",
+                lambda: MLPClassifier(
+                    hidden_layer_sizes=(MLP_LAYERS[1],), activation="logistic",
+                    solver="lbfgs", max_iter=MLP_MAX_ITER, tol=1e-6,
+                    random_state=0,
+                ).fit(SkScaler().fit_transform(X), y),
+                train,
+            )
+        elif cfg == "3":
+            train, _ = _dataset(n)
+            X, y = _proxy_xy(train)
+
+            def fit_rf():
+                Xs = SelectKBest(chi2, k=CHISQ_TOP).fit_transform(
+                    MinMaxScaler().fit_transform(X), y
+                )
+                SkRF(
+                    n_estimators=RF_TREES, max_depth=RF_DEPTH, n_jobs=-1,
+                    random_state=0,
+                ).fit(Xs, y)
+
+            record("3", f"SelectKBest(chi2,k={CHISQ_TOP}) + RF", fit_rf, train)
+        elif cfg == "4":
+            train, _ = _dataset(n)
+            X, y = _proxy_xy(train)
+            record(
+                "4", f"OneVsRest(GradientBoosting x{GBT_ROUNDS})",
+                lambda: OneVsRestClassifier(
+                    GradientBoostingClassifier(
+                        n_estimators=GBT_ROUNDS, max_depth=GBT_DEPTH,
+                        learning_rate=0.1, random_state=0,
+                    )
+                ).fit(X, y),
+                train,
+            )
+        elif cfg == "5":
+            train, test = _dataset(n, binary=True)
+            X, y = _proxy_xy(train)
+            Xt, _ = _proxy_xy(test)
+            scaler = SkScaler().fit(X)
+            clf = SkLR(max_iter=20).fit(scaler.transform(X), y)
+
+            def serve():
+                per = max(len(Xt) // 20, 1)
+                for i in range(20):
+                    chunk = Xt[i * per : (i + 1) * per]
+                    if len(chunk):
+                        clf.predict_proba(scaler.transform(chunk))
+
+            t0 = time.perf_counter()
+            serve()
+            dt = time.perf_counter() - t0
+            cache["5"] = {
+                "baseline": "sklearn CPU proxy: chunked predict_proba",
+                "rows_per_s": len(Xt) / dt,
+                "n_rows": int(len(Xt)),
+                "host_cpus": os.cpu_count(),
+            }
+            print(f"baseline config 5: {len(Xt)/dt:.0f} rows/s", file=sys.stderr)
+
+    with open(BASELINE_CACHE, "w") as f:
+        json.dump(cache, f, indent=1)
+    return cache
+
+
+def _vs_baseline(cfg: str, result: dict):
+    if not os.path.exists(BASELINE_CACHE):
+        return None
+    with open(BASELINE_CACHE) as f:
+        cache = json.load(f)
+    base = cache.get(cfg)
+    if base is None and cfg == "2" and "train_s" in cache:
+        base = cache  # legacy single-config cache layout
+    if base is None:
+        return None
+    if cfg == "5":
+        return result["value"] / base["rows_per_s"]  # throughput ratio
+    scale = result["n_rows"] / max(base["n_rows"], 1)
+    return (base["train_s"] * scale) / result["value"]
+
+
+def run_config(cfg: str, rows):
     import jax
 
-    from sntc_tpu.evaluation import MulticlassClassificationEvaluator
     from sntc_tpu.parallel.context import get_default_mesh
 
-    df, _ = _dataset(n_rows)
-    train, test = df.random_split([0.8, 0.2], seed=0)
     mesh = get_default_mesh()
-
-    t0 = time.perf_counter()
-    model = _build_pipeline(mesh).fit(train)
-    cold_s = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    model = _build_pipeline(mesh).fit(train)
-    warm_s = time.perf_counter() - t0
-
-    out = model.transform(test)
-    f1 = MulticlassClassificationEvaluator(
-        metricName="macroF1", mesh=mesh
-    ).evaluate(out)
-    return {
-        "train_s": warm_s,
-        "cold_train_s": cold_s,
-        "macro_f1": f1,
-        "n_rows": train.num_rows,
-        "platform": jax.devices()[0].platform,
+    result = BENCHES[cfg](rows or DEFAULT_ROWS[cfg], mesh)
+    line = {
+        "metric": result["metric"],
+        "value": round(result["value"], 3),
+        "unit": result.get("unit", "s"),
+        "vs_baseline": (
+            round(v, 2) if (v := _vs_baseline(cfg, result)) else None
+        ),
     }
-
-
-def measure_baseline(n_rows: int = N_ROWS):
-    """CPU proxy: sklearn MLP, same topology/optimizer family/iterations."""
-    from sklearn.neural_network import MLPClassifier
-    from sklearn.preprocessing import StandardScaler as SkScaler
-
-    df, feature_cols = _dataset(n_rows)
-    train, _ = df.random_split([0.8, 0.2], seed=0)
-    X = np.stack([train[c] for c in feature_cols], axis=1)
-    labels, y = np.unique(train["Label"].astype(str), return_inverse=True)
-
-    t0 = time.perf_counter()
-    Xs = SkScaler().fit_transform(X)
-    clf = MLPClassifier(
-        hidden_layer_sizes=(MLP_LAYERS[1],),
-        activation="logistic",
-        solver="lbfgs",
-        max_iter=MLP_MAX_ITER,
-        tol=1e-6,
-        random_state=0,
-    )
-    clf.fit(Xs, y)
-    baseline_s = time.perf_counter() - t0
-
-    payload = {
-        "baseline": "sklearn MLPClassifier (CPU proxy for Spark-CPU; "
-        "same 78-64-15 topology, logistic hiddens, lbfgs, 100 iters)",
-        "train_s": baseline_s,
-        "n_rows": int(train.num_rows),
-        "n_iters": int(clf.n_iter_),
-        "host_cpus": os.cpu_count(),
-    }
-    with open(BASELINE_CACHE, "w") as f:
-        json.dump(payload, f, indent=1)
-    return payload
+    for k in ("cold_value", "n_rows"):
+        if k in result:
+            line[k] = (
+                round(result[k], 3) if isinstance(result[k], float) else result[k]
+            )
+    line.update(result.get("quality", {}))
+    line["platform"] = jax.devices()[0].platform
+    line["baseline"] = "sklearn-cpu-proxy (baseline_proxy.json)"
+    return line
 
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="2", choices=list(BENCHES) + ["all"])
+    ap.add_argument("--rows", type=int, default=None)
     ap.add_argument("--measure-baseline", action="store_true")
-    ap.add_argument("--rows", type=int, default=N_ROWS)
     args = ap.parse_args()
 
+    configs = list(BENCHES) if args.config == "all" else [args.config]
+
     if args.measure_baseline:
-        payload = measure_baseline(args.rows)
-        print(json.dumps(payload))
+        cache = measure_baseline(configs, args.rows)
+        print(json.dumps({c: cache.get(c) for c in configs}))
         return
 
-    result = bench_flagship(args.rows)
-
-    vs_baseline = None
-    if os.path.exists(BASELINE_CACHE):
-        with open(BASELINE_CACHE) as f:
-            base = json.load(f)
-        # scale the cached proxy linearly if row counts differ
-        scale = result["n_rows"] / max(base["n_rows"], 1)
-        vs_baseline = (base["train_s"] * scale) / result["train_s"]
-
-    print(
-        json.dumps(
-            {
-                "metric": "cicids2017_15class_mlp_pipeline_train_wall_clock",
-                "value": round(result["train_s"], 3),
-                "unit": "s",
-                "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
-                "cold_value": round(result["cold_train_s"], 3),
-                "macro_f1": round(result["macro_f1"], 4),
-                "n_rows": result["n_rows"],
-                "platform": result["platform"],
-                "baseline": "sklearn-cpu-proxy (baseline_proxy.json)",
-            }
-        )
-    )
+    # flagship (config 2) last so the driver's final line is the headline
+    ordered = sorted(configs, key=lambda c: (c == "2", c))
+    for cfg in ordered:
+        print(json.dumps(run_config(cfg, args.rows)), flush=True)
 
 
 if __name__ == "__main__":

@@ -27,6 +27,17 @@ class ClassifierParams:
     probabilityCol = Param("output probability column", default="probability")
 
 
+class CheckpointParams:
+    """Mid-fit checkpoint/resume (SURVEY.md §5.4 — beyond Spark parity)."""
+
+    checkpointInterval = Param(
+        "persist optimizer state every N iterations/boosting rounds "
+        "(-1 = off); a re-run fit with the same checkpointDir resumes",
+        default=-1,
+    )
+    checkpointDir = Param("directory for mid-fit optimizer state", default=None)
+
+
 class ClassifierEstimator(ClassifierParams, Estimator):
     """Base estimator: extracts (X, y, w) from the frame."""
 

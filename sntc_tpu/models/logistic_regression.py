@@ -30,9 +30,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
 from sntc_tpu.models.base import (
+    CheckpointParams,
     ClassificationModel,
     ClassifierEstimator,
 )
+from sntc_tpu.mlio.optimizer_checkpoint import run_segmented
 from sntc_tpu.ops.lbfgs import minimize_lbfgs
 from sntc_tpu.parallel.collectives import shard_batch, shard_weights
 from sntc_tpu.parallel.context import get_default_mesh
@@ -55,11 +57,13 @@ def _lr_summarize(xs, ys, ws, k):
 
 @partial(
     jax.jit,
-    static_argnames=("binomial", "fit_intercept", "k", "max_iter", "tol", "use_l1"),
+    static_argnames=(
+        "binomial", "fit_intercept", "k", "max_iter", "tol", "use_l1", "resume",
+    ),
 )
 def _lr_optimize(
-    xs, ys, ws, inv_std, l2, pen_l2, l1_vec, theta0,
-    *, binomial, fit_intercept, k, max_iter, tol, use_l1,
+    xs, ys, ws, inv_std, l2, pen_l2, l1_vec, theta0, init_state, iter_limit,
+    *, binomial, fit_intercept, k, max_iter, tol, use_l1, resume=False,
 ):
     """The whole LBFGS/OWLQN fit as one cached XLA program.
 
@@ -105,6 +109,9 @@ def _lr_optimize(
         max_iter=max_iter,
         tol=tol,
         l1=l1_vec if use_l1 else None,
+        init_state=init_state if resume else None,
+        return_state=True,
+        iter_limit=iter_limit,
     )
 
 
@@ -134,7 +141,7 @@ class _LrParams:
     )
 
 
-class LogisticRegression(_LrParams, ClassifierEstimator):
+class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
     def __init__(self, mesh=None, **kwargs):
         super().__init__(**kwargs)
         self._mesh = mesh
@@ -199,19 +206,43 @@ class LogisticRegression(_LrParams, ClassifierEstimator):
         )
         l1_vec = np.concatenate([l1 * pen_l1, np.zeros(n_int)]).astype(np.float32)
 
-        res = _lr_optimize(
-            xs, ys, ws,
-            jnp.asarray(inv_std, jnp.float32),
-            jnp.asarray(l2, jnp.float32),
-            jnp.asarray(pen_l2),
-            jnp.asarray(l1_vec),
-            jnp.asarray(theta0),
-            binomial=binomial,
-            fit_intercept=fit_intercept,
-            k=k,
-            max_iter=self.getMaxIter(),
-            tol=self.getTol(),
-            use_l1=use_l1,
+        def opt_call(init_state, resume, iter_limit):
+            init_dev = (
+                None
+                if init_state is None
+                else jax.tree.map(jnp.asarray, init_state)
+            )
+            return _lr_optimize(
+                xs, ys, ws,
+                jnp.asarray(inv_std, jnp.float32),
+                jnp.asarray(l2, jnp.float32),
+                jnp.asarray(pen_l2),
+                jnp.asarray(l1_vec),
+                jnp.asarray(theta0),
+                init_dev,
+                jnp.asarray(iter_limit, jnp.int32),
+                binomial=binomial,
+                fit_intercept=fit_intercept,
+                k=k,
+                max_iter=self.getMaxIter(),
+                tol=self.getTol(),
+                use_l1=use_l1,
+                resume=resume,
+            )
+
+        fingerprint = {
+            "algo": "logistic_regression",
+            "n_coef": n_coef, "n_int": n_int, "num_classes": k,
+            "binomial": binomial, "regParam": reg, "elasticNetParam": alpha,
+            "maxIter": self.getMaxIter(), "tol": self.getTol(),
+            "standardization": standardize, "n_rows": n,
+        }
+        res = run_segmented(
+            opt_call,
+            self.getMaxIter(),
+            self.getCheckpointInterval(),
+            self.getCheckpointDir(),
+            fingerprint,
         )
 
         theta = np.asarray(res.x, np.float64)

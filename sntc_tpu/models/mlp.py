@@ -27,7 +27,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
-from sntc_tpu.models.base import ClassificationModel, ClassifierEstimator
+from sntc_tpu.mlio.optimizer_checkpoint import run_segmented
+from sntc_tpu.models.base import (
+    CheckpointParams,
+    ClassificationModel,
+    ClassifierEstimator,
+)
 from sntc_tpu.ops.lbfgs import minimize_lbfgs
 from sntc_tpu.parallel.collectives import shard_batch, shard_weights
 from sntc_tpu.parallel.context import get_default_mesh
@@ -63,9 +68,13 @@ def _forward(theta: jnp.ndarray, X: jnp.ndarray, layers: Tuple[int, ...]):
     return h
 
 
-@partial(jax.jit, static_argnames=("layers", "max_iter", "tol", "solver", "step_size"))
+@partial(
+    jax.jit,
+    static_argnames=("layers", "max_iter", "tol", "solver", "step_size", "resume"),
+)
 def _mlp_optimize(
-    xs, ys, ws, theta0, *, layers, max_iter, tol, solver, step_size
+    xs, ys, ws, theta0, init_state, iter_limit,
+    *, layers, max_iter, tol, solver, step_size, resume=False,
 ):
     w_sum = jnp.sum(ws)
 
@@ -82,7 +91,9 @@ def _mlp_optimize(
 
     if solver == "l-bfgs":
         return minimize_lbfgs(
-            value_and_grad, theta0, max_iter=max_iter, tol=tol
+            value_and_grad, theta0, max_iter=max_iter, tol=tol,
+            init_state=init_state if resume else None,
+            return_state=True, iter_limit=iter_limit,
         )
 
     # solver == "gd": full-batch gradient descent with constant step
@@ -100,12 +111,15 @@ def _mlp_optimize(
     hist = hist.at[max_iter].set(f_final)
     from sntc_tpu.ops.lbfgs import LbfgsResult
 
-    return LbfgsResult(
-        x=theta,
-        loss=f_final,
-        n_iters=jnp.asarray(max_iter, jnp.int32),
-        history=hist,
-        converged=jnp.asarray(True),
+    return (
+        LbfgsResult(
+            x=theta,
+            loss=f_final,
+            n_iters=jnp.asarray(max_iter, jnp.int32),
+            history=hist,
+            converged=jnp.asarray(True),
+        ),
+        None,  # gd has no resumable state (mid-fit checkpointing is l-bfgs)
     )
 
 
@@ -128,7 +142,7 @@ class _MlpParams:
     )
 
 
-class MultilayerPerceptronClassifier(_MlpParams, ClassifierEstimator):
+class MultilayerPerceptronClassifier(_MlpParams, CheckpointParams, ClassifierEstimator):
     def __init__(self, mesh=None, initialWeights: Optional[np.ndarray] = None, **kwargs):
         super().__init__(**kwargs)
         self._mesh = mesh
@@ -168,13 +182,35 @@ class MultilayerPerceptronClassifier(_MlpParams, ClassifierEstimator):
                 parts.append(np.zeros(d_out, np.float32))
             theta0 = np.concatenate(parts)
 
-        res = _mlp_optimize(
-            xs, ys, ws, jnp.asarray(theta0),
-            layers=layers,
-            max_iter=self.getMaxIter(),
-            tol=self.getTol(),
-            solver=self.getSolver(),
-            step_size=self.getStepSize(),
+        def opt_call(init_state, resume, iter_limit):
+            init_dev = (
+                None if init_state is None
+                else jax.tree.map(jnp.asarray, init_state)
+            )
+            return _mlp_optimize(
+                xs, ys, ws, jnp.asarray(theta0), init_dev,
+                jnp.asarray(iter_limit, jnp.int32),
+                layers=layers,
+                max_iter=self.getMaxIter(),
+                tol=self.getTol(),
+                solver=self.getSolver(),
+                step_size=self.getStepSize(),
+                resume=resume,
+            )
+
+        fingerprint = {
+            "algo": "mlp", "layers": list(layers), "seed": self.getSeed(),
+            "maxIter": self.getMaxIter(), "tol": self.getTol(),
+            "solver": self.getSolver(), "n_rows": int(X.shape[0]),
+        }
+        interval = (
+            self.getCheckpointInterval()
+            if self.getSolver() == "l-bfgs"
+            else -1  # gd state is just theta; not checkpointed
+        )
+        res = run_segmented(
+            opt_call, self.getMaxIter(), interval,
+            self.getCheckpointDir(), fingerprint,
         )
 
         model = MultilayerPerceptronClassificationModel(

@@ -27,7 +27,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
-from sntc_tpu.models.base import ClassificationModel, ClassifierEstimator
+from sntc_tpu.mlio import optimizer_checkpoint as _ckpt
+from sntc_tpu.models.base import (
+    CheckpointParams,
+    ClassificationModel,
+    ClassifierEstimator,
+)
 from sntc_tpu.models.tree.grower import (
     Forest,
     forest_leaf_stats,
@@ -71,7 +76,7 @@ class _GbtParams(_TreeEnsembleParams):
     featureSubsetStrategy = Param("feature subset per node", default="all")
 
 
-class GBTClassifier(_GbtParams, ClassifierEstimator):
+class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
     def __init__(self, mesh=None, **kwargs):
         super().__init__(**kwargs)
         self._mesh = mesh
@@ -97,7 +102,6 @@ class GBTClassifier(_GbtParams, ClassifierEstimator):
         binned = bin_features(xs, jnp.asarray(edges))
         y_signed = (2.0 * ys - 1.0).astype(jnp.float32)
 
-        rng = np.random.default_rng(self.getSeed())
         rate = self.getSubsamplingRate()
         subset_k = resolve_feature_subset_k(
             self.getFeatureSubsetStrategy(), F, 1, is_classification=False
@@ -113,16 +117,37 @@ class GBTClassifier(_GbtParams, ClassifierEstimator):
 
         def round_weights(i):
             if rate < 1.0:
-                mask = (rng.random(xs.shape[0]) < rate).astype(np.float32)
+                # per-round seeded: resume-deterministic (checkpointing)
+                r = np.random.default_rng(self.getSeed() + 7919 * (i + 1))
+                mask = (r.random(xs.shape[0]) < rate).astype(np.float32)
             else:
                 mask = np.ones(xs.shape[0], np.float32)
             return jax.device_put(
                 mask[None, :], NamedSharding(mesh, P(None, axis))
             )
 
+        # mid-fit round checkpointing (SURVEY.md §5.4): resume skips
+        # completed boosting rounds, restoring trees and margins
+        ckpt_dir = self.getCheckpointDir()
+        interval = self.getCheckpointInterval()
+        fingerprint = {
+            "algo": "gbt", "maxIter": n_rounds, "maxDepth": self.getMaxDepth(),
+            "stepSize": step, "seed": self.getSeed(), "n_rows": n,
+            "maxBins": n_bins,
+        }
         features, thresholds, leaves, weights = [], [], [], []
         margin = jnp.zeros(xs.shape[0], jnp.float32)
-        for m in range(n_rounds):
+        start_round = 0
+        if ckpt_dir and interval > 0:
+            saved = _ckpt.load_state(ckpt_dir, fingerprint)
+            if saved is not None and int(saved["round"]) > 0:
+                start_round = int(saved["round"])
+                features = list(saved["feature"])
+                thresholds = list(saved["threshold"])
+                leaves = list(saved["leaf_stats"])
+                weights = list(saved["tree_weights"])
+                margin = jnp.asarray(saved["margin"])
+        for m in range(start_round, n_rounds):
             if m == 0:
                 row_stats = _label_stats(y_signed, ws)
                 tree_weight = 1.0
@@ -145,7 +170,22 @@ class GBTClassifier(_GbtParams, ClassifierEstimator):
             thresholds.append(forest.threshold[0])
             leaves.append(forest.leaf_stats[0])
             weights.append(tree_weight)
+            if ckpt_dir and interval > 0 and (m + 1) % interval == 0:
+                _ckpt.save_state(
+                    ckpt_dir,
+                    {
+                        "round": m + 1,
+                        "feature": np.stack(features),
+                        "threshold": np.stack(thresholds),
+                        "leaf_stats": np.stack(leaves),
+                        "tree_weights": np.asarray(weights, np.float32),
+                        "margin": np.asarray(margin),
+                    },
+                    fingerprint,
+                )
 
+        if ckpt_dir and interval > 0:
+            _ckpt.clear_state(ckpt_dir)
         ensemble = Forest(
             feature=np.stack(features),
             threshold=np.stack(thresholds),

@@ -59,12 +59,24 @@ def minimize_lbfgs(
     l1: Optional[jnp.ndarray] = None,
     max_linesearch: int = 30,
     c1: float = 1e-4,
-) -> LbfgsResult:
+    init_state=None,
+    return_state: bool = False,
+    iter_limit=None,
+):
     """Minimize ``f(x) + sum(l1 * |x|)`` where ``value_and_grad`` gives the
     smooth part.  ``l1=None`` (or all-zero) is plain LBFGS; otherwise OWLQN.
 
     Jit-safe: call inside jit with sharded data closed over in
     ``value_and_grad``.
+
+    Resumable (SURVEY.md §5.4 mid-fit checkpointing): pass
+    ``return_state=True`` to also get the full optimizer state (a pytree of
+    arrays — position, gradient, curvature memory, iteration counter,
+    objective history); persist it and pass back as ``init_state`` to
+    continue EXACTLY where the run stopped — the resumed trajectory is
+    bit-identical to an uninterrupted one on the same hardware.  ``k`` in
+    the state is the absolute iteration count; the loop runs while
+    ``k < max_iter``.
     """
     d = x0.shape[0]
     m = history_size
@@ -89,23 +101,31 @@ def minimize_lbfgs(
             return jnp.where((l1v == 0) | keep, x_new, 0.0)
         return x_new
 
-    f0, g0 = value_and_grad(x0)
-    obj0 = full_obj(x0, f0)
-    history0 = jnp.full((max_iter + 1,), obj0, x0.dtype)
-
-    state0 = {
-        "x": x0,
-        "f": f0,  # smooth part
-        "obj": obj0,  # smooth + l1
-        "g": g0,  # smooth gradient
-        "s_hist": jnp.zeros((m, d), x0.dtype),
-        "y_hist": jnp.zeros((m, d), x0.dtype),
-        "rho": jnp.zeros((m,), x0.dtype),
-        "k": jnp.asarray(0, jnp.int32),
-        "n_upd": jnp.asarray(0, jnp.int32),
-        "done": jnp.asarray(False),
-        "history": history0,
-    }
+    if init_state is not None:
+        state0 = dict(init_state)
+        # the stored history may be shorter/longer than this run's horizon
+        old_hist = state0["history"]
+        hist = jnp.full((max_iter + 1,), state0["obj"], x0.dtype)
+        n_copy = min(old_hist.shape[0], max_iter + 1)
+        state0["history"] = hist.at[:n_copy].set(old_hist[:n_copy])
+        state0["done"] = jnp.asarray(False)  # a resume request re-arms the loop
+    else:
+        f0, g0 = value_and_grad(x0)
+        obj0 = full_obj(x0, f0)
+        history0 = jnp.full((max_iter + 1,), obj0, x0.dtype)
+        state0 = {
+            "x": x0,
+            "f": f0,  # smooth part
+            "obj": obj0,  # smooth + l1
+            "g": g0,  # smooth gradient
+            "s_hist": jnp.zeros((m, d), x0.dtype),
+            "y_hist": jnp.zeros((m, d), x0.dtype),
+            "rho": jnp.zeros((m,), x0.dtype),
+            "k": jnp.asarray(0, jnp.int32),
+            "n_upd": jnp.asarray(0, jnp.int32),
+            "done": jnp.asarray(False),
+            "history": history0,
+        }
 
     def two_loop(state, pg):
         """Standard masked two-loop recursion over the circular history."""
@@ -185,8 +205,17 @@ def minimize_lbfgs(
         )
         return ok, x_new, f_new, obj_new
 
+    # iter_limit: dynamic stop bound for segmented (checkpointed) runs —
+    # the same compiled program serves every segment; max_iter (static)
+    # only sizes the history buffer
+    limit = (
+        jnp.asarray(max_iter, jnp.int32)
+        if iter_limit is None
+        else jnp.minimum(jnp.asarray(iter_limit, jnp.int32), max_iter)
+    )
+
     def cond(state):
-        return (~state["done"]) & (state["k"] < max_iter)
+        return (~state["done"]) & (state["k"] < limit)
 
     def body(state):
         pg = effective_grad(state["x"], state["g"])
@@ -243,10 +272,13 @@ def minimize_lbfgs(
     # pad history beyond n_iters with the final objective
     idx = jnp.arange(max_iter + 1)
     hist = jnp.where(idx <= final["k"], final["history"], final["obj"])
-    return LbfgsResult(
+    result = LbfgsResult(
         x=final["x"],
         loss=final["obj"],
         n_iters=final["k"],
         history=hist,
         converged=final["done"],
     )
+    if return_state:
+        return result, final
+    return result

@@ -1,0 +1,158 @@
+"""Fault injection (SURVEY.md §5.3): interrupt a fit, resume from the
+mid-fit checkpoint, and assert the trajectory is identical to an
+uninterrupted run — the restart-from-checkpoint recovery model that
+replaces Spark's lineage recomputation."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.mlio.optimizer_checkpoint import load_state
+from sntc_tpu.models import (
+    GBTClassifier,
+    LogisticRegression,
+    MultilayerPerceptronClassifier,
+)
+
+
+def _data(n=1500, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] ** 2 + 0.3 * rng.normal(size=n) > 0.5).astype(
+        np.float64
+    )
+    return Frame({"features": X, "label": y})
+
+
+def test_lr_interrupted_fit_resumes_bit_identical(mesh8, tmp_path):
+    f = _data()
+    ckpt = str(tmp_path / "lr_ckpt")
+    common = dict(mesh=mesh8, regParam=1e-3, tol=1e-12)
+
+    # uninterrupted run: 40 iterations
+    full = LogisticRegression(maxIter=40, **common).fit(f)
+
+    # "crashed" run: same config, but stop after 15 iterations by lying
+    # about maxIter... instead simulate the crash by checkpointing every 5
+    # and fitting with maxIter=15 (fingerprint uses maxIter, so keep 40 and
+    # interrupt via a small interval + an induced exception-free partial run)
+    # -> the honest simulation: run maxIter=40 with interval 15, capture the
+    # state file mid-flight via a monkeypatched save that aborts after the
+    # first segment.
+    from sntc_tpu.mlio import optimizer_checkpoint as oc
+
+    calls = {"n": 0}
+    orig_save = oc.save_state
+
+    class Boom(RuntimeError):
+        pass
+
+    def crashing_save(ckpt_dir, state, fp):
+        orig_save(ckpt_dir, state, fp)
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise Boom("injected crash after first checkpoint")
+
+    oc.save_state = crashing_save
+    try:
+        with pytest.raises(Boom):
+            LogisticRegression(
+                maxIter=40, checkpointInterval=15, checkpointDir=ckpt, **common
+            ).fit(f)
+    finally:
+        oc.save_state = orig_save
+
+    # state survived the crash at iteration 15
+    state = load_state(
+        ckpt,
+        fingerprint={
+            "algo": "logistic_regression", "n_coef": 6, "n_int": 1,
+            "num_classes": 2, "binomial": True, "regParam": 1e-3,
+            "elasticNetParam": 0.0, "maxIter": 40, "tol": 1e-12,
+            "standardization": True, "n_rows": 1500,
+        },
+    )
+    assert state is not None and 0 < int(state["k"]) <= 15
+
+    # resume: same estimator config, same checkpoint dir
+    resumed = LogisticRegression(
+        maxIter=40, checkpointInterval=15, checkpointDir=ckpt, **common
+    ).fit(f)
+
+    np.testing.assert_array_equal(resumed.coefficients, full.coefficients)
+    assert resumed.intercept == full.intercept
+    # objective trajectory continuity: identical history
+    np.testing.assert_array_equal(
+        resumed.summary.objectiveHistory, full.summary.objectiveHistory
+    )
+    # checkpoint cleaned up after successful completion
+    assert not os.path.exists(os.path.join(ckpt, "lbfgs_state.npz"))
+
+
+def test_lr_stale_fingerprint_ignored(mesh8, tmp_path):
+    f = _data(seed=1)
+    ckpt = str(tmp_path / "ckpt")
+    LogisticRegression(
+        mesh=mesh8, maxIter=10, checkpointInterval=4, checkpointDir=ckpt
+    ).fit(f)
+    # different hyperparams -> old state must not be resumed
+    m = LogisticRegression(
+        mesh=mesh8, maxIter=10, regParam=0.5, checkpointInterval=4,
+        checkpointDir=ckpt,
+    ).fit(f)
+    ref = LogisticRegression(mesh=mesh8, maxIter=10, regParam=0.5).fit(f)
+    np.testing.assert_array_equal(m.coefficients, ref.coefficients)
+
+
+def test_mlp_checkpointed_equals_straight(mesh8, tmp_path):
+    f = _data(seed=2)
+    kw = dict(mesh=mesh8, layers=[6, 8, 2], seed=4, tol=1e-12)
+    full = MultilayerPerceptronClassifier(maxIter=30, **kw).fit(f)
+    seg = MultilayerPerceptronClassifier(
+        maxIter=30, checkpointInterval=7,
+        checkpointDir=str(tmp_path / "mlp"), **kw,
+    ).fit(f)
+    np.testing.assert_array_equal(seg.weights, full.weights)
+
+
+def test_gbt_resume_skips_completed_rounds(mesh8, tmp_path):
+    f = _data(seed=3)
+    ckpt = str(tmp_path / "gbt")
+    kw = dict(mesh=mesh8, maxDepth=3, stepSize=0.3, seed=1)
+    full = GBTClassifier(maxIter=8, **kw).fit(f)
+
+    from sntc_tpu.mlio import optimizer_checkpoint as oc
+
+    orig_save = oc.save_state
+    calls = {"n": 0}
+
+    class Boom(RuntimeError):
+        pass
+
+    def crashing_save(ckpt_dir, state, fp):
+        orig_save(ckpt_dir, state, fp)
+        calls["n"] += 1
+        if calls["n"] == 2:  # crash after round 4's checkpoint
+            raise Boom()
+
+    oc.save_state = crashing_save
+    try:
+        with pytest.raises(Boom):
+            GBTClassifier(
+                maxIter=8, checkpointInterval=2, checkpointDir=ckpt, **kw
+            ).fit(f)
+    finally:
+        oc.save_state = orig_save
+
+    resumed = GBTClassifier(
+        maxIter=8, checkpointInterval=2, checkpointDir=ckpt, **kw
+    ).fit(f)
+    np.testing.assert_array_equal(resumed.forest.feature, full.forest.feature)
+    np.testing.assert_allclose(
+        resumed.forest.leaf_stats, full.forest.leaf_stats, rtol=1e-6
+    )
+    np.testing.assert_array_equal(
+        resumed.transform(f)["prediction"], full.transform(f)["prediction"]
+    )
