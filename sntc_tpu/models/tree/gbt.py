@@ -156,7 +156,7 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
                 tree_weight = step
             forest = grow_forest(
                 binned, row_stats, round_weights(m), edges,
-                seed=self.getSeed() + m, **grow_kwargs,
+                seed=self.getSeed() + m, mesh=mesh, **grow_kwargs,
             )
             contrib = _tree_margin(
                 xs,

@@ -114,10 +114,14 @@ def _stat_count(stats: jnp.ndarray, impurity: str) -> jnp.ndarray:
 
 @partial(
     jax.jit,
-    static_argnames=("n_nodes", "n_bins", "impurity", "subset_k", "is_last"),
+    static_argnames=(
+        "n_nodes", "n_bins", "impurity", "subset_k", "is_last",
+        "hist_impl", "mesh", "interpret",
+    ),
 )
 def _level_pass(
     binned,  # [N, F] int32, row-sharded
+    binned_t,  # [F, N] int32, row-sharded on axis 1 (pallas layout)
     row_stats,  # [N, S] f32, row-sharded (user weight folded in)
     w_trees,  # [T, N] f32 bagging weights, sharded on N
     node_idx,  # [T, N] int32 (-1 = inactive), sharded on N
@@ -130,27 +134,62 @@ def _level_pass(
     impurity: str,
     subset_k: int,
     is_last: bool,
+    hist_impl: str = "segment",
+    mesh=None,
+    interpret: bool = False,
 ):
     n, F = binned.shape
     S = row_stats.shape[1]
+    T = w_trees.shape[0]
 
     # ---- histogram: [T, nodes, F, B, S] ------------------------------------
-    def per_tree(args):
-        w_t, node_t = args
-        active = (node_t >= 0).astype(row_stats.dtype)
-        ids = jnp.where(node_t >= 0, node_t, 0)
-        data = row_stats * (w_t * active)[:, None]
+    if hist_impl == "pallas":
+        # MXU one-hot matmul kernel per shard, explicit psum over the mesh
+        # (sntc_tpu/ops/pallas_histogram.py)
+        from jax.sharding import PartitionSpec as P
 
-        def per_feature(carry, f):
-            seg = ids * n_bins + binned[:, f]
-            h = jax.ops.segment_sum(data, seg, num_segments=n_nodes * n_bins)
-            return carry, h
+        from sntc_tpu.ops.pallas_histogram import level_histogram_pallas
 
-        _, hists = jax.lax.scan(per_feature, 0, jnp.arange(F))
-        return hists  # [F, nodes*B, S]
+        axis = mesh.axis_names[0]
 
-    hists = jax.lax.map(per_tree, (w_trees, node_idx))  # [T, F, nodes*B, S]
-    T = w_trees.shape[0]
+        def shard_fn(bt, rs, wt, ni):
+            def one_tree(args):
+                w_t, node_t = args
+                active = (node_t >= 0).astype(rs.dtype)
+                data = rs * (w_t * active)[:, None]
+                return level_histogram_pallas(
+                    bt, node_t, data,
+                    n_nodes=n_nodes, n_bins=n_bins, interpret=interpret,
+                )  # [F, nodes*B, S]
+
+            hs = jax.lax.map(one_tree, (wt, ni))  # [T, F, nodes*B, S]
+            return jax.lax.psum(hs, axis)
+
+        hists = jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(None, axis), P(axis, None), P(None, axis), P(None, axis)),
+            out_specs=P(),
+            check_vma=False,  # pallas_call outputs carry no vma metadata
+        )(binned_t, row_stats, w_trees, node_idx)
+    else:
+        def per_tree(args):
+            w_t, node_t = args
+            active = (node_t >= 0).astype(row_stats.dtype)
+            ids = jnp.where(node_t >= 0, node_t, 0)
+            data = row_stats * (w_t * active)[:, None]
+
+            def per_feature(carry, f):
+                seg = ids * n_bins + binned[:, f]
+                h = jax.ops.segment_sum(
+                    data, seg, num_segments=n_nodes * n_bins
+                )
+                return carry, h
+
+            _, hists = jax.lax.scan(per_feature, 0, jnp.arange(F))
+            return hists  # [F, nodes*B, S]
+
+        hists = jax.lax.map(per_tree, (w_trees, node_idx))  # [T,F,nodes*B,S]
     hist = hists.reshape(T, F, n_nodes, n_bins, S).transpose(0, 2, 1, 3, 4)
 
     # ---- split evaluation --------------------------------------------------
@@ -246,8 +285,26 @@ def grow_forest(
     subset_k: int,
     impurity: str,
     seed: int,
+    mesh=None,
+    hist_impl: str = None,
 ) -> Forest:
-    """Grow T trees level-synchronously; returns host-side dense heaps."""
+    """Grow T trees level-synchronously; returns host-side dense heaps.
+
+    ``hist_impl``: "segment" (XLA scatter-add, default) or "pallas" (MXU
+    one-hot matmul kernel; requires ``mesh``).  Overridable via the
+    ``SNTC_TREE_HIST`` env var.
+    """
+    import os
+
+    if hist_impl is None:
+        hist_impl = os.environ.get("SNTC_TREE_HIST", "segment")
+    if hist_impl == "pallas" and mesh is None:
+        hist_impl = "segment"
+    interpret = jax.default_backend() != "tpu"
+    binned_t = (
+        jnp.transpose(binned) if hist_impl == "pallas" else
+        jnp.zeros((binned.shape[1], 1), jnp.int32)  # unused placeholder
+    )
     T = w_trees.shape[0]
     n, F = binned.shape
     S = row_stats.shape[1]
@@ -274,10 +331,11 @@ def grow_forest(
         off = heap_offset(depth)
         key, sub = jax.random.split(key)
         out = _level_pass(
-            binned, row_stats, w_trees, node_idx, sub,
+            binned, binned_t, row_stats, w_trees, node_idx, sub,
             jnp.float32(min_instances_per_node), jnp.float32(min_info_gain),
             n_nodes=n_nodes, n_bins=n_bins, impurity=impurity,
             subset_k=subset_k, is_last=(depth == max_depth - 1),
+            hist_impl=hist_impl, mesh=mesh, interpret=interpret,
         )
         do_split = np.asarray(out["do_split"])
         has_rows = np.asarray(out["has_rows"])

@@ -114,6 +114,7 @@ class RandomForestClassifier(_RfParams, ClassifierEstimator):
             subset_k=subset_k,
             impurity=self.getImpurity(),
             seed=self.getSeed(),
+            mesh=mesh,
         )
         model = RandomForestClassificationModel(forest=forest, n_classes=k)
         model.setParams(

@@ -1,0 +1,123 @@
+"""Pallas TPU kernel: per-level tree histogram as MXU one-hot matmuls.
+
+The tree grower's hot op (SURVEY.md §3.2/§7.2 item 1) is the
+(node, feature, bin, stat) sufficient-statistics accumulation.  The XLA
+fallback (sntc_tpu/ops/histogram.py + grower) lowers it to scatter-adds,
+which serialize on TPU.  This kernel recasts it as dense matmuls:
+
+    for each (feature f, row-block r):
+        ids     = node_idx * B + bin[f]                  # [TILE_N]
+        onehot  = (iota_cols == ids)                     # [TILE_N, NBpad]
+        acc[f] += stats_blockᵀ @ onehot                  # [S, NBpad] on MXU
+
+so the accumulation rides the systolic array instead of scatter units.
+The row-block axis is the innermost grid dimension; the output block for
+feature ``f`` is revisited across row-blocks and accumulated in place
+(initialized at r == 0) — the standard Pallas reduction pattern.
+
+Layouts: ``binned`` arrives transposed ``[F, N]`` so each (f, r) block is
+lane-contiguous; the output is ``[F, S_pad, NB_pad]`` with the large
+node×bin axis last (128-lane aligned).  Stats arrive pre-weighted
+(bagging × user weight × active mask), so padded/dead rows contribute 0.
+
+Selection: ``grower`` uses this kernel on TPU when ``SNTC_TREE_HIST=pallas``
+(default remains the XLA segment-sum until the kernel is profiled on real
+hardware); interpret mode backs the CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU too (interpret mode); guard anyway
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    _HAS_PLTPU = False
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _hist_kernel(binned_ref, node_ref, stats_ref, acc_ref, *, n_bins, nb_pad):
+    r = pl.program_id(1)
+    bins = binned_ref[0, :]  # [TILE_N] int32 (feature f's bins)
+    nodes = node_ref[0, :]  # [TILE_N] int32 (-1 = inactive)
+    ids = jnp.where(nodes >= 0, nodes * n_bins + bins, nb_pad - 1)
+    # dead rows point at the last padded column, which is sliced off;
+    # their stats are also zero (pre-masked), so this is belt & braces
+    onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, (bins.shape[0], nb_pad), 1)
+        == ids[:, None]
+    ).astype(jnp.float32)
+    contrib = jnp.dot(
+        stats_ref[:].T, onehot, preferred_element_type=jnp.float32
+    )  # [S_pad, NB_pad]
+
+    @pl.when(r == 0)
+    def _init():
+        acc_ref[0] = contrib
+
+    @pl.when(r != 0)
+    def _acc():
+        acc_ref[0] += contrib
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_nodes", "n_bins", "tile_n", "interpret"),
+)
+def level_histogram_pallas(
+    binned_t: jnp.ndarray,  # [F, N] int32 (transposed bins)
+    node_idx: jnp.ndarray,  # [N] int32
+    weighted_stats: jnp.ndarray,  # [N, S] f32, pre-weighted/masked
+    *,
+    n_nodes: int,
+    n_bins: int,
+    tile_n: int = 1024,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One tree's level histogram ``[n_nodes * n_bins, S]`` (LOCAL rows —
+    caller psums across shards)."""
+    f, n = binned_t.shape
+    s = weighted_stats.shape[1]
+    nb = n_nodes * n_bins
+    nb_pad = _round_up(max(nb + 1, 128), 128)  # +1: dead-row dump column
+    s_pad = _round_up(s, 8)
+    n_pad = _round_up(n, tile_n)
+
+    if n_pad != n:
+        binned_t = jnp.pad(binned_t, ((0, 0), (0, n_pad - n)))
+        node_idx = jnp.pad(
+            node_idx, (0, n_pad - n), constant_values=-1
+        )
+        weighted_stats = jnp.pad(
+            weighted_stats, ((0, n_pad - n), (0, 0))
+        )
+    if s_pad != s:
+        weighted_stats = jnp.pad(weighted_stats, ((0, 0), (0, s_pad - s)))
+
+    node_2d = node_idx[None, :]  # [1, N]
+    grid = (f, n_pad // tile_n)
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, n_bins=n_bins, nb_pad=nb_pad),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tile_n), lambda i, r: (i, r)),  # binned_t
+            pl.BlockSpec((1, tile_n), lambda i, r: (0, r)),  # node_idx
+            pl.BlockSpec((tile_n, s_pad), lambda i, r: (r, 0)),  # stats
+        ],
+        out_specs=pl.BlockSpec((1, s_pad, nb_pad), lambda i, r: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((f, s_pad, nb_pad), jnp.float32),
+        interpret=interpret,
+    )(binned_t, node_2d, weighted_stats)
+
+    # [F, S_pad, NB_pad] -> [F, NB, S] (the grower's layout)
+    return out[:, :s, :nb].transpose(0, 2, 1)
