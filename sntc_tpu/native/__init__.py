@@ -1,0 +1,27 @@
+"""Native host components — ctypes bindings over sntc_tpu/native/*.cpp.
+
+The C++ NetFlow v5 parser is built on first use (g++ -O3 -shared; the
+toolchain is in-image) and cached next to the source.  A pure-Python
+``struct`` fallback keeps the feature available if no compiler exists;
+both implementations are cross-checked by tests/test_netflow.py.
+"""
+
+from sntc_tpu.native.netflow import (
+    NF5_FIELDS,
+    NF5_FIELD_NAMES,
+    make_datagram,
+    netflow_to_flow_frame,
+    parse_datagram,
+    parse_stream,
+    using_native,
+)
+
+__all__ = [
+    "NF5_FIELDS",
+    "NF5_FIELD_NAMES",
+    "parse_datagram",
+    "parse_stream",
+    "make_datagram",
+    "netflow_to_flow_frame",
+    "using_native",
+]
