@@ -215,13 +215,19 @@ def bench_config5(n_rows, mesh):
 
     from sntc_tpu.core.base import Pipeline, PipelineModel
     from sntc_tpu.models import LogisticRegression
-    from sntc_tpu.serve import MemorySink, MemorySource, StreamingQuery
+    from sntc_tpu.serve import (
+        MemorySink,
+        MemorySource,
+        StreamingQuery,
+        compile_serving,
+    )
 
     train, test = _dataset(n_rows, binary=True)
     pipe = Pipeline(stages=_feature_stages(mesh) + [
         LogisticRegression(mesh=mesh, maxIter=20)
     ]).fit(train)
-    serve_model = PipelineModel(stages=pipe.getStages()[1:])  # no indexer
+    # serving pipeline: drop the indexer, fold the scaler into the model
+    serve_model = compile_serving(PipelineModel(stages=pipe.getStages()[1:]))
 
     n_batches = 20
     per = max(test.num_rows // n_batches, 1)

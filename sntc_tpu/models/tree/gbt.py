@@ -136,6 +136,7 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
             "maxBins": n_bins,
         }
         features, thresholds, leaves, weights = [], [], [], []
+        gains, counts = [], []
         margin = jnp.zeros(xs.shape[0], jnp.float32)
         start_round = 0
         if ckpt_dir and interval > 0:
@@ -146,6 +147,8 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
                 thresholds = list(saved["threshold"])
                 leaves = list(saved["leaf_stats"])
                 weights = list(saved["tree_weights"])
+                gains = list(saved["gain"])
+                counts = list(saved["count"])
                 margin = jnp.asarray(saved["margin"])
         for m in range(start_round, n_rounds):
             if m == 0:
@@ -169,6 +172,8 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
             features.append(forest.feature[0])
             thresholds.append(forest.threshold[0])
             leaves.append(forest.leaf_stats[0])
+            gains.append(forest.gain[0])
+            counts.append(forest.count[0])
             weights.append(tree_weight)
             if ckpt_dir and interval > 0 and (m + 1) % interval == 0:
                 _ckpt.save_state(
@@ -178,6 +183,8 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
                         "feature": np.stack(features),
                         "threshold": np.stack(thresholds),
                         "leaf_stats": np.stack(leaves),
+                        "gain": np.stack(gains),
+                        "count": np.stack(counts),
                         "tree_weights": np.asarray(weights, np.float32),
                         "margin": np.asarray(margin),
                     },
@@ -191,6 +198,8 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
             threshold=np.stack(thresholds),
             leaf_stats=np.stack(leaves),
             max_depth=self.getMaxDepth(),
+            gain=np.stack(gains),
+            count=np.stack(counts),
         )
         model = GBTClassificationModel(
             forest=ensemble, tree_weights=np.asarray(weights, np.float32)
@@ -227,6 +236,8 @@ class GBTClassificationModel(_GbtParams, ClassificationModel):
                 "feature": self.forest.feature,
                 "threshold": self.forest.threshold,
                 "leaf_stats": self.forest.leaf_stats,
+                "gain": self.forest.gain,
+                "count": self.forest.count,
                 "tree_weights": self.treeWeights,
             },
         )
@@ -236,10 +247,16 @@ class GBTClassificationModel(_GbtParams, ClassificationModel):
         forest = Forest(
             arrays["feature"], arrays["threshold"], arrays["leaf_stats"],
             int(extra["max_depth"]),
+            arrays.get("gain"), arrays.get("count"),
         )
         m = cls(forest=forest, tree_weights=arrays["tree_weights"])
         m.setParams(**params)
         return m
+
+    @property
+    def featureImportances(self) -> np.ndarray:
+        n_features = int(self.forest.feature.max()) + 1
+        return self.forest.feature_importances(n_features)
 
     def margin(self, X: np.ndarray) -> np.ndarray:
         return np.asarray(

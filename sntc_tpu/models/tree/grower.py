@@ -42,12 +42,34 @@ class Forest(NamedTuple):
     ``feature[t, h] >= 0`` marks an internal node (split on that feature at
     ``threshold``); ``-1`` marks a leaf with ``leaf_stats[t, h]`` (class
     counts or [w, wy, wy²]); ``-2`` marks a never-created slot.
+    ``gain``/``count`` are populated on internal nodes (0 elsewhere) and
+    feed ``featureImportances`` (Spark ``computeFeatureImportance`` parity).
     """
 
     feature: np.ndarray  # [T, H] int32
     threshold: np.ndarray  # [T, H] f32
     leaf_stats: np.ndarray  # [T, H, S] f32
     max_depth: int
+    gain: np.ndarray = None  # [T, H] f32
+    count: np.ndarray = None  # [T, H] f32
+
+    def feature_importances(self, n_features: int) -> np.ndarray:
+        """Gain×count importances, normalized per tree then overall —
+        Spark ``TreeEnsembleModel.featureImportances`` semantics."""
+        total = np.zeros(n_features, np.float64)
+        for t in range(self.feature.shape[0]):
+            imp = np.zeros(n_features, np.float64)
+            internal = self.feature[t] >= 0
+            np.add.at(
+                imp,
+                self.feature[t][internal],
+                (self.gain[t] * self.count[t])[internal],
+            )
+            s = imp.sum()
+            if s > 0:
+                total += imp / s
+        s = total.sum()
+        return (total / s if s > 0 else total).astype(np.float64)
 
 
 def heap_offset(depth: int) -> int:
@@ -258,9 +280,11 @@ def _level_pass(
     return {
         "best_feat": best_feat,
         "best_bin": best_bin,
+        "best_gain": best_gain,
         "do_split": do_split,
         "has_rows": has_rows,
         "parent_stats": parent,
+        "parent_count": parent_cnt,
         "left_stats": bl,
         "right_stats": br,
         "new_node_idx": new_node_idx,
@@ -313,12 +337,15 @@ def grow_forest(
     feature = np.full((T, H), -2, np.int32)
     threshold = np.zeros((T, H), np.float32)
     leaf_stats = np.zeros((T, H, S), np.float32)
+    gain_arr = np.zeros((T, H), np.float32)
+    count_arr = np.zeros((T, H), np.float32)
 
     if max_depth == 0:
         stats = np.asarray(_root_stats(row_stats, w_trees))
         feature[:, 0] = -1
         leaf_stats[:, 0] = stats
-        return Forest(feature, threshold, leaf_stats, max_depth)
+        return Forest(feature, threshold, leaf_stats, max_depth,
+                      gain_arr, count_arr)
 
     node_idx = jnp.zeros((T, n), jnp.int32)
     # mark root as existing (leaf until proven split)
@@ -356,6 +383,10 @@ def grow_forest(
             split_mask, edges[best_feat.clip(0), best_bin.clip(0)], 0.0
         )
         leaf_stats[:, lvl] = np.where(leaf_mask[..., None], parent_stats, 0.0)
+        best_gain = np.asarray(out["best_gain"])
+        parent_cnt = np.asarray(out["parent_count"])
+        gain_arr[:, lvl] = np.where(split_mask, best_gain, 0.0)
+        count_arr[:, lvl] = np.where(split_mask, parent_cnt, 0.0)
 
         # children of split nodes exist at the next level
         next_off = heap_offset(depth + 1)
@@ -377,7 +408,8 @@ def grow_forest(
                 child_exists[..., None], child_stats, 0.0
             )
 
-    return Forest(feature, threshold, leaf_stats, max_depth)
+    return Forest(feature, threshold, leaf_stats, max_depth,
+                  gain_arr, count_arr)
 
 
 @partial(jax.jit, static_argnames=("max_depth",))

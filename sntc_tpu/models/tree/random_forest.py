@@ -116,7 +116,9 @@ class RandomForestClassifier(_RfParams, ClassifierEstimator):
             seed=self.getSeed(),
             mesh=mesh,
         )
-        model = RandomForestClassificationModel(forest=forest, n_classes=k)
+        model = RandomForestClassificationModel(
+            forest=forest, n_classes=k, n_features=F
+        )
         model.setParams(
             **{k2: v for k2, v in self.paramValues().items() if model.hasParam(k2)}
         )
@@ -134,10 +136,12 @@ def _rf_raw(X, feature, threshold, leaf_stats, *, max_depth):
 
 
 class RandomForestClassificationModel(_RfParams, ClassificationModel):
-    def __init__(self, forest: Forest, n_classes: int, **kwargs):
+    def __init__(self, forest: Forest, n_classes: int, n_features: int = 0,
+                 **kwargs):
         super().__init__(**kwargs)
         self.forest = forest
         self._n_classes = int(n_classes)
+        self._n_features = int(n_features)
 
     @property
     def num_classes(self) -> int:
@@ -149,11 +153,17 @@ class RandomForestClassificationModel(_RfParams, ClassificationModel):
 
     def _save_extra(self):
         return (
-            {"n_classes": self._n_classes, "max_depth": self.forest.max_depth},
+            {
+                "n_classes": self._n_classes,
+                "max_depth": self.forest.max_depth,
+                "n_features": self._n_features,
+            },
             {
                 "feature": self.forest.feature,
                 "threshold": self.forest.threshold,
                 "leaf_stats": self.forest.leaf_stats,
+                "gain": self.forest.gain,
+                "count": self.forest.count,
             },
         )
 
@@ -162,10 +172,20 @@ class RandomForestClassificationModel(_RfParams, ClassificationModel):
         forest = Forest(
             arrays["feature"], arrays["threshold"], arrays["leaf_stats"],
             int(extra["max_depth"]),
+            arrays.get("gain"), arrays.get("count"),
         )
-        m = cls(forest=forest, n_classes=int(extra["n_classes"]))
+        m = cls(
+            forest=forest,
+            n_classes=int(extra["n_classes"]),
+            n_features=int(extra.get("n_features", 0)),
+        )
         m.setParams(**params)
         return m
+
+    @property
+    def featureImportances(self) -> np.ndarray:
+        n = self._n_features or int(self.forest.feature.max()) + 1
+        return self.forest.feature_importances(n)
 
     def _raw_predict(self, X: np.ndarray) -> np.ndarray:
         return np.asarray(

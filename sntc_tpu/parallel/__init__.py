@@ -10,8 +10,10 @@ from sntc_tpu.parallel.collectives import (
     make_tree_aggregate,
     pad_rows,
     shard_batch,
+    shard_weights,
     tree_aggregate,
 )
+from sntc_tpu.parallel.distributed import global_mesh, initialize, process_info
 
 __all__ = [
     "DATA_AXIS",
@@ -22,6 +24,10 @@ __all__ = [
     "replicated_sharding",
     "pad_rows",
     "shard_batch",
+    "shard_weights",
     "tree_aggregate",
     "make_tree_aggregate",
+    "initialize",
+    "global_mesh",
+    "process_info",
 ]

@@ -1,0 +1,90 @@
+"""Multi-host initialization — the driver/executor cluster analog.
+
+Behavioral spec: SURVEY.md §5.8: Spark's comm backend is netty RPC between
+the driver and executor JVMs; the TPU-native equivalent of "adding hosts"
+is ``jax.distributed`` — each host runs the SAME SPMD program, XLA routes
+gradient/histogram reductions over ICI within a slice and DCN across
+slices.  No framework code changes: the mesh just gets bigger, and the
+``"data"`` axis keeps carrying the treeAggregate-analog psums.
+
+Single-host (the v5e-8 v0 target, one ICI domain) needs none of this —
+``initialize()`` is a no-op unless multi-host env/args are present.
+
+Usage on each host of a pod slice:
+
+    from sntc_tpu.parallel.distributed import initialize, global_mesh
+    initialize()                      # env-driven (TPU pods auto-detect)
+    mesh = global_mesh()              # 1-D "data" mesh over ALL devices
+    ... estimators take mesh= as usual ...
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from sntc_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host job.  With no arguments, relies on
+    ``jax.distributed``'s environment auto-detection (TPU pod runtimes set
+    it); returns False (no-op) when nothing indicates a multi-host setup.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    if coordinator_address is None and num_processes is None:
+        import os
+
+        multi_host_markers = (
+            "JAX_COORDINATOR_ADDRESS",
+            "COORDINATOR_ADDRESS",
+            "MEGASCALE_COORDINATOR_ADDRESS",
+        )
+        if not any(os.environ.get(m) for m in multi_host_markers):
+            return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def global_mesh(model: int = 1) -> Mesh:
+    """Mesh over ALL devices of the job (local or multi-host).
+
+    Device order follows ``jax.devices()`` (globally consistent), so the
+    leading ``"data"`` axis groups each host's local devices contiguously:
+    data-parallel psum segments reduce over ICI first, then cross-host DCN
+    — the hierarchy SURVEY.md §5.8 prescribes.
+    """
+    devices = jax.devices()
+    if model == 1:
+        return Mesh(np.array(devices), (DATA_AXIS,))
+    if len(devices) % model:
+        raise ValueError(
+            f"{len(devices)} devices not divisible by model={model}"
+        )
+    arr = np.array(devices).reshape(len(devices) // model, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def process_info() -> dict:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

@@ -1,4 +1,5 @@
 from sntc_tpu.serve.transform import BatchPredictor
+from sntc_tpu.serve.fuse import compile_serving
 from sntc_tpu.serve.streaming import (
     ConsoleSink,
     CsvDirSink,
@@ -10,6 +11,7 @@ from sntc_tpu.serve.streaming import (
 
 __all__ = [
     "BatchPredictor",
+    "compile_serving",
     "StreamingQuery",
     "FileStreamSource",
     "MemorySource",

@@ -1,0 +1,35 @@
+import numpy as np
+
+from sntc_tpu.parallel import global_mesh, initialize, process_info
+
+
+def test_initialize_noop_single_host(monkeypatch):
+    for m in (
+        "JAX_COORDINATOR_ADDRESS",
+        "COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS",
+    ):
+        monkeypatch.delenv(m, raising=False)
+    assert initialize() is False  # no multi-host markers -> no-op
+
+
+def test_global_mesh_covers_all_devices(mesh8):
+    m = global_mesh()
+    assert m.devices.size == 8
+    assert m.axis_names == ("data",)
+    m2 = global_mesh(model=2)
+    assert dict(m2.shape) == {"data": 4, "model": 2}
+    # the mesh drives a real reduction
+    import jax.numpy as jnp
+
+    from sntc_tpu.parallel import make_tree_aggregate, shard_batch
+
+    x = np.ones((16, 2), np.float32)
+    xs, w = shard_batch(m, x)
+    out = make_tree_aggregate(lambda xs, w: jnp.sum(xs * w[:, None]), m)(xs, w)
+    assert float(out) == 32.0
+
+
+def test_process_info_single():
+    info = process_info()
+    assert info["process_count"] == 1 and info["process_index"] == 0

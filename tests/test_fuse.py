@@ -1,0 +1,74 @@
+import numpy as np
+
+from sntc_tpu.core.base import Pipeline, PipelineModel
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.feature import StandardScaler, VectorAssembler
+from sntc_tpu.models import (
+    LogisticRegression,
+    MultilayerPerceptronClassifier,
+)
+from sntc_tpu.serve.fuse import compile_serving
+
+
+def _frame(n=800, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(3.0, 2.0, size=(n, d)).astype(np.float32)
+    X[:, d - 1] = 5.0  # constant feature exercises the f=0 path
+    y = (X[:, 0] > 3.0).astype(np.float64)
+    return Frame({"features": X, "label": y})
+
+
+def _pipeline(head, mesh):
+    return Pipeline(stages=[
+        StandardScaler(mesh=mesh, inputCol="features", outputCol="scaled",
+                       withMean=True),
+        head,
+    ])
+
+
+def test_fold_scaler_into_lr(mesh8):
+    f = _frame()
+    pm = _pipeline(
+        LogisticRegression(mesh=mesh8, featuresCol="scaled", maxIter=40), mesh8
+    ).fit(f)
+    fused = compile_serving(pm)
+    assert len(fused.getStages()) == 1
+    a, b = pm.transform(f), fused.transform(f)
+    np.testing.assert_allclose(a["probability"], b["probability"], atol=1e-5)
+    np.testing.assert_array_equal(a["prediction"], b["prediction"])
+
+
+def test_fold_scaler_into_mlp(mesh8):
+    f = _frame(seed=1)
+    pm = _pipeline(
+        MultilayerPerceptronClassifier(
+            mesh=mesh8, featuresCol="scaled", layers=[6, 8, 2], maxIter=40
+        ),
+        mesh8,
+    ).fit(f)
+    fused = compile_serving(pm)
+    assert len(fused.getStages()) == 1
+    a, b = pm.transform(f), fused.transform(f)
+    np.testing.assert_allclose(a["probability"], b["probability"], atol=1e-4)
+    agree = (a["prediction"] == b["prediction"]).mean()
+    assert agree > 0.999
+
+
+def test_non_matching_stages_untouched(mesh8):
+    f = _frame(seed=2)
+    # assembler ahead of scaler: assembler passes through, pair still fuses
+    raw = Frame({f"c{i}": f["features"][:, i] for i in range(6)})
+    raw = raw.with_column("label", f["label"])
+    pm = Pipeline(stages=[
+        VectorAssembler(inputCols=[f"c{i}" for i in range(6)], outputCol="features"),
+        StandardScaler(mesh=mesh8, inputCol="features", outputCol="scaled"),
+        LogisticRegression(mesh=mesh8, featuresCol="scaled", maxIter=20),
+    ]).fit(raw)
+    fused = compile_serving(pm)
+    assert len(fused.getStages()) == 2  # assembler + folded model
+    np.testing.assert_array_equal(
+        pm.transform(raw)["prediction"], fused.transform(raw)["prediction"]
+    )
+    # scaler NOT feeding the model -> untouched
+    pm2 = PipelineModel(stages=[pm.getStages()[1]])
+    assert len(compile_serving(pm2).getStages()) == 1

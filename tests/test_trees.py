@@ -124,6 +124,28 @@ def test_ovr_gbt_multiclass(mesh8):
     assert (out["prediction"] == y).mean() > 0.9
 
 
+def test_feature_importances(mesh8):
+    """Signal features dominate importances (Spark gain*count semantics)."""
+    rng = np.random.default_rng(11)
+    n = 3000
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    y = ((X[:, 2] > 0) ^ (X[:, 5] > 0.5)).astype(np.float64)
+    f = Frame({"features": X, "label": y})
+    rf = RandomForestClassifier(
+        mesh=mesh8, numTrees=8, maxDepth=4, seed=0,
+        featureSubsetStrategy="all", bootstrap=False,
+    ).fit(f)
+    imp = rf.featureImportances
+    assert imp.shape == (8,)
+    assert imp.sum() == pytest.approx(1.0)
+    assert set(np.argsort(imp)[-2:]) == {2, 5}
+
+    gbt = GBTClassifier(mesh=mesh8, maxIter=6, maxDepth=3, seed=0).fit(f)
+    gimp = gbt.featureImportances
+    assert gimp.sum() == pytest.approx(1.0)
+    assert set(np.argsort(gimp)[-2:]) == {2, 5}
+
+
 def test_tree_models_save_load(tmp_path, mesh8):
     f, X, y = _blobs(n=600, k=3, seed=8)
     rf = RandomForestClassifier(mesh=mesh8, numTrees=3, maxDepth=3, seed=0).fit(f)
