@@ -76,13 +76,29 @@ class StandardScalerModel(_ScalerParams, Model):
         m.setParams(**params)
         return m
 
+    def affine(self):
+        """``(mu, f)`` of the map ``x' = (x - mu) * f`` this model applies
+        (float64; honors withMean/withStd, constant features get f=0).
+        Single source of truth for both ``transform`` and serving-time
+        fusion (``sntc_tpu.serve.fuse``)."""
+        std = self.std.astype(np.float64)
+        f = (
+            np.divide(1.0, std, out=np.zeros_like(std), where=std > 0)
+            if self.getWithStd()
+            else np.ones_like(std)
+        )
+        mu = (
+            self.mean.astype(np.float64)
+            if self.getWithMean()
+            else np.zeros_like(self.mean, dtype=np.float64)
+        )
+        return mu, f
+
     def transform(self, frame: Frame) -> Frame:
         X = frame[self.getInputCol()].astype(np.float32)
+        mu, f = self.affine()
         if self.getWithMean():
-            X = X - self.mean
+            X = X - mu.astype(np.float32)
         if self.getWithStd():
-            factor = np.divide(
-                1.0, self.std, out=np.zeros_like(self.std), where=self.std > 0
-            ).astype(np.float32)
-            X = X * factor
+            X = X * f.astype(np.float32)
         return frame.with_column(self.getOutputCol(), X)

@@ -83,6 +83,9 @@ def save_model(stage: PipelineStage, path: str) -> str:
     extra, arrays = (
         stage._save_extra() if hasattr(stage, "_save_extra") else ({}, {})
     )
+    # optional payloads (e.g. a Forest loaded from an old save without
+    # gain/count) come through as None — omit rather than corrupt the npz
+    arrays = {k: v for k, v in arrays.items() if v is not None}
 
     meta["params"] = params
     meta["extra"] = extra

@@ -141,7 +141,9 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
         start_round = 0
         if ckpt_dir and interval > 0:
             saved = _ckpt.load_state(ckpt_dir, fingerprint)
-            if saved is not None and int(saved["round"]) > 0:
+            # "gain" guards against state files written by older layouts:
+            # a missing key means restart rather than crash mid-resume
+            if saved is not None and int(saved["round"]) > 0 and "gain" in saved:
                 start_round = int(saved["round"])
                 features = list(saved["feature"])
                 thresholds = list(saved["threshold"])
@@ -202,7 +204,9 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
             count=np.stack(counts),
         )
         model = GBTClassificationModel(
-            forest=ensemble, tree_weights=np.asarray(weights, np.float32)
+            forest=ensemble,
+            tree_weights=np.asarray(weights, np.float32),
+            n_features=F,
         )
         model.setParams(
             **{k2: v for k2, v in self.paramValues().items() if model.hasParam(k2)}
@@ -220,10 +224,12 @@ def _gbt_margin(X, feature, threshold, leaf_stats, tree_weights, *, max_depth):
 
 
 class GBTClassificationModel(_GbtParams, ClassificationModel):
-    def __init__(self, forest: Forest, tree_weights: np.ndarray, **kwargs):
+    def __init__(self, forest: Forest, tree_weights: np.ndarray,
+                 n_features: int = 0, **kwargs):
         super().__init__(**kwargs)
         self.forest = forest
         self.treeWeights = np.asarray(tree_weights, np.float32)
+        self._n_features = int(n_features)
 
     @property
     def num_classes(self) -> int:
@@ -231,7 +237,8 @@ class GBTClassificationModel(_GbtParams, ClassificationModel):
 
     def _save_extra(self):
         return (
-            {"max_depth": self.forest.max_depth},
+            {"max_depth": self.forest.max_depth,
+             "n_features": self._n_features},
             {
                 "feature": self.forest.feature,
                 "threshold": self.forest.threshold,
@@ -249,14 +256,21 @@ class GBTClassificationModel(_GbtParams, ClassificationModel):
             int(extra["max_depth"]),
             arrays.get("gain"), arrays.get("count"),
         )
-        m = cls(forest=forest, tree_weights=arrays["tree_weights"])
+        m = cls(
+            forest=forest,
+            tree_weights=arrays["tree_weights"],
+            n_features=int(extra.get("n_features", 0)),
+        )
         m.setParams(**params)
         return m
 
     @property
     def featureImportances(self) -> np.ndarray:
-        n_features = int(self.forest.feature.max()) + 1
-        return self.forest.feature_importances(n_features)
+        n = self._n_features or int(self.forest.feature.max()) + 1
+        # Spark's GBTClassificationModel passes perTreeNormalization=false
+        return self.forest.feature_importances(
+            n, per_tree_normalization=False
+        )
 
     def margin(self, X: np.ndarray) -> np.ndarray:
         return np.asarray(

@@ -53,9 +53,20 @@ class Forest(NamedTuple):
     gain: np.ndarray = None  # [T, H] f32
     count: np.ndarray = None  # [T, H] f32
 
-    def feature_importances(self, n_features: int) -> np.ndarray:
-        """Gain×count importances, normalized per tree then overall —
-        Spark ``TreeEnsembleModel.featureImportances`` semantics."""
+    def feature_importances(
+        self, n_features: int, per_tree_normalization: bool = True
+    ) -> np.ndarray:
+        """Gain×count importances — Spark ``TreeEnsembleModel.
+        featureImportances`` semantics: each tree's contributions are
+        normalized to sum 1 first for forests (RF), left raw for boosted
+        ensembles (GBT passes ``perTreeNormalization=false`` upstream),
+        then the total is normalized."""
+        if self.gain is None or self.count is None:
+            raise ValueError(
+                "featureImportances unavailable: this model was saved "
+                "without per-node split statistics (gain/count); re-fit "
+                "to compute importances"
+            )
         total = np.zeros(n_features, np.float64)
         for t in range(self.feature.shape[0]):
             imp = np.zeros(n_features, np.float64)
@@ -65,9 +76,12 @@ class Forest(NamedTuple):
                 self.feature[t][internal],
                 (self.gain[t] * self.count[t])[internal],
             )
-            s = imp.sum()
-            if s > 0:
-                total += imp / s
+            if per_tree_normalization:
+                s = imp.sum()
+                if s > 0:
+                    total += imp / s
+            else:
+                total += imp
         s = total.sum()
         return (total / s if s > 0 else total).astype(np.float64)
 

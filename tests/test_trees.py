@@ -142,8 +142,23 @@ def test_feature_importances(mesh8):
 
     gbt = GBTClassifier(mesh=mesh8, maxIter=6, maxDepth=3, seed=0).fit(f)
     gimp = gbt.featureImportances
+    # full training width even if some features are never split on
+    assert gimp.shape == (8,)
     assert gimp.sum() == pytest.approx(1.0)
     assert set(np.argsort(gimp)[-2:]) == {2, 5}
+
+
+def test_feature_importances_unavailable_without_stats():
+    from sntc_tpu.models.tree.grower import Forest
+
+    forest = Forest(
+        feature=np.array([[0, -1, -1]], np.int32),
+        threshold=np.zeros((1, 3), np.float32),
+        leaf_stats=np.zeros((1, 3, 2), np.float32),
+        max_depth=1,
+    )
+    with pytest.raises(ValueError, match="without per-node split"):
+        forest.feature_importances(4)
 
 
 def test_tree_models_save_load(tmp_path, mesh8):
