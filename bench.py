@@ -441,7 +441,18 @@ def main():
     ap.add_argument("--config", default="2", choices=list(BENCHES) + ["all"])
     ap.add_argument("--rows", type=int, default=None)
     ap.add_argument("--measure-baseline", action="store_true")
+    ap.add_argument(
+        "--platform", default=os.environ.get("BENCH_PLATFORM"),
+        help="force a JAX platform (e.g. 'cpu' for local validation when "
+        "the TPU tunnel is unavailable); the host sitecustomize pins "
+        "jax_platforms so the JAX_PLATFORMS env var alone is ignored",
+    )
     args = ap.parse_args()
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     configs = list(BENCHES) if args.config == "all" else [args.config]
 

@@ -44,8 +44,26 @@ class PipelineStage(Params):
 
 
 class Transformer(PipelineStage):
+    # the conventional input-column param names this base can discover;
+    # stages reading columns through differently-named params MUST override
+    # input_columns() so pipeline rewrites (sntc_tpu.serve.fuse) see them
+    _INPUT_COL_PARAMS = ("inputCol", "featuresCol", "inputCols")
+
     def transform(self, frame: Frame) -> Frame:
         raise NotImplementedError
+
+    def input_columns(self) -> List[str]:
+        """Column names this stage reads at transform time (unset params
+        contribute nothing — an unset stage consumes nothing yet)."""
+        out: List[str] = []
+        for name in self._INPUT_COL_PARAMS:
+            if not self.hasParam(name) or not self.isDefined(name):
+                continue
+            val = self.getOrDefault(name)
+            if val is None:
+                continue
+            out.extend(val if isinstance(val, (list, tuple)) else [val])
+        return out
 
     def __call__(self, frame: Frame) -> Frame:
         return self.transform(frame)

@@ -29,14 +29,18 @@ class VectorAssembler(Transformer):
 
     def transform(self, frame: Frame) -> Frame:
         names: List[str] = self.getInputCols()
-        parts = []
-        for name in names:
-            col = frame[name]
+        cols = [frame[name] for name in names]
+        widths = [1 if c.ndim == 1 else c.shape[1] for c in cols]
+        # single allocation, cast-on-assign — no per-column intermediate
+        # copies (this runs per micro-batch on the serving hot path [B:11])
+        X = np.empty((frame.num_rows, sum(widths)), np.float32)
+        off = 0
+        for col, w in zip(cols, widths):
             if col.ndim == 1:
-                parts.append(col.astype(np.float32)[:, None])
+                X[:, off] = col
             else:
-                parts.append(col.astype(np.float32))
-        X = np.concatenate(parts, axis=1) if parts else np.zeros((frame.num_rows, 0), np.float32)
+                X[:, off : off + w] = col
+            off += w
 
         mode = self.getHandleInvalid()
         if mode != "keep":

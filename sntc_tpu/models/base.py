@@ -84,6 +84,13 @@ class ClassificationModel(ClassifierParams, Model):
     def _raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
+    def _predict_raw_prob(self, X: np.ndarray):
+        """(raw, probability) for a feature matrix.  Subclasses override to
+        fuse both into ONE device program (one dispatch per micro-batch on
+        the serving hot path [B:11]); the default is the two-step path."""
+        raw = self._raw_predict(X)
+        return raw, self._raw_to_probability(raw)
+
     def _prob_to_prediction(self, prob: np.ndarray) -> np.ndarray:
         if self.num_classes == 2:
             t = self.getThreshold()
@@ -92,8 +99,7 @@ class ClassificationModel(ClassifierParams, Model):
 
     def transform(self, frame: Frame) -> Frame:
         X = frame[self.getFeaturesCol()].astype(np.float32, copy=False)
-        raw = self._raw_predict(X)
-        prob = self._raw_to_probability(raw)
+        raw, prob = self._predict_raw_prob(X)
         out = frame
         if self.getRawPredictionCol():
             out = out.with_column(self.getRawPredictionCol(), raw)

@@ -295,6 +295,26 @@ def _margins(X, coefT, intercepts):
     return X @ coefT + intercepts[None, :]
 
 
+@partial(jax.jit, static_argnames=("binomial",))
+def _predict_fused(X, coefT, intercepts, *, binomial):
+    """raw margins + probabilities in ONE program (one dispatch per
+    serving micro-batch [B:11]).
+
+    Probability is softmax of the ORIGINAL margins: for binomial models
+    column 0 of the coefficient matrix is identically zero, so
+    softmax([0, m]) == [1-σ(m), σ(m)] — Spark's sigmoid(margin), NOT the
+    sigmoid(2m) that softmax of the symmetrized rawPrediction [-m, +m]
+    would give."""
+    margins = X @ coefT + intercepts[None, :]
+    prob = jax.nn.softmax(margins, axis=1)
+    if binomial:
+        m = margins[:, 1] - margins[:, 0]
+        raw = jnp.stack([-m, m], axis=1)
+    else:
+        raw = margins
+    return raw, prob
+
+
 class LogisticRegressionModel(_LrParams, ClassificationModel):
     def __init__(
         self,
@@ -304,10 +324,23 @@ class LogisticRegressionModel(_LrParams, ClassificationModel):
         **kwargs,
     ):
         super().__init__(**kwargs)
-        self.coefficientMatrix = np.asarray(coefficient_matrix, np.float32)
-        self.interceptVector = np.asarray(intercepts, np.float32)
+        self.coefficientMatrix = np.array(coefficient_matrix, np.float32)
+        self.interceptVector = np.array(intercepts, np.float32)
+        # read-only (own copy): predict caches device copies, so silent
+        # in-place mutation would serve stale weights — make it raise instead
+        self.coefficientMatrix.flags.writeable = False
+        self.interceptVector.flags.writeable = False
         self.is_binomial = bool(is_binomial)
         self.summary: Optional[LogisticRegressionSummary] = None
+        self._dev_params = None  # lazy device-resident (coefT, intercepts)
+
+    def _device_params(self):
+        if self._dev_params is None:
+            self._dev_params = (
+                jnp.asarray(self.coefficientMatrix.T),
+                jnp.asarray(self.interceptVector),
+            )
+        return self._dev_params
 
     def _save_extra(self):
         return (
@@ -346,22 +379,28 @@ class LogisticRegressionModel(_LrParams, ClassificationModel):
         return self.coefficientMatrix.shape[0]
 
     def _raw_predict(self, X: np.ndarray) -> np.ndarray:
-        raw = np.asarray(
-            _margins(
-                jnp.asarray(X),
-                jnp.asarray(self.coefficientMatrix.T),
-                jnp.asarray(self.interceptVector),
-            )
-        )
+        coefT, b = self._device_params()
+        raw = np.asarray(_margins(jnp.asarray(X), coefT, b))
         if self.is_binomial:
             # Spark binary rawPrediction is [-margin, +margin]
             m = raw[:, 1] - raw[:, 0]
             raw = np.stack([-m, m], axis=1)
         return raw
 
+    def _predict_raw_prob(self, X: np.ndarray):
+        coefT, b = self._device_params()
+        raw, prob = _predict_fused(
+            jnp.asarray(X), coefT, b, binomial=self.is_binomial
+        )
+        return np.asarray(raw), np.asarray(prob)
+
     def _raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
         if self.is_binomial:
-            p1 = 1.0 / (1.0 + np.exp(-raw[:, 1]))
+            # raw = [-m, +m]; Spark probability is sigmoid(m) — numerically
+            # stable form, no exp overflow on extreme margins
+            m = raw[:, 1]
+            e = np.exp(-np.abs(m))
+            p1 = np.where(m >= 0, 1.0, e) / (1.0 + e)
             return np.stack([1.0 - p1, p1], axis=1)
         z = raw - raw.max(axis=1, keepdims=True)
         e = np.exp(z)

@@ -233,12 +233,29 @@ def _mlp_margins(theta, X, layers):
     return _forward(theta, X, layers)
 
 
+@partial(jax.jit, static_argnames=("layers",))
+def _mlp_predict_fused(theta, X, layers):
+    """Margins + softmax probabilities in one program (one dispatch per
+    serving micro-batch [B:11])."""
+    raw = _forward(theta, X, layers)
+    return raw, jax.nn.softmax(raw, axis=1)
+
+
 class MultilayerPerceptronClassificationModel(_MlpParams, ClassificationModel):
     def __init__(self, weights: np.ndarray, layers: List[int], **kwargs):
         super().__init__(**kwargs)
-        self.weights = np.asarray(weights, np.float32)
+        self.weights = np.array(weights, np.float32)
+        # read-only (own copy): predict caches a device copy, so silent
+        # in-place mutation would serve stale weights — make it raise instead
+        self.weights.flags.writeable = False
         self.set("layers", list(layers))
         self.summary = None
+        self._dev_weights = None  # lazy device-resident flat weights
+
+    def _device_weights(self):
+        if self._dev_weights is None:
+            self._dev_weights = jnp.asarray(self.weights)
+        return self._dev_weights
 
     def _save_extra(self):
         return {}, {"weights": self.weights}
@@ -257,11 +274,19 @@ class MultilayerPerceptronClassificationModel(_MlpParams, ClassificationModel):
     def _raw_predict(self, X: np.ndarray) -> np.ndarray:
         return np.asarray(
             _mlp_margins(
-                jnp.asarray(self.weights),
+                self._device_weights(),
                 jnp.asarray(X),
                 tuple(int(v) for v in self.getLayers()),
             )
         )
+
+    def _predict_raw_prob(self, X: np.ndarray):
+        raw, prob = _mlp_predict_fused(
+            self._device_weights(),
+            jnp.asarray(X),
+            tuple(int(v) for v in self.getLayers()),
+        )
+        return np.asarray(raw), np.asarray(prob)
 
     def _raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
         z = raw - raw.max(axis=1, keepdims=True)

@@ -76,14 +76,9 @@ _FOLDABLE = {
 
 
 def _consumes(stage, col: str) -> bool:
-    for p in ("inputCol", "featuresCol"):
-        if stage.hasParam(p) and stage.getOrDefault(p) == col:
-            return True
-    if stage.hasParam("inputCols"):
-        cols = stage.getOrDefault("inputCols")
-        if cols and col in cols:
-            return True
-    return False
+    # total, not heuristic: Transformer.input_columns() covers the standard
+    # input params and is overridable by stages with nonstandard ones
+    return col in stage.input_columns()
 
 
 def compile_serving(pipeline: PipelineModel) -> PipelineModel:

@@ -152,6 +152,11 @@ class StreamingQuery:
         self._commits_dir = os.path.join(checkpoint_dir, "commits")
         os.makedirs(self._offsets_dir, exist_ok=True)
         os.makedirs(self._commits_dir, exist_ok=True)
+        # recover bookkeeping from the log ONCE; afterwards the engine tracks
+        # it in memory (the WAL files are still written per batch — the
+        # directory scan per batch was pure overhead, not durability)
+        self._last_committed = self._scan_last_committed()
+        self._end_offset = self._read_committed_end(self._last_committed)
 
     # -- checkpoint bookkeeping -------------------------------------------
 
@@ -161,16 +166,21 @@ class StreamingQuery:
             for p in glob.glob(os.path.join(d, "*.json"))
         )
 
-    def last_committed(self) -> int:
+    def _scan_last_committed(self) -> int:
         ids = self._log_ids(self._commits_dir)
         return ids[-1] if ids else -1
 
-    def _committed_end(self) -> int:
-        last = self.last_committed()
+    def _read_committed_end(self, last: int) -> int:
         if last < 0:
             return 0
         with open(os.path.join(self._commits_dir, f"{last}.json")) as f:
             return json.load(f)["end"]
+
+    def last_committed(self) -> int:
+        return self._last_committed
+
+    def _committed_end(self) -> int:
+        return self._end_offset
 
     def _pending_intent(self, batch_id: int):
         path = os.path.join(self._offsets_dir, f"{batch_id}.json")
@@ -207,6 +217,8 @@ class StreamingQuery:
             os.path.join(self._commits_dir, f"{batch_id}.json"), "w"
         ) as f:
             json.dump(intent, f)
+        self._last_committed = batch_id
+        self._end_offset = intent["end"]
         return True
 
     def process_available(self) -> int:

@@ -133,3 +133,26 @@ def test_family_validation(mesh8):
     f, _, _ = _multi_data(n=300)
     with pytest.raises(ValueError, match="binomial"):
         LogisticRegression(mesh=mesh8, family="binomial").fit(f)
+
+
+def test_binomial_probability_is_sigmoid_of_margin(mesh8):
+    """Pin Spark parity: probability = sigmoid(m), NOT sigmoid(2m) — softmax
+    of the symmetrized rawPrediction [-m, +m] would silently double the
+    logit.  Also pins fused (device) == two-step (numpy) paths and overflow
+    safety on extreme margins."""
+    f, X, y = _binary_data(n=400, seed=6)
+    model = LogisticRegression(mesh=mesh8, maxIter=50).fit(f)
+    out = model.transform(f)
+    m = out["rawPrediction"][:, 1]
+    expected_p1 = 1.0 / (1.0 + np.exp(-m))
+    np.testing.assert_allclose(out["probability"][:, 1], expected_p1, rtol=1e-5)
+    # two-step numpy path agrees with the fused device path
+    raw = model._raw_predict(X)
+    np.testing.assert_allclose(
+        model._raw_to_probability(raw)[:, 1], expected_p1, rtol=1e-5
+    )
+    # extreme margins: no overflow warnings, saturate to {0, 1}
+    huge = np.stack([-np.float64([1e4, -1e4]), np.float64([1e4, -1e4])], axis=1)
+    with np.errstate(over="raise"):
+        p = model._raw_to_probability(huge)
+    np.testing.assert_allclose(p[:, 1], [1.0, 0.0], atol=1e-10)
