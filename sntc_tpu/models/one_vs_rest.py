@@ -40,26 +40,49 @@ class OneVsRest(_OvrParams, ClassifierEstimator):
         self._mesh = mesh
 
     def _fit(self, frame: Frame) -> "OneVsRestModel":
-        X, y, _ = self._extract(frame)
+        X, y, w = self._extract(frame)
         k = int(y.max()) + 1
-        models: List[ClassificationModel] = []
-        bin_col = f"ovr_label_{self.uid}"
-        overrides = {
-            "labelCol": bin_col,
-            "featuresCol": self.getFeaturesCol(),
-        }
-        # forward sample weights to every binary sub-fit (Spark parity)
-        if self.getWeightCol() and self.classifier.hasParam("weightCol"):
-            overrides["weightCol"] = self.getWeightCol()
-        for c in range(k):
-            y_c = (y == c).astype(np.float64)
-            sub = frame.with_column(bin_col, y_c)
-            models.append(self.classifier.copy(overrides).fit(sub))
+        models: List[ClassificationModel] = self._fit_vectorized(X, y, w, k)
+        if models is None:
+            models = []
+            bin_col = f"ovr_label_{self.uid}"
+            overrides = {
+                "labelCol": bin_col,
+                "featuresCol": self.getFeaturesCol(),
+            }
+            # forward sample weights to every binary sub-fit (Spark parity)
+            if self.getWeightCol() and self.classifier.hasParam("weightCol"):
+                overrides["weightCol"] = self.getWeightCol()
+            for c in range(k):
+                y_c = (y == c).astype(np.float64)
+                sub = frame.with_column(bin_col, y_c)
+                models.append(self.classifier.copy(overrides).fit(sub))
         model = OneVsRestModel(models=models)
         model.setParams(
             **{k2: v for k2, v in self.paramValues().items() if model.hasParam(k2)}
         )
         return model
+
+    def _fit_vectorized(self, X, y, w, k):
+        """All-classes-at-once fit when the base classifier supports riding
+        the grower's tree axis (GBT: K trees per boosting round over the
+        same binned features — SURVEY.md §7.2 item 4).  Returns None when
+        the classifier has no vectorized path or mid-fit checkpointing is
+        requested (the sequential path owns that)."""
+        from sntc_tpu.models.tree.gbt import GBTClassifier, fit_gbt_ovr_vectorized
+        from sntc_tpu.parallel.context import get_default_mesh
+
+        if not isinstance(self.classifier, GBTClassifier):
+            return None
+        if self.classifier.getCheckpointInterval() > 0:
+            return None
+        # a weightCol set on the classifier itself (not this OvR) refers to
+        # a column of the relabeled sub-frame — only the sequential path
+        # reproduces that
+        if self.classifier.getWeightCol() and not self.getWeightCol():
+            return None
+        mesh = self._mesh or self.classifier._mesh or get_default_mesh()
+        return fit_gbt_ovr_vectorized(self.classifier, X, y, w, k, mesh)
 
     def _sub_stages(self):
         return [self.classifier]

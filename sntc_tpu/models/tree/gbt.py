@@ -58,13 +58,70 @@ def _label_stats(y_signed, ws):
 
 
 @partial(jax.jit, static_argnames=("max_depth",))
-def _tree_margin(X, feature, threshold, leaf_stats, *, max_depth):
-    """Mean-residual leaf value of a single regression tree, per row."""
+def _forest_margins(X, feature, threshold, leaf_stats, *, max_depth):
+    """Per-tree mean-residual leaf values [T, N] (the vectorized
+    one-vs-rest path: tree t is class t's tree for this round)."""
     stats = forest_leaf_stats(
         X, feature, threshold, leaf_stats, max_depth=max_depth
-    )  # [1, N, 3]
-    s = stats[0]
-    return s[:, 1] / jnp.maximum(s[:, 0], 1e-12)
+    )  # [T, N, 3]
+    return stats[..., 1] / jnp.maximum(stats[..., 0], 1e-12)
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def _ovr_signed_labels(ys, *, num_classes):
+    """[K, N] signed one-vs-rest labels: +1 where y==k else -1."""
+    k = jnp.arange(num_classes)[:, None]
+    return (2.0 * (ys[None, :] == k) - 1.0).astype(jnp.float32)
+
+
+@jax.jit
+def _ovr_label_stats(y_signed, ws):
+    return jax.vmap(lambda ysk: _label_stats(ysk, ws))(y_signed)  # [K,N,3]
+
+
+@jax.jit
+def _ovr_residual_stats(y_signed, ws, margins):
+    return jax.vmap(
+        lambda ysk, mk: _residual_stats(ysk, ws, mk)
+    )(y_signed, margins)  # [K, N, 3]
+
+
+def _prepare_boosting(classifier: "GBTClassifier", X, y, w, mesh):
+    """Shared boosting setup for the sequential (binary, checkpointable)
+    and vectorized one-vs-rest paths — ONE place for the bin edges,
+    sharding, grower kwargs, and the per-round subsample-mask seed, so the
+    two paths cannot drift apart (they must train identical trees)."""
+    n, F = X.shape
+    n_bins = classifier.getMaxBins()
+    seed = classifier.getSeed()
+    rate = classifier.getSubsamplingRate()
+
+    edges = quantile_bin_edges(X, max_bins=n_bins, seed=seed)
+    xs, ys, _ = shard_batch(mesh, X, y.astype(np.int32))
+    ws = shard_weights(mesh, w, xs.shape[0])
+    binned = bin_features(xs, jnp.asarray(edges))
+
+    subset_k = resolve_feature_subset_k(
+        classifier.getFeatureSubsetStrategy(), F, 1, is_classification=False
+    )
+    grow_kwargs = dict(
+        n_bins=n_bins,
+        max_depth=classifier.getMaxDepth(),
+        min_instances_per_node=float(classifier.getMinInstancesPerNode()),
+        min_info_gain=float(classifier.getMinInfoGain()),
+        subset_k=subset_k,
+        impurity="variance",
+    )
+
+    def round_mask(i: int) -> np.ndarray:
+        """Host [n_pad] subsample mask for boosting round ``i`` —
+        per-round seeded: resume-deterministic (checkpointing)."""
+        if rate < 1.0:
+            r = np.random.default_rng(seed + 7919 * (i + 1))
+            return (r.random(xs.shape[0]) < rate).astype(np.float32)
+        return np.ones(xs.shape[0], np.float32)
+
+    return edges, xs, ys, ws, binned, grow_kwargs, round_mask
 
 
 class _GbtParams(_TreeEnsembleParams):
@@ -93,37 +150,16 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
         n_bins = self.getMaxBins()
         n_rounds = self.getMaxIter()
         step = self.getStepSize()
-
-        edges = quantile_bin_edges(X, max_bins=n_bins, seed=self.getSeed())
-        xs, ys, _ = shard_batch(mesh, X, y.astype(np.int32))
-        ws = shard_weights(mesh, w, xs.shape[0])
         axis = mesh.axis_names[0]
 
-        binned = bin_features(xs, jnp.asarray(edges))
+        edges, xs, ys, ws, binned, grow_kwargs, round_mask = _prepare_boosting(
+            self, X, y, w, mesh
+        )
         y_signed = (2.0 * ys - 1.0).astype(jnp.float32)
 
-        rate = self.getSubsamplingRate()
-        subset_k = resolve_feature_subset_k(
-            self.getFeatureSubsetStrategy(), F, 1, is_classification=False
-        )
-        grow_kwargs = dict(
-            n_bins=n_bins,
-            max_depth=self.getMaxDepth(),
-            min_instances_per_node=float(self.getMinInstancesPerNode()),
-            min_info_gain=float(self.getMinInfoGain()),
-            subset_k=subset_k,
-            impurity="variance",
-        )
-
         def round_weights(i):
-            if rate < 1.0:
-                # per-round seeded: resume-deterministic (checkpointing)
-                r = np.random.default_rng(self.getSeed() + 7919 * (i + 1))
-                mask = (r.random(xs.shape[0]) < rate).astype(np.float32)
-            else:
-                mask = np.ones(xs.shape[0], np.float32)
             return jax.device_put(
-                mask[None, :], NamedSharding(mesh, P(None, axis))
+                round_mask(i)[None, :], NamedSharding(mesh, P(None, axis))
             )
 
         # mid-fit round checkpointing (SURVEY.md §5.4): resume skips
@@ -163,13 +199,13 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
                 binned, row_stats, round_weights(m), edges,
                 seed=self.getSeed() + m, mesh=mesh, **grow_kwargs,
             )
-            contrib = _tree_margin(
+            contrib = _forest_margins(
                 xs,
                 jnp.asarray(forest.feature),
                 jnp.asarray(forest.threshold),
                 jnp.asarray(forest.leaf_stats),
                 max_depth=forest.max_depth,
-            )
+            )[0]
             margin = margin + tree_weight * contrib
             features.append(forest.feature[0])
             thresholds.append(forest.threshold[0])
@@ -291,3 +327,103 @@ class GBTClassificationModel(_GbtParams, ClassificationModel):
     def _raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
         p1 = 1.0 / (1.0 + np.exp(-raw[:, 1]))
         return np.stack([1.0 - p1, p1], axis=1)
+
+
+def fit_gbt_ovr_vectorized(
+    classifier: "GBTClassifier",
+    X: np.ndarray,
+    y: np.ndarray,
+    w: np.ndarray,
+    num_classes: int,
+    mesh,
+) -> list:
+    """All K one-vs-rest binary GBT fits in ONE boosting loop [B:10].
+
+    The class axis rides the grower's tree axis: every round grows K trees
+    over the SAME binned features with per-class residual stats
+    (``row_stats[K, N, 3]``) — K× fewer level passes, host syncs, and
+    binning passes than OneVsRest's sequential sub-fits, and the K-wide
+    histograms batch better on the MXU (SURVEY.md §7.2 item 4).
+
+    Exactly reproduces the sequential fits when ``featureSubsetStrategy=
+    "all"`` (the GBT default): the per-round subsampling mask is shared
+    across classes, matching sequential OneVsRest where every class copy
+    carries the same seed.  With feature subsetting the per-class random
+    subsets differ from the sequential run (documented deviation).
+
+    Returns a list of K fitted :class:`GBTClassificationModel`.
+    """
+    n, F = X.shape
+    K = int(num_classes)
+    n_rounds = classifier.getMaxIter()
+    step = classifier.getStepSize()
+    seed = classifier.getSeed()
+    axis = mesh.axis_names[0]
+
+    edges, xs, ys, ws, binned, grow_kwargs, round_mask = _prepare_boosting(
+        classifier, X, y, w, mesh
+    )
+    n_pad = xs.shape[0]
+    y_signed = _ovr_signed_labels(ys, num_classes=K)  # [K, Np]
+    row_sharding = NamedSharding(mesh, P(None, axis))
+
+    def round_weights(i):
+        # one [n_pad] host->device transfer; the K-way copy happens
+        # on-device (no K redundant host buffers on the fit hot loop)
+        mask = jax.device_put(round_mask(i), NamedSharding(mesh, P(axis)))
+        return jax.jit(
+            lambda v: jnp.broadcast_to(v[None], (K, n_pad)),
+            out_shardings=row_sharding,
+        )(mask)
+
+    margins = jax.device_put(np.zeros((K, n_pad), np.float32), row_sharding)
+    feats, thrs, lvs, gns, cnts, wts = [], [], [], [], [], []
+    for m in range(n_rounds):
+        if m == 0:
+            row_stats = _ovr_label_stats(y_signed, ws)
+            tree_weight = 1.0
+        else:
+            row_stats = _ovr_residual_stats(y_signed, ws, margins)
+            tree_weight = step
+        forest = grow_forest(
+            binned, row_stats, round_weights(m), edges,
+            seed=seed + m, mesh=mesh, **grow_kwargs,
+        )
+        contribs = _forest_margins(
+            xs,
+            jnp.asarray(forest.feature),
+            jnp.asarray(forest.threshold),
+            jnp.asarray(forest.leaf_stats),
+            max_depth=forest.max_depth,
+        )  # [K, Np]
+        margins = margins + tree_weight * contribs
+        feats.append(forest.feature)
+        thrs.append(forest.threshold)
+        lvs.append(forest.leaf_stats)
+        gns.append(forest.gain)
+        cnts.append(forest.count)
+        wts.append(tree_weight)
+
+    tree_weights = np.asarray(wts, np.float32)
+    models = []
+    for c in range(K):
+        ensemble = Forest(
+            feature=np.stack([f[c] for f in feats]),
+            threshold=np.stack([t[c] for t in thrs]),
+            leaf_stats=np.stack([l[c] for l in lvs]),
+            max_depth=classifier.getMaxDepth(),
+            gain=np.stack([g[c] for g in gns]),
+            count=np.stack([ct[c] for ct in cnts]),
+        )
+        model = GBTClassificationModel(
+            forest=ensemble, tree_weights=tree_weights, n_features=F,
+        )
+        model.setParams(
+            **{
+                k2: v
+                for k2, v in classifier.paramValues().items()
+                if model.hasParam(k2)
+            }
+        )
+        models.append(model)
+    return models

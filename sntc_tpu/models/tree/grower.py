@@ -158,7 +158,9 @@ def _stat_count(stats: jnp.ndarray, impurity: str) -> jnp.ndarray:
 def _level_pass(
     binned,  # [N, F] int32, row-sharded
     binned_t,  # [F, N] int32, row-sharded on axis 1 (pallas layout)
-    row_stats,  # [N, S] f32, row-sharded (user weight folded in)
+    row_stats,  # [N, S] f32 shared, or [T, N, S] per-tree (the vectorized
+    #            one-vs-rest path: every "tree" is a different binary
+    #            problem over the same binned features) — row-sharded
     w_trees,  # [T, N] f32 bagging weights, sharded on N
     node_idx,  # [T, N] int32 (-1 = inactive), sharded on N
     key,  # PRNG key for feature subsetting
@@ -175,8 +177,9 @@ def _level_pass(
     interpret: bool = False,
 ):
     n, F = binned.shape
-    S = row_stats.shape[1]
+    S = row_stats.shape[-1]
     T = w_trees.shape[0]
+    per_tree_stats = row_stats.ndim == 3
 
     # ---- histogram: [T, nodes, F, B, S] ------------------------------------
     if hist_impl == "pallas":
@@ -187,33 +190,39 @@ def _level_pass(
         from sntc_tpu.ops.pallas_histogram import level_histogram_pallas
 
         axis = mesh.axis_names[0]
+        rs_spec = (
+            P(None, axis, None) if per_tree_stats else P(axis, None)
+        )
 
         def shard_fn(bt, rs, wt, ni):
             def one_tree(args):
-                w_t, node_t = args
-                active = (node_t >= 0).astype(rs.dtype)
-                data = rs * (w_t * active)[:, None]
+                w_t, node_t, rs_t = args
+                active = (node_t >= 0).astype(rs_t.dtype)
+                data = rs_t * (w_t * active)[:, None]
                 return level_histogram_pallas(
                     bt, node_t, data,
                     n_nodes=n_nodes, n_bins=n_bins, interpret=interpret,
                 )  # [F, nodes*B, S]
 
-            hs = jax.lax.map(one_tree, (wt, ni))  # [T, F, nodes*B, S]
+            rs_all = (
+                rs if per_tree_stats
+                else jnp.broadcast_to(rs[None], (wt.shape[0],) + rs.shape)
+            )
+            hs = jax.lax.map(one_tree, (wt, ni, rs_all))  # [T, F, nodes*B, S]
             return jax.lax.psum(hs, axis)
 
         hists = jax.shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(P(None, axis), P(axis, None), P(None, axis), P(None, axis)),
+            in_specs=(P(None, axis), rs_spec, P(None, axis), P(None, axis)),
             out_specs=P(),
             check_vma=False,  # pallas_call outputs carry no vma metadata
         )(binned_t, row_stats, w_trees, node_idx)
     else:
-        def per_tree(args):
-            w_t, node_t = args
-            active = (node_t >= 0).astype(row_stats.dtype)
+        def hist_one(w_t, node_t, rs_t):
+            active = (node_t >= 0).astype(rs_t.dtype)
             ids = jnp.where(node_t >= 0, node_t, 0)
-            data = row_stats * (w_t * active)[:, None]
+            data = rs_t * (w_t * active)[:, None]
 
             def per_feature(carry, f):
                 seg = ids * n_bins + binned[:, f]
@@ -225,7 +234,15 @@ def _level_pass(
             _, hists = jax.lax.scan(per_feature, 0, jnp.arange(F))
             return hists  # [F, nodes*B, S]
 
-        hists = jax.lax.map(per_tree, (w_trees, node_idx))  # [T,F,nodes*B,S]
+        if per_tree_stats:
+            hists = jax.lax.map(
+                lambda args: hist_one(*args), (w_trees, node_idx, row_stats)
+            )
+        else:
+            hists = jax.lax.map(
+                lambda args: hist_one(args[0], args[1], row_stats),
+                (w_trees, node_idx),
+            )  # [T, F, nodes*B, S]
     hist = hists.reshape(T, F, n_nodes, n_bins, S).transpose(0, 2, 1, 3, 4)
 
     # ---- split evaluation --------------------------------------------------
@@ -307,12 +324,14 @@ def _level_pass(
 
 @jax.jit
 def _root_stats(row_stats, w_trees):
+    if row_stats.ndim == 3:
+        return jnp.einsum("tn,tns->ts", w_trees, row_stats)
     return jnp.einsum("tn,ns->ts", w_trees, row_stats)
 
 
 def grow_forest(
     binned,  # [N, F] int32 (device, row-sharded)
-    row_stats,  # [N, S] f32 (device, row-sharded)
+    row_stats,  # [N, S] shared or [T, N, S] per-tree f32 (device, row-sharded)
     w_trees,  # [T, N] f32 (device, sharded on N axis=1)
     edges: np.ndarray,  # [F, B-1] host bin thresholds
     *,
@@ -345,7 +364,7 @@ def grow_forest(
     )
     T = w_trees.shape[0]
     n, F = binned.shape
-    S = row_stats.shape[1]
+    S = row_stats.shape[-1]
     H = (1 << (max_depth + 1)) - 1
 
     feature = np.full((T, H), -2, np.int32)
