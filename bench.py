@@ -301,9 +301,14 @@ def measure_baseline(configs, rows):
         with open(BASELINE_CACHE) as f:
             cache = json.load(f)
 
-    def record(cfg, desc, fn, train):
+    from sklearn.metrics import f1_score, roc_auc_score
+
+    def record(cfg, desc, fn, train, quality_fn=None):
+        """Time ``fn`` (fit; returns quality inputs) and record proxy
+        train-wall-clock + held-out quality — the 'equal macro-F1' side of
+        the [B:2] metric of record, measured for the proxy too."""
         t0 = time.perf_counter()
-        fn()
+        fitted = fn()
         dt = time.perf_counter() - t0
         cache[cfg] = {
             "baseline": f"sklearn CPU proxy: {desc}",
@@ -311,49 +316,84 @@ def measure_baseline(configs, rows):
             "n_rows": int(train.num_rows),
             "host_cpus": os.cpu_count(),
         }
-        print(f"baseline config {cfg}: {dt:.1f}s", file=sys.stderr)
+        if quality_fn is not None:
+            cache[cfg]["quality"] = quality_fn(fitted)
+        print(
+            f"baseline config {cfg}: {dt:.1f}s {cache[cfg].get('quality', '')}",
+            file=sys.stderr,
+        )
 
     for cfg in configs:
         n = rows or DEFAULT_ROWS[cfg]
         if cfg == "1":
-            train, _ = _dataset(n, binary=True)
+            train, test = _dataset(n, binary=True)
             X, y = _proxy_xy(train)
+            Xt, yt = _proxy_xy(test)
+
+            def fit_lr():
+                scaler = SkScaler().fit(X)
+                return scaler, SkLR(max_iter=LR_MAX_ITER, tol=1e-6).fit(
+                    scaler.transform(X), y
+                )
+
             record(
-                "1", "LogisticRegression lbfgs, standardized",
-                lambda: SkLR(max_iter=LR_MAX_ITER, tol=1e-6).fit(
-                    SkScaler().fit_transform(X), y
-                ),
-                train,
+                "1", "LogisticRegression lbfgs, standardized", fit_lr, train,
+                lambda f: {
+                    "areaUnderROC": float(roc_auc_score(
+                        yt, f[1].predict_proba(f[0].transform(Xt))[:, 1]
+                    ))
+                },
             )
         elif cfg == "2":
-            train, _ = _dataset(n)
+            train, test = _dataset(n)
             X, y = _proxy_xy(train)
-            record(
-                "2", "MLPClassifier 78-64-15 logistic lbfgs 100 iters",
-                lambda: MLPClassifier(
+            Xt, yt = _proxy_xy(test)
+
+            def fit_mlp():
+                scaler = SkScaler().fit(X)
+                return scaler, MLPClassifier(
                     hidden_layer_sizes=(MLP_LAYERS[1],), activation="logistic",
                     solver="lbfgs", max_iter=MLP_MAX_ITER, tol=1e-6,
                     random_state=0,
-                ).fit(SkScaler().fit_transform(X), y),
-                train,
+                ).fit(scaler.transform(X), y)
+
+            record(
+                "2", "MLPClassifier 78-64-15 logistic lbfgs 100 iters",
+                fit_mlp, train,
+                lambda f: {
+                    "macro_f1": float(f1_score(
+                        yt, f[1].predict(f[0].transform(Xt)), average="macro"
+                    ))
+                },
             )
         elif cfg == "3":
-            train, _ = _dataset(n)
+            train, test = _dataset(n)
             X, y = _proxy_xy(train)
+            Xt, yt = _proxy_xy(test)
 
             def fit_rf():
-                Xs = SelectKBest(chi2, k=CHISQ_TOP).fit_transform(
-                    MinMaxScaler().fit_transform(X), y
-                )
-                SkRF(
+                mm = MinMaxScaler().fit(X)
+                sel = SelectKBest(chi2, k=CHISQ_TOP).fit(mm.transform(X), y)
+                rf = SkRF(
                     n_estimators=RF_TREES, max_depth=RF_DEPTH, n_jobs=-1,
                     random_state=0,
-                ).fit(Xs, y)
+                ).fit(sel.transform(mm.transform(X)), y)
+                return mm, sel, rf
 
-            record("3", f"SelectKBest(chi2,k={CHISQ_TOP}) + RF", fit_rf, train)
+            record(
+                "3", f"SelectKBest(chi2,k={CHISQ_TOP}) + RF", fit_rf, train,
+                lambda f: {
+                    "macro_f1": float(f1_score(
+                        yt,
+                        f[2].predict(f[1].transform(f[0].transform(Xt))),
+                        average="macro",
+                    ))
+                },
+            )
         elif cfg == "4":
-            train, _ = _dataset(n)
+            train, test = _dataset(n)
             X, y = _proxy_xy(train)
+            Xt, yt = _proxy_xy(test)
             record(
                 "4", f"OneVsRest(GradientBoosting x{GBT_ROUNDS})",
                 lambda: OneVsRestClassifier(
@@ -363,6 +403,11 @@ def measure_baseline(configs, rows):
                     )
                 ).fit(X, y),
                 train,
+                lambda f: {
+                    "macro_f1": float(f1_score(
+                        yt, f.predict(Xt), average="macro"
+                    ))
+                },
             )
         elif cfg == "5":
             train, test = _dataset(n, binary=True)
@@ -394,15 +439,19 @@ def measure_baseline(configs, rows):
     return cache
 
 
-def _vs_baseline(cfg: str, result: dict):
+def _load_baseline(cfg: str) -> dict:
     if not os.path.exists(BASELINE_CACHE):
-        return None
+        return {}
     with open(BASELINE_CACHE) as f:
         cache = json.load(f)
     base = cache.get(cfg)
     if base is None and cfg == "2" and "train_s" in cache:
         base = cache  # legacy single-config cache layout
-    if base is None:
+    return base or {}
+
+
+def _vs_baseline(cfg: str, result: dict, base: dict):
+    if not base:
         return None
     if cfg == "5":
         return result["value"] / base["rows_per_s"]  # throughput ratio
@@ -417,12 +466,13 @@ def run_config(cfg: str, rows):
 
     mesh = get_default_mesh()
     result = BENCHES[cfg](rows or DEFAULT_ROWS[cfg], mesh)
+    base = _load_baseline(cfg)
     line = {
         "metric": result["metric"],
         "value": round(result["value"], 3),
         "unit": result.get("unit", "s"),
         "vs_baseline": (
-            round(v, 2) if (v := _vs_baseline(cfg, result)) else None
+            round(v, 2) if (v := _vs_baseline(cfg, result, base)) else None
         ),
     }
     for k in ("cold_value", "n_rows"):
@@ -431,9 +481,34 @@ def run_config(cfg: str, rows):
                 round(result[k], 3) if isinstance(result[k], float) else result[k]
             )
     line.update(result.get("quality", {}))
+    if "quality" in base:
+        line["baseline_quality"] = base["quality"]
     line["platform"] = jax.devices()[0].platform
     line["baseline"] = "sklearn-cpu-proxy (baseline_proxy.json)"
     return line
+
+
+def _probe_default_backend() -> bool:
+    """True if the default JAX backend initializes within the timeout.
+
+    Probed in a SUBPROCESS: backend init on a hung TPU tunnel blocks
+    forever with no interruptible handle, so the only safe way to test it
+    is from a process we can kill (``BENCH_PROBE_TIMEOUT_S`` to tune, 0
+    disables the probe and trusts the backend)."""
+    import subprocess
+
+    timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 180))
+    if timeout_s <= 0:
+        return True
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 
 def main():
@@ -449,17 +524,29 @@ def main():
     )
     args = ap.parse_args()
 
-    if args.platform:
-        import jax
-
-        jax.config.update("jax_platforms", args.platform)
-
     configs = list(BENCHES) if args.config == "all" else [args.config]
 
     if args.measure_baseline:
+        # sklearn-only path: no JAX, so no backend probe needed
         cache = measure_baseline(configs, args.rows)
         print(json.dumps({c: cache.get(c) for c in configs}))
         return
+
+    platform = args.platform
+    if not platform and not _probe_default_backend():
+        # the TPU tunnel can hang indefinitely inside jax.devices(); a
+        # hung bench records nothing — fall back to CPU, clearly labeled
+        # (the "platform" field in the output line shows what really ran)
+        print(
+            "bench: default JAX backend unreachable (probe timeout); "
+            "falling back to platform=cpu",
+            file=sys.stderr,
+        )
+        platform = "cpu"
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
 
     # flagship (config 2) last so the driver's final line is the headline
     ordered = sorted(configs, key=lambda c: (c == "2", c))

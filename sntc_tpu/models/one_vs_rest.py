@@ -74,7 +74,12 @@ class OneVsRest(_OvrParams, ClassifierEstimator):
 
         if not isinstance(self.classifier, GBTClassifier):
             return None
-        if self.classifier.getCheckpointInterval() > 0:
+        # sequential only when checkpointing would actually happen (both
+        # interval AND dir set — matching GBTClassifier._fit's own gate)
+        if (
+            self.classifier.getCheckpointInterval() > 0
+            and self.classifier.getCheckpointDir()
+        ):
             return None
         # a weightCol set on the classifier itself (not this OvR) refers to
         # a column of the relabeled sub-frame — only the sequential path

@@ -367,14 +367,18 @@ def fit_gbt_ovr_vectorized(
     y_signed = _ovr_signed_labels(ys, num_classes=K)  # [K, Np]
     row_sharding = NamedSharding(mesh, P(None, axis))
 
+    # built once: a per-round jit(lambda) would retrace every round
+    broadcast_k = jax.jit(
+        lambda v: jnp.broadcast_to(v[None], (K, n_pad)),
+        out_shardings=row_sharding,
+    )
+
     def round_weights(i):
         # one [n_pad] host->device transfer; the K-way copy happens
         # on-device (no K redundant host buffers on the fit hot loop)
-        mask = jax.device_put(round_mask(i), NamedSharding(mesh, P(axis)))
-        return jax.jit(
-            lambda v: jnp.broadcast_to(v[None], (K, n_pad)),
-            out_shardings=row_sharding,
-        )(mask)
+        return broadcast_k(
+            jax.device_put(round_mask(i), NamedSharding(mesh, P(axis)))
+        )
 
     margins = jax.device_put(np.zeros((K, n_pad), np.float32), row_sharding)
     feats, thrs, lvs, gns, cnts, wts = [], [], [], [], [], []

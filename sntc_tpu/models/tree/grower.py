@@ -195,8 +195,7 @@ def _level_pass(
         )
 
         def shard_fn(bt, rs, wt, ni):
-            def one_tree(args):
-                w_t, node_t, rs_t = args
+            def hist_one(w_t, node_t, rs_t):
                 active = (node_t >= 0).astype(rs_t.dtype)
                 data = rs_t * (w_t * active)[:, None]
                 return level_histogram_pallas(
@@ -204,11 +203,14 @@ def _level_pass(
                     n_nodes=n_nodes, n_bins=n_bins, interpret=interpret,
                 )  # [F, nodes*B, S]
 
-            rs_all = (
-                rs if per_tree_stats
-                else jnp.broadcast_to(rs[None], (wt.shape[0],) + rs.shape)
-            )
-            hs = jax.lax.map(one_tree, (wt, ni, rs_all))  # [T, F, nodes*B, S]
+            if per_tree_stats:
+                hs = jax.lax.map(lambda a: hist_one(*a), (wt, ni, rs))
+            else:
+                # shared stats stay closure-captured (no [T, n, S]
+                # broadcast materialized per shard)
+                hs = jax.lax.map(
+                    lambda a: hist_one(a[0], a[1], rs), (wt, ni)
+                )  # [T, F, nodes*B, S]
             return jax.lax.psum(hs, axis)
 
         hists = jax.shard_map(
