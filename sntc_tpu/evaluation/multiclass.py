@@ -95,6 +95,15 @@ class MulticlassMetrics:
     def recall_by_label(self) -> np.ndarray:
         return self._safe_div(self.true_positives, self.label_counts)
 
+    # Spark aliases: TPR == recall
+    true_positive_rate_by_label = recall_by_label
+
+    def false_positive_rate_by_label(self) -> np.ndarray:
+        """FP / negatives per class (Spark ``falsePositiveRateByLabel``)."""
+        fp = self.prediction_counts - self.true_positives
+        negatives = self.confusion.sum() - self.label_counts
+        return self._safe_div(fp, negatives)
+
     def f_measure_by_label(self, beta: float = 1.0) -> np.ndarray:
         p, r = self.precision_by_label(), self.recall_by_label()
         b2 = beta * beta
@@ -121,6 +130,21 @@ class MulticlassMetrics:
     def weighted_f_measure(self, beta: float = 1.0) -> float:
         return float((self._weights() * self.f_measure_by_label(beta)).sum())
 
+    def weighted_true_positive_rate(self) -> float:
+        return self.weighted_recall()
+
+    def weighted_false_positive_rate(self) -> float:
+        return float(
+            (self._weights() * self.false_positive_rate_by_label()).sum()
+        )
+
+    def hamming_loss(self) -> float:
+        """Misclassification fraction (single-label: 1 − accuracy)."""
+        total = self.confusion.sum()
+        if not total:
+            return 0.0
+        return float((total - self.true_positives.sum()) / total)
+
     def macro_f1(self) -> float:
         """Unweighted mean of per-class F1 over classes present in the TRUE
         labels ([B:2] metric of record)."""
@@ -130,23 +154,41 @@ class MulticlassMetrics:
 
 
 class MulticlassClassificationEvaluator:
-    """Spark-parity evaluator facade over :class:`MulticlassMetrics`."""
+    """Spark-parity evaluator facade over :class:`MulticlassMetrics`.
+
+    ``metricLabel`` selects the class for the ``...ByLabel`` metrics;
+    ``logLoss`` reads ``probabilityCol`` (Spark semantics: −log of the
+    true-class probability, clamped by ``eps``)."""
 
     _METRICS = (
         "f1",
         "accuracy",
         "weightedPrecision",
         "weightedRecall",
+        "weightedTruePositiveRate",
+        "weightedFalsePositiveRate",
         "weightedFMeasure",
+        "truePositiveRateByLabel",
+        "falsePositiveRateByLabel",
+        "precisionByLabel",
+        "recallByLabel",
+        "fMeasureByLabel",
+        "logLoss",
+        "hammingLoss",
         "macroF1",
     )
+    _SMALLER_IS_BETTER = ("logLoss", "hammingLoss", "weightedFalsePositiveRate",
+                          "falsePositiveRateByLabel")
 
     def __init__(
         self,
         metricName: str = "f1",
         labelCol: str = "label",
         predictionCol: str = "prediction",
+        probabilityCol: str = "probability",
+        metricLabel: float = 0.0,
         beta: float = 1.0,
+        eps: float = 1e-15,
         mesh=None,
     ):
         if metricName not in self._METRICS:
@@ -156,7 +198,10 @@ class MulticlassClassificationEvaluator:
         self.metricName = metricName
         self.labelCol = labelCol
         self.predictionCol = predictionCol
+        self.probabilityCol = probabilityCol
+        self.metricLabel = metricLabel
         self.beta = beta
+        self.eps = eps
         self._mesh = mesh
 
     def metrics(self, frame: Frame) -> MulticlassMetrics:
@@ -164,20 +209,43 @@ class MulticlassClassificationEvaluator:
             frame[self.labelCol], frame[self.predictionCol], mesh=self._mesh
         )
 
+    def _log_loss(self, frame: Frame) -> float:
+        prob = np.asarray(frame[self.probabilityCol], np.float64)
+        y = np.asarray(frame[self.labelCol]).astype(np.int64)
+        p_true = prob[np.arange(len(y)), y]
+        return float(-np.mean(np.log(np.clip(p_true, self.eps, None))))
+
     def evaluate(self, frame: Frame) -> float:
-        m = self.metrics(frame)
         name = self.metricName
+        if name == "logLoss":
+            return self._log_loss(frame)
+        m = self.metrics(frame)
+        lbl = int(self.metricLabel)
         if name == "f1":
             return m.weighted_f_measure()
         if name == "accuracy":
             return m.accuracy
         if name == "weightedPrecision":
             return m.weighted_precision()
-        if name == "weightedRecall":
+        if name in ("weightedRecall", "weightedTruePositiveRate"):
             return m.weighted_recall()
+        if name == "weightedFalsePositiveRate":
+            return m.weighted_false_positive_rate()
         if name == "weightedFMeasure":
             return m.weighted_f_measure(self.beta)
+        if name == "truePositiveRateByLabel":
+            return float(m.recall_by_label()[lbl])
+        if name == "falsePositiveRateByLabel":
+            return float(m.false_positive_rate_by_label()[lbl])
+        if name == "precisionByLabel":
+            return float(m.precision_by_label()[lbl])
+        if name == "recallByLabel":
+            return float(m.recall_by_label()[lbl])
+        if name == "fMeasureByLabel":
+            return float(m.f_measure_by_label(self.beta)[lbl])
+        if name == "hammingLoss":
+            return m.hamming_loss()
         return m.macro_f1()
 
     def isLargerBetter(self) -> bool:
-        return True
+        return self.metricName not in self._SMALLER_IS_BETTER

@@ -54,6 +54,10 @@ class _TuningParams:
     )
     collectSubModels = Param("keep every (fold, grid) sub-model", default=False,
                              validator=validators.is_bool())
+    foldCol = Param(
+        "optional column of user-assigned fold indices in [0, numFolds)",
+        default=None,
+    )
 
 
 class CrossValidator(_TuningParams, Estimator):
@@ -68,8 +72,26 @@ class CrossValidator(_TuningParams, Estimator):
 
     def _fit(self, frame: Frame) -> "CrossValidatorModel":
         k = self.getNumFolds()
-        rng = np.random.default_rng(self.getSeed())
-        fold_of = rng.integers(0, k, size=frame.num_rows)
+        if self.getFoldCol():
+            raw = np.asarray(frame[self.getFoldCol()])
+            fold_of = raw.astype(np.int64)
+            if not np.array_equal(raw.astype(np.float64), fold_of):
+                raise ValueError("foldCol values must be integers")
+            if fold_of.min(initial=0) < 0 or fold_of.max(initial=0) >= k:
+                raise ValueError(
+                    f"foldCol values must lie in [0, numFolds={k})"
+                )
+            present = np.bincount(fold_of, minlength=k)
+            if (present == 0).any():
+                empty = np.flatnonzero(present == 0).tolist()
+                raise ValueError(
+                    f"foldCol leaves folds {empty} empty: every fold in "
+                    f"[0, numFolds={k}) needs rows (an empty fold would be "
+                    "silently fit/scored on nothing)"
+                )
+        else:
+            rng = np.random.default_rng(self.getSeed())
+            fold_of = rng.integers(0, k, size=frame.num_rows)
         grid = self.estimatorParamMaps
         metrics = np.zeros((len(grid), k))
         sub_models: Optional[List[List[Model]]] = (
@@ -131,6 +153,8 @@ class _TvsParams:
     trainRatio = Param("train fraction", default=0.75, validator=validators.in_range(0, 1))
     seed = Param("split seed", default=0)
     parallelism = Param("API parity only", default=1, validator=validators.gteq(1))
+    collectSubModels = Param("keep every grid-point sub-model", default=False,
+                             validator=validators.is_bool())
 
 
 class TrainValidationSplit(_TvsParams, Estimator):
@@ -152,9 +176,14 @@ class TrainValidationSplit(_TvsParams, Estimator):
         )
         grid = self.estimatorParamMaps
         metrics = []
+        sub_models: Optional[List[Model]] = (
+            [] if self.getCollectSubModels() else None
+        )
         for params in grid:
             model = self.estimator.copy(params).fit(train)
             metrics.append(self.evaluator.evaluate(model.transform(valid)))
+            if sub_models is not None:
+                sub_models.append(model)
         arr = np.asarray(metrics)
         best_idx = (
             int(np.argmax(arr))
@@ -163,17 +192,19 @@ class TrainValidationSplit(_TvsParams, Estimator):
         )
         best_model = self.estimator.copy(grid[best_idx]).fit(frame)
         return TrainValidationSplitModel(
-            bestModel=best_model, validationMetrics=metrics, bestIndex=best_idx
+            bestModel=best_model, validationMetrics=metrics,
+            bestIndex=best_idx, subModels=sub_models,
         )
 
 
 class TrainValidationSplitModel(Model):
     def __init__(self, bestModel: Model = None, validationMetrics=None,
-                 bestIndex: int = 0, **kwargs):
+                 bestIndex: int = 0, subModels=None, **kwargs):
         super().__init__(**kwargs)
         self.bestModel = bestModel
         self.validationMetrics = validationMetrics or []
         self.bestIndex = bestIndex
+        self.subModels = subModels
 
     def transform(self, frame: Frame) -> Frame:
         return self.bestModel.transform(frame)

@@ -99,3 +99,50 @@ def test_binary_evaluator_uses_raw_column():
     f = Frame({"label": y, "rawPrediction": raw})
     ev = BinaryClassificationEvaluator()
     assert ev.evaluate(f) == pytest.approx(1.0)
+
+
+def test_extended_multiclass_metrics_match_sklearn(mesh8):
+    from sklearn.metrics import (
+        hamming_loss as sk_hamming,
+        log_loss as sk_logloss,
+        precision_score,
+        recall_score,
+    )
+
+    rng = np.random.default_rng(9)
+    y = rng.integers(0, 4, size=500).astype(np.float64)
+    p = rng.integers(0, 4, size=500).astype(np.float64)
+    prob = rng.dirichlet(np.ones(4), size=500)
+    f = Frame({"label": y, "prediction": p, "probability": prob})
+
+    def ev(name, **kw):
+        return MulticlassClassificationEvaluator(
+            metricName=name, mesh=mesh8, **kw
+        ).evaluate(f)
+
+    assert ev("hammingLoss") == pytest.approx(sk_hamming(y, p))
+    assert ev("logLoss") == pytest.approx(
+        sk_logloss(y, prob, labels=[0, 1, 2, 3])
+    )
+    assert ev("precisionByLabel", metricLabel=2) == pytest.approx(
+        precision_score(y, p, labels=[2], average="macro", zero_division=0)
+    )
+    assert ev("recallByLabel", metricLabel=3) == pytest.approx(
+        recall_score(y, p, labels=[3], average="macro", zero_division=0)
+    )
+    assert ev("truePositiveRateByLabel", metricLabel=1) == pytest.approx(
+        recall_score(y, p, labels=[1], average="macro", zero_division=0)
+    )
+    # FPR by label: FP / negatives, cross-checked by hand
+    fp = ((p == 2) & (y != 2)).sum()
+    assert ev("falsePositiveRateByLabel", metricLabel=2) == pytest.approx(
+        fp / (y != 2).sum()
+    )
+    assert ev("weightedTruePositiveRate") == pytest.approx(
+        ev("weightedRecall")
+    )
+    # smaller-is-better metrics invert the tuning direction
+    assert not MulticlassClassificationEvaluator(
+        metricName="logLoss"
+    ).isLargerBetter()
+    assert MulticlassClassificationEvaluator(metricName="f1").isLargerBetter()

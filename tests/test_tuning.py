@@ -104,3 +104,48 @@ def test_utils_metrics_logger(tmp_path):
     with t.phase("a"):
         pass
     assert t.counts["a"] == 2 and "a" in t.summary()
+
+
+def test_cross_validator_fold_col(mesh8):
+    f = _data(n=400, seed=3)
+    folds = (np.arange(400) % 3).astype(np.float64)
+    f = f.with_column("myfold", folds)
+    cv = CrossValidator(
+        estimator=LogisticRegression(mesh=mesh8, maxIter=20),
+        estimatorParamMaps=ParamGridBuilder().addGrid("regParam", [0.0, 0.1]).build(),
+        evaluator=MulticlassClassificationEvaluator(metricName="accuracy", mesh=mesh8),
+        numFolds=3, foldCol="myfold",
+    ).fit(f)
+    assert len(cv.avgMetrics) == 2
+    with pytest.raises(ValueError, match="foldCol"):
+        CrossValidator(
+            estimator=LogisticRegression(mesh=mesh8),
+            evaluator=MulticlassClassificationEvaluator(mesh=mesh8),
+            numFolds=2, foldCol="myfold",
+        ).fit(f)  # fold index 2 out of range for numFolds=2
+
+
+def test_tvs_collect_sub_models(mesh8):
+    f = _data(n=300, seed=4)
+    tvs = TrainValidationSplit(
+        estimator=LogisticRegression(mesh=mesh8, maxIter=20),
+        estimatorParamMaps=ParamGridBuilder().addGrid("regParam", [0.0, 0.05]).build(),
+        evaluator=MulticlassClassificationEvaluator(metricName="accuracy", mesh=mesh8),
+        collectSubModels=True,
+    ).fit(f)
+    assert tvs.subModels is not None and len(tvs.subModels) == 2
+
+
+def test_cross_validator_fold_col_rejects_empty_and_fractional(mesh8):
+    f = _data(n=90, seed=5)
+    ev = MulticlassClassificationEvaluator(metricName="accuracy", mesh=mesh8)
+    est = LogisticRegression(mesh=mesh8, maxIter=10)
+    with pytest.raises(ValueError, match="empty"):
+        CrossValidator(
+            estimator=est, evaluator=ev, numFolds=3,
+            foldCol="z",
+        ).fit(f.with_column("z", np.zeros(90)))  # folds 1,2 empty
+    with pytest.raises(ValueError, match="integers"):
+        CrossValidator(
+            estimator=est, evaluator=ev, numFolds=2, foldCol="z",
+        ).fit(f.with_column("z", np.full(90, 0.5)))
