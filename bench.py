@@ -78,7 +78,10 @@ def _feature_stages(mesh, with_scaler=True):
     from sntc_tpu.feature import StandardScaler, StringIndexer, VectorAssembler
 
     stages = [
-        StringIndexer(inputCol="Label", outputCol="label"),
+        # skip: a label unseen in train (possible in small subsets; Spark
+        # apps set this for the same reason) drops the row at transform
+        StringIndexer(inputCol="Label", outputCol="label",
+                      handleInvalid="skip"),
         VectorAssembler(inputCols=CICIDS2017_FEATURES, outputCol="rawFeatures"),
     ]
     if with_scaler:
@@ -280,12 +283,22 @@ BENCHES = {
 # ---------------------------------------------------------------------------
 
 
-def _proxy_xy(train):
+def _proxy_xy(frame, vocab=None):
+    """(X, y, vocab): labels encoded against ``vocab`` (built from this
+    frame when None).  Rows with labels outside the vocab are DROPPED —
+    symmetric with the pipeline under test, whose StringIndexer uses
+    handleInvalid='skip'; per-frame np.unique codes would silently
+    misalign train vs test whenever their label sets differ."""
     from sntc_tpu.data import CICIDS2017_FEATURES
 
-    X = np.stack([train[c] for c in CICIDS2017_FEATURES], axis=1)
-    _, y = np.unique(train["Label"].astype(str), return_inverse=True)
-    return X, y
+    X = np.stack([frame[c] for c in CICIDS2017_FEATURES], axis=1)
+    labels = frame["Label"].astype(str)
+    if vocab is None:
+        vocab = np.unique(labels)
+    idx = np.searchsorted(vocab, labels)
+    idx_c = np.clip(idx, 0, len(vocab) - 1)
+    valid = vocab[idx_c] == labels
+    return X[valid], idx_c[valid].astype(np.int64), vocab
 
 
 def measure_baseline(configs, rows):
@@ -330,8 +343,8 @@ def measure_baseline(configs, rows):
         n = rows or DEFAULT_ROWS[cfg]
         if cfg == "1":
             train, test = _dataset(n, binary=True)
-            X, y = _proxy_xy(train)
-            Xt, yt = _proxy_xy(test)
+            X, y, vocab = _proxy_xy(train)
+            Xt, yt, _ = _proxy_xy(test, vocab)
 
             def fit_lr():
                 scaler = SkScaler().fit(X)
@@ -349,8 +362,8 @@ def measure_baseline(configs, rows):
             )
         elif cfg == "2":
             train, test = _dataset(n)
-            X, y = _proxy_xy(train)
-            Xt, yt = _proxy_xy(test)
+            X, y, vocab = _proxy_xy(train)
+            Xt, yt, _ = _proxy_xy(test, vocab)
 
             def fit_mlp():
                 scaler = SkScaler().fit(X)
@@ -371,8 +384,8 @@ def measure_baseline(configs, rows):
             )
         elif cfg == "3":
             train, test = _dataset(n)
-            X, y = _proxy_xy(train)
-            Xt, yt = _proxy_xy(test)
+            X, y, vocab = _proxy_xy(train)
+            Xt, yt, _ = _proxy_xy(test, vocab)
 
             def fit_rf():
                 mm = MinMaxScaler().fit(X)
@@ -395,8 +408,8 @@ def measure_baseline(configs, rows):
             )
         elif cfg == "4":
             train, test = _dataset(n)
-            X, y = _proxy_xy(train)
-            Xt, yt = _proxy_xy(test)
+            X, y, vocab = _proxy_xy(train)
+            Xt, yt, _ = _proxy_xy(test, vocab)
             record(
                 "4", f"OneVsRest(GradientBoosting x{GBT_ROUNDS})",
                 lambda: OneVsRestClassifier(
@@ -414,28 +427,39 @@ def measure_baseline(configs, rows):
             )
         elif cfg == "5":
             train, test = _dataset(n, binary=True)
-            X, y = _proxy_xy(train)
-            Xt, _ = _proxy_xy(test)
+            X, y, _ = _proxy_xy(train)
             scaler = SkScaler().fit(X)
             clf = SkLR(max_iter=20).fit(scaler.transform(X), y)
+            # symmetric with the engine under test: micro-batches arrive as
+            # COLUMNS (the NetFlow/Arrow record shape [B:11]) and each chunk
+            # pays feature assembly, scaling, and predict
+            from sntc_tpu.data import CICIDS2017_FEATURES
+
+            cols = [
+                np.ascontiguousarray(test[c], dtype=np.float64)
+                for c in CICIDS2017_FEATURES
+            ]
+            n_test = test.num_rows
 
             def serve():
-                per = max(len(Xt) // 20, 1)
+                per = max(n_test // 20, 1)
                 for i in range(20):
-                    chunk = Xt[i * per : (i + 1) * per]
-                    if len(chunk):
+                    s, e = i * per, min((i + 1) * per, n_test)
+                    if e > s:
+                        chunk = np.stack([c[s:e] for c in cols], axis=1)
                         clf.predict_proba(scaler.transform(chunk))
 
             t0 = time.perf_counter()
             serve()
             dt = time.perf_counter() - t0
             cache["5"] = {
-                "baseline": "sklearn CPU proxy: chunked predict_proba",
-                "rows_per_s": len(Xt) / dt,
-                "n_rows": int(len(Xt)),
+                "baseline": "sklearn CPU proxy: columnar chunked "
+                "assemble+scale+predict_proba",
+                "rows_per_s": n_test / dt,
+                "n_rows": int(n_test),
                 "host_cpus": os.cpu_count(),
             }
-            print(f"baseline config 5: {len(Xt)/dt:.0f} rows/s", file=sys.stderr)
+            print(f"baseline config 5: {n_test/dt:.0f} rows/s", file=sys.stderr)
 
     with open(BASELINE_CACHE, "w") as f:
         json.dump(cache, f, indent=1)

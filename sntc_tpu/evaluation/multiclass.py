@@ -195,6 +195,10 @@ class MulticlassClassificationEvaluator:
             raise ValueError(
                 f"unknown metricName {metricName!r}; one of {self._METRICS}"
             )
+        if metricName.endswith("ByLabel") and metricLabel < 0:
+            raise ValueError(
+                f"metricLabel must be a class index >= 0, got {metricLabel}"
+            )
         self.metricName = metricName
         self.labelCol = labelCol
         self.predictionCol = predictionCol
@@ -205,9 +209,23 @@ class MulticlassClassificationEvaluator:
         self._mesh = mesh
 
     def metrics(self, frame: Frame) -> MulticlassMetrics:
-        return MulticlassMetrics(
+        # by-label metrics: size the confusion matrix to cover metricLabel
+        # so a class absent from this frame reads as 0 (the 0/0 -> 0
+        # convention) instead of an IndexError mid-tuning
+        num_classes = (
+            int(self.metricLabel) + 1
+            if self.metricName.endswith("ByLabel")
+            else None
+        )
+        m = MulticlassMetrics(
             frame[self.labelCol], frame[self.predictionCol], mesh=self._mesh
         )
+        if num_classes is not None and m.num_classes < num_classes:
+            m = MulticlassMetrics(
+                frame[self.labelCol], frame[self.predictionCol],
+                num_classes=num_classes, mesh=self._mesh,
+            )
+        return m
 
     def _log_loss(self, frame: Frame) -> float:
         prob = np.asarray(frame[self.probabilityCol], np.float64)

@@ -58,29 +58,49 @@ def _unpack(theta: jnp.ndarray, layers: Tuple[int, ...]):
     return out
 
 
-def _forward(theta: jnp.ndarray, X: jnp.ndarray, layers: Tuple[int, ...]):
-    """Margins (pre-softmax) of the final layer."""
+def _forward(
+    theta: jnp.ndarray,
+    X: jnp.ndarray,
+    layers: Tuple[int, ...],
+    compute_dtype=jnp.float32,
+):
+    """Margins (pre-softmax) of the final layer.
+
+    ``compute_dtype=bfloat16`` feeds the MXU its native input width
+    (double the f32 matmul throughput on v5e) while accumulating in f32
+    (``preferred_element_type``); activations/params stay f32 elsewhere."""
     h = X
     wbs = _unpack(theta, layers)
     for i, (W, b) in enumerate(wbs):
-        z = h @ W + b[None, :]
+        z = (
+            jax.lax.dot(
+                h.astype(compute_dtype),
+                W.astype(compute_dtype),
+                preferred_element_type=jnp.float32,
+            )
+            + b[None, :]
+        )
         h = jax.nn.sigmoid(z) if i < len(wbs) - 1 else z
     return h
 
 
 @partial(
     jax.jit,
-    static_argnames=("layers", "max_iter", "tol", "solver", "step_size", "resume"),
+    static_argnames=(
+        "layers", "max_iter", "tol", "solver", "step_size", "resume",
+        "compute_dtype",
+    ),
 )
 def _mlp_optimize(
     xs, ys, ws, theta0, init_state, iter_limit,
     *, layers, max_iter, tol, solver, step_size, resume=False,
+    compute_dtype=jnp.float32,
 ):
     w_sum = jnp.sum(ws)
 
     def value_and_grad(theta):
         def loss_fn(theta):
-            margins = _forward(theta, xs, layers)
+            margins = _forward(theta, xs, layers, compute_dtype)
             logp = jax.nn.log_softmax(margins, axis=1)
             picked = jnp.take_along_axis(
                 logp, ys[:, None].astype(jnp.int32), axis=1
@@ -140,6 +160,13 @@ class _MlpParams:
         default=128,
         validator=validators.gt(0),
     )
+    computeDtype = Param(
+        "matmul input dtype: float32 | bfloat16 (bf16 feeds the MXU its "
+        "native width — ~2x f32 throughput on v5e — accumulating in f32; "
+        "beyond Spark parity, which is f64 on JVM)",
+        default="float32",
+        validator=validators.one_of("float32", "bfloat16"),
+    )
 
 
 class MultilayerPerceptronClassifier(_MlpParams, CheckpointParams, ClassifierEstimator):
@@ -196,12 +223,14 @@ class MultilayerPerceptronClassifier(_MlpParams, CheckpointParams, ClassifierEst
                 solver=self.getSolver(),
                 step_size=self.getStepSize(),
                 resume=resume,
+                compute_dtype=jnp.dtype(self.getComputeDtype()),
             )
 
         fingerprint = {
             "algo": "mlp", "layers": list(layers), "seed": self.getSeed(),
             "maxIter": self.getMaxIter(), "tol": self.getTol(),
             "solver": self.getSolver(), "n_rows": int(X.shape[0]),
+            "computeDtype": self.getComputeDtype(),
         }
         interval = (
             self.getCheckpointInterval()

@@ -1,0 +1,35 @@
+"""Driver-contract smoke: every bench config runs and emits the agreed
+JSON shape (the driver parses ONE line: metric/value/unit/vs_baseline).
+
+Tiny rows — this is a wiring test, not a measurement."""
+
+import json
+import subprocess
+import sys
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("cfg", ["1", "3", "5"])
+def test_bench_config_emits_contract_line(cfg):
+    env = dict(
+        os.environ,
+        BENCH_ROWS="2000",
+        BENCH_PLATFORM="cpu",
+        BENCH_PROBE_TIMEOUT_S="0",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--config", cfg],
+        env=env, capture_output=True, text=True, timeout=500,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "platform"):
+        assert key in rec, rec
+    assert rec["value"] > 0

@@ -146,3 +146,19 @@ def test_extended_multiclass_metrics_match_sklearn(mesh8):
         metricName="logLoss"
     ).isLargerBetter()
     assert MulticlassClassificationEvaluator(metricName="f1").isLargerBetter()
+
+
+def test_by_label_metric_absent_class_and_negative(mesh8):
+    f = Frame({
+        "label": np.array([0.0, 1.0, 1.0]),
+        "prediction": np.array([0.0, 1.0, 0.0]),
+    })
+    # class 5 absent everywhere: 0/0 -> 0, not IndexError
+    v = MulticlassClassificationEvaluator(
+        metricName="recallByLabel", metricLabel=5, mesh=mesh8
+    ).evaluate(f)
+    assert v == 0.0
+    with pytest.raises(ValueError, match="metricLabel"):
+        MulticlassClassificationEvaluator(
+            metricName="recallByLabel", metricLabel=-1
+        )

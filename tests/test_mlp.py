@@ -88,3 +88,15 @@ def test_mlp_save_load(tmp_path, mesh8):
     np.testing.assert_array_equal(
         loaded.transform(f)["prediction"], m.transform(f)["prediction"]
     )
+
+
+def test_bfloat16_compute_dtype_close_to_f32(mesh8):
+    f, y = _multi_blobs(n=2000, k=3, seed=8)
+    kw = dict(mesh=mesh8, layers=[6, 16, 3], maxIter=40, seed=0)
+    m32 = MultilayerPerceptronClassifier(**kw).fit(f)
+    m16 = MultilayerPerceptronClassifier(computeDtype="bfloat16", **kw).fit(f)
+    acc32 = (m32.transform(f)["prediction"] == y).mean()
+    acc16 = (m16.transform(f)["prediction"] == y).mean()
+    assert acc16 > acc32 - 0.03, (acc16, acc32)
+    with pytest.raises(ValueError):
+        MultilayerPerceptronClassifier(computeDtype="float16", **kw)
