@@ -148,14 +148,7 @@ def _stat_count(stats: jnp.ndarray, impurity: str) -> jnp.ndarray:
     return stats.sum(axis=-1)
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "n_nodes", "n_bins", "impurity", "subset_k", "is_last",
-        "hist_impl", "mesh", "interpret",
-    ),
-)
-def _level_pass(
+def _level_core(
     binned,  # [N, F] int32, row-sharded
     binned_t,  # [F, N] int32, row-sharded on axis 1 (pallas layout)
     row_stats,  # [N, S] f32 shared, or [T, N, S] per-tree (the vectorized
@@ -171,11 +164,13 @@ def _level_pass(
     n_bins: int,
     impurity: str,
     subset_k: int,
-    is_last: bool,
     hist_impl: str = "segment",
     mesh=None,
     interpret: bool = False,
+    route: bool = True,
 ):
+    """One level's histogram + split evaluation + (optional) row routing.
+    Traced inside :func:`_grow_fused`'s unrolled level loop."""
     n, F = binned.shape
     S = row_stats.shape[-1]
     T = w_trees.shape[0]
@@ -293,10 +288,8 @@ def _level_pass(
     )[:, :, 0]  # [T, nodes, S]
     br = parent - bl
 
-    # ---- route rows to children -------------------------------------------
-    if is_last:
-        new_node_idx = node_idx
-    else:
+    # ---- route rows to children (skipped at the last level) ----------------
+    if route:
         idx = jnp.where(node_idx >= 0, node_idx, 0)  # [T, N]
         splits = jnp.take_along_axis(do_split, idx, axis=1)  # [T, N]
         feats = jnp.take_along_axis(best_feat, idx, axis=1)  # [T, N]
@@ -309,6 +302,8 @@ def _level_pass(
         new_node_idx = jnp.where(
             (node_idx >= 0) & splits, child, -1
         ).astype(jnp.int32)
+    else:
+        new_node_idx = node_idx
 
     return {
         "best_feat": best_feat,
@@ -365,86 +360,117 @@ def grow_forest(
         jnp.zeros((binned.shape[1], 1), jnp.int32)  # unused placeholder
     )
     T = w_trees.shape[0]
-    n, F = binned.shape
     S = row_stats.shape[-1]
     H = (1 << (max_depth + 1)) - 1
 
-    feature = np.full((T, H), -2, np.int32)
-    threshold = np.zeros((T, H), np.float32)
-    leaf_stats = np.zeros((T, H, S), np.float32)
-    gain_arr = np.zeros((T, H), np.float32)
-    count_arr = np.zeros((T, H), np.float32)
-
     if max_depth == 0:
+        feature = np.full((T, H), -2, np.int32)
+        threshold = np.zeros((T, H), np.float32)
+        leaf_stats = np.zeros((T, H, S), np.float32)
         stats = np.asarray(_root_stats(row_stats, w_trees))
         feature[:, 0] = -1
         leaf_stats[:, 0] = stats
         return Forest(feature, threshold, leaf_stats, max_depth,
-                      gain_arr, count_arr)
+                      np.zeros((T, H), np.float32), np.zeros((T, H), np.float32))
 
-    node_idx = jnp.zeros((T, n), jnp.int32)
-    # mark root as existing (leaf until proven split)
-    exists = np.zeros((T, H), bool)
-    exists[:, 0] = True
-
-    key = jax.random.PRNGKey(seed)
-    for depth in range(max_depth):
-        n_nodes = 1 << depth
-        off = heap_offset(depth)
-        key, sub = jax.random.split(key)
-        out = _level_pass(
-            binned, binned_t, row_stats, w_trees, node_idx, sub,
-            jnp.float32(min_instances_per_node), jnp.float32(min_info_gain),
-            n_nodes=n_nodes, n_bins=n_bins, impurity=impurity,
-            subset_k=subset_k, is_last=(depth == max_depth - 1),
-            hist_impl=hist_impl, mesh=mesh, interpret=interpret,
-        )
-        do_split = np.asarray(out["do_split"])
-        has_rows = np.asarray(out["has_rows"])
-        best_feat = np.asarray(out["best_feat"])
-        best_bin = np.asarray(out["best_bin"])
-        parent_stats = np.asarray(out["parent_stats"])
-        node_idx = out["new_node_idx"]
-
-        lvl = slice(off, off + n_nodes)
-        lvl_exists = exists[:, lvl]
-        split_mask = do_split & lvl_exists
-        leaf_mask = lvl_exists & ~split_mask
-
-        feature[:, lvl] = np.where(
-            split_mask, best_feat, np.where(lvl_exists, -1, -2)
-        )
-        threshold[:, lvl] = np.where(
-            split_mask, edges[best_feat.clip(0), best_bin.clip(0)], 0.0
-        )
-        leaf_stats[:, lvl] = np.where(leaf_mask[..., None], parent_stats, 0.0)
-        best_gain = np.asarray(out["best_gain"])
-        parent_cnt = np.asarray(out["parent_count"])
-        gain_arr[:, lvl] = np.where(split_mask, best_gain, 0.0)
-        count_arr[:, lvl] = np.where(split_mask, parent_cnt, 0.0)
-
-        # children of split nodes exist at the next level
-        next_off = heap_offset(depth + 1)
-        child_exists = np.zeros((T, 1 << (depth + 1)), bool)
-        child_exists[:, 0::2] = split_mask
-        child_exists[:, 1::2] = split_mask
-        exists[:, next_off : next_off + (1 << (depth + 1))] = child_exists
-
-        if depth == max_depth - 1:
-            # children are leaves with the chosen split's child stats
-            left_stats = np.asarray(out["left_stats"])
-            right_stats = np.asarray(out["right_stats"])
-            lvl2 = slice(next_off, next_off + (1 << (depth + 1)))
-            child_stats = np.zeros((T, 1 << (depth + 1), S), np.float32)
-            child_stats[:, 0::2] = left_stats
-            child_stats[:, 1::2] = right_stats
-            feature[:, lvl2] = np.where(child_exists, -1, -2)
-            leaf_stats[:, lvl2] = np.where(
-                child_exists[..., None], child_stats, 0.0
-            )
-
+    keys = jax.random.split(jax.random.PRNGKey(seed), max_depth)
+    out = _grow_fused(
+        binned, binned_t, row_stats, w_trees, jnp.asarray(edges), keys,
+        jnp.float32(min_instances_per_node), jnp.float32(min_info_gain),
+        max_depth=max_depth, n_bins=n_bins, impurity=impurity,
+        subset_k=subset_k, hist_impl=hist_impl, mesh=mesh,
+        interpret=interpret,
+    )
+    feature, threshold, leaf_stats, gain_arr, count_arr = (
+        np.asarray(a) for a in out
+    )
     return Forest(feature, threshold, leaf_stats, max_depth,
                   gain_arr, count_arr)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "max_depth", "n_bins", "impurity", "subset_k", "hist_impl",
+        "mesh", "interpret",
+    ),
+)
+def _grow_fused(
+    binned, binned_t, row_stats, w_trees, edges_dev, keys,
+    min_instances, min_info_gain,
+    *, max_depth, n_bins, impurity, subset_k, hist_impl, mesh, interpret,
+):
+    """The WHOLE level-wise growth as one XLA program: the depth loop is
+    unrolled at trace time, so every level keeps its exact node count
+    (``2^d`` — no padding waste) and heap updates are static slices.  No
+    host round trip per level — the forest leaves the device exactly once
+    (SURVEY.md §1 restack: the per-level driver synchronization of Spark's
+    ``while nodeStack`` loop disappears entirely)."""
+    T, n = w_trees.shape
+    S = row_stats.shape[-1]
+    H = (1 << (max_depth + 1)) - 1
+
+    feature = jnp.full((T, H), -2, jnp.int32)
+    threshold = jnp.zeros((T, H), jnp.float32)
+    leaf_stats = jnp.zeros((T, H, S), jnp.float32)
+    gain_a = jnp.zeros((T, H), jnp.float32)
+    count_a = jnp.zeros((T, H), jnp.float32)
+    node_idx = jnp.zeros((T, n), jnp.int32)
+    exists_lvl = jnp.ones((T, 1), bool)  # root exists
+
+    for depth in range(max_depth):
+        n_nodes = 1 << depth
+        off = n_nodes - 1
+        out = _level_core(
+            binned, binned_t, row_stats, w_trees, node_idx, keys[depth],
+            min_instances, min_info_gain,
+            n_nodes=n_nodes, n_bins=n_bins, impurity=impurity,
+            subset_k=subset_k, hist_impl=hist_impl, mesh=mesh,
+            interpret=interpret,
+            route=depth < max_depth - 1,
+        )
+        split_mask = out["do_split"] & exists_lvl
+        leaf_mask = exists_lvl & ~split_mask
+
+        lvl = slice(off, off + n_nodes)
+        bf_c, bb_c = out["best_feat"].clip(0), out["best_bin"].clip(0)
+        feature = feature.at[:, lvl].set(
+            jnp.where(split_mask, out["best_feat"],
+                      jnp.where(exists_lvl, -1, -2))
+        )
+        threshold = threshold.at[:, lvl].set(
+            jnp.where(split_mask, edges_dev[bf_c, bb_c], 0.0)
+        )
+        leaf_stats = leaf_stats.at[:, lvl, :].set(
+            jnp.where(leaf_mask[..., None], out["parent_stats"], 0.0)
+        )
+        gain_a = gain_a.at[:, lvl].set(
+            jnp.where(split_mask, out["best_gain"], 0.0)
+        )
+        count_a = count_a.at[:, lvl].set(
+            jnp.where(split_mask, out["parent_count"], 0.0)
+        )
+
+        # children written as leaves with the chosen split's child stats;
+        # the next (deeper) level overwrites its whole slice, re-deciding
+        # which of them split further
+        child_exists = jnp.repeat(split_mask, 2, axis=1)  # [T, 2*n_nodes]
+        child_stats = jnp.stack(
+            [out["left_stats"], out["right_stats"]], axis=2
+        ).reshape(T, 2 * n_nodes, S)
+        lvl2 = slice(off + n_nodes, off + 3 * n_nodes)
+        feature = feature.at[:, lvl2].set(
+            jnp.where(child_exists, -1, -2)
+        )
+        leaf_stats = leaf_stats.at[:, lvl2, :].set(
+            jnp.where(child_exists[..., None], child_stats, 0.0)
+        )
+
+        exists_lvl = child_exists
+        if depth < max_depth - 1:
+            node_idx = out["new_node_idx"]
+
+    return feature, threshold, leaf_stats, gain_a, count_a
 
 
 @partial(jax.jit, static_argnames=("max_depth",))
