@@ -189,6 +189,7 @@ class MulticlassClassificationEvaluator:
         metricLabel: float = 0.0,
         beta: float = 1.0,
         eps: float = 1e-15,
+        weightCol: str = None,
         mesh=None,
     ):
         if metricName not in self._METRICS:
@@ -206,32 +207,41 @@ class MulticlassClassificationEvaluator:
         self.metricLabel = metricLabel
         self.beta = beta
         self.eps = eps
+        self.weightCol = weightCol
         self._mesh = mesh
 
     def metrics(self, frame: Frame) -> MulticlassMetrics:
         # by-label metrics: size the confusion matrix to cover metricLabel
         # so a class absent from this frame reads as 0 (the 0/0 -> 0
         # convention) instead of an IndexError mid-tuning
-        num_classes = (
-            int(self.metricLabel) + 1
-            if self.metricName.endswith("ByLabel")
-            else None
+        labels = frame[self.labelCol]
+        preds = frame[self.predictionCol]
+        num_classes = None
+        if self.metricName.endswith("ByLabel"):
+            # size the matrix up-front (cheap host max) so the device
+            # confusion-matrix reduction runs exactly once
+            observed = int(
+                max(
+                    np.max(labels, initial=-1.0), np.max(preds, initial=-1.0)
+                )
+            ) + 1
+            num_classes = max(observed, int(self.metricLabel) + 1)
+        weights = frame[self.weightCol] if self.weightCol else None
+        return MulticlassMetrics(
+            labels, preds, weights=weights, num_classes=num_classes,
+            mesh=self._mesh,
         )
-        m = MulticlassMetrics(
-            frame[self.labelCol], frame[self.predictionCol], mesh=self._mesh
-        )
-        if num_classes is not None and m.num_classes < num_classes:
-            m = MulticlassMetrics(
-                frame[self.labelCol], frame[self.predictionCol],
-                num_classes=num_classes, mesh=self._mesh,
-            )
-        return m
 
     def _log_loss(self, frame: Frame) -> float:
         prob = np.asarray(frame[self.probabilityCol], np.float64)
         y = np.asarray(frame[self.labelCol]).astype(np.int64)
         p_true = prob[np.arange(len(y)), y]
-        return float(-np.mean(np.log(np.clip(p_true, self.eps, None))))
+        # Spark clamps to [eps, 1-eps] on both sides (MulticlassMetrics.logLoss)
+        losses = -np.log(np.clip(p_true, self.eps, 1.0 - self.eps))
+        if self.weightCol:
+            w = np.asarray(frame[self.weightCol], np.float64)
+            return float(np.sum(w * losses) / np.sum(w))
+        return float(np.mean(losses))
 
     def evaluate(self, frame: Frame) -> float:
         name = self.metricName

@@ -72,6 +72,12 @@ class ClassificationModel(ClassifierParams, Model):
         default=0.5,
         validator=validators.in_range(0.0, 1.0),
     )
+    thresholds = Param(
+        "per-class thresholds (length numClasses, at most one zero); "
+        "prediction = argmax(probability[k] / thresholds[k]) — Spark "
+        "ProbabilisticClassificationModel.probability2prediction",
+        default=None,
+    )
 
     @property
     def num_classes(self) -> int:
@@ -92,6 +98,28 @@ class ClassificationModel(ClassifierParams, Model):
         return raw, self._raw_to_probability(raw)
 
     def _prob_to_prediction(self, prob: np.ndarray) -> np.ndarray:
+        ts = self.getThresholds()
+        if ts is not None:
+            ts = np.asarray(ts, np.float64)
+            if ts.shape != (prob.shape[1],):
+                raise ValueError(
+                    f"thresholds length {ts.shape} must equal "
+                    f"numClasses {prob.shape[1]}"
+                )
+            if (ts < 0).any() or (ts == 0).sum() > 1:
+                raise ValueError(
+                    "thresholds must be non-negative with at most one zero"
+                )
+            zero = ts == 0
+            with np.errstate(divide="ignore", invalid="ignore"):
+                scaled = prob / ts
+            # Spark: p/0 -> +inf when p > 0; a 0/0 class never wins
+            scaled = np.where(
+                zero[None, :],
+                np.where(prob > 0, np.inf, -np.inf),
+                scaled,
+            )
+            return np.argmax(scaled, axis=1).astype(np.float64)
         if self.num_classes == 2:
             t = self.getThreshold()
             return (prob[:, 1] > t).astype(np.float64)

@@ -58,12 +58,15 @@ def _lr_summarize(xs, ys, ws, k):
 @partial(
     jax.jit,
     static_argnames=(
-        "binomial", "fit_intercept", "k", "max_iter", "tol", "use_l1", "resume",
+        "binomial", "fit_intercept", "k", "max_iter", "tol", "use_l1",
+        "resume", "use_bounds",
     ),
 )
 def _lr_optimize(
     xs, ys, ws, inv_std, l2, pen_l2, l1_vec, theta0, init_state, iter_limit,
+    lb, ub,
     *, binomial, fit_intercept, k, max_iter, tol, use_l1, resume=False,
+    use_bounds=False,
 ):
     """The whole LBFGS/OWLQN fit as one cached XLA program.
 
@@ -112,6 +115,7 @@ def _lr_optimize(
         init_state=init_state if resume else None,
         return_state=True,
         iter_limit=iter_limit,
+        bounds=(lb, ub) if use_bounds else None,
     )
 
 
@@ -139,12 +143,91 @@ class _LrParams:
         "binomial | multinomial | auto", default="auto",
         validator=validators.one_of("auto", "binomial", "multinomial"),
     )
+    lowerBoundsOnCoefficients = Param(
+        "coefficient lower bounds, shape [1, D] (binomial) or [K, D]; "
+        "requires elasticNetParam contributions of L1 to be zero",
+        default=None,
+    )
+    upperBoundsOnCoefficients = Param(
+        "coefficient upper bounds, same shape as the lower bounds",
+        default=None,
+    )
+    lowerBoundsOnIntercepts = Param(
+        "intercept lower bounds, length 1 (binomial) or K", default=None
+    )
+    upperBoundsOnIntercepts = Param(
+        "intercept upper bounds, length 1 (binomial) or K", default=None
+    )
+
+
+def _bounds_digest(lb: np.ndarray, ub: np.ndarray) -> str:
+    import hashlib
+
+    h = hashlib.md5()
+    h.update(np.ascontiguousarray(lb, np.float32).tobytes())
+    h.update(np.ascontiguousarray(ub, np.float32).tobytes())
+    return h.hexdigest()
 
 
 class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
     def __init__(self, mesh=None, **kwargs):
         super().__init__(**kwargs)
         self._mesh = mesh
+
+    def _build_bounds(self, d, k, binomial, n_coef, n_int, std):
+        """Flatten user bounds into theta-ordered (lb, ub) vectors.
+
+        Bounds are declared on ORIGINAL-space coefficients (Spark
+        ``lowerBoundsOnCoefficients`` etc.); the optimizer works in the
+        scaled space ``coef_scaled = coef_orig * std``, so coefficient
+        bounds scale by ``std`` per feature.  Intercepts are never scaled.
+        """
+        lbc = self.getLowerBoundsOnCoefficients()
+        ubc = self.getUpperBoundsOnCoefficients()
+        lbi = self.getLowerBoundsOnIntercepts()
+        ubi = self.getUpperBoundsOnIntercepts()
+        if lbc is None and ubc is None and lbi is None and ubi is None:
+            z = np.zeros(n_coef + n_int, np.float32)
+            return z, z, False
+        rows = 1 if binomial else k
+        lb = np.full(n_coef + n_int, -np.inf, np.float64)
+        ub = np.full(n_coef + n_int, np.inf, np.float64)
+
+        def coef_part(mat, name):
+            m = np.asarray(mat, np.float64)
+            if m.shape != (rows, d):
+                raise ValueError(
+                    f"{name} must have shape ({rows}, {d}), got {m.shape}"
+                )
+            # theta coefficient layout is [D, rows] flattened; ±inf entries
+            # stay infinite (inf * 0 would be NaN on std=0 features).  A
+            # finite bound on a zero-variance feature collapses to 0 — its
+            # original-space coefficient is identically 0 anyway (Spark
+            # reports 0 for constant features too).
+            with np.errstate(invalid="ignore"):  # inf * 0 in the dead branch
+                scaled = np.where(np.isinf(m), m, m * std[None, :])
+            return scaled.T.reshape(-1)
+
+        if lbc is not None:
+            lb[:n_coef] = coef_part(lbc, "lowerBoundsOnCoefficients")
+        if ubc is not None:
+            ub[:n_coef] = coef_part(ubc, "upperBoundsOnCoefficients")
+        if n_int:
+            def int_part(vec, name):
+                v = np.asarray(vec, np.float64).reshape(-1)
+                if v.shape != (rows,):
+                    raise ValueError(
+                        f"{name} must have length {rows}, got {v.shape}"
+                    )
+                return v
+
+            if lbi is not None:
+                lb[n_coef:] = int_part(lbi, "lowerBoundsOnIntercepts")
+            if ubi is not None:
+                ub[n_coef:] = int_part(ubi, "upperBoundsOnIntercepts")
+        if not (lb <= ub).all():
+            raise ValueError("lower bounds must not exceed upper bounds")
+        return lb, ub, True
 
     def _fit(self, frame: Frame) -> "LogisticRegressionModel":
         mesh = self._mesh or get_default_mesh()
@@ -206,6 +289,21 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
         )
         l1_vec = np.concatenate([l1 * pen_l1, np.zeros(n_int)]).astype(np.float32)
 
+        # ---- bound constraints (Spark's bound-constrained variant) ----
+        lb_t, ub_t, use_bounds = self._build_bounds(
+            d, k, binomial, n_coef, n_int, std
+        )
+        if use_bounds and use_l1:
+            raise ValueError(
+                "bound-constrained optimization only supports none/L2 "
+                "regularization (Spark parity): set elasticNetParam=0"
+            )
+        if use_bounds and fit_intercept:
+            # the prior-log-odds init must start inside the box
+            theta0[n_coef:] = np.clip(
+                theta0[n_coef:], lb_t[n_coef:], ub_t[n_coef:]
+            )
+
         def opt_call(init_state, resume, iter_limit):
             init_dev = (
                 None
@@ -221,6 +319,8 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
                 jnp.asarray(theta0),
                 init_dev,
                 jnp.asarray(iter_limit, jnp.int32),
+                jnp.asarray(lb_t, jnp.float32),
+                jnp.asarray(ub_t, jnp.float32),
                 binomial=binomial,
                 fit_intercept=fit_intercept,
                 k=k,
@@ -228,6 +328,7 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
                 tol=self.getTol(),
                 use_l1=use_l1,
                 resume=resume,
+                use_bounds=use_bounds,
             )
 
         fingerprint = {
@@ -236,6 +337,9 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
             "binomial": binomial, "regParam": reg, "elasticNetParam": alpha,
             "maxIter": self.getMaxIter(), "tol": self.getTol(),
             "standardization": standardize, "n_rows": n,
+            "bounds": (
+                _bounds_digest(lb_t, ub_t) if use_bounds else None
+            ),
         }
         res = run_segmented(
             opt_call,
@@ -265,10 +369,12 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
             intercepts = np.asarray(b if fit_intercept else np.zeros(k), np.float64)
             # Spark canonicalization: the softmax is invariant to uniform
             # shifts; unpenalized intercepts are mean-centered, and with no
-            # regularization the coefficients are too
-            if fit_intercept:
+            # regularization the coefficients are too — SKIPPED under bound
+            # constraints (centering could move them outside the box), as
+            # Spark does
+            if fit_intercept and not use_bounds:
                 intercepts = intercepts - intercepts.mean()
-            if reg == 0.0:
+            if reg == 0.0 and not use_bounds:
                 coef_matrix = coef_matrix - coef_matrix.mean(axis=0, keepdims=True)
 
         n_iters = int(res.n_iters)

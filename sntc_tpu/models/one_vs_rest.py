@@ -42,17 +42,27 @@ class OneVsRest(_OvrParams, ClassifierEstimator):
     def _fit(self, frame: Frame) -> "OneVsRestModel":
         X, y, w = self._extract(frame)
         k = int(y.max()) + 1
-        models: List[ClassificationModel] = self._fit_vectorized(X, y, w, k)
-        if models is None:
+        bin_col = f"ovr_label_{self.uid}"
+        overrides = {
+            "labelCol": bin_col,
+            "featuresCol": self.getFeaturesCol(),
+        }
+        # forward sample weights to every binary sub-fit (Spark parity)
+        if self.getWeightCol() and self.classifier.hasParam("weightCol"):
+            overrides["weightCol"] = self.getWeightCol()
+        models: List[ClassificationModel] = self._fit_vectorized(
+            X, y, w, k, frame
+        )
+        if models is not None:
+            # persisted metadata must be path-independent: vectorized
+            # sub-models carry the same column overrides the sequential
+            # sub-fits get via classifier.copy(overrides)
+            for sub in models:
+                sub.setParams(
+                    **{k2: v for k2, v in overrides.items() if sub.hasParam(k2)}
+                )
+        else:
             models = []
-            bin_col = f"ovr_label_{self.uid}"
-            overrides = {
-                "labelCol": bin_col,
-                "featuresCol": self.getFeaturesCol(),
-            }
-            # forward sample weights to every binary sub-fit (Spark parity)
-            if self.getWeightCol() and self.classifier.hasParam("weightCol"):
-                overrides["weightCol"] = self.getWeightCol()
             for c in range(k):
                 y_c = (y == c).astype(np.float64)
                 sub = frame.with_column(bin_col, y_c)
@@ -63,7 +73,7 @@ class OneVsRest(_OvrParams, ClassifierEstimator):
         )
         return model
 
-    def _fit_vectorized(self, X, y, w, k):
+    def _fit_vectorized(self, X, y, w, k, frame):
         """All-classes-at-once fit when the base classifier supports riding
         the grower's tree axis (GBT: K trees per boosting round over the
         same binned features — SURVEY.md §7.2 item 4).  Returns None when
@@ -87,7 +97,12 @@ class OneVsRest(_OvrParams, ClassifierEstimator):
         if self.classifier.getWeightCol() and not self.getWeightCol():
             return None
         mesh = self._mesh or self.classifier._mesh or get_default_mesh()
-        return fit_gbt_ovr_vectorized(self.classifier, X, y, w, k, mesh)
+        # validated boosting: the indicator column lives on the input frame
+        vcol = self.classifier.getValidationIndicatorCol()
+        val_mask = np.asarray(frame[vcol]).astype(bool) if vcol else None
+        return fit_gbt_ovr_vectorized(
+            self.classifier, X, y, w, k, mesh, val_mask=val_mask
+        )
 
     def _sub_stages(self):
         return [self.classifier]

@@ -131,6 +131,63 @@ class _GbtParams(_TreeEnsembleParams):
         "boosting loss", default="logistic", validator=validators.one_of("logistic")
     )
     featureSubsetStrategy = Param("feature subset per node", default="all")
+    validationIndicatorCol = Param(
+        "boolean column marking validation rows; when set, boosting stops "
+        "early on validation-loss plateau (Spark runWithValidation)",
+        default=None,
+    )
+    validationTol = Param(
+        "early-stop threshold on validation-loss improvement",
+        default=0.01,
+        validator=validators.gteq(0),
+    )
+
+
+def _stable_log1p_exp(x: np.ndarray) -> np.ndarray:
+    return np.maximum(x, 0.0) + np.log1p(np.exp(-np.abs(x)))
+
+
+def _validation_error(margin, y_signed, w):
+    """Spark ``LogLoss.computeError``: weighted mean of
+    ``2·log1p(exp(-2·y·F))`` over the validation rows."""
+    loss = 2.0 * _stable_log1p_exp(
+        -2.0 * np.asarray(y_signed, np.float64) * np.asarray(margin, np.float64)
+    )
+    w = np.asarray(w, np.float64)
+    return np.sum(w * loss, axis=-1) / np.sum(w)
+
+
+class _ValidationTracker:
+    """Spark ``GradientBoostedTrees.boost`` validated-stop bookkeeping.
+
+    After round 0 the first error seeds ``best``; for each later round,
+    stop when the improvement over ``best`` falls below
+    ``tol * max(current, 0.01)``, else record a new best.  The final model
+    keeps ``best_m`` trees (the stopping round's tree is discarded).
+    ``k > 1`` tracks one-vs-rest classes independently (per-class stop,
+    global loop end when all classes are done).
+    """
+
+    def __init__(self, tol: float, k: int = 1):
+        self.tol = float(tol)
+        self.best_err = np.full(k, np.inf)
+        self.best_m = np.zeros(k, np.int64)
+        self.done = np.zeros(k, bool)
+
+    def update(self, round_idx: int, errs) -> bool:
+        errs = np.atleast_1d(np.asarray(errs, np.float64))
+        for i, err in enumerate(errs):
+            if self.done[i]:
+                continue
+            if round_idx == 0:
+                self.best_err[i] = err
+                self.best_m[i] = 1
+            elif self.best_err[i] - err < self.tol * max(err, 0.01):
+                self.done[i] = True
+            elif err < self.best_err[i]:
+                self.best_err[i] = err
+                self.best_m[i] = round_idx + 1
+        return bool(self.done.all())
 
 
 class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
@@ -140,9 +197,24 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
 
     def _fit(self, frame: Frame) -> "GBTClassificationModel":
         mesh = self._mesh or get_default_mesh()
+        val_col = self.getValidationIndicatorCol()
+        X_val = y_val = w_val = None
+        if val_col:
+            vmask = np.asarray(frame[val_col]).astype(bool)
+            if not vmask.any() or vmask.all():
+                raise ValueError(
+                    "validationIndicatorCol must mark a non-empty proper "
+                    "subset of rows"
+                )
+            X_val, y_val, w_val = self._extract(frame.filter(vmask))
+            frame = frame.filter(~vmask)
         X, y, w = self._extract(frame)
         n, F = X.shape
-        if int(y.max(initial=0)) > 1:
+        y_max = int(y.max(initial=0))
+        if y_val is not None:
+            # validation rows must satisfy the binary contract too
+            y_max = max(y_max, int(y_val.max(initial=0)))
+        if y_max > 1:
             raise ValueError(
                 "GBTClassifier is binary-only (Spark parity); wrap in "
                 "OneVsRest for multiclass [B:10]"
@@ -169,8 +241,16 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
         fingerprint = {
             "algo": "gbt", "maxIter": n_rounds, "maxDepth": self.getMaxDepth(),
             "stepSize": step, "seed": self.getSeed(), "n_rows": n,
-            "maxBins": n_bins,
+            "maxBins": n_bins, "validation": bool(val_col),
+            "validationTol": float(self.getValidationTol()),
         }
+        tracker = (
+            _ValidationTracker(self.getValidationTol()) if val_col else None
+        )
+        if val_col:
+            X_val_j = jnp.asarray(X_val)
+            y_signed_val = 2.0 * y_val.astype(np.float64) - 1.0
+            margin_val = np.zeros(len(y_val), np.float64)
         features, thresholds, leaves, weights = [], [], [], []
         gains, counts = [], []
         margin = jnp.zeros(xs.shape[0], jnp.float32)
@@ -179,7 +259,10 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
             saved = _ckpt.load_state(ckpt_dir, fingerprint)
             # "gain" guards against state files written by older layouts:
             # a missing key means restart rather than crash mid-resume
-            if saved is not None and int(saved["round"]) > 0 and "gain" in saved:
+            ok = saved is not None and int(saved["round"]) > 0 and "gain" in saved
+            if ok and val_col and "val_done" not in saved:
+                ok = False
+            if ok:
                 start_round = int(saved["round"])
                 features = list(saved["feature"])
                 thresholds = list(saved["threshold"])
@@ -188,6 +271,19 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
                 gains = list(saved["gain"])
                 counts = list(saved["count"])
                 margin = jnp.asarray(saved["margin"])
+                if val_col:
+                    margin_val = np.asarray(saved["val_margin"], np.float64)
+                    tracker.best_err = np.asarray(
+                        saved["val_best_err"], np.float64
+                    ).reshape(1)
+                    tracker.best_m = np.asarray(
+                        saved["val_best_m"], np.int64
+                    ).reshape(1)
+                    tracker.done = np.asarray(
+                        saved["val_done"], bool
+                    ).reshape(1)
+                    start_round = n_rounds if tracker.done[0] else start_round
+        stopped = False
         for m in range(start_round, n_rounds):
             if m == 0:
                 row_stats = _label_stats(y_signed, ws)
@@ -213,22 +309,45 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
             gains.append(forest.gain[0])
             counts.append(forest.count[0])
             weights.append(tree_weight)
-            if ckpt_dir and interval > 0 and (m + 1) % interval == 0:
-                _ckpt.save_state(
-                    ckpt_dir,
-                    {
-                        "round": m + 1,
-                        "feature": np.stack(features),
-                        "threshold": np.stack(thresholds),
-                        "leaf_stats": np.stack(leaves),
-                        "gain": np.stack(gains),
-                        "count": np.stack(counts),
-                        "tree_weights": np.asarray(weights, np.float32),
-                        "margin": np.asarray(margin),
-                    },
-                    fingerprint,
+            if val_col:
+                contrib_val = _forest_margins(
+                    X_val_j,
+                    jnp.asarray(forest.feature),
+                    jnp.asarray(forest.threshold),
+                    jnp.asarray(forest.leaf_stats),
+                    max_depth=forest.max_depth,
+                )[0]
+                margin_val = margin_val + tree_weight * np.asarray(
+                    contrib_val, np.float64
                 )
+                err = _validation_error(margin_val, y_signed_val, w_val)
+                if tracker.update(m, err):
+                    stopped = True
+            if ckpt_dir and interval > 0 and (m + 1) % interval == 0:
+                state = {
+                    "round": m + 1,
+                    "feature": np.stack(features),
+                    "threshold": np.stack(thresholds),
+                    "leaf_stats": np.stack(leaves),
+                    "gain": np.stack(gains),
+                    "count": np.stack(counts),
+                    "tree_weights": np.asarray(weights, np.float32),
+                    "margin": np.asarray(margin),
+                }
+                if val_col:
+                    state["val_margin"] = margin_val
+                    state["val_best_err"] = tracker.best_err
+                    state["val_best_m"] = tracker.best_m
+                    state["val_done"] = tracker.done
+                _ckpt.save_state(ckpt_dir, state, fingerprint)
+            if stopped:
+                break
 
+        if val_col:
+            keep = int(tracker.best_m[0])
+            features, thresholds = features[:keep], thresholds[:keep]
+            leaves, weights = leaves[:keep], weights[:keep]
+            gains, counts = gains[:keep], counts[:keep]
         if ckpt_dir and interval > 0:
             _ckpt.clear_state(ckpt_dir)
         ensemble = Forest(
@@ -270,6 +389,11 @@ class GBTClassificationModel(_GbtParams, ClassificationModel):
     @property
     def num_classes(self) -> int:
         return 2
+
+    @property
+    def numTrees(self) -> int:
+        """Trees kept — ``< maxIter`` after a validated-boosting stop."""
+        return int(len(self.treeWeights))
 
     def _save_extra(self):
         return (
@@ -336,6 +460,7 @@ def fit_gbt_ovr_vectorized(
     w: np.ndarray,
     num_classes: int,
     mesh,
+    val_mask: Optional[np.ndarray] = None,
 ) -> list:
     """All K one-vs-rest binary GBT fits in ONE boosting loop [B:10].
 
@@ -351,8 +476,23 @@ def fit_gbt_ovr_vectorized(
     carries the same seed.  With feature subsetting the per-class random
     subsets differ from the sequential run (documented deviation).
 
+    Validated boosting (``val_mask`` rows held out, Spark
+    ``runWithValidation``): classes stop **per-class** — each class keeps
+    its own ``best_m`` trees — while the joint loop runs until every class
+    has plateaued (trees grown for already-done classes are discarded at
+    truncation), exactly matching the sequential per-class sub-fits.
+
     Returns a list of K fitted :class:`GBTClassificationModel`.
     """
+    if val_mask is not None:
+        val_mask = np.asarray(val_mask).astype(bool)
+        if not val_mask.any() or val_mask.all():
+            raise ValueError(
+                "validationIndicatorCol must mark a non-empty proper "
+                "subset of rows"
+            )
+        X_val, y_val, w_val = X[val_mask], y[val_mask], w[val_mask]
+        X, y, w = X[~val_mask], y[~val_mask], w[~val_mask]
     n, F = X.shape
     K = int(num_classes)
     n_rounds = classifier.getMaxIter()
@@ -363,6 +503,15 @@ def fit_gbt_ovr_vectorized(
     edges, xs, ys, ws, binned, grow_kwargs, round_mask = _prepare_boosting(
         classifier, X, y, w, mesh
     )
+    tracker = None
+    if val_mask is not None:
+        tracker = _ValidationTracker(classifier.getValidationTol(), k=K)
+        X_val_j = jnp.asarray(X_val)
+        ks = np.arange(K)[:, None]
+        y_signed_val = (
+            2.0 * (y_val[None, :] == ks) - 1.0
+        ).astype(np.float64)  # [K, Nv]
+        margins_val = np.zeros((K, len(y_val)), np.float64)
     n_pad = xs.shape[0]
     y_signed = _ovr_signed_labels(ys, num_classes=K)  # [K, Np]
     row_sharding = NamedSharding(mesh, P(None, axis))
@@ -407,20 +556,35 @@ def fit_gbt_ovr_vectorized(
         gns.append(forest.gain)
         cnts.append(forest.count)
         wts.append(tree_weight)
+        if tracker is not None:
+            contribs_val = _forest_margins(
+                X_val_j,
+                jnp.asarray(forest.feature),
+                jnp.asarray(forest.threshold),
+                jnp.asarray(forest.leaf_stats),
+                max_depth=forest.max_depth,
+            )  # [K, Nv]
+            margins_val = margins_val + tree_weight * np.asarray(
+                contribs_val, np.float64
+            )
+            errs = _validation_error(margins_val, y_signed_val, w_val)
+            if tracker.update(m, errs):
+                break
 
     tree_weights = np.asarray(wts, np.float32)
     models = []
     for c in range(K):
+        keep = int(tracker.best_m[c]) if tracker is not None else len(feats)
         ensemble = Forest(
-            feature=np.stack([f[c] for f in feats]),
-            threshold=np.stack([t[c] for t in thrs]),
-            leaf_stats=np.stack([l[c] for l in lvs]),
+            feature=np.stack([f[c] for f in feats[:keep]]),
+            threshold=np.stack([t[c] for t in thrs[:keep]]),
+            leaf_stats=np.stack([l[c] for l in lvs[:keep]]),
             max_depth=classifier.getMaxDepth(),
-            gain=np.stack([g[c] for g in gns]),
-            count=np.stack([ct[c] for ct in cnts]),
+            gain=np.stack([g[c] for g in gns[:keep]]),
+            count=np.stack([ct[c] for ct in cnts[:keep]]),
         )
         model = GBTClassificationModel(
-            forest=ensemble, tree_weights=tree_weights, n_features=F,
+            forest=ensemble, tree_weights=tree_weights[:keep], n_features=F,
         )
         model.setParams(
             **{

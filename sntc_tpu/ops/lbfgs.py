@@ -62,9 +62,16 @@ def minimize_lbfgs(
     init_state=None,
     return_state: bool = False,
     iter_limit=None,
+    bounds: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
 ):
     """Minimize ``f(x) + sum(l1 * |x|)`` where ``value_and_grad`` gives the
     smooth part.  ``l1=None`` (or all-zero) is plain LBFGS; otherwise OWLQN.
+
+    ``bounds=(lb, ub)`` (±inf entries allowed, exclusive with ``l1``)
+    switches to projected LBFGS — the Breeze ``LBFGSB`` analog behind
+    Spark's bound-constrained LR: coordinates at an active bound with an
+    outward-pushing gradient are frozen out of the two-loop direction, and
+    every line-search candidate is clipped into the box.
 
     Jit-safe: call inside jit with sharded data closed over in
     ``value_and_grad``.
@@ -81,7 +88,21 @@ def minimize_lbfgs(
     d = x0.shape[0]
     m = history_size
     use_l1 = l1 is not None
+    use_bounds = bounds is not None
+    if use_l1 and use_bounds:
+        raise ValueError("l1 and bounds are mutually exclusive (Spark parity)")
     l1v = jnp.zeros((d,), x0.dtype) if l1 is None else jnp.asarray(l1, x0.dtype)
+    if use_bounds:
+        lb = jnp.asarray(bounds[0], x0.dtype)
+        ub = jnp.asarray(bounds[1], x0.dtype)
+        x0 = jnp.clip(x0, lb, ub)
+
+    def free_mask(x, g):
+        """Coordinates free to move: not pinned at a bound the (negative)
+        gradient would push them through."""
+        at_lo = (x <= lb) & (g > 0)
+        at_hi = (x >= ub) & (g < 0)
+        return ~(at_lo | at_hi)
 
     def full_obj(x, f_smooth):
         if use_l1:
@@ -89,9 +110,12 @@ def minimize_lbfgs(
         return f_smooth
 
     def effective_grad(x, g):
-        """Gradient driving the two-loop: pseudo-gradient under L1."""
+        """Gradient driving the two-loop: pseudo-gradient under L1,
+        projected gradient under bounds."""
         if use_l1:
             return _pseudo_gradient(x, g, l1v)
+        if use_bounds:
+            return jnp.where(free_mask(x, g), g, 0.0)
         return g
 
     def project_orthant(x_new, xi):
@@ -180,9 +204,12 @@ def minimize_lbfgs(
         def ls_body(carry):
             it, alpha, ok, x_new, f_new, obj_new = carry
             x_cand = project_orthant(x + alpha * direction, xi)
+            if use_bounds:
+                x_cand = jnp.clip(x_cand, lb, ub)
             f_cand, _ = value_and_grad(x_cand)
             obj_cand = full_obj(x_cand, f_cand)
-            if use_l1:
+            if use_l1 or use_bounds:
+                # sufficient decrease on the ACTUAL (projected) displacement
                 decrease = c1 * _dot(pg, x_cand - x)
             else:
                 decrease = c1 * alpha * gd
@@ -223,6 +250,11 @@ def minimize_lbfgs(
         if use_l1:
             # constrain direction to the descent orthant (Andrew & Gao eq. 4)
             direction = jnp.where(direction * pg < 0, direction, 0.0)
+        if use_bounds:
+            # frozen coordinates stay put; the rest clip in the line search
+            direction = jnp.where(
+                free_mask(state["x"], state["g"]), direction, 0.0
+            )
         ok, x_new, f_new, obj_new = line_search(state, direction, pg)
 
         _, g_new = value_and_grad(x_new)

@@ -134,6 +134,17 @@ class ConsoleSink(StreamSink):
 
 
 class StreamingQuery:
+    """Micro-batch inference engine (SURVEY.md §3.5, §5.4 mechanism 3).
+
+    **Single writer per checkpoint dir**: commit bookkeeping is recovered
+    from the WAL once at construction and tracked in memory afterwards, so
+    exactly one live ``StreamingQuery`` may own a checkpoint directory (the
+    same contract Spark's ``MicroBatchExecution`` enforces via a run lock).
+    Starting a second query on the same dir, or committing externally while
+    one runs, yields stale bookkeeping — recover by constructing a fresh
+    query, which re-scans the log.
+    """
+
     def __init__(
         self,
         model: Transformer,

@@ -22,6 +22,9 @@ import jax  # noqa: E402
 import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# SURVEY.md §5.2: CICIDS2017's Inf/NaN values make silent NaN propagation a
+# real hazard — fail tests at the op that produced the first NaN.
+jax.config.update("jax_debug_nans", True)
 
 
 @pytest.fixture(scope="session")
