@@ -55,6 +55,40 @@ def _lr_summarize(xs, ys, ws, k):
     )
 
 
+def _lr_value_and_grad(
+    theta, xs, ys, ws, inv_std, l2, pen_l2, w_sum,
+    *, binomial, fit_intercept, k, n_coef,
+):
+    """Smooth objective + gradient shared by the single and grid fits."""
+    d = xs.shape[1]
+
+    def loss_fn(theta):
+        coef = theta[:n_coef]
+        W = coef.reshape(d, 1) if binomial else coef.reshape(d, k)
+        b = (
+            theta[n_coef:]
+            if fit_intercept
+            else jnp.zeros((1 if binomial else k,), theta.dtype)
+        )
+        Wd = W * inv_std[:, None]  # fold scaling into the matmul
+        margins = xs @ Wd + b[None, :]
+        if binomial:
+            z = margins[:, 0]
+            yf = ys.astype(z.dtype)
+            data = jnp.sum(ws * (jnp.logaddexp(0.0, z) - yf * z))
+        else:
+            logp = jax.nn.log_softmax(margins, axis=1)
+            picked = jnp.take_along_axis(
+                logp, ys[:, None].astype(jnp.int32), axis=1
+            )[:, 0]
+            data = -jnp.sum(ws * picked)
+        data = data / w_sum
+        penalty = 0.5 * l2 * jnp.sum(pen_l2 * theta[:n_coef] ** 2)
+        return data + penalty
+
+    return jax.value_and_grad(loss_fn)(theta)
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -80,31 +114,11 @@ def _lr_optimize(
     w_sum = jnp.sum(ws)
 
     def value_and_grad(theta):
-        def loss_fn(theta):
-            coef = theta[:n_coef]
-            W = coef.reshape(d, 1) if binomial else coef.reshape(d, k)
-            b = (
-                theta[n_coef:]
-                if fit_intercept
-                else jnp.zeros((1 if binomial else k,), theta.dtype)
-            )
-            Wd = W * inv_std[:, None]  # fold scaling into the matmul
-            margins = xs @ Wd + b[None, :]
-            if binomial:
-                z = margins[:, 0]
-                yf = ys.astype(z.dtype)
-                data = jnp.sum(ws * (jnp.logaddexp(0.0, z) - yf * z))
-            else:
-                logp = jax.nn.log_softmax(margins, axis=1)
-                picked = jnp.take_along_axis(
-                    logp, ys[:, None].astype(jnp.int32), axis=1
-                )[:, 0]
-                data = -jnp.sum(ws * picked)
-            data = data / w_sum
-            penalty = 0.5 * l2 * jnp.sum(pen_l2 * theta[:n_coef] ** 2)
-            return data + penalty
-
-        return jax.value_and_grad(loss_fn)(theta)
+        return _lr_value_and_grad(
+            theta, xs, ys, ws, inv_std, l2, pen_l2, w_sum,
+            binomial=binomial, fit_intercept=fit_intercept, k=k,
+            n_coef=n_coef,
+        )
 
     return minimize_lbfgs(
         value_and_grad,
@@ -117,6 +131,45 @@ def _lr_optimize(
         iter_limit=iter_limit,
         bounds=(lb, ub) if use_bounds else None,
     )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "binomial", "fit_intercept", "k", "max_iter", "tol", "use_l1",
+    ),
+)
+def _lr_optimize_grid(
+    xs, ys, ws, inv_std, l2_b, pen_l2_b, l1_vec_b, theta0_b,
+    *, binomial, fit_intercept, k, max_iter, tol, use_l1,
+):
+    """G grid points fit in ONE XLA program via ``vmap`` over the
+    hyperparameter axis (SURVEY.md §2.5 "task parallelism": Spark's
+    CrossValidator/OneVsRest thread pools overlap independent fits; on TPU
+    the same overlap is a batched axis — every LBFGS iteration's G matmuls
+    fuse into one MXU-batched contraction over the SHARED sharded data).
+
+    Lanes run until all converge (vmapped ``while_loop``); each lane's own
+    ``n_iters``/``converged`` are per-lane exact.
+    """
+    d = xs.shape[1]
+    n_coef = d if binomial else d * k
+    w_sum = jnp.sum(ws)
+
+    def one(l2, pen_l2, l1_vec, theta0):
+        def value_and_grad(theta):
+            return _lr_value_and_grad(
+                theta, xs, ys, ws, inv_std, l2, pen_l2, w_sum,
+                binomial=binomial, fit_intercept=fit_intercept, k=k,
+                n_coef=n_coef,
+            )
+
+        return minimize_lbfgs(
+            value_and_grad, theta0, max_iter=max_iter, tol=tol,
+            l1=l1_vec if use_l1 else None,
+        )
+
+    return jax.vmap(one)(l2_b, pen_l2_b, l1_vec_b, theta0_b)
 
 
 class LogisticRegressionSummary:
@@ -189,6 +242,11 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
         if lbc is None and ubc is None and lbi is None and ubi is None:
             z = np.zeros(n_coef + n_int, np.float32)
             return z, z, False
+        if n_int == 0 and (lbi is not None or ubi is not None):
+            raise ValueError(
+                "intercept bounds require fitIntercept=True (the bound "
+                "would otherwise silently constrain nothing)"
+            )
         rows = 1 if binomial else k
         lb = np.full(n_coef + n_int, -np.inf, np.float64)
         ub = np.full(n_coef + n_int, np.inf, np.float64)
@@ -229,8 +287,11 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
             raise ValueError("lower bounds must not exceed upper bounds")
         return lb, ub, True
 
-    def _fit(self, frame: Frame) -> "LogisticRegressionModel":
-        mesh = self._mesh or get_default_mesh()
+    def _prep_data(self, frame: Frame, mesh) -> dict:
+        """Shared per-dataset prep: shard, summarize (one treeAggregate).
+
+        Split out so the grid-batched fit (``_fit_grid``) pays for the data
+        upload and summarizer pass ONCE across all grid points."""
         X, y, w = self._extract(frame)
         n, d = X.shape
         num_classes = int(y.max()) + 1 if n else 2
@@ -257,24 +318,27 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
         std = np.sqrt(var)
         inv_std = np.divide(1.0, std, out=np.zeros_like(std), where=std > 0)
         class_counts = np.maximum(np.asarray(cc, np.float64), 1e-12)
+        return {
+            "xs": xs, "ys": ys, "ws": ws, "n": n, "d": d, "k": k,
+            "binomial": family == "binomial", "std": std,
+            "inv_std": inv_std, "class_counts": class_counts,
+        }
 
+    def _grid_vectors(self, prep: dict) -> dict:
+        """Per-grid-point optimizer inputs from shared prep (called on a
+        ``copy(params)`` of the estimator for each grid point)."""
+        d, k, binomial = prep["d"], prep["k"], prep["binomial"]
         reg = self.getRegParam()
         alpha = self.getElasticNetParam()
         l2 = reg * (1.0 - alpha)
         l1 = reg * alpha
         fit_intercept = self.getFitIntercept()
         standardize = self.getStandardization()
-        binomial = family == "binomial"
+        inv_std, class_counts = prep["inv_std"], prep["class_counts"]
         n_coef = d if binomial else d * k
         n_int = (1 if binomial else k) if fit_intercept else 0
-
-        # penalty weights in the SCALED space: standardization=True penalizes
-        # scaled coefs directly; False matches original-space penalties
-        # (coef_orig = coef_scaled * inv_std)
         pen_scale = np.ones(d) if standardize else inv_std
         pen_l2 = np.tile(pen_scale**2, 1 if binomial else k).astype(np.float32)
-
-        # init: zero coefficients, prior-log-odds intercepts (Spark parity)
         theta0 = np.zeros(n_coef + n_int, dtype=np.float32)
         if fit_intercept:
             priors = class_counts / class_counts.sum()
@@ -282,12 +346,173 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
                 theta0[n_coef] = np.log(priors[1] / priors[0]) if k == 2 else 0.0
             else:
                 theta0[n_coef:] = np.log(priors)
-
-        use_l1 = l1 > 0
         pen_l1 = np.tile(
             np.ones(d) if standardize else inv_std, 1 if binomial else k
         )
-        l1_vec = np.concatenate([l1 * pen_l1, np.zeros(n_int)]).astype(np.float32)
+        l1_vec = np.concatenate(
+            [l1 * pen_l1, np.zeros(n_int)]
+        ).astype(np.float32)
+        return {
+            "l2": np.float32(l2), "pen_l2": pen_l2, "l1_vec": l1_vec,
+            "theta0": theta0, "use_l1": l1 > 0, "n_coef": n_coef,
+            "n_int": n_int,
+        }
+
+    def _theta_to_model(
+        self, theta, prep, n_iters, history, use_bounds=False
+    ) -> "LogisticRegressionModel":
+        """Unscale + canonicalize a solution vector into a fitted model."""
+        d, k, binomial = prep["d"], prep["k"], prep["binomial"]
+        inv_std = prep["inv_std"]
+        fit_intercept = self.getFitIntercept()
+        reg = self.getRegParam()
+        n_coef = d if binomial else d * k
+        theta = np.asarray(theta, np.float64)
+        W_scaled, b = (
+            (theta[:n_coef].reshape(d, 1), theta[n_coef:])
+            if binomial
+            else (theta[:n_coef].reshape(d, k), theta[n_coef:])
+        )
+        coef_orig = W_scaled * inv_std[:, None]  # back to original space
+        if binomial:
+            coefficients = np.zeros((2, d))
+            coefficients[1] = coef_orig[:, 0]
+            intercepts = np.zeros(2)
+            if fit_intercept:
+                intercepts[1] = b[0]
+            coef_matrix = coefficients
+        else:
+            coef_matrix = coef_orig.T  # [K, D]
+            intercepts = np.asarray(
+                b if fit_intercept else np.zeros(k), np.float64
+            )
+            # Spark canonicalization: the softmax is invariant to uniform
+            # shifts; unpenalized intercepts are mean-centered, and with no
+            # regularization the coefficients are too — SKIPPED under bound
+            # constraints (centering could move them outside the box), as
+            # Spark does
+            if fit_intercept and not use_bounds:
+                intercepts = intercepts - intercepts.mean()
+            if reg == 0.0 and not use_bounds:
+                coef_matrix = coef_matrix - coef_matrix.mean(
+                    axis=0, keepdims=True
+                )
+
+        n_iters = int(n_iters)
+        model = LogisticRegressionModel(
+            coefficient_matrix=coef_matrix.astype(np.float32),
+            intercepts=np.asarray(intercepts, np.float32),
+            is_binomial=binomial,
+        )
+        model.setParams(
+            **{
+                name: val
+                for name, val in self.paramValues().items()
+                if model.hasParam(name)
+            }
+        )
+        model.summary = LogisticRegressionSummary(
+            np.asarray(history)[: n_iters + 1], n_iters
+        )
+        return model
+
+    # ---- grid-batched fitting (CrossValidator/TrainValidationSplit) ----
+
+    _GRID_VARYING = frozenset(
+        {"regParam", "elasticNetParam", "standardization"}
+    )
+    _GRID_UNIFORM = frozenset({"maxIter", "tol", "fitIntercept", "family"})
+
+    def supports_batched_grid(self, param_maps) -> bool:
+        """True if ``param_maps`` can run as ONE vmapped device program:
+        every key is a hyperparameter the batched program accepts, compile-
+        time (static) knobs are uniform across points, and no bound
+        constraints or mid-fit checkpointing are in play."""
+        if len(param_maps) < 2:
+            return False
+        keys = set().union(*param_maps)
+        if not keys <= (self._GRID_VARYING | self._GRID_UNIFORM):
+            return False
+        for kk in keys & self._GRID_UNIFORM:
+            vals = {m.get(kk, self.paramValues().get(kk)) for m in param_maps}
+            if len(vals) > 1:
+                return False
+        if any(
+            self.paramValues().get(p) is not None
+            for p in (
+                "lowerBoundsOnCoefficients", "upperBoundsOnCoefficients",
+                "lowerBoundsOnIntercepts", "upperBoundsOnIntercepts",
+            )
+        ):
+            return False
+        if self.getCheckpointInterval() != -1:
+            return False
+        return True
+
+    def _fit_grid(self, frame: Frame, param_maps):
+        """Fit all ``param_maps`` over the SAME frame in (at most two)
+        batched device programs; returns one fitted model per map, in
+        order.  Data upload + summarizer run once; L1 (OWLQN) and L2-only
+        (plain LBFGS) points batch separately — their update rules differ
+        in-program (static ``use_l1``)."""
+        mesh = self._mesh or get_default_mesh()
+        ests = [self.copy(m) for m in param_maps]
+        prep = ests[0]._prep_data(frame, mesh)
+        vecs = [e._grid_vectors(prep) for e in ests]
+        max_iter = ests[0].getMaxIter()
+        tol = ests[0].getTol()
+        fit_intercept = ests[0].getFitIntercept()
+
+        models: list = [None] * len(ests)
+        for flag in (False, True):
+            idxs = [i for i, v in enumerate(vecs) if bool(v["use_l1"]) == flag]
+            if not idxs:
+                continue
+            res = _lr_optimize_grid(
+                prep["xs"], prep["ys"], prep["ws"],
+                jnp.asarray(prep["inv_std"], jnp.float32),
+                jnp.asarray(np.stack([vecs[i]["l2"] for i in idxs])),
+                jnp.asarray(np.stack([vecs[i]["pen_l2"] for i in idxs])),
+                jnp.asarray(np.stack([vecs[i]["l1_vec"] for i in idxs])),
+                jnp.asarray(np.stack([vecs[i]["theta0"] for i in idxs])),
+                binomial=prep["binomial"],
+                fit_intercept=fit_intercept,
+                k=prep["k"],
+                max_iter=max_iter,
+                tol=tol,
+                use_l1=flag,
+            )
+            xs_h = np.asarray(res.x)
+            iters_h = np.asarray(res.n_iters)
+            hist_h = np.asarray(res.history)
+            for lane, i in enumerate(idxs):
+                models[i] = ests[i]._theta_to_model(
+                    xs_h[lane], prep, iters_h[lane], hist_h[lane]
+                )
+        return models
+
+    def _fit(self, frame: Frame) -> "LogisticRegressionModel":
+        mesh = self._mesh or get_default_mesh()
+        prep = self._prep_data(frame, mesh)
+        xs, ys, ws = prep["xs"], prep["ys"], prep["ws"]
+        n, d, k = prep["n"], prep["d"], prep["k"]
+        binomial = prep["binomial"]
+        std, inv_std = prep["std"], prep["inv_std"]
+
+        reg = self.getRegParam()
+        alpha = self.getElasticNetParam()
+        fit_intercept = self.getFitIntercept()
+        standardize = self.getStandardization()
+
+        # penalty weights / init via the shared grid-vector builder
+        # (standardization=True penalizes scaled coefs directly; False
+        # matches original-space penalties; intercepts init to prior log
+        # odds — Spark parity)
+        vec = self._grid_vectors(prep)
+        l2, pen_l2 = vec["l2"], vec["pen_l2"]
+        l1_vec, theta0 = vec["l1_vec"], vec["theta0"]
+        use_l1 = vec["use_l1"]
+        n_coef, n_int = vec["n_coef"], vec["n_int"]
 
         # ---- bound constraints (Spark's bound-constrained variant) ----
         lb_t, ub_t, use_bounds = self._build_bounds(
@@ -349,51 +574,9 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
             fingerprint,
         )
 
-        theta = np.asarray(res.x, np.float64)
-        W_scaled, b = (
-            (theta[:n_coef].reshape(d, 1), theta[n_coef:])
-            if binomial
-            else (theta[:n_coef].reshape(d, k), theta[n_coef:])
+        return self._theta_to_model(
+            res.x, prep, res.n_iters, res.history, use_bounds=use_bounds
         )
-        coef_orig = W_scaled * inv_std[:, None]  # back to original space
-        if binomial:
-            coefficients = np.zeros((2, d))
-            coefficients[1] = coef_orig[:, 0]
-            intercepts = np.zeros(2)
-            if fit_intercept:
-                intercepts[1] = b[0]
-            # store the natural binary parameterization too
-            coef_matrix = coefficients
-        else:
-            coef_matrix = coef_orig.T  # [K, D]
-            intercepts = np.asarray(b if fit_intercept else np.zeros(k), np.float64)
-            # Spark canonicalization: the softmax is invariant to uniform
-            # shifts; unpenalized intercepts are mean-centered, and with no
-            # regularization the coefficients are too — SKIPPED under bound
-            # constraints (centering could move them outside the box), as
-            # Spark does
-            if fit_intercept and not use_bounds:
-                intercepts = intercepts - intercepts.mean()
-            if reg == 0.0 and not use_bounds:
-                coef_matrix = coef_matrix - coef_matrix.mean(axis=0, keepdims=True)
-
-        n_iters = int(res.n_iters)
-        model = LogisticRegressionModel(
-            coefficient_matrix=coef_matrix.astype(np.float32),
-            intercepts=np.asarray(intercepts, np.float32),
-            is_binomial=binomial,
-        )
-        model.setParams(
-            **{
-                name: val
-                for name, val in self.paramValues().items()
-                if model.hasParam(name)
-            }
-        )
-        model.summary = LogisticRegressionSummary(
-            np.asarray(res.history)[: n_iters + 1], n_iters
-        )
-        return model
 
 
 @jax.jit

@@ -143,15 +143,12 @@ class _GbtParams(_TreeEnsembleParams):
     )
 
 
-def _stable_log1p_exp(x: np.ndarray) -> np.ndarray:
-    return np.maximum(x, 0.0) + np.log1p(np.exp(-np.abs(x)))
-
-
 def _validation_error(margin, y_signed, w):
     """Spark ``LogLoss.computeError``: weighted mean of
     ``2·log1p(exp(-2·y·F))`` over the validation rows."""
-    loss = 2.0 * _stable_log1p_exp(
-        -2.0 * np.asarray(y_signed, np.float64) * np.asarray(margin, np.float64)
+    loss = 2.0 * np.logaddexp(
+        0.0,
+        -2.0 * np.asarray(y_signed, np.float64) * np.asarray(margin, np.float64),
     )
     w = np.asarray(w, np.float64)
     return np.sum(w * loss, axis=-1) / np.sum(w)

@@ -18,6 +18,7 @@ weight through ``fn``).
 
 from __future__ import annotations
 
+import os
 from typing import Callable
 
 import jax
@@ -28,8 +29,23 @@ from sntc_tpu.parallel.mesh import DATA_AXIS
 
 
 def pad_rows(n: int, n_shards: int) -> int:
-    """Rows after padding ``n`` up to a multiple of ``n_shards``."""
-    return ((n + n_shards - 1) // n_shards) * n_shards
+    """Rows after padding ``n`` up to a multiple of ``n_shards``, then up to
+    a shape BUCKET.
+
+    Bucketing rounds the per-shard row count to ~1.6% granularity so nearly
+    equal dataset sizes (e.g. the k train splits of a CrossValidator fold
+    loop) compile ONE XLA program instead of k — distinct compiled shapes
+    are O(log n) overall.  Padded rows carry weight 0 everywhere (the
+    masked-row idiom of this module), so results are unchanged.  Disable
+    with ``SNTC_SHAPE_BUCKETS=0`` for exact-shape debugging.
+    """
+    m = ((n + n_shards - 1) // n_shards) * n_shards
+    per = m // n_shards
+    if per <= 64 or os.environ.get("SNTC_SHAPE_BUCKETS", "1") == "0":
+        return m
+    q = 1 << (per.bit_length() - 6)  # 1/64 granularity of the leading bit
+    per = ((per + q - 1) // q) * q
+    return per * n_shards
 
 
 def shard_batch(mesh: Mesh, *arrays: np.ndarray, axis_name: str = DATA_AXIS):

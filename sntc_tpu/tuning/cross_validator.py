@@ -3,14 +3,23 @@
 Behavioral spec: SURVEY.md §2.4 (upstream ``ml/tuning/CrossValidator.scala``
 [U]): k-fold × param-grid search, metric averaged over folds per grid
 point, best point refit on the full data; ``TrainValidationSplit`` is the
-single-split variant.  ``parallelism`` is accepted for API parity — each
-fit already saturates the mesh, so grid points run sequentially (the
-thread-pool existed to overlap Spark job scheduling, SURVEY.md §2.5 "task
-parallelism").
+single-split variant.
+
+Task parallelism (SURVEY.md §2.5): Spark overlapped grid fits with a
+``parallelism`` thread pool.  Here, estimators that expose
+``supports_batched_grid``/``_fit_grid`` (LogisticRegression) run the WHOLE
+grid as one vmapped device program per fold — data uploaded and summarized
+once, every LBFGS iteration MXU-batched over the grid axis.  For
+estimators without a batched path, fits run sequentially (each already
+saturates the mesh) and a ``parallelism`` > 1 request logs a warning
+instead of silently no-opping.  ``SNTC_TUNING_BATCH=0`` forces the
+sequential path (debugging/verification).
 """
 
 from __future__ import annotations
 
+import logging
+import os
 from itertools import product
 from typing import Any, Dict, List, Optional
 
@@ -19,6 +28,40 @@ import numpy as np
 from sntc_tpu.core.base import Estimator, Model
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
+
+logger = logging.getLogger(__name__)
+
+
+def _is_batched(estimator, grid) -> bool:
+    return (
+        os.environ.get("SNTC_TUNING_BATCH", "1") != "0"
+        and hasattr(estimator, "supports_batched_grid")
+        and estimator.supports_batched_grid(grid)
+    )
+
+
+def _grid_fit(estimator, train: Frame, grid):
+    """Yields one fitted model per grid point, in order: one vmapped
+    program when the estimator supports it, otherwise a sequential loop
+    (lazy, so the caller holds at most one sequential model at a time)."""
+    if _is_batched(estimator, grid):
+        yield from estimator._fit_grid(train, grid)
+        return
+    for params in grid:
+        yield estimator.copy(params).fit(train)
+
+
+def _warn_parallelism_noop(estimator, grid, parallelism: int):
+    if parallelism <= 1:
+        return
+    if not _is_batched(estimator, grid):
+        logger.warning(
+            "parallelism=%d has no effect for %s: grid fits run "
+            "sequentially (each fit saturates the device mesh); "
+            "estimators with a batched grid path (e.g. LogisticRegression) "
+            "overlap grid points automatically",
+            parallelism, type(estimator).__name__,
+        )
 
 
 class ParamGridBuilder:
@@ -49,7 +92,9 @@ class _TuningParams:
     numFolds = Param("cross-validation folds", default=3, validator=validators.gteq(2))
     seed = Param("fold split seed", default=0)
     parallelism = Param(
-        "API parity only; fits already saturate the mesh", default=1,
+        "accepted for API parity; batched-grid estimators overlap grid "
+        "points on-device regardless, others warn and run sequentially",
+        default=1,
         validator=validators.gteq(1),
     )
     collectSubModels = Param("keep every (fold, grid) sub-model", default=False,
@@ -98,11 +143,11 @@ class CrossValidator(_TuningParams, Estimator):
             [[] for _ in grid] if self.getCollectSubModels() else None
         )
 
+        _warn_parallelism_noop(self.estimator, grid, self.getParallelism())
         for fold in range(k):
             train = frame.filter(fold_of != fold)
             valid = frame.filter(fold_of == fold)
-            for gi, params in enumerate(grid):
-                model = self.estimator.copy(params).fit(train)
+            for gi, model in enumerate(_grid_fit(self.estimator, train, grid)):
                 metrics[gi, fold] = self.evaluator.evaluate(
                     model.transform(valid)
                 )
@@ -152,7 +197,11 @@ class CrossValidatorModel(Model):
 class _TvsParams:
     trainRatio = Param("train fraction", default=0.75, validator=validators.in_range(0, 1))
     seed = Param("split seed", default=0)
-    parallelism = Param("API parity only", default=1, validator=validators.gteq(1))
+    parallelism = Param(
+        "accepted for API parity; batched-grid estimators overlap grid "
+        "points on-device regardless, others warn and run sequentially",
+        default=1, validator=validators.gteq(1),
+    )
     collectSubModels = Param("keep every grid-point sub-model", default=False,
                              validator=validators.is_bool())
 
@@ -179,8 +228,8 @@ class TrainValidationSplit(_TvsParams, Estimator):
         sub_models: Optional[List[Model]] = (
             [] if self.getCollectSubModels() else None
         )
-        for params in grid:
-            model = self.estimator.copy(params).fit(train)
+        _warn_parallelism_noop(self.estimator, grid, self.getParallelism())
+        for model in _grid_fit(self.estimator, train, grid):
             metrics.append(self.evaluator.evaluate(model.transform(valid)))
             if sub_models is not None:
                 sub_models.append(model)

@@ -71,7 +71,7 @@ def test_lr_interrupted_fit_resumes_bit_identical(mesh8, tmp_path):
             "algo": "logistic_regression", "n_coef": 6, "n_int": 1,
             "num_classes": 2, "binomial": True, "regParam": 1e-3,
             "elasticNetParam": 0.0, "maxIter": 40, "tol": 1e-12,
-            "standardization": True, "n_rows": 1500,
+            "standardization": True, "n_rows": 1500, "bounds": None,
         },
     )
     assert state is not None and 0 < int(state["k"]) <= 15

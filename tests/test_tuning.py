@@ -149,3 +149,130 @@ def test_cross_validator_fold_col_rejects_empty_and_fractional(mesh8):
         CrossValidator(
             estimator=est, evaluator=ev, numFolds=2, foldCol="z",
         ).fit(f.with_column("z", np.full(90, 0.5)))
+
+
+# ---------------------------------------------------------------------------
+# batched (vmapped) grid fits — SURVEY.md §2.5 task parallelism
+# ---------------------------------------------------------------------------
+
+
+def _data15(n=1500, seed=3, k=4):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    W = rng.normal(size=(6, k))
+    y = np.argmax(X @ W + 0.3 * rng.normal(size=(n, k)), axis=1).astype(
+        np.float64
+    )
+    return Frame({"features": X, "label": y})
+
+
+def test_supports_batched_grid_rules(mesh8):
+    lr = LogisticRegression(mesh=mesh8, maxIter=10)
+    ok = [{"regParam": 0.0}, {"regParam": 0.1, "elasticNetParam": 0.5}]
+    assert lr.supports_batched_grid(ok)
+    # single point: nothing to batch
+    assert not lr.supports_batched_grid([{"regParam": 0.1}])
+    # non-uniform static knob
+    assert not lr.supports_batched_grid(
+        [{"maxIter": 5}, {"maxIter": 20}]
+    )
+    # uniform static knob is fine
+    assert lr.supports_batched_grid(
+        [{"maxIter": 5, "regParam": 0.0}, {"maxIter": 5, "regParam": 0.1}]
+    )
+    # unknown/unsupported key -> sequential fallback
+    assert not lr.supports_batched_grid(
+        [{"regParam": 0.0}, {"featuresCol": "other"}]
+    )
+    # bound constraints -> sequential fallback
+    lb = np.full((1, 5), -1.0)
+    bounded = LogisticRegression(
+        mesh=mesh8, maxIter=10, lowerBoundsOnCoefficients=lb
+    )
+    assert not bounded.supports_batched_grid(ok)
+
+
+def test_fit_grid_matches_individual_fits(mesh8):
+    f = _data()
+    lr = LogisticRegression(mesh=mesh8, maxIter=25)
+    grid = (
+        ParamGridBuilder()
+        .addGrid("regParam", [0.0, 0.01, 0.1])
+        .build()
+    )
+    batched = lr._fit_grid(f, grid)
+    for params, bm in zip(grid, batched):
+        sm = lr.copy(params).fit(f)
+        np.testing.assert_allclose(
+            bm.coefficientMatrix, sm.coefficientMatrix, atol=2e-3
+        )
+        np.testing.assert_allclose(
+            bm.interceptVector, sm.interceptVector, atol=2e-3
+        )
+        # grid-point params land on the batched models too
+        assert bm.getRegParam() == params["regParam"]
+
+
+def test_fit_grid_mixed_l1_l2_groups(mesh8):
+    """L1 (OWLQN) and L2 (LBFGS) points batch separately but return in
+    grid order, matching their individual fits."""
+    f = _data15()
+    lr = LogisticRegression(mesh=mesh8, maxIter=20)
+    grid = [
+        {"regParam": 0.05, "elasticNetParam": 1.0},  # pure L1
+        {"regParam": 0.0},                            # unregularized
+        {"regParam": 0.05, "elasticNetParam": 0.0},   # pure L2
+        {"regParam": 0.05, "elasticNetParam": 0.5},   # elastic net
+    ]
+    batched = lr._fit_grid(f, grid)
+    assert len(batched) == 4
+    for params, bm in zip(grid, batched):
+        sm = lr.copy(params).fit(f)
+        np.testing.assert_allclose(
+            bm.coefficientMatrix, sm.coefficientMatrix, atol=5e-3
+        )
+
+
+def test_cross_validator_batched_matches_sequential(mesh8, monkeypatch):
+    f = _data(800)
+    grid = ParamGridBuilder().addGrid("regParam", [1e-4, 0.05, 5.0]).build()
+
+    def run():
+        cv = CrossValidator(
+            estimator=LogisticRegression(mesh=mesh8, maxIter=20),
+            estimatorParamMaps=grid,
+            evaluator=MulticlassClassificationEvaluator(
+                metricName="accuracy", mesh=mesh8
+            ),
+            numFolds=2,
+            seed=5,
+        )
+        return cv.fit(f)
+
+    monkeypatch.setenv("SNTC_TUNING_BATCH", "0")
+    seq = run()
+    monkeypatch.setenv("SNTC_TUNING_BATCH", "1")
+    bat = run()
+    assert bat.bestIndex == seq.bestIndex
+    np.testing.assert_allclose(bat.avgMetrics, seq.avgMetrics, atol=1e-3)
+
+
+def test_parallelism_noop_warns(mesh8, caplog):
+    """Spark-ported code setting parallelism on a non-batchable estimator
+    gets a warning, not silence (VERDICT weak item 7)."""
+    import logging
+
+    f = _data(300)
+    grid = ParamGridBuilder().addGrid("maxIter", [5, 10]).build()  # static-varying
+    cv = CrossValidator(
+        estimator=LogisticRegression(mesh=mesh8),
+        estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(
+            metricName="accuracy", mesh=mesh8
+        ),
+        numFolds=2,
+        parallelism=4,
+    )
+    with caplog.at_level(logging.WARNING, logger="sntc_tpu.tuning.cross_validator"):
+        cv.fit(f)
+    assert any("parallelism" in r.message for r in caplog.records)
