@@ -65,6 +65,20 @@ class Transformer(PipelineStage):
             out.extend(val if isinstance(val, (list, tuple)) else [val])
         return out
 
+    def transform_async(self, frame: Frame):
+        """Dispatch this transform without blocking on device results.
+
+        Returns a zero-arg ``finalize`` callable that materializes and
+        returns the output Frame.  Device-backed models override this to
+        dispatch their compute and defer host materialization, so a caller
+        can overlap the NEXT batch's host work with this batch's device
+        compute and transfer — the serving micro-batch pipeline ([B:11];
+        JAX dispatch is asynchronous, only materialization blocks).  The
+        default runs synchronously and is always correct.
+        """
+        out = self.transform(frame)
+        return lambda: out
+
     def __call__(self, frame: Frame) -> Frame:
         return self.transform(frame)
 
@@ -145,3 +159,15 @@ class PipelineModel(Model):
         for stage in self.getStages():
             current = stage.transform(current)
         return current
+
+    def transform_async(self, frame: Frame):
+        """Host stages run now; the final stage's device dispatch is
+        deferred to its own ``transform_async`` (feature prep for batch
+        i+1 overlaps batch i's device compute in a pipelined serve loop)."""
+        stages = self.getStages()
+        if not stages:
+            return lambda: frame
+        current = frame
+        for stage in stages[:-1]:
+            current = stage.transform(current)
+        return stages[-1].transform_async(current)

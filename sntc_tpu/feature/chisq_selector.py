@@ -23,7 +23,11 @@ from sntc_tpu.core.base import Estimator, Model
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
 from sntc_tpu.ops.binning import bin_features, quantile_bin_edges
-from sntc_tpu.ops.histogram import binned_contingency, chi_square
+from sntc_tpu.ops.histogram import (
+    binned_contingency,
+    binned_contingency_onehot,
+    chi_square,
+)
 from sntc_tpu.parallel.collectives import make_tree_aggregate, shard_batch
 from sntc_tpu.parallel.context import get_default_mesh
 
@@ -60,6 +64,10 @@ class ChiSqSelector(_SelectorParams, Estimator):
         self._mesh = mesh
 
     def _fit(self, frame: Frame) -> "ChiSqSelectorModel":
+        import jax
+
+        from sntc_tpu.ops.pallas_histogram import resolve_hist_impl
+
         mesh = self._mesh or get_default_mesh()
         X = frame[self.getFeaturesCol()].astype(np.float32)
         y = frame[self.getLabelCol()].astype(np.int32)
@@ -69,13 +77,25 @@ class ChiSqSelector(_SelectorParams, Estimator):
 
         xs, ys, w = shard_batch(mesh, X, y)
 
+        on_tpu = jax.default_backend() == "tpu"
+        impl = resolve_hist_impl(1, n_bins, mesh)
+
         def contingency(xs, ys, w):
             binned = bin_features(xs, edges)
+            if impl == "pallas":
+                return binned_contingency_onehot(
+                    binned, ys, w, n_bins=n_bins, n_classes=n_classes,
+                    interpret=not on_tpu,
+                )
             return binned_contingency(
                 binned, ys, w, n_bins=n_bins, n_classes=n_classes
             )
 
-        observed = np.asarray(make_tree_aggregate(contingency, mesh)(xs, ys, w))
+        observed = np.asarray(
+            make_tree_aggregate(
+                contingency, mesh, check_vma=impl != "pallas"
+            )(xs, ys, w)
+        )
         stats, p_values, _ = chi_square(observed)
 
         order = np.lexsort((np.arange(len(stats)), -stats, p_values))

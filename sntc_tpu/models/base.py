@@ -98,21 +98,13 @@ class ClassificationModel(ClassifierParams, Model):
         return raw, self._raw_to_probability(raw)
 
     def _prob_to_prediction(self, prob: np.ndarray) -> np.ndarray:
-        ts = self.getThresholds()
-        if ts is not None:
-            ts = np.asarray(ts, np.float64)
-            if ts.shape != (prob.shape[1],):
-                raise ValueError(
-                    f"thresholds length {ts.shape} must equal "
-                    f"numClasses {prob.shape[1]}"
-                )
-            if (ts < 0).any() or (ts == 0).sum() > 1:
-                raise ValueError(
-                    "thresholds must be non-negative with at most one zero"
-                )
+        # one rule + one validation: _threshold_mode (shared with the
+        # fused device serve programs)
+        mode, thr = self._threshold_mode()
+        if mode == "thresholds":
+            ts = thr.astype(np.float64)
             zero = ts == 0
-            with np.errstate(divide="ignore", invalid="ignore"):
-                scaled = prob / ts
+            scaled = prob / np.where(zero, 1.0, ts)
             # Spark: p/0 -> +inf when p > 0; a 0/0 class never wins
             scaled = np.where(
                 zero[None, :],
@@ -120,14 +112,20 @@ class ClassificationModel(ClassifierParams, Model):
                 scaled,
             )
             return np.argmax(scaled, axis=1).astype(np.float64)
-        if self.num_classes == 2:
-            t = self.getThreshold()
-            return (prob[:, 1] > t).astype(np.float64)
+        if mode == "binary":
+            return (prob[:, 1] > thr[0]).astype(np.float64)
         return np.argmax(prob, axis=1).astype(np.float64)
 
     def transform(self, frame: Frame) -> Frame:
         X = frame[self.getFeaturesCol()].astype(np.float32, copy=False)
-        raw, prob = self._predict_raw_prob(X)
+        rp = (
+            self._predict_raw_prob_host(X)
+            if X.shape[0] <= self._host_serve_rows()
+            else None
+        )
+        if rp is None:
+            rp = self._predict_raw_prob(X)
+        raw, prob = rp
         out = frame
         if self.getRawPredictionCol():
             out = out.with_column(self.getRawPredictionCol(), raw)
@@ -138,6 +136,84 @@ class ClassificationModel(ClassifierParams, Model):
                 self.getPredictionCol(), self._prob_to_prediction(prob)
             )
         return out
+
+    def _threshold_mode(self):
+        """(mode, thr) describing the probability→prediction rule, with
+        the same validation as :meth:`_prob_to_prediction` — ``mode`` is a
+        static program variant, ``thr`` its parameter vector."""
+        ts = self.getThresholds()
+        if ts is not None:
+            ts = np.asarray(ts, np.float64)
+            if ts.shape != (self.num_classes,):
+                raise ValueError(
+                    f"thresholds length {ts.shape} must equal "
+                    f"numClasses {self.num_classes}"
+                )
+            if (ts < 0).any() or (ts == 0).sum() > 1:
+                raise ValueError(
+                    "thresholds must be non-negative with at most one zero"
+                )
+            return "thresholds", ts.astype(np.float32)
+        if self.num_classes == 2:
+            return "binary", np.asarray([self.getThreshold()], np.float32)
+        return "argmax", np.zeros(1, np.float32)
+
+    def _predict_all_dev(self, X: np.ndarray):
+        """Optional one-dispatch device path: a PACKED ``[N, 2K+1]`` device
+        array of ``raw | prob | prediction`` columns (one device→host
+        transfer materializes everything), or None when this model has no
+        fused device program (callers fall back to the sync transform)."""
+        return None
+
+    def _predict_raw_prob_host(self, X: np.ndarray):
+        """Optional pure-host (numpy) predict path, or None.  Used for
+        micro-batches below the host-serve crossover: at small batch sizes
+        the device dispatch + transfer round trip (a full network RTT on a
+        tunneled TPU; still dominant on PCIe at a few thousand rows of a
+        tiny model) dwarfs the FLOPs."""
+        return None
+
+    @staticmethod
+    def _host_serve_rows() -> int:
+        import os
+
+        return int(os.environ.get("SNTC_SERVE_HOST_ROWS", 16384))
+
+    def transform_async(self, frame: Frame):
+        """One fused device dispatch; host materialization deferred to the
+        returned finalize (see Transformer.transform_async).  Small
+        micro-batches take the pure-host path instead (no device round
+        trip at all; ``transform`` applies the same placement rule)."""
+        X = frame[self.getFeaturesCol()].astype(np.float32, copy=False)
+        dev = (
+            None
+            if X.shape[0] <= self._host_serve_rows()
+            else self._predict_all_dev(X)
+        )
+        if dev is None:
+            out = self.transform(frame)
+            return lambda: out
+
+        def finalize():
+            packed = np.asarray(dev)
+            k = self.num_classes
+            out = frame
+            if self.getRawPredictionCol():
+                out = out.with_column(
+                    self.getRawPredictionCol(), packed[:, :k]
+                )
+            if self.getProbabilityCol():
+                out = out.with_column(
+                    self.getProbabilityCol(), packed[:, k : 2 * k]
+                )
+            if self.getPredictionCol():
+                out = out.with_column(
+                    self.getPredictionCol(),
+                    packed[:, 2 * k].astype(np.float64),
+                )
+            return out
+
+        return finalize
 
     def predict(self, X: np.ndarray) -> np.ndarray:
         """Convenience: prediction indices for a raw feature matrix."""

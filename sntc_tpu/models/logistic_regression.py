@@ -604,6 +604,31 @@ def _predict_fused(X, coefT, intercepts, *, binomial):
     return raw, prob
 
 
+@partial(jax.jit, static_argnames=("binomial", "mode"))
+def _lr_serve(X, coefT, intercepts, thr, *, binomial, mode):
+    """raw + probability + prediction in ONE device program, PACKED into a
+    single ``[N, 2K+1]`` output — one dispatch and one device→host
+    transfer per serving micro-batch ([B:11]; device→host transfers cost a
+    full network round trip each on a tunneled TPU and do not overlap)."""
+    raw, prob = _predict_fused(X, coefT, intercepts, binomial=binomial)
+    if mode == "thresholds":
+        zero = thr == 0
+        scaled = prob / jnp.where(zero, 1.0, thr)[None, :]
+        scaled = jnp.where(
+            zero[None, :],
+            jnp.where(prob > 0, jnp.inf, -jnp.inf),
+            scaled,
+        )
+        pred = jnp.argmax(scaled, axis=1)
+    elif mode == "binary":
+        pred = (prob[:, 1] > thr[0]).astype(jnp.int32)
+    else:
+        pred = jnp.argmax(prob, axis=1)
+    return jnp.concatenate(
+        [raw, prob, pred[:, None].astype(raw.dtype)], axis=1
+    )
+
+
 class LogisticRegressionModel(_LrParams, ClassificationModel):
     def __init__(
         self,
@@ -682,6 +707,25 @@ class LogisticRegressionModel(_LrParams, ClassificationModel):
             jnp.asarray(X), coefT, b, binomial=self.is_binomial
         )
         return np.asarray(raw), np.asarray(prob)
+
+    def _predict_all_dev(self, X: np.ndarray):
+        coefT, b = self._device_params()
+        mode, thr = self._threshold_mode()
+        return _lr_serve(
+            jnp.asarray(X), coefT, b, jnp.asarray(thr),
+            binomial=self.is_binomial, mode=mode,
+        )
+
+    def _predict_raw_prob_host(self, X: np.ndarray):
+        """numpy predict for micro-batches below the host-serve crossover
+        (a [N,78]×[78,K] matmul — the device round trip costs more)."""
+        margins = X @ self.coefficientMatrix.T + self.interceptVector[None, :]
+        if self.is_binomial:
+            m = margins[:, 1] - margins[:, 0]
+            raw = np.stack([-m, m], axis=1)
+        else:
+            raw = margins
+        return raw, self._raw_to_probability(raw)
 
     def _raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
         if self.is_binomial:

@@ -45,28 +45,68 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _hist_kernel(binned_ref, node_ref, stats_ref, acc_ref, *, n_bins, nb_pad):
+_F_BLOCK = 8  # features per grid step (TPU sublane granularity)
+_ONEHOT_BUDGET = 4 * 1024 * 1024  # VMEM budget for the in-kernel one-hot
+_MIN_TILE = 128
+
+
+def hist_fits_pallas(n_nodes: int, n_bins: int) -> bool:
+    """True if a level histogram of this width fits the kernel's VMEM
+    budget at the minimum row tile (beyond it, the one-hot block alone
+    would exhaust VMEM — callers fall back to the segment_sum impl)."""
+    nb_pad = _round_up(max(n_nodes * n_bins + 1, 128), 128)
+    return _MIN_TILE * nb_pad * 4 <= _ONEHOT_BUDGET
+
+
+def resolve_hist_impl(n_nodes_max: int, n_bins: int, mesh=None) -> str:
+    """Histogram impl selection shared by the tree grower and
+    ChiSqSelector: the one-hot MXU kernel on TPU (scatter-adds serialize
+    there; profiled 2.75–15× faster on a real v5e chip), segment_sum
+    elsewhere, when no mesh is available, or when the widest level
+    overflows the kernel's VMEM budget.  ``SNTC_TREE_HIST`` overrides."""
+    import os
+
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    impl = os.environ.get(
+        "SNTC_TREE_HIST", "pallas" if on_tpu else "segment"
+    )
+    if impl == "pallas" and (
+        mesh is None or not hist_fits_pallas(n_nodes_max, n_bins)
+    ):
+        return "segment"
+    return impl
+
+
+def _hist_kernel(
+    binned_ref, node_ref, stats_ref, acc_ref, *, n_bins, nb_pad, f_block
+):
     r = pl.program_id(1)
-    bins = binned_ref[0, :]  # [TILE_N] int32 (feature f's bins)
     nodes = node_ref[0, :]  # [TILE_N] int32 (-1 = inactive)
-    ids = jnp.where(nodes >= 0, nodes * n_bins + bins, nb_pad - 1)
-    # dead rows point at the last padded column, which is sliced off;
-    # their stats are also zero (pre-masked), so this is belt & braces
-    onehot = (
-        jax.lax.broadcasted_iota(jnp.int32, (bins.shape[0], nb_pad), 1)
-        == ids[:, None]
-    ).astype(jnp.float32)
-    contrib = jnp.dot(
-        stats_ref[:].T, onehot, preferred_element_type=jnp.float32
-    )  # [S_pad, NB_pad]
+    stats_t = stats_ref[:].T  # [S_pad, TILE_N]
+    alive = nodes >= 0
+    base = nodes * n_bins
+    for j in range(f_block):  # unrolled: f_block matmuls per grid step
+        bins = binned_ref[j, :]  # [TILE_N] int32 (feature f+j's bins)
+        ids = jnp.where(alive, base + bins, nb_pad - 1)
+        # dead rows point at the last padded column, which is sliced off;
+        # their stats are also zero (pre-masked), so this is belt & braces
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, (bins.shape[0], nb_pad), 1)
+            == ids[:, None]
+        ).astype(jnp.float32)
+        contrib = jnp.dot(
+            stats_t, onehot, preferred_element_type=jnp.float32
+        )  # [S_pad, NB_pad]
 
-    @pl.when(r == 0)
-    def _init():
-        acc_ref[0] = contrib
+        @pl.when(r == 0)
+        def _init(j=j, contrib=contrib):
+            acc_ref[j] = contrib
 
-    @pl.when(r != 0)
-    def _acc():
-        acc_ref[0] += contrib
+        @pl.when(r != 0)
+        def _acc(j=j, contrib=contrib):
+            acc_ref[j] += contrib
 
 
 @functools.partial(
@@ -80,17 +120,28 @@ def level_histogram_pallas(
     *,
     n_nodes: int,
     n_bins: int,
-    tile_n: int = 1024,
+    tile_n: int = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """One tree's level histogram ``[n_nodes * n_bins, S]`` (LOCAL rows —
-    caller psums across shards)."""
+    caller psums across shards).
+
+    Grid is ``(F/8, N/tile)``: feature blocks of 8 satisfy the TPU sublane
+    tiling rule (a block's second-to-last dim must be a multiple of 8), and
+    the row tile adapts so the in-VMEM one-hot ``[tile, NB_pad]`` stays
+    ~4 MB regardless of the node×bin width (GBT's 128-bin levels would
+    otherwise blow VMEM).
+    """
     f, n = binned_t.shape
     s = weighted_stats.shape[1]
     nb = n_nodes * n_bins
     nb_pad = _round_up(max(nb + 1, 128), 128)  # +1: dead-row dump column
     s_pad = _round_up(s, 8)
+    if tile_n is None:
+        budget = _ONEHOT_BUDGET // (nb_pad * 4)
+        tile_n = max(_MIN_TILE, min(2048, (budget // 128) * 128))
     n_pad = _round_up(n, tile_n)
+    f_pad = _round_up(f, _F_BLOCK)
 
     if n_pad != n:
         binned_t = jnp.pad(binned_t, ((0, 0), (0, n_pad - n)))
@@ -100,24 +151,30 @@ def level_histogram_pallas(
         weighted_stats = jnp.pad(
             weighted_stats, ((0, n_pad - n), (0, 0))
         )
+    if f_pad != f:
+        binned_t = jnp.pad(binned_t, ((0, f_pad - f), (0, 0)))
     if s_pad != s:
         weighted_stats = jnp.pad(weighted_stats, ((0, 0), (0, s_pad - s)))
 
     node_2d = node_idx[None, :]  # [1, N]
-    grid = (f, n_pad // tile_n)
+    grid = (f_pad // _F_BLOCK, n_pad // tile_n)
 
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, n_bins=n_bins, nb_pad=nb_pad),
+        functools.partial(
+            _hist_kernel, n_bins=n_bins, nb_pad=nb_pad, f_block=_F_BLOCK
+        ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, tile_n), lambda i, r: (i, r)),  # binned_t
+            pl.BlockSpec((_F_BLOCK, tile_n), lambda i, r: (i, r)),  # binned_t
             pl.BlockSpec((1, tile_n), lambda i, r: (0, r)),  # node_idx
             pl.BlockSpec((tile_n, s_pad), lambda i, r: (r, 0)),  # stats
         ],
-        out_specs=pl.BlockSpec((1, s_pad, nb_pad), lambda i, r: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((f, s_pad, nb_pad), jnp.float32),
+        out_specs=pl.BlockSpec(
+            (_F_BLOCK, s_pad, nb_pad), lambda i, r: (i, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((f_pad, s_pad, nb_pad), jnp.float32),
         interpret=interpret,
     )(binned_t, node_2d, weighted_stats)
 
-    # [F, S_pad, NB_pad] -> [F, NB, S] (the grower's layout)
-    return out[:, :s, :nb].transpose(0, 2, 1)
+    # [F_pad, S_pad, NB_pad] -> [F, NB, S] (the grower's layout)
+    return out[:f, :s, :nb].transpose(0, 2, 1)

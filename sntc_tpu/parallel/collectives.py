@@ -95,6 +95,7 @@ def make_tree_aggregate(
     fn: Callable,
     mesh: Mesh,
     axis_name: str = DATA_AXIS,
+    check_vma: bool = True,
 ) -> Callable:
     """Build a jitted ``agg(*arrays) -> pytree`` that computes
     ``psum_over_shards(fn(shard_of(*arrays)))``.
@@ -117,7 +118,8 @@ def make_tree_aggregate(
             )
 
         return jax.shard_map(
-            local, mesh=mesh, in_specs=in_specs, out_specs=P()
+            local, mesh=mesh, in_specs=in_specs, out_specs=P(),
+            check_vma=check_vma,  # False for fns with pallas_call inside
         )(*arrays)
 
     return jax.jit(agg)

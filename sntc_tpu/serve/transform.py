@@ -34,6 +34,15 @@ class BatchPredictor:
         ]
         return Frame.concat_all(parts)
 
+    def predict_frame_async(self, frame: Frame):
+        """Dispatch without blocking; returns a zero-arg finalize producing
+        the output Frame (see Transformer.transform_async).  Oversized
+        frames fall back to the chunked synchronous path."""
+        if frame.num_rows <= self.chunk_rows:
+            return self.model.transform_async(frame)
+        out = self.predict_frame(frame)
+        return lambda: out
+
     def predict_batch(
         self, batch: Union[pa.RecordBatch, pa.Table]
     ) -> pa.Table:

@@ -145,3 +145,79 @@ def test_file_source_and_csv_sink(model, tmp_path, mesh8):
     assert q2.process_available() == 1
     outs = sorted(os.listdir(out_dir))
     assert outs == ["batch_000000.csv", "batch_000001.csv"]
+
+
+# ---------------------------------------------------------------------------
+# pipelined (async-dispatch) engine — VERDICT r1 item 3 / config 5
+# ---------------------------------------------------------------------------
+
+
+def test_transform_async_matches_transform(model):
+    f = _batch(500, 7)
+    ref = model.transform(f)
+    out = model.transform_async(f)()
+    for col in ("rawPrediction", "probability", "prediction"):
+        np.testing.assert_allclose(out[col], ref[col], rtol=1e-6)
+    assert out["prediction"].dtype == ref["prediction"].dtype
+
+
+def test_transform_async_honors_threshold_and_thresholds(model):
+    f = _batch(400, 8)
+    for params in ({"threshold": 0.9}, {"thresholds": [0.7, 0.3]}):
+        m = model.copy(params)
+        np.testing.assert_array_equal(
+            m.transform_async(f)()["prediction"],
+            m.transform(f)["prediction"],
+        )
+
+
+def test_pipelined_query_matches_depth1(model, tmp_path):
+    batches = [_batch(40, s) for s in range(6)]
+    outs = {}
+    for depth in (1, 3):
+        src = MemorySource(batches)
+        sink = MemorySink()
+        q = StreamingQuery(
+            model, src, sink, str(tmp_path / f"ckpt_d{depth}"),
+            max_batch_offsets=1, pipeline_depth=depth,
+        )
+        assert q.process_available() == 6
+        outs[depth] = sink
+    for (i1, f1), (i3, f3) in zip(outs[1].batches, outs[3].batches):
+        assert i1 == i3
+        np.testing.assert_array_equal(f1["prediction"], f3["prediction"])
+
+
+def test_pipelined_crash_replays_inflight_intents(model, tmp_path):
+    """A crash with several WAL'd-but-uncommitted intents must replay them
+    with their logged ranges on restart (exactly-once, depth > 1)."""
+    ckpt = str(tmp_path / "ckpt_crash")
+    batches = [_batch(40, s) for s in range(5)]
+    src = MemorySource(batches)
+    sink = MemorySink()
+    q = StreamingQuery(model, src, sink, ckpt, max_batch_offsets=1,
+                       pipeline_depth=3)
+    # dispatch 3 intents, commit only the first, then "crash"
+    assert q._run_one_batch()
+    assert q.last_committed() == 0
+    assert len(q._in_flight) == 2
+    pending = [i for (_, i, _) in q._in_flight]
+    del q  # crash: in-flight batches lost, intents remain in the WAL
+
+    sink2 = MemorySink()
+    q2 = StreamingQuery(model, src, sink2, ckpt, max_batch_offsets=1,
+                        pipeline_depth=3)
+    assert q2.last_committed() == 0
+    assert q2.process_available() == 4  # replays 2 intents + 2 fresh
+    committed = sorted(
+        int(os.path.splitext(p)[0])
+        for p in os.listdir(os.path.join(ckpt, "commits"))
+    )
+    assert committed == [0, 1, 2, 3, 4]
+    # the replayed batches used the crashed run's logged ranges
+    with open(os.path.join(ckpt, "commits", "1.json")) as f:
+        assert json.load(f) == pending[0]
+    with open(os.path.join(ckpt, "commits", "2.json")) as f:
+        assert json.load(f) == pending[1]
+    # every source batch delivered exactly once, in order
+    assert [f.num_rows for f in sink2.frames] == [40, 40, 40, 40]
