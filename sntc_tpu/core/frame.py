@@ -34,10 +34,20 @@ class Frame:
     __slots__ = ("_columns", "_num_rows")
 
     def __init__(self, columns: Mapping[str, ColumnLike]):
+        import jax
+
         cols: Dict[str, np.ndarray] = {}
         num_rows: Optional[int] = None
         for name, value in columns.items():
-            arr = value if isinstance(value, np.ndarray) else np.asarray(value)
+            # jax.Array columns are held AS-IS: a device-resident column
+            # (e.g. StandardScalerModel's on-device output) flows to the
+            # next estimator without a host round trip; any numpy-only op
+            # falls back through __array__ (which materializes)
+            arr = (
+                value
+                if isinstance(value, (np.ndarray, jax.Array))
+                else np.asarray(value)
+            )
             if arr.ndim not in (1, 2):
                 raise ValueError(
                     f"column {name!r} must be 1-D or 2-D, got shape {arr.shape}"
@@ -176,6 +186,8 @@ class Frame:
     def to_arrow(self) -> pa.Table:
         arrays, names = [], []
         for name, arr in self._columns.items():
+            if not isinstance(arr, np.ndarray):
+                arr = np.asarray(arr)  # materialize device-resident columns
             if arr.ndim == 2:
                 width = arr.shape[1]
                 flat = pa.array(arr.reshape(-1))

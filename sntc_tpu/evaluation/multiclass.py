@@ -31,6 +31,19 @@ from sntc_tpu.parallel.collectives import (
 )
 from sntc_tpu.parallel.context import get_default_mesh
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=None)
+def _confusion_agg(mesh, k: int):
+    """One compiled confusion-matrix program per (mesh, num_classes)
+    across all evaluations (a rebuilt aggregate recompiles per call)."""
+
+    def conf(ys, ps, ws):
+        return jax.ops.segment_sum(ws, ys * k + ps, num_segments=k * k)
+
+    return make_tree_aggregate(conf, mesh)
+
 
 class MulticlassMetrics:
     """Confusion-matrix metrics for (prediction, label) pairs.
@@ -64,10 +77,7 @@ class MulticlassMetrics:
         ys, ps, _ = shard_batch(mesh, y, p)
         ws = shard_weights(mesh, w, ys.shape[0])
 
-        def conf(ys, ps, ws):
-            return jax.ops.segment_sum(ws, ys * k + ps, num_segments=k * k)
-
-        flat = make_tree_aggregate(conf, mesh)(ys, ps, ws)
+        flat = _confusion_agg(mesh, k)(ys, ps, ws)
         self.confusion = np.asarray(flat, np.float64).reshape(k, k)
         self.num_classes = k
 

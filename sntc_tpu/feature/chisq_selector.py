@@ -15,8 +15,10 @@ SPMD pass over the mesh; the χ² statistics and selection happen on host
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import List
 
+import jax.numpy as jnp
 import numpy as np
 
 from sntc_tpu.core.base import Estimator, Model
@@ -30,6 +32,30 @@ from sntc_tpu.ops.histogram import (
 )
 from sntc_tpu.parallel.collectives import make_tree_aggregate, shard_batch
 from sntc_tpu.parallel.context import get_default_mesh
+
+
+@lru_cache(maxsize=None)
+def _contingency_agg(mesh, n_bins, n_classes, impl, interpret):
+    """One compiled contingency program per configuration across fits
+    (edges arrive as a replicated ARGUMENT, not a baked-in constant —
+    rebuilding the aggregate per fit recompiled on every call)."""
+
+    def contingency(xs, ys, w, edges):
+        binned = bin_features(xs, edges)
+        if impl == "pallas":
+            return binned_contingency_onehot(
+                binned, ys, w, n_bins=n_bins, n_classes=n_classes,
+                interpret=interpret,
+            )
+        return binned_contingency(
+            binned, ys, w, n_bins=n_bins, n_classes=n_classes
+        )
+
+    return make_tree_aggregate(
+        contingency, mesh,
+        check_vma=impl != "pallas",
+        replicated_args=(3,),
+    )
 
 
 class _SelectorParams:
@@ -80,21 +106,10 @@ class ChiSqSelector(_SelectorParams, Estimator):
         on_tpu = jax.default_backend() == "tpu"
         impl = resolve_hist_impl(1, n_bins, mesh)
 
-        def contingency(xs, ys, w):
-            binned = bin_features(xs, edges)
-            if impl == "pallas":
-                return binned_contingency_onehot(
-                    binned, ys, w, n_bins=n_bins, n_classes=n_classes,
-                    interpret=not on_tpu,
-                )
-            return binned_contingency(
-                binned, ys, w, n_bins=n_bins, n_classes=n_classes
-            )
-
         observed = np.asarray(
-            make_tree_aggregate(
-                contingency, mesh, check_vma=impl != "pallas"
-            )(xs, ys, w)
+            _contingency_agg(mesh, n_bins, n_classes, impl, not on_tpu)(
+                xs, ys, w, jnp.asarray(edges)
+            )
         )
         stats, p_values, _ = chi_square(observed)
 

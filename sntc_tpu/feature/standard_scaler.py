@@ -7,11 +7,19 @@ constant features (std == 0) mapped to 0, exactly as Spark does.
 
 TPU design: the fit is ONE ``tree_aggregate`` pass — per-shard weighted
 ``(Σx, Σx², Σw)`` partials ``psum``-reduced over ICI (the treeAggregate
-summarizer analog, SURVEY.md §3.1).
+summarizer analog, SURVEY.md §3.1).  The fitted model remembers the
+sharded device copy of its training input: transforming that same frame
+(what ``Pipeline.fit`` does next) scales ON DEVICE and hands downstream
+estimators a device-resident column — the 62 MB feature matrix crosses
+the host↔device boundary once per pipeline fit, not three times
+(SURVEY.md §7.2 item 5: load once, keep device-resident).
 """
 
 from __future__ import annotations
 
+from functools import lru_cache, partial
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -29,6 +37,27 @@ class _ScalerParams:
     withStd = Param("scale to unit std", default=True, validator=validators.is_bool())
 
 
+def _moments(xs, w):
+    return {
+        "sum": jnp.einsum("n,nd->d", w, xs),
+        "sumsq": jnp.einsum("n,nd->d", w, xs * xs),
+        "count": jnp.sum(w),
+    }
+
+
+@lru_cache(maxsize=None)
+def _moments_agg(mesh):
+    # one compiled program per (mesh, input shape) across ALL fits
+    return make_tree_aggregate(_moments, mesh)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _affine_dev(xs, mu, f, *, n):
+    """(x - mu) * f on the device-resident padded input, sliced back to
+    the frame's true row count."""
+    return ((xs - mu[None, :]) * f[None, :])[:n]
+
+
 class StandardScaler(_ScalerParams, Estimator):
     def __init__(self, mesh=None, **kwargs):
         super().__init__(**kwargs)
@@ -39,14 +68,7 @@ class StandardScaler(_ScalerParams, Estimator):
         X = frame[self.getInputCol()]
         xs, w = shard_batch(mesh, X)
 
-        def moments(xs, w):
-            return {
-                "sum": jnp.einsum("n,nd->d", w, xs),
-                "sumsq": jnp.einsum("n,nd->d", w, xs * xs),
-                "count": jnp.sum(w),
-            }
-
-        out = make_tree_aggregate(moments, mesh)(xs, w)
+        out = _moments_agg(mesh)(xs, w)
         n = float(out["count"])
         mean = np.asarray(out["sum"], dtype=np.float64) / n
         # unbiased variance, clamped: f32 sumsq can dip slightly negative
@@ -58,6 +80,14 @@ class StandardScaler(_ScalerParams, Estimator):
             mean=mean.astype(np.float32), std=std.astype(np.float32)
         )
         model.setParams(**self.paramValues())
+        # device-resident reuse: transform(SAME input object) skips the
+        # re-upload and scales the already-sharded copy.  Released on first
+        # hit (the Pipeline.fit flow uses it exactly once) so a long-lived
+        # fitted model does not pin the training set in host RAM + HBM.
+        from sntc_tpu.parallel.collectives import _device_cache_max_bytes
+
+        if _device_cache_max_bytes() > 0:
+            model._dev_cache = (X, xs)
         return model
 
 
@@ -66,6 +96,9 @@ class StandardScalerModel(_ScalerParams, Model):
         super().__init__(**kwargs)
         self.mean = np.asarray(mean)
         self.std = np.asarray(std)
+        # (input object, sharded device copy) captured at fit time; see
+        # StandardScaler._fit
+        self._dev_cache = None
 
     def _save_extra(self):
         return {}, {"mean": self.mean, "std": self.std}
@@ -95,8 +128,23 @@ class StandardScalerModel(_ScalerParams, Model):
         return mu, f
 
     def transform(self, frame: Frame) -> Frame:
-        X = frame[self.getInputCol()].astype(np.float32)
+        X = frame[self.getInputCol()]
         mu, f = self.affine()
+        cache = self._dev_cache
+        if cache is not None and cache[0] is X:
+            # the frame being transformed is the one this model was fit on
+            # (the Pipeline.fit flow): scale the device-resident sharded
+            # copy — no re-upload, and downstream estimators consume the
+            # device column directly
+            self._dev_cache = None  # single-shot: release the pinned copy
+            scaled = _affine_dev(
+                cache[1],
+                jnp.asarray(mu, jnp.float32),
+                jnp.asarray(f, jnp.float32),
+                n=X.shape[0],
+            )
+            return frame.with_column(self.getOutputCol(), scaled)
+        X = X.astype(np.float32)
         if self.getWithMean():
             X = X - mu.astype(np.float32)
         if self.getWithStd():

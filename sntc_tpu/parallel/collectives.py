@@ -19,6 +19,7 @@ weight through ``fn``).
 from __future__ import annotations
 
 import os
+from collections import OrderedDict
 from typing import Callable
 
 import jax
@@ -26,6 +27,78 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sntc_tpu.parallel.mesh import DATA_AXIS
+
+# ---------------------------------------------------------------------------
+# device-residency cache — the BlockManager / ``df.cache()`` analog.
+#
+# Frames are immutable by contract (sntc_tpu.core.frame), so re-sharding the
+# SAME host array (re-fit on one dataset, CrossValidator's final refit, a
+# second estimator reading the same column) can return the already-resident
+# device copy instead of re-crossing the host↔device link — on a tunneled
+# TPU that link costs seconds per 100 MB, and Spark survives the same
+# re-scan problem only via explicit ``.cache()``.  Identity-keyed through a
+# WEAK reference to the host array: a live array re-used is a hit; once the
+# caller drops the array the entry dies with it (no pinning of throwaway
+# uploads) and a recycled ``id`` can never false-hit because the dead
+# weakref invalidates the entry.  Byte-bounded LRU on the device side;
+# ``SNTC_DEVICE_CACHE_MB=0`` disables.
+# ---------------------------------------------------------------------------
+
+_DEVICE_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
+
+
+def _device_cache_max_bytes() -> int:
+    return int(os.environ.get("SNTC_DEVICE_CACHE_MB", "2048")) * (1 << 20)
+
+
+def _cached_shard_put(arr, n_pad: int, sharding):
+    """Pad ``arr`` to ``n_pad`` rows (replicating row 0) and device_put it
+    under ``sharding``, memoized on the identity of the UNPADDED array."""
+    import weakref
+
+    cacheable = (
+        isinstance(arr, np.ndarray)
+        and arr.nbytes >= (1 << 20)
+        and _device_cache_max_bytes() > 0
+    )
+    # sweep entries whose host array was garbage-collected
+    for k in [k for k, e in _DEVICE_CACHE.items() if e[0]() is None]:
+        del _DEVICE_CACHE[k]
+    key = (id(arr), n_pad, sharding)
+    if cacheable:
+        hit = _DEVICE_CACHE.get(key)
+        if hit is not None and hit[0]() is arr:
+            _DEVICE_CACHE.move_to_end(key)
+            return hit[1]
+    n = arr.shape[0]
+    if n_pad != n:
+        if isinstance(arr, jax.Array):
+            # device-resident input: pad on device, never revisit the host
+            import jax.numpy as jnp
+
+            pad_block = jnp.broadcast_to(
+                arr[:1], (n_pad - n,) + arr.shape[1:]
+            )
+            arr_p = jnp.concatenate([arr, pad_block], axis=0)
+        else:
+            pad_block = np.broadcast_to(
+                arr[:1], (n_pad - n,) + arr.shape[1:]
+            )
+            arr_p = np.concatenate([arr, pad_block], axis=0)
+    else:
+        arr_p = arr
+    dev = jax.device_put(arr_p, sharding)
+    if cacheable:
+        try:
+            ref = weakref.ref(arr)
+        except TypeError:  # non-weakref-able array subclass
+            return dev
+        _DEVICE_CACHE[key] = (ref, dev)
+        total = sum(e[1].nbytes for e in _DEVICE_CACHE.values())
+        while total > _device_cache_max_bytes() and len(_DEVICE_CACHE) > 1:
+            _, old = _DEVICE_CACHE.popitem(last=False)
+            total -= old[1].nbytes
+    return dev
 
 
 def pad_rows(n: int, n_shards: int) -> int:
@@ -64,13 +137,10 @@ def shard_batch(mesh: Mesh, *arrays: np.ndarray, axis_name: str = DATA_AXIS):
     for arr in arrays:
         if arr.shape[0] != n:
             raise ValueError("all arrays must share the leading dimension")
-        if n_pad != n:
-            pad_block = np.broadcast_to(arr[:1], (n_pad - n,) + arr.shape[1:])
-            arr = np.concatenate([arr, pad_block], axis=0)
         sharding = NamedSharding(
             mesh, P(axis_name, *([None] * (arr.ndim - 1)))
         )
-        out.append(jax.device_put(arr, sharding))
+        out.append(_cached_shard_put(arr, n_pad, sharding))
     weights = np.zeros(n_pad, dtype=np.float32)
     weights[:n] = 1.0
     out.append(jax.device_put(weights, NamedSharding(mesh, P(axis_name))))
@@ -96,6 +166,7 @@ def make_tree_aggregate(
     mesh: Mesh,
     axis_name: str = DATA_AXIS,
     check_vma: bool = True,
+    replicated_args: tuple = (),
 ) -> Callable:
     """Build a jitted ``agg(*arrays) -> pytree`` that computes
     ``psum_over_shards(fn(shard_of(*arrays)))``.
@@ -103,12 +174,22 @@ def make_tree_aggregate(
     ``fn`` takes row-shards (leading axis = local rows) and returns a pytree
     of fixed-shape partials; every leaf is summed across the mesh axis.
     The result is replicated on all devices (the driver-side combOp result,
-    but living on-device).
+    but living on-device).  Argument positions in ``replicated_args`` are
+    NOT row-sharded — every shard sees them whole (per-call constants like
+    bin edges; passing them as arguments instead of closing over them keeps
+    one compiled program across calls).
+
+    NOTE each call builds a fresh ``jit`` wrapper with its own compile
+    cache: callers that aggregate repeatedly (every estimator ``fit``)
+    must build ONCE and reuse — on a TPU a rebuilt wrapper recompiles the
+    whole program per call (~8 s observed for the scaler's moments pass).
     """
 
     def agg(*arrays):
         in_specs = tuple(
-            P(axis_name, *([None] * (a.ndim - 1))) for a in arrays
+            P() if i in replicated_args
+            else P(axis_name, *([None] * (a.ndim - 1)))
+            for i, a in enumerate(arrays)
         )
 
         def local(*shards):

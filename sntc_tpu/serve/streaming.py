@@ -241,13 +241,20 @@ class StreamingQuery:
         return True
 
     def _retire_oldest(self) -> None:
-        """Materialize the oldest in-flight batch, sink it, commit."""
-        batch_id, intent, finalize = self._in_flight.pop(0)
+        """Materialize the oldest in-flight batch, sink it, commit.
+
+        The entry leaves ``_in_flight`` only AFTER its commit file is
+        written: if the sink raises, the batch stays queued and the next
+        ``process_available`` retries it from its WAL'd intent — popping
+        first would silently skip the batch and shift every later
+        ``batch_id`` (exactly-once violation)."""
+        batch_id, intent, finalize = self._in_flight[0]
         self.sink.add_batch(batch_id, finalize())
         with open(
             os.path.join(self._commits_dir, f"{batch_id}.json"), "w"
         ) as f:
             json.dump(intent, f)
+        self._in_flight.pop(0)
         self._last_committed = batch_id
         self._end_offset = intent["end"]
 

@@ -70,3 +70,22 @@ def test_arrow_roundtrip_with_vector_column():
     assert g.columns == f.columns
     assert np.array_equal(g["vec"], f["vec"])
     assert list(g["label"]) == list(f["label"])
+
+
+def test_device_columns_round_trip():
+    """jax.Array columns are held as-is (device residency) and materialize
+    through to_arrow/to_pandas and numpy fallbacks."""
+    import jax.numpy as jnp
+
+    x = jnp.arange(12, dtype=jnp.float32).reshape(6, 2)
+    f = Frame({"v": x, "s": np.arange(6.0)})
+    assert f.num_rows == 6
+    table = f.to_arrow()
+    assert table.num_rows == 6
+    np.testing.assert_array_equal(
+        np.asarray(f["v"]), np.arange(12, dtype=np.float32).reshape(6, 2)
+    )
+    sliced = f.slice(1, 3)
+    assert sliced.num_rows == 2
+    filtered = f.filter(np.asarray(f["s"]) > 2.0)
+    assert filtered.num_rows == 3

@@ -221,3 +221,31 @@ def test_pipelined_crash_replays_inflight_intents(model, tmp_path):
         assert json.load(f) == pending[1]
     # every source batch delivered exactly once, in order
     assert [f.num_rows for f in sink2.frames] == [40, 40, 40, 40]
+
+
+def test_pipelined_sink_failure_retries_not_skips(model, tmp_path):
+    """A transient sink failure must leave the batch queued for retry —
+    not skip it and shift later batch ids (exactly-once under depth>1)."""
+    batches = [_batch(30, s) for s in range(4)]
+    src = MemorySource(batches)
+
+    class FlakySink(MemorySink):
+        def __init__(self):
+            super().__init__()
+            self.fail_on = {1}
+
+        def add_batch(self, batch_id, frame):
+            if batch_id in self.fail_on:
+                self.fail_on.discard(batch_id)
+                raise IOError("transient sink outage")
+            super().add_batch(batch_id, frame)
+
+    sink = FlakySink()
+    q = StreamingQuery(model, src, sink, str(tmp_path / "ckpt_flaky"),
+                       max_batch_offsets=1, pipeline_depth=2)
+    with pytest.raises(IOError):
+        q.process_available()
+    # retry drains the rest, including the failed batch, in order
+    assert q.process_available() == 3
+    assert [i for i, _ in sink.batches] == [0, 1, 2, 3]
+    assert q.last_committed() == 3
