@@ -131,12 +131,16 @@ class StandardScalerModel(_ScalerParams, Model):
         X = frame[self.getInputCol()]
         mu, f = self.affine()
         cache = self._dev_cache
+        # single-shot either way: the cache exists for the one
+        # Pipeline.fit-flow transform right after fit; ANY first transform
+        # releases it so a kept model (CV sub-models, serving) never pins
+        # the training set in host RAM + HBM
+        self._dev_cache = None
         if cache is not None and cache[0] is X:
             # the frame being transformed is the one this model was fit on
             # (the Pipeline.fit flow): scale the device-resident sharded
             # copy — no re-upload, and downstream estimators consume the
             # device column directly
-            self._dev_cache = None  # single-shot: release the pinned copy
             scaled = _affine_dev(
                 cache[1],
                 jnp.asarray(mu, jnp.float32),

@@ -23,8 +23,10 @@ from sntc_tpu.core.params import Param, validators
 # same columns ⇒ the same stack).  Re-fitting on one dataset then reuses
 # one X object, which keeps the downstream device-residency cache
 # (sntc_tpu.parallel.collectives) hot — without this, every fit restacks
-# 62 MB AND re-uploads it.  Entries pin their input columns, so ids cannot
-# be reused while cached.
+# 62 MB AND re-uploads it.  Input columns are held by WEAK reference: a
+# dead column invalidates (and sweeps) the entry, so dropping the dataset
+# frees the memo too, and a recycled id can never false-hit.  Shares the
+# ``SNTC_DEVICE_CACHE_MB=0`` kill switch with the device cache.
 _ASSEMBLE_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
 _ASSEMBLE_CACHE_MAX = 4
 
@@ -39,14 +41,25 @@ class VectorAssembler(Transformer):
     )
 
     def transform(self, frame: Frame) -> Frame:
+        import weakref
+
+        from sntc_tpu.parallel.collectives import _device_cache_max_bytes
+
         names: List[str] = self.getInputCols()
         cols = [frame[name] for name in names]
         mode = self.getHandleInvalid()
 
+        memo_on = _device_cache_max_bytes() > 0
+        # sweep entries whose input columns were garbage-collected
+        for k in [
+            k for k, e in _ASSEMBLE_CACHE.items()
+            if any(r() is None for r in e[0])
+        ]:
+            del _ASSEMBLE_CACHE[k]
         key = (tuple(id(c) for c in cols), mode)
-        hit = _ASSEMBLE_CACHE.get(key)
+        hit = _ASSEMBLE_CACHE.get(key) if memo_on else None
         if hit is not None and all(
-            r is c for r, c in zip(hit[0], cols)
+            r() is c for r, c in zip(hit[0], cols)
         ):
             _ASSEMBLE_CACHE.move_to_end(key)
             X, invalid = hit[1], hit[2]
@@ -74,13 +87,21 @@ class VectorAssembler(Transformer):
                             "or use handleInvalid='skip'"
                         )
                     invalid = bad
-            _ASSEMBLE_CACHE[key] = (tuple(cols), X, invalid)
-            while len(_ASSEMBLE_CACHE) > _ASSEMBLE_CACHE_MAX or (
-                len(_ASSEMBLE_CACHE) > 1
-                and sum(e[1].nbytes for e in _ASSEMBLE_CACHE.values())
-                > (2 << 30)
-            ):
-                _ASSEMBLE_CACHE.popitem(last=False)
+            if memo_on:
+                try:
+                    refs = tuple(weakref.ref(c) for c in cols)
+                except TypeError:
+                    refs = None  # non-weakref-able column type
+                if refs is not None:
+                    _ASSEMBLE_CACHE[key] = (refs, X, invalid)
+                    while len(_ASSEMBLE_CACHE) > _ASSEMBLE_CACHE_MAX or (
+                        len(_ASSEMBLE_CACHE) > 1
+                        and sum(
+                            e[1].nbytes for e in _ASSEMBLE_CACHE.values()
+                        )
+                        > (2 << 30)
+                    ):
+                        _ASSEMBLE_CACHE.popitem(last=False)
 
         if invalid is not None:  # skip mode with rows to drop
             frame = frame.filter(~invalid)

@@ -64,6 +64,31 @@ class ClassifierEstimator(ClassifierParams, Estimator):
         return X, y, w
 
 
+def pack_serve_outputs(raw, prob, thr, mode: str):
+    """Traceable tail shared by every model's fused serve program:
+    probability→prediction under ``mode`` (see ``_threshold_mode``), then
+    raw|prob|prediction packed into ONE ``[N, 2K+1]`` array so a serving
+    micro-batch costs a single device→host transfer."""
+    import jax.numpy as jnp
+
+    if mode == "thresholds":
+        zero = thr == 0
+        scaled = prob / jnp.where(zero, 1.0, thr)[None, :]
+        scaled = jnp.where(
+            zero[None, :],
+            jnp.where(prob > 0, jnp.inf, -jnp.inf),
+            scaled,
+        )
+        pred = jnp.argmax(scaled, axis=1)
+    elif mode == "binary":
+        pred = (prob[:, 1] > thr[0]).astype(jnp.int32)
+    else:
+        pred = jnp.argmax(prob, axis=1)
+    return jnp.concatenate(
+        [raw, prob, pred[:, None].astype(raw.dtype)], axis=1
+    )
+
+
 class ClassificationModel(ClassifierParams, Model):
     """Base fitted model: margins -> probability -> prediction columns."""
 

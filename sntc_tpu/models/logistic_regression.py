@@ -610,23 +610,10 @@ def _lr_serve(X, coefT, intercepts, thr, *, binomial, mode):
     single ``[N, 2K+1]`` output — one dispatch and one device→host
     transfer per serving micro-batch ([B:11]; device→host transfers cost a
     full network round trip each on a tunneled TPU and do not overlap)."""
+    from sntc_tpu.models.base import pack_serve_outputs
+
     raw, prob = _predict_fused(X, coefT, intercepts, binomial=binomial)
-    if mode == "thresholds":
-        zero = thr == 0
-        scaled = prob / jnp.where(zero, 1.0, thr)[None, :]
-        scaled = jnp.where(
-            zero[None, :],
-            jnp.where(prob > 0, jnp.inf, -jnp.inf),
-            scaled,
-        )
-        pred = jnp.argmax(scaled, axis=1)
-    elif mode == "binary":
-        pred = (prob[:, 1] > thr[0]).astype(jnp.int32)
-    else:
-        pred = jnp.argmax(prob, axis=1)
-    return jnp.concatenate(
-        [raw, prob, pred[:, None].astype(raw.dtype)], axis=1
-    )
+    return pack_serve_outputs(raw, prob, thr, mode)
 
 
 class LogisticRegressionModel(_LrParams, ClassificationModel):

@@ -375,6 +375,23 @@ def _gbt_margin(X, feature, threshold, leaf_stats, tree_weights, *, max_depth):
     return jnp.einsum("m,mn->n", tree_weights, values)
 
 
+@partial(jax.jit, static_argnames=("max_depth", "mode"))
+def _gbt_serve(
+    X, feature, threshold, leaf_stats, tree_weights, thr, *, max_depth, mode
+):
+    """Traverse + margin + sigmoid + predict, packed: one dispatch and one
+    device→host transfer per serving micro-batch."""
+    from sntc_tpu.models.base import pack_serve_outputs
+
+    m = _gbt_margin(
+        X, feature, threshold, leaf_stats, tree_weights, max_depth=max_depth
+    )
+    raw = jnp.stack([-2.0 * m, 2.0 * m], axis=1)
+    p1 = jax.nn.sigmoid(2.0 * m)
+    prob = jnp.stack([1.0 - p1, p1], axis=1)
+    return pack_serve_outputs(raw, prob, thr, mode)
+
+
 class GBTClassificationModel(_GbtParams, ClassificationModel):
     def __init__(self, forest: Forest, tree_weights: np.ndarray,
                  n_features: int = 0, **kwargs):
@@ -382,6 +399,17 @@ class GBTClassificationModel(_GbtParams, ClassificationModel):
         self.forest = forest
         self.treeWeights = np.asarray(tree_weights, np.float32)
         self._n_features = int(n_features)
+        self._dev_forest = None  # lazy device copies (serving hot path)
+
+    def _device_forest(self):
+        if self._dev_forest is None:
+            self._dev_forest = (
+                jnp.asarray(self.forest.feature),
+                jnp.asarray(self.forest.threshold),
+                jnp.asarray(self.forest.leaf_stats),
+                jnp.asarray(self.treeWeights),
+            )
+        return self._dev_forest
 
     @property
     def num_classes(self) -> int:
@@ -433,10 +461,7 @@ class GBTClassificationModel(_GbtParams, ClassificationModel):
         return np.asarray(
             _gbt_margin(
                 jnp.asarray(X),
-                jnp.asarray(self.forest.feature),
-                jnp.asarray(self.forest.threshold),
-                jnp.asarray(self.forest.leaf_stats),
-                jnp.asarray(self.treeWeights),
+                *self._device_forest(),
                 max_depth=self.forest.max_depth,
             )
         )
@@ -448,6 +473,16 @@ class GBTClassificationModel(_GbtParams, ClassificationModel):
     def _raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
         p1 = 1.0 / (1.0 + np.exp(-raw[:, 1]))
         return np.stack([1.0 - p1, p1], axis=1)
+
+    def _predict_all_dev(self, X: np.ndarray):
+        mode, thr = self._threshold_mode()
+        return _gbt_serve(
+            jnp.asarray(X),
+            *self._device_forest(),
+            jnp.asarray(thr),
+            max_depth=self.forest.max_depth,
+            mode=mode,
+        )
 
 
 def fit_gbt_ovr_vectorized(

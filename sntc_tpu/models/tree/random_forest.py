@@ -135,6 +135,19 @@ def _rf_raw(X, feature, threshold, leaf_stats, *, max_depth):
     return probs.sum(axis=0)  # [N, C] — Spark's summed per-tree votes
 
 
+@partial(jax.jit, static_argnames=("max_depth", "mode"))
+def _rf_serve(X, feature, threshold, leaf_stats, thr, *, max_depth, mode):
+    """Traverse + normalize + predict, packed: one dispatch and one
+    device→host transfer per serving micro-batch."""
+    from sntc_tpu.models.base import pack_serve_outputs
+
+    raw = _rf_raw(
+        X, feature, threshold, leaf_stats, max_depth=max_depth
+    )
+    prob = raw / jnp.maximum(raw.sum(axis=1, keepdims=True), 1e-12)
+    return pack_serve_outputs(raw, prob, thr, mode)
+
+
 class RandomForestClassificationModel(_RfParams, ClassificationModel):
     def __init__(self, forest: Forest, n_classes: int, n_features: int = 0,
                  **kwargs):
@@ -142,6 +155,16 @@ class RandomForestClassificationModel(_RfParams, ClassificationModel):
         self.forest = forest
         self._n_classes = int(n_classes)
         self._n_features = int(n_features)
+        self._dev_forest = None  # lazy device copies (serving hot path)
+
+    def _device_forest(self):
+        if self._dev_forest is None:
+            self._dev_forest = (
+                jnp.asarray(self.forest.feature),
+                jnp.asarray(self.forest.threshold),
+                jnp.asarray(self.forest.leaf_stats),
+            )
+        return self._dev_forest
 
     @property
     def num_classes(self) -> int:
@@ -191,9 +214,7 @@ class RandomForestClassificationModel(_RfParams, ClassificationModel):
         return np.asarray(
             _rf_raw(
                 jnp.asarray(X),
-                jnp.asarray(self.forest.feature),
-                jnp.asarray(self.forest.threshold),
-                jnp.asarray(self.forest.leaf_stats),
+                *self._device_forest(),
                 max_depth=self.forest.max_depth,
             )
         )
@@ -201,3 +222,13 @@ class RandomForestClassificationModel(_RfParams, ClassificationModel):
     def _raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
         totals = raw.sum(axis=1, keepdims=True)
         return raw / np.maximum(totals, 1e-12)
+
+    def _predict_all_dev(self, X: np.ndarray):
+        mode, thr = self._threshold_mode()
+        return _rf_serve(
+            jnp.asarray(X),
+            *self._device_forest(),
+            jnp.asarray(thr),
+            max_depth=self.forest.max_depth,
+            mode=mode,
+        )

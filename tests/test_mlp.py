@@ -100,3 +100,26 @@ def test_bfloat16_compute_dtype_close_to_f32(mesh8):
     assert acc16 > acc32 - 0.03, (acc16, acc32)
     with pytest.raises(ValueError):
         MultilayerPerceptronClassifier(computeDtype="float16", **kw)
+
+
+def test_mlp_serve_paths_agree(mesh8, monkeypatch):
+    """Host (numpy), sync device, and fused async device serve paths all
+    produce the same columns (placement must never change results)."""
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(600, 6)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    f = Frame({"features": X, "label": y})
+    m = MultilayerPerceptronClassifier(
+        mesh=mesh8, layers=[6, 8, 2], maxIter=25, seed=0
+    ).fit(f)
+
+    monkeypatch.setenv("SNTC_SERVE_HOST_ROWS", "0")  # force device
+    dev = m.transform(f)
+    dev_async = m.transform_async(f)()
+    monkeypatch.setenv("SNTC_SERVE_HOST_ROWS", "100000")  # force host
+    host = m.transform(f)
+    for col in ("rawPrediction", "probability"):
+        np.testing.assert_allclose(dev[col], host[col], atol=1e-5)
+        np.testing.assert_allclose(dev_async[col], dev[col], atol=1e-6)
+    np.testing.assert_array_equal(dev["prediction"], host["prediction"])
+    np.testing.assert_array_equal(dev_async["prediction"], dev["prediction"])

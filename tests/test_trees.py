@@ -231,3 +231,30 @@ def test_ovr_gbt_vectorized_with_subsampling(mesh8):
     np.testing.assert_array_equal(
         vec.models[0].forest.feature, seq0.forest.feature
     )
+
+
+def test_tree_serve_paths_agree(mesh8, monkeypatch):
+    """Sync and fused-async serve paths agree for RF and GBT models."""
+    from sntc_tpu.models import GBTClassifier, RandomForestClassifier
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(500, 8)).astype(np.float32)
+    y3 = np.argmax(X[:, :3] + 0.5 * rng.normal(size=(500, 3)), axis=1).astype(
+        np.float64
+    )
+    y2 = (X[:, 0] > 0).astype(np.float64)
+
+    rf = RandomForestClassifier(
+        mesh=mesh8, numTrees=5, maxDepth=3, seed=0
+    ).fit(Frame({"features": X, "label": y3}))
+    gbt = GBTClassifier(mesh=mesh8, maxIter=4, maxDepth=3, seed=0).fit(
+        Frame({"features": X, "label": y2})
+    )
+    f3 = Frame({"features": X})
+    monkeypatch.setenv("SNTC_SERVE_HOST_ROWS", "0")  # force the device path
+    for m in (rf, gbt):
+        ref = m.transform(f3)
+        out = m.transform_async(f3)()
+        for col in ("rawPrediction", "probability"):
+            np.testing.assert_allclose(out[col], ref[col], atol=1e-5)
+        np.testing.assert_array_equal(out["prediction"], ref["prediction"])
