@@ -1,0 +1,3 @@
+from sntc_tpu.app import main
+
+raise SystemExit(main())

@@ -1,0 +1,289 @@
+"""Application entry points — the reference's L0 script layer.
+
+Behavioral spec: SURVEY.md §2.1: the reference app is a set of driver
+scripts over CICIDS2017 day CSVs — per-estimator train/eval scripts
+(`[R]`, capability fixed by [B:6-12]) and a streaming-inference script
+([B:11]).  This module is their CLI equivalent:
+
+    python -m sntc_tpu synth    --out data/ --rows 100000
+    python -m sntc_tpu train    --data data/ --estimator mlp --model-out m/
+    python -m sntc_tpu evaluate --data data/ --model m/ --metric macroF1
+    python -m sntc_tpu serve    --model m/ --watch data/in --out data/out \
+                                --checkpoint data/ckpt
+
+``train`` assembles the same pipeline shapes the five bench configs use
+(StringIndexer → VectorAssembler → [StandardScaler] → estimator);
+``serve`` runs the micro-batch engine over a watched CSV directory with
+offset/commit resume.  Real "MachineLearningCVE" day CSVs drop in
+unchanged; ``synth`` writes schema-identical synthetic days.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+TRAIN_DEFAULT_LAYERS = "78,64,15"
+
+
+def _build_estimator(name: str, mesh, args):
+    from sntc_tpu.models import (
+        GBTClassifier,
+        LogisticRegression,
+        MultilayerPerceptronClassifier,
+        OneVsRest,
+        RandomForestClassifier,
+    )
+
+    if name == "lr":
+        return LogisticRegression(
+            mesh=mesh, maxIter=args.max_iter, regParam=args.reg_param
+        )
+    if name == "mlp":
+        layers = [int(v) for v in args.layers.split(",")]
+        return MultilayerPerceptronClassifier(
+            mesh=mesh, layers=layers, maxIter=args.max_iter, seed=args.seed
+        )
+    if name == "rf":
+        return RandomForestClassifier(
+            mesh=mesh, numTrees=args.num_trees, maxDepth=args.max_depth,
+            seed=args.seed,
+        )
+    if name == "gbt":
+        return OneVsRest(
+            classifier=GBTClassifier(
+                mesh=mesh, maxIter=args.max_iter, maxDepth=args.max_depth,
+                stepSize=args.step_size, seed=args.seed,
+                maxBins=args.max_bins,
+            ),
+            featuresCol=args.features_col,
+        )
+    raise SystemExit(f"unknown estimator {name!r} (lr|mlp|rf|gbt)")
+
+
+def _feature_stages(mesh, args, with_scaler: bool):
+    from sntc_tpu.data import CICIDS2017_FEATURES
+    from sntc_tpu.feature import (
+        ChiSqSelector,
+        StandardScaler,
+        StringIndexer,
+        VectorAssembler,
+    )
+
+    stages = [
+        StringIndexer(inputCol=args.label_col, outputCol="label",
+                      handleInvalid="skip"),
+        VectorAssembler(inputCols=CICIDS2017_FEATURES,
+                        outputCol="rawFeatures", handleInvalid="skip"),
+    ]
+    if args.chisq_top:
+        stages.append(ChiSqSelector(
+            mesh=mesh, numTopFeatures=args.chisq_top,
+            featuresCol="rawFeatures", labelCol="label",
+            outputCol=args.features_col,
+        ))
+    elif with_scaler:
+        stages.append(StandardScaler(
+            mesh=mesh, inputCol="rawFeatures", outputCol=args.features_col,
+            withMean=True,
+        ))
+    return stages
+
+
+def _load_data(args):
+    from sntc_tpu.data import clean_flows, load_csv_dir
+
+    df = clean_flows(load_csv_dir(args.data))
+    if args.binary:
+        import numpy as np
+
+        df = df.with_column(
+            args.label_col,
+            np.where(
+                df[args.label_col].astype(str) == "BENIGN", "benign", "attack"
+            ).astype(object),
+        )
+    return df
+
+
+def cmd_train(args) -> int:
+    from sntc_tpu.core.base import Pipeline
+    from sntc_tpu.data import CICIDS2017_FEATURES
+    from sntc_tpu.evaluation import MulticlassClassificationEvaluator
+    from sntc_tpu.mlio import save_model
+    from sntc_tpu.parallel.context import get_default_mesh
+
+    mesh = get_default_mesh()
+    df = _load_data(args)
+    train, test = df.random_split(
+        [1 - args.test_fraction, args.test_fraction], seed=args.seed
+    )
+    with_scaler = args.estimator in ("lr", "mlp")
+    # the column the estimator reads = whatever the LAST feature stage
+    # writes: chisq/scaler write --features-col, a bare assembler leaves
+    # "rawFeatures" (trees consume unscaled features, as the reference does)
+    if not args.chisq_top and not with_scaler:
+        args.features_col = "rawFeatures"
+    n_features = args.chisq_top or len(CICIDS2017_FEATURES)
+    layers = [int(v) for v in args.layers.split(",")]
+    if args.estimator == "mlp" and layers[0] != n_features:
+        if args.layers == TRAIN_DEFAULT_LAYERS:
+            layers[0] = n_features  # default layers track the input width
+            args.layers = ",".join(str(v) for v in layers)
+        else:
+            raise SystemExit(
+                f"--layers input width {layers[0]} != feature count "
+                f"{n_features} (after --chisq-top selection)"
+            )
+    est = _build_estimator(args.estimator, mesh, args)
+    if est.hasParam("featuresCol"):
+        est.set("featuresCol", args.features_col)
+    pipe = Pipeline(stages=_feature_stages(mesh, args, with_scaler) + [est])
+    t0 = time.perf_counter()
+    model = pipe.fit(train)
+    fit_s = time.perf_counter() - t0
+    f1 = MulticlassClassificationEvaluator(
+        metricName=args.metric, mesh=mesh
+    ).evaluate(model.transform(test))
+    if args.model_out:
+        save_model(model, args.model_out)
+    print(json.dumps({
+        "estimator": args.estimator, "train_rows": train.num_rows,
+        "fit_wall_clock_s": round(fit_s, 3), args.metric: f1,
+        "model_out": args.model_out,
+    }))
+    return 0
+
+
+def cmd_evaluate(args) -> int:
+    from sntc_tpu.evaluation import MulticlassClassificationEvaluator
+    from sntc_tpu.mlio import load_model
+    from sntc_tpu.parallel.context import get_default_mesh
+
+    mesh = get_default_mesh()
+    model = load_model(args.model)
+    df = _load_data(args)
+    value = MulticlassClassificationEvaluator(
+        metricName=args.metric, mesh=mesh
+    ).evaluate(model.transform(df))
+    print(json.dumps({"rows": df.num_rows, args.metric: value}))
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from sntc_tpu.core.base import PipelineModel
+    from sntc_tpu.feature.string_indexer import StringIndexerModel
+    from sntc_tpu.mlio import load_model
+    from sntc_tpu.serve import (
+        CsvDirSink,
+        FileStreamSource,
+        StreamingQuery,
+        compile_serving,
+    )
+
+    model = load_model(args.model)
+    if isinstance(model, PipelineModel):
+        # no labels on live flows: drop the label indexer, fuse the scaler
+        stages = [
+            s for s in model.getStages()
+            if not isinstance(s, StringIndexerModel)
+        ]
+        model = compile_serving(PipelineModel(stages=stages))
+    q = StreamingQuery(
+        model,
+        FileStreamSource(args.watch),
+        CsvDirSink(args.out, columns=["prediction"]),
+        args.checkpoint,
+        max_batch_offsets=args.max_files_per_batch,
+        pipeline_depth=args.pipeline_depth,
+    )
+    if args.once:
+        n = q.process_available()
+        print(json.dumps({"batches": n}))
+        return 0
+    print(f"serving: watching {args.watch} -> {args.out} "
+          f"(checkpoint {args.checkpoint}); Ctrl-C to stop", file=sys.stderr)
+    try:
+        q.run(poll_interval=args.poll_interval)
+    except KeyboardInterrupt:
+        q.stop()
+    return 0
+
+
+def cmd_synth(args) -> int:
+    from sntc_tpu.data import write_day_csvs
+
+    paths = write_day_csvs(
+        args.out, n_rows_per_day=args.rows // args.days, n_days=args.days,
+        seed=args.seed,
+    )
+    print(json.dumps({"files": paths}))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sntc_tpu",
+        description=__doc__.split("\n\n")[1],
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--data", required=True,
+                       help="directory of CICIDS2017-schema day CSVs")
+        p.add_argument("--label-col", default="Label")
+        p.add_argument("--binary", action="store_true",
+                       help="benign-vs-attack relabel (config 1 [B:7])")
+        p.add_argument("--metric", default="macroF1")
+        p.add_argument("--seed", type=int, default=0)
+
+    p = sub.add_parser("train", help="fit a pipeline, report held-out metric")
+    common(p)
+    p.add_argument("--estimator", default="mlp", choices=["lr", "mlp", "rf", "gbt"])
+    p.add_argument("--model-out", default=None)
+    p.add_argument("--test-fraction", type=float, default=0.2)
+    p.add_argument("--max-iter", type=int, default=100)
+    p.add_argument("--reg-param", type=float, default=1e-4)
+    p.add_argument("--layers", default=TRAIN_DEFAULT_LAYERS)
+    p.add_argument("--num-trees", type=int, default=20)
+    p.add_argument("--max-depth", type=int, default=5)
+    p.add_argument("--step-size", type=float, default=0.1)
+    p.add_argument("--max-bins", type=int, default=128)
+    p.add_argument("--chisq-top", type=int, default=0,
+                   help="if > 0, use ChiSqSelector(k) instead of the scaler")
+    p.add_argument("--features-col", default="features")
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("evaluate", help="evaluate a saved model on CSVs")
+    common(p)
+    p.add_argument("--model", required=True)
+    p.set_defaults(fn=cmd_evaluate)
+
+    p = sub.add_parser("serve", help="micro-batch streaming inference [B:11]")
+    p.add_argument("--model", required=True)
+    p.add_argument("--watch", required=True, help="input CSV directory")
+    p.add_argument("--out", required=True, help="output CSV directory")
+    p.add_argument("--checkpoint", required=True,
+                   help="offset/commit WAL directory (exactly-once resume)")
+    p.add_argument("--max-files-per-batch", type=int, default=None)
+    p.add_argument("--pipeline-depth", type=int, default=2)
+    p.add_argument("--poll-interval", type=float, default=1.0)
+    p.add_argument("--once", action="store_true",
+                   help="drain available files and exit")
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("synth", help="write schema-identical synthetic day CSVs")
+    p.add_argument("--out", required=True)
+    p.add_argument("--rows", type=int, default=80_000)
+    p.add_argument("--days", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_synth)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

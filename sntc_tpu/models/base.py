@@ -141,16 +141,7 @@ class ClassificationModel(ClassifierParams, Model):
             return (prob[:, 1] > thr[0]).astype(np.float64)
         return np.argmax(prob, axis=1).astype(np.float64)
 
-    def transform(self, frame: Frame) -> Frame:
-        X = frame[self.getFeaturesCol()].astype(np.float32, copy=False)
-        rp = (
-            self._predict_raw_prob_host(X)
-            if X.shape[0] <= self._host_serve_rows()
-            else None
-        )
-        if rp is None:
-            rp = self._predict_raw_prob(X)
-        raw, prob = rp
+    def _build_output(self, frame: Frame, raw, prob) -> Frame:
         out = frame
         if self.getRawPredictionCol():
             out = out.with_column(self.getRawPredictionCol(), raw)
@@ -161,6 +152,17 @@ class ClassificationModel(ClassifierParams, Model):
                 self.getPredictionCol(), self._prob_to_prediction(prob)
             )
         return out
+
+    def transform(self, frame: Frame) -> Frame:
+        X = frame[self.getFeaturesCol()].astype(np.float32, copy=False)
+        rp = (
+            self._predict_raw_prob_host(X)
+            if X.shape[0] <= self._host_serve_rows()
+            else None
+        )
+        if rp is None:
+            rp = self._predict_raw_prob(X)
+        return self._build_output(frame, *rp)
 
     def _threshold_mode(self):
         """(mode, thr) describing the probability→prediction rule, with
@@ -207,14 +209,17 @@ class ClassificationModel(ClassifierParams, Model):
     def transform_async(self, frame: Frame):
         """One fused device dispatch; host materialization deferred to the
         returned finalize (see Transformer.transform_async).  Small
-        micro-batches take the pure-host path instead (no device round
-        trip at all; ``transform`` applies the same placement rule)."""
+        micro-batches take the pure-host path instead WHEN the model has
+        one (no device round trip at all; ``transform`` applies the same
+        placement rule) — models without a host path keep the fused
+        async dispatch at every batch size."""
         X = frame[self.getFeaturesCol()].astype(np.float32, copy=False)
-        dev = (
-            None
-            if X.shape[0] <= self._host_serve_rows()
-            else self._predict_all_dev(X)
-        )
+        if X.shape[0] <= self._host_serve_rows():
+            rp = self._predict_raw_prob_host(X)
+            if rp is not None:
+                out = self._build_output(frame, *rp)
+                return lambda: out
+        dev = self._predict_all_dev(X)
         if dev is None:
             out = self.transform(frame)
             return lambda: out

@@ -35,6 +35,7 @@ from sntc_tpu.models.base import (
 )
 from sntc_tpu.models.tree.grower import (
     Forest,
+    ForestDeviceMixin,
     forest_leaf_stats,
     grow_forest,
     resolve_feature_subset_k,
@@ -392,24 +393,16 @@ def _gbt_serve(
     return pack_serve_outputs(raw, prob, thr, mode)
 
 
-class GBTClassificationModel(_GbtParams, ClassificationModel):
+class GBTClassificationModel(_GbtParams, ForestDeviceMixin, ClassificationModel):
     def __init__(self, forest: Forest, tree_weights: np.ndarray,
                  n_features: int = 0, **kwargs):
         super().__init__(**kwargs)
         self.forest = forest
         self.treeWeights = np.asarray(tree_weights, np.float32)
         self._n_features = int(n_features)
-        self._dev_forest = None  # lazy device copies (serving hot path)
 
-    def _device_forest(self):
-        if self._dev_forest is None:
-            self._dev_forest = (
-                jnp.asarray(self.forest.feature),
-                jnp.asarray(self.forest.threshold),
-                jnp.asarray(self.forest.leaf_stats),
-                jnp.asarray(self.treeWeights),
-            )
-        return self._dev_forest
+    def _forest_arrays(self) -> tuple:
+        return super()._forest_arrays() + (self.treeWeights,)
 
     @property
     def num_classes(self) -> int:

@@ -90,6 +90,27 @@ def heap_offset(depth: int) -> int:
     return (1 << depth) - 1
 
 
+class ForestDeviceMixin:
+    """Lazy device-resident copies of the dense forest tensors: model
+    parameters upload once per process, not once per serving micro-batch
+    (each upload is a host→device transfer on the [B:11] hot path).
+    Subclasses override ``_forest_arrays`` to add extra tensors (GBT's
+    tree weights)."""
+
+    _dev_forest = None
+
+    def _forest_arrays(self) -> tuple:
+        f = self.forest
+        return (f.feature, f.threshold, f.leaf_stats)
+
+    def _device_forest(self) -> tuple:
+        if self._dev_forest is None:
+            self._dev_forest = tuple(
+                jnp.asarray(a) for a in self._forest_arrays()
+            )
+        return self._dev_forest
+
+
 def resolve_feature_subset_k(strategy, n_features: int, n_trees: int,
                              is_classification: bool) -> int:
     """Spark featureSubsetStrategy semantics (SURVEY.md §2.3)."""

@@ -28,6 +28,7 @@ from sntc_tpu.core.params import Param, validators
 from sntc_tpu.models.base import ClassificationModel, ClassifierEstimator
 from sntc_tpu.models.tree.grower import (
     Forest,
+    ForestDeviceMixin,
     forest_leaf_stats,
     grow_forest,
     resolve_feature_subset_k,
@@ -148,23 +149,13 @@ def _rf_serve(X, feature, threshold, leaf_stats, thr, *, max_depth, mode):
     return pack_serve_outputs(raw, prob, thr, mode)
 
 
-class RandomForestClassificationModel(_RfParams, ClassificationModel):
+class RandomForestClassificationModel(_RfParams, ForestDeviceMixin, ClassificationModel):
     def __init__(self, forest: Forest, n_classes: int, n_features: int = 0,
                  **kwargs):
         super().__init__(**kwargs)
         self.forest = forest
         self._n_classes = int(n_classes)
         self._n_features = int(n_features)
-        self._dev_forest = None  # lazy device copies (serving hot path)
-
-    def _device_forest(self):
-        if self._dev_forest is None:
-            self._dev_forest = (
-                jnp.asarray(self.forest.feature),
-                jnp.asarray(self.forest.threshold),
-                jnp.asarray(self.forest.leaf_stats),
-            )
-        return self._dev_forest
 
     @property
     def num_classes(self) -> int:

@@ -1,0 +1,84 @@
+"""CLI entry-point tests — the reference's L0 script layer (SURVEY.md §2.1):
+train/eval scripts and the streaming-inference script as `python -m
+sntc_tpu` subcommands, driven end-to-end on synthetic day CSVs."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sntc_tpu.app import main
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory, mesh8):
+    d = str(tmp_path_factory.mktemp("days"))
+    assert main(["synth", "--out", d, "--rows", "6000", "--days", "3"]) == 0
+    return d
+
+
+def test_train_evaluate_roundtrip(data_dir, tmp_path, capsys):
+    model_dir = str(tmp_path / "model")
+    rc = main([
+        "train", "--data", data_dir, "--estimator", "lr", "--binary",
+        "--max-iter", "20", "--model-out", model_dir,
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["macroF1"] > 0.5 and out["fit_wall_clock_s"] > 0
+    assert os.path.isdir(model_dir)
+
+    rc = main(["evaluate", "--data", data_dir, "--model", model_dir,
+               "--binary", "--metric", "accuracy"])
+    assert rc == 0
+    ev = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert ev["accuracy"] > 0.5
+
+
+def test_train_rf_with_chisq(data_dir, tmp_path, capsys):
+    rc = main([
+        "train", "--data", data_dir, "--estimator", "rf",
+        "--num-trees", "4", "--max-depth", "3", "--chisq-top", "20",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert 0.0 <= out["macroF1"] <= 1.0
+
+
+def test_serve_once(data_dir, tmp_path, capsys):
+    model_dir = str(tmp_path / "model")
+    main(["train", "--data", data_dir, "--estimator", "lr", "--binary",
+          "--max-iter", "15", "--model-out", model_dir])
+    capsys.readouterr()
+    out_dir = str(tmp_path / "out")
+    rc = main([
+        "serve", "--model", model_dir, "--watch", data_dir,
+        "--out", out_dir, "--checkpoint", str(tmp_path / "ckpt"),
+        "--max-files-per-batch", "1", "--once",
+    ])
+    assert rc == 0
+    served = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert served["batches"] == 3
+    assert len(os.listdir(out_dir)) == 3
+    # resume: nothing new -> zero batches
+    rc = main([
+        "serve", "--model", model_dir, "--watch", data_dir,
+        "--out", out_dir, "--checkpoint", str(tmp_path / "ckpt"), "--once",
+    ])
+    served = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert served["batches"] == 0
+
+
+def test_train_rf_default_and_chisq_mlp(data_dir, capsys):
+    """rf/gbt without --chisq-top consume the assembler output; --chisq-top
+    with the default mlp layers adapts the input layer width."""
+    assert main(["train", "--data", data_dir, "--estimator", "rf",
+                 "--num-trees", "3", "--max-depth", "2"]) == 0
+    json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert main(["train", "--data", data_dir, "--estimator", "mlp",
+                 "--chisq-top", "20", "--max-iter", "5"]) == 0
+    json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    with pytest.raises(SystemExit):
+        main(["train", "--data", data_dir, "--estimator", "mlp",
+              "--chisq-top", "20", "--layers", "40,8,15"])
