@@ -127,16 +127,24 @@ def cmd_train(args) -> int:
     if not args.chisq_top and not with_scaler:
         args.features_col = "rawFeatures"
     n_features = args.chisq_top or len(CICIDS2017_FEATURES)
-    layers = [int(v) for v in args.layers.split(",")]
-    if args.estimator == "mlp" and layers[0] != n_features:
-        if args.layers == TRAIN_DEFAULT_LAYERS:
-            layers[0] = n_features  # default layers track the input width
-            args.layers = ",".join(str(v) for v in layers)
-        else:
-            raise SystemExit(
-                f"--layers input width {layers[0]} != feature count "
-                f"{n_features} (after --chisq-top selection)"
-            )
+    if args.estimator == "mlp":
+        import numpy as np
+
+        n_classes = int(np.unique(train[args.label_col].astype(str)).size)
+        layers = [int(v) for v in args.layers.split(",")]
+        is_default = args.layers == TRAIN_DEFAULT_LAYERS
+        for pos, want, what in (
+            (0, n_features, "input width / feature count"),
+            (-1, n_classes, "output width / class count"),
+        ):
+            if layers[pos] != want:
+                if is_default:
+                    layers[pos] = want  # default layers track the data
+                else:
+                    raise SystemExit(
+                        f"--layers {what} mismatch: {layers[pos]} != {want}"
+                    )
+        args.layers = ",".join(str(v) for v in layers)
     est = _build_estimator(args.estimator, mesh, args)
     if est.hasParam("featuresCol"):
         est.set("featuresCol", args.features_col)
@@ -184,17 +192,33 @@ def cmd_serve(args) -> int:
     )
 
     model = load_model(args.model)
+    out_cols = ["prediction"]
     if isinstance(model, PipelineModel):
-        # no labels on live flows: drop the label indexer, fuse the scaler
-        stages = [
-            s for s in model.getStages()
-            if not isinstance(s, StringIndexerModel)
-        ]
-        model = compile_serving(PipelineModel(stages=stages))
+        # no labels on live flows: drop the LABEL indexer (the one writing
+        # --label-index-col; indexers on feature columns are kept) and map
+        # predictions back to label STRINGS with its vocabulary — the
+        # reference app's output shape.  The scaler fuses into the model.
+        from sntc_tpu.feature import IndexToString
+
+        stages, tail = [], []
+        for s in model.getStages():
+            if (
+                isinstance(s, StringIndexerModel)
+                and s.getOutputCol() == args.label_index_col
+            ):
+                tail = [IndexToString(
+                    inputCol="prediction", outputCol="predictedLabel",
+                    labels=s.labels,
+                )]
+            else:
+                stages.append(s)
+        model = compile_serving(PipelineModel(stages=stages + tail))
+        if tail:
+            out_cols = ["prediction", "predictedLabel"]
     q = StreamingQuery(
         model,
         FileStreamSource(args.watch),
-        CsvDirSink(args.out, columns=["prediction"]),
+        CsvDirSink(args.out, columns=out_cols),
         args.checkpoint,
         max_batch_offsets=args.max_files_per_batch,
         pipeline_depth=args.pipeline_depth,
@@ -267,6 +291,9 @@ def main(argv=None) -> int:
     p.add_argument("--out", required=True, help="output CSV directory")
     p.add_argument("--checkpoint", required=True,
                    help="offset/commit WAL directory (exactly-once resume)")
+    p.add_argument("--label-index-col", default="label",
+                   help="outputCol of the LABEL StringIndexer to strip "
+                   "(feature-column indexers are kept)")
     p.add_argument("--max-files-per-batch", type=int, default=None)
     p.add_argument("--pipeline-depth", type=int, default=2)
     p.add_argument("--poll-interval", type=float, default=1.0)

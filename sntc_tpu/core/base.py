@@ -161,13 +161,34 @@ class PipelineModel(Model):
         return current
 
     def transform_async(self, frame: Frame):
-        """Host stages run now; the final stage's device dispatch is
-        deferred to its own ``transform_async`` (feature prep for batch
-        i+1 overlaps batch i's device compute in a pipelined serve loop)."""
+        """Host stages before the last device-dispatching stage run now;
+        that stage's dispatch is deferred to its own ``transform_async``
+        (feature prep for batch i+1 overlaps batch i's device compute in a
+        pipelined serve loop), and trailing host-only stages (e.g.
+        ``IndexToString`` on the prediction) run inside finalize."""
         stages = self.getStages()
         if not stages:
             return lambda: frame
+        split = len(stages) - 1
+        for i in reversed(range(len(stages))):
+            if (
+                type(stages[i]).transform_async
+                is not Transformer.transform_async
+            ):
+                split = i
+                break
         current = frame
-        for stage in stages[:-1]:
+        for stage in stages[:split]:
             current = stage.transform(current)
-        return stages[-1].transform_async(current)
+        fin = stages[split].transform_async(current)
+        tail = stages[split + 1:]
+        if not tail:
+            return fin
+
+        def finalize():
+            out = fin()
+            for stage in tail:
+                out = stage.transform(out)
+            return out
+
+        return finalize

@@ -43,16 +43,20 @@ from sntc_tpu.parallel.context import get_default_mesh
 from functools import partial
 
 
-@partial(jax.jit, static_argnames=("k",))
-def _lr_summarize(xs, ys, ws, k):
-    """Moments + class counts in one pass; with mesh-sharded inputs XLA
-    inserts the ICI all-reduce (the summarizer treeAggregate of §3.1)."""
+def _lr_summarize_impl(xs, ys, ws, k):
     return (
         jnp.einsum("n,nd->d", ws, xs),
         jnp.einsum("n,nd->d", ws, xs * xs),
         jnp.sum(ws),
         jax.ops.segment_sum(ws, ys, num_segments=k),
     )
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _lr_summarize(xs, ys, ws, k):
+    """Moments + class counts in one pass; with mesh-sharded inputs XLA
+    inserts the ICI all-reduce (the summarizer treeAggregate of §3.1)."""
+    return _lr_summarize_impl(xs, ys, ws, k)
 
 
 def _lr_value_and_grad(
@@ -172,6 +176,55 @@ def _lr_optimize_grid(
     return jax.vmap(one)(l2_b, pen_l2_b, l1_vec_b, theta0_b)
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "binomial", "fit_intercept", "k", "max_iter", "tol", "use_l1",
+    ),
+)
+def _lr_optimize_lanes(
+    xs, ys, ws_folds, fold_idx_b, inv_std_b, l2_b, pen_l2_b, l1_vec_b,
+    theta0_b,
+    *, binomial, fit_intercept, k, max_iter, tol, use_l1,
+):
+    """Fold×grid lanes in ONE program: like :func:`_lr_optimize_grid` but
+    each lane reads its OWN row-weight vector — a CV fold is just a 0/1
+    weight mask over the shared sharded data — and carries its own
+    standardization, so the whole k-fold × grid sweep becomes one vmapped
+    LBFGS.  Lanes index ``ws_folds[F, N]`` by ``fold_idx`` in-program:
+    the masks upload once (sharded), not once per lane."""
+    d = xs.shape[1]
+    n_coef = d if binomial else d * k
+
+    def one(fold_idx, inv_std, l2, pen_l2, l1_vec, theta0):
+        ws = ws_folds[fold_idx]
+        w_sum = jnp.sum(ws)
+
+        def value_and_grad(theta):
+            return _lr_value_and_grad(
+                theta, xs, ys, ws, inv_std, l2, pen_l2, w_sum,
+                binomial=binomial, fit_intercept=fit_intercept, k=k,
+                n_coef=n_coef,
+            )
+
+        return minimize_lbfgs(
+            value_and_grad, theta0, max_iter=max_iter, tol=tol,
+            l1=l1_vec if use_l1 else None,
+        )
+
+    return jax.vmap(one)(
+        fold_idx_b, inv_std_b, l2_b, pen_l2_b, l1_vec_b, theta0_b
+    )
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _lr_summarize_folds(xs, ys, ws_b, k):
+    """Per-fold summarizer: vmapped moments + class counts over per-lane
+    weight vectors (each CV fold standardizes on ITS train split, exactly
+    as a sequential sub-fit would)."""
+    return jax.vmap(lambda ws: _lr_summarize_impl(xs, ys, ws, k))(ws_b)
+
+
 class LogisticRegressionSummary:
     """Training summary (the ``LogisticRegressionTrainingSummary`` analog)."""
 
@@ -287,13 +340,8 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
             raise ValueError("lower bounds must not exceed upper bounds")
         return lb, ub, True
 
-    def _prep_data(self, frame: Frame, mesh) -> dict:
-        """Shared per-dataset prep: shard, summarize (one treeAggregate).
-
-        Split out so the grid-batched fit (``_fit_grid``) pays for the data
-        upload and summarizer pass ONCE across all grid points."""
-        X, y, w = self._extract(frame)
-        n, d = X.shape
+    def _resolve_family(self, y, n):
+        """(binomial, num_classes) with Spark's auto/validation rules."""
         num_classes = int(y.max()) + 1 if n else 2
         family = self.getFamily()
         if family == "auto":
@@ -302,25 +350,37 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
             raise ValueError(
                 f"binomial family with {num_classes} classes; use multinomial"
             )
-        num_classes = max(num_classes, 2)
-        k = num_classes
+        return family == "binomial", max(num_classes, 2)
+
+    @staticmethod
+    def _moments_to_stats(s1, s2, cnt, cc):
+        """(std, inv_std, class_counts) from one summarizer pass."""
+        w_sum = max(float(cnt), 1e-12)
+        mean = np.asarray(s1, np.float64) / w_sum
+        var = np.maximum(np.asarray(s2, np.float64) / w_sum - mean**2, 0.0)
+        std = np.sqrt(var)
+        inv_std = np.divide(1.0, std, out=np.zeros_like(std), where=std > 0)
+        return std, inv_std, np.maximum(np.asarray(cc, np.float64), 1e-12)
+
+    def _prep_data(self, frame: Frame, mesh) -> dict:
+        """Shared per-dataset prep: shard, summarize (one treeAggregate).
+
+        Split out so the grid-batched fit (``_fit_grid``) pays for the data
+        upload and summarizer pass ONCE across all grid points."""
+        X, y, w = self._extract(frame)
+        n, d = X.shape
+        binomial, k = self._resolve_family(y, n)
 
         xs, ys, _ = shard_batch(mesh, X, y.astype(np.int32))
         ws = shard_weights(mesh, w, xs.shape[0])
 
         # ---- summarizer pass: moments + class counts (one treeAggregate) ----
-        s1, s2, cnt, cc = _lr_summarize(xs, ys, ws, k)
-        w_sum = float(cnt)
-        mean = np.asarray(s1, np.float64) / max(w_sum, 1e-12)
-        var = np.maximum(
-            np.asarray(s2, np.float64) / max(w_sum, 1e-12) - mean**2, 0.0
+        std, inv_std, class_counts = self._moments_to_stats(
+            *_lr_summarize(xs, ys, ws, k)
         )
-        std = np.sqrt(var)
-        inv_std = np.divide(1.0, std, out=np.zeros_like(std), where=std > 0)
-        class_counts = np.maximum(np.asarray(cc, np.float64), 1e-12)
         return {
             "xs": xs, "ys": ys, "ws": ws, "n": n, "d": d, "k": k,
-            "binomial": family == "binomial", "std": std,
+            "binomial": binomial, "std": std,
             "inv_std": inv_std, "class_counts": class_counts,
         }
 
@@ -448,6 +508,98 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
         if self.getCheckpointInterval() != -1:
             return False
         return True
+
+    def _fit_grid_folds(self, frame: Frame, param_maps, fold_of, num_folds):
+        """CrossValidator's ENTIRE k-fold × grid sweep in (at most two)
+        device programs: a fold is a 0/1 row-weight mask over the shared
+        sharded data, so (fold, grid point) lanes vmap together — data is
+        uploaded once, each lane standardizes on its own fold's moments
+        (matching a sequential sub-fit), and every LBFGS iteration batches
+        all lanes' matmuls on the MXU.  Returns ``[num_folds][G]`` fitted
+        models."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._mesh or get_default_mesh()
+        ests = [self.copy(m) for m in param_maps]
+        G = len(ests)
+        X, y, w = self._extract(frame)
+        n, d = X.shape
+        binomial, k = ests[0]._resolve_family(y, n)
+
+        xs, ys, _ = shard_batch(mesh, X, y.astype(np.int32))
+        n_pad = xs.shape[0]
+        fold_of = np.asarray(fold_of)
+        masks = np.zeros((num_folds, n_pad), np.float32)
+        for f in range(num_folds):
+            masks[f, :n] = (fold_of != f) * w  # zero weight = not in fold
+        axis = mesh.axis_names[0]
+        ws_folds = jax.device_put(masks, NamedSharding(mesh, P(None, axis)))
+
+        s1, s2, cnt, cc = _lr_summarize_folds(xs, ys, ws_folds, k)
+        s1, s2, cnt, cc = (np.asarray(a, np.float64) for a in (s1, s2, cnt, cc))
+        preps = []
+        for f in range(num_folds):
+            std, inv_std, class_counts = self._moments_to_stats(
+                s1[f], s2[f], cnt[f], cc[f]
+            )
+            preps.append({
+                "xs": xs, "ys": ys, "n": n, "d": d, "k": k,
+                "binomial": binomial, "std": std, "inv_std": inv_std,
+                "class_counts": class_counts,
+            })
+
+        vecs = [
+            [ests[g]._grid_vectors(preps[f]) for g in range(G)]
+            for f in range(num_folds)
+        ]
+        max_iter, tol = ests[0].getMaxIter(), ests[0].getTol()
+        fit_intercept = ests[0].getFitIntercept()
+        models = [[None] * G for _ in range(num_folds)]
+        for flag in (False, True):
+            lanes = [
+                (f, g)
+                for f in range(num_folds)
+                for g in range(G)
+                if bool(vecs[f][g]["use_l1"]) == flag
+            ]
+            if not lanes:
+                continue
+            res = _lr_optimize_lanes(
+                xs, ys,
+                ws_folds,
+                jnp.asarray(
+                    np.asarray([f for f, _ in lanes], np.int32)
+                ),
+                jnp.asarray(
+                    np.stack(
+                        [preps[f]["inv_std"] for f, _ in lanes]
+                    ).astype(np.float32)
+                ),
+                jnp.asarray(np.stack([vecs[f][g]["l2"] for f, g in lanes])),
+                jnp.asarray(
+                    np.stack([vecs[f][g]["pen_l2"] for f, g in lanes])
+                ),
+                jnp.asarray(
+                    np.stack([vecs[f][g]["l1_vec"] for f, g in lanes])
+                ),
+                jnp.asarray(
+                    np.stack([vecs[f][g]["theta0"] for f, g in lanes])
+                ),
+                binomial=binomial,
+                fit_intercept=fit_intercept,
+                k=k,
+                max_iter=max_iter,
+                tol=tol,
+                use_l1=flag,
+            )
+            xs_h = np.asarray(res.x)
+            iters_h = np.asarray(res.n_iters)
+            hist_h = np.asarray(res.history)
+            for lane, (f, g) in enumerate(lanes):
+                models[f][g] = ests[g]._theta_to_model(
+                    xs_h[lane], preps[f], iters_h[lane], hist_h[lane]
+                )
+        return models
 
     def _fit_grid(self, frame: Frame, param_maps):
         """Fit all ``param_maps`` over the SAME frame in (at most two)

@@ -144,10 +144,26 @@ class CrossValidator(_TuningParams, Estimator):
         )
 
         _warn_parallelism_noop(self.estimator, grid, self.getParallelism())
+        # strongest path: the whole k-fold × grid sweep as one vmapped
+        # device program (folds are per-lane weight masks; data uploads
+        # once) — available when the estimator supports batched grids
+        fold_models = None
+        if _is_batched(self.estimator, grid) and hasattr(
+            self.estimator, "_fit_grid_folds"
+        ):
+            fold_models = self.estimator._fit_grid_folds(
+                frame, grid, fold_of, k
+            )
         for fold in range(k):
-            train = frame.filter(fold_of != fold)
             valid = frame.filter(fold_of == fold)
-            for gi, model in enumerate(_grid_fit(self.estimator, train, grid)):
+            models = (
+                fold_models[fold]
+                if fold_models is not None
+                else _grid_fit(
+                    self.estimator, frame.filter(fold_of != fold), grid
+                )
+            )
+            for gi, model in enumerate(models):
                 metrics[gi, fold] = self.evaluator.evaluate(
                     model.transform(valid)
                 )

@@ -60,7 +60,14 @@ def test_serve_once(data_dir, tmp_path, capsys):
     assert rc == 0
     served = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert served["batches"] == 3
-    assert len(os.listdir(out_dir)) == 3
+    outs = sorted(os.listdir(out_dir))
+    assert len(outs) == 3
+    # predictions come back as label STRINGS via the indexer's vocabulary
+    with open(os.path.join(out_dir, outs[0])) as fh:
+        header = fh.readline()
+        first = fh.readline()
+    assert "predictedLabel" in header
+    assert any(lbl in first for lbl in ('"benign"', '"attack"', "benign", "attack"))
     # resume: nothing new -> zero batches
     rc = main([
         "serve", "--model", model_dir, "--watch", data_dir,

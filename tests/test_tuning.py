@@ -276,3 +276,25 @@ def test_parallelism_noop_warns(mesh8, caplog):
     with caplog.at_level(logging.WARNING, logger="sntc_tpu.tuning.cross_validator"):
         cv.fit(f)
     assert any("parallelism" in r.message for r in caplog.records)
+
+
+def test_fit_grid_folds_matches_per_fold_fits(mesh8):
+    """The one-program fold×grid sweep equals per-fold subset fits: a fold
+    is a zero-weight mask, so coefficients must match fits on the actual
+    row subsets (modulo f32 summation order)."""
+    f = _data(900, seed=8)
+    lr = LogisticRegression(mesh=mesh8, maxIter=20)
+    grid = [{"regParam": 0.0}, {"regParam": 0.05, "elasticNetParam": 1.0}]
+    rng = np.random.default_rng(3)
+    fold_of = rng.integers(0, 3, size=f.num_rows)
+    batched = lr._fit_grid_folds(f, grid, fold_of, 3)
+    assert len(batched) == 3 and all(len(row) == 2 for row in batched)
+    for fold in range(3):
+        train = f.filter(fold_of != fold)
+        for gi, params in enumerate(grid):
+            ref = lr.copy(params).fit(train)
+            np.testing.assert_allclose(
+                batched[fold][gi].coefficientMatrix,
+                ref.coefficientMatrix,
+                atol=5e-3,
+            )
