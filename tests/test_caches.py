@@ -1,0 +1,93 @@
+"""Device-residency + assembly caches (the BlockManager/.cache() analog):
+identity semantics, weakref lifetime, byte bounds, kill switch."""
+
+import gc
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.feature import VectorAssembler
+from sntc_tpu.feature.vector_assembler import _ASSEMBLE_CACHE
+from sntc_tpu.parallel.collectives import (
+    _DEVICE_CACHE,
+    pad_rows,
+    shard_batch,
+)
+
+
+def test_pad_rows_buckets_nearby_sizes(monkeypatch):
+    # fold-sized datasets land in one bucket -> one compiled program
+    a, b = pad_rows(200_000, 8), pad_rows(199_000, 8)
+    assert a == b
+    # far-apart sizes differ
+    assert pad_rows(100_000, 8) != pad_rows(200_000, 8)
+    # small inputs are exact (no bucket waste)
+    assert pad_rows(100, 4) == 100
+    monkeypatch.setenv("SNTC_SHAPE_BUCKETS", "0")
+    assert pad_rows(200_001, 8) == 200_008
+
+
+def test_shard_batch_device_cache_identity(mesh8):
+    X = np.random.default_rng(0).normal(size=(5000, 60)).astype(np.float32)
+    xs1, _ = shard_batch(mesh8, X)
+    xs2, _ = shard_batch(mesh8, X)          # same object -> same buffer
+    assert xs1 is xs2
+    xs3, _ = shard_batch(mesh8, X.copy())   # equal content, new object
+    assert xs3 is not xs1
+
+
+def test_shard_batch_cache_entry_dies_with_array(mesh8):
+    X = np.random.default_rng(1).normal(size=(5000, 60)).astype(np.float32)
+    shard_batch(mesh8, X)
+    key_count = len(_DEVICE_CACHE)
+    assert key_count >= 1
+    del X
+    gc.collect()
+    # next call sweeps dead entries
+    Y = np.random.default_rng(2).normal(size=(4000, 60)).astype(np.float32)
+    shard_batch(mesh8, Y)
+    assert all(e[0]() is not None for e in _DEVICE_CACHE.values())
+
+
+def test_device_cache_kill_switch(mesh8, monkeypatch):
+    monkeypatch.setenv("SNTC_DEVICE_CACHE_MB", "0")
+    X = np.random.default_rng(3).normal(size=(5000, 60)).astype(np.float32)
+    xs1, _ = shard_batch(mesh8, X)
+    xs2, _ = shard_batch(mesh8, X)
+    assert xs1 is not xs2
+
+
+def test_assembler_memo_reuses_stack(monkeypatch):
+    cols = {
+        "a": np.arange(1000.0, dtype=np.float64),
+        "b": np.arange(1000.0, dtype=np.float64) * 2,
+    }
+    f1 = Frame(cols)
+    f2 = f1.with_column("extra", np.zeros(1000))  # shares a/b arrays
+    va = VectorAssembler(inputCols=["a", "b"], outputCol="v",
+                         handleInvalid="keep")
+    X1 = va.transform(f1)["v"]
+    X2 = va.transform(f2)["v"]
+    assert X1 is X2  # identical column objects -> one stack
+    monkeypatch.setenv("SNTC_DEVICE_CACHE_MB", "0")
+    X3 = va.transform(f1)["v"]
+    assert X3 is not X1
+
+
+def test_assembler_memo_sweeps_dead_columns():
+    before = len(_ASSEMBLE_CACHE)
+    big = np.random.default_rng(4).normal(size=(2000,)).astype(np.float64)
+    f = Frame({"a": big, "b": big.copy()})
+    va = VectorAssembler(inputCols=["a", "b"], outputCol="v",
+                         handleInvalid="keep")
+    va.transform(f)
+    del f, big
+    gc.collect()
+    va2 = VectorAssembler(inputCols=["a", "b"], outputCol="v",
+                          handleInvalid="keep")
+    f2 = Frame({"a": np.ones(10), "b": np.ones(10)})
+    va2.transform(f2)
+    assert all(
+        all(r() is not None for r in e[0]) for e in _ASSEMBLE_CACHE.values()
+    )
