@@ -217,6 +217,38 @@ def _lr_optimize_lanes(
     )
 
 
+@partial(
+    jax.jit,
+    static_argnames=("fit_intercept", "max_iter", "tol", "use_l1"),
+)
+def _lr_optimize_ovr(
+    xs, ys, ws, inv_std, l2, pen_l2, l1_vec, class_ids, theta0_b,
+    *, fit_intercept, max_iter, tol, use_l1,
+):
+    """K one-vs-rest BINARY fits in ONE program: lane c relabels the
+    shared sharded labels in-program (``ys == c``) — Spark's OvR
+    ``parallelism`` thread pool becomes a vmapped class axis over data
+    that uploads once (SURVEY.md §2.5 task parallelism)."""
+    d = xs.shape[1]
+    w_sum = jnp.sum(ws)
+
+    def one(cid, theta0):
+        ys_c = (ys == cid).astype(jnp.int32)
+
+        def value_and_grad(theta):
+            return _lr_value_and_grad(
+                theta, xs, ys_c, ws, inv_std, l2, pen_l2, w_sum,
+                binomial=True, fit_intercept=fit_intercept, k=2, n_coef=d,
+            )
+
+        return minimize_lbfgs(
+            value_and_grad, theta0, max_iter=max_iter, tol=tol,
+            l1=l1_vec if use_l1 else None,
+        )
+
+    return jax.vmap(one)(class_ids, theta0_b)
+
+
 @partial(jax.jit, static_argnames=("k",))
 def _lr_summarize_folds(xs, ys, ws_b, k):
     """Per-fold summarizer: vmapped moments + class counts over per-lane
@@ -264,6 +296,12 @@ class _LrParams:
     upperBoundsOnIntercepts = Param(
         "intercept upper bounds, length 1 (binomial) or K", default=None
     )
+
+
+_BOUND_PARAMS = (
+    "lowerBoundsOnCoefficients", "upperBoundsOnCoefficients",
+    "lowerBoundsOnIntercepts", "upperBoundsOnIntercepts",
+)
 
 
 def _bounds_digest(lb: np.ndarray, ub: np.ndarray) -> str:
@@ -384,39 +422,45 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
             "inv_std": inv_std, "class_counts": class_counts,
         }
 
-    def _grid_vectors(self, prep: dict) -> dict:
-        """Per-grid-point optimizer inputs from shared prep (called on a
-        ``copy(params)`` of the estimator for each grid point)."""
-        d, k, binomial = prep["d"], prep["k"], prep["binomial"]
+    def _penalty_vectors(self, d: int, k: int, binomial: bool, inv_std):
+        """Elastic-net penalty weights in the SCALED optimization space —
+        the ONE encoding of Spark's standardization=True/False penalty
+        semantics, shared by single fits, grid lanes, and OvR lanes."""
         reg = self.getRegParam()
         alpha = self.getElasticNetParam()
         l2 = reg * (1.0 - alpha)
         l1 = reg * alpha
         fit_intercept = self.getFitIntercept()
         standardize = self.getStandardization()
-        inv_std, class_counts = prep["inv_std"], prep["class_counts"]
         n_coef = d if binomial else d * k
         n_int = (1 if binomial else k) if fit_intercept else 0
         pen_scale = np.ones(d) if standardize else inv_std
         pen_l2 = np.tile(pen_scale**2, 1 if binomial else k).astype(np.float32)
+        l1_vec = np.concatenate(
+            [l1 * np.tile(pen_scale, 1 if binomial else k), np.zeros(n_int)]
+        ).astype(np.float32)
+        return {
+            "l2": np.float32(l2), "pen_l2": pen_l2, "l1_vec": l1_vec,
+            "use_l1": l1 > 0, "n_coef": n_coef, "n_int": n_int,
+        }
+
+    def _grid_vectors(self, prep: dict) -> dict:
+        """Per-grid-point optimizer inputs from shared prep (called on a
+        ``copy(params)`` of the estimator for each grid point)."""
+        d, k, binomial = prep["d"], prep["k"], prep["binomial"]
+        vec = self._penalty_vectors(d, k, binomial, prep["inv_std"])
+        n_coef, n_int = vec["n_coef"], vec["n_int"]
+        class_counts = prep["class_counts"]
         theta0 = np.zeros(n_coef + n_int, dtype=np.float32)
-        if fit_intercept:
+        if self.getFitIntercept():
+            # prior-log-odds intercept init (Spark parity)
             priors = class_counts / class_counts.sum()
             if binomial:
                 theta0[n_coef] = np.log(priors[1] / priors[0]) if k == 2 else 0.0
             else:
                 theta0[n_coef:] = np.log(priors)
-        pen_l1 = np.tile(
-            np.ones(d) if standardize else inv_std, 1 if binomial else k
-        )
-        l1_vec = np.concatenate(
-            [l1 * pen_l1, np.zeros(n_int)]
-        ).astype(np.float32)
-        return {
-            "l2": np.float32(l2), "pen_l2": pen_l2, "l1_vec": l1_vec,
-            "theta0": theta0, "use_l1": l1 > 0, "n_coef": n_coef,
-            "n_int": n_int,
-        }
+        vec["theta0"] = theta0
+        return vec
 
     def _theta_to_model(
         self, theta, prep, n_iters, history, use_bounds=False
@@ -498,11 +542,7 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
             if len(vals) > 1:
                 return False
         if any(
-            self.paramValues().get(p) is not None
-            for p in (
-                "lowerBoundsOnCoefficients", "upperBoundsOnCoefficients",
-                "lowerBoundsOnIntercepts", "upperBoundsOnIntercepts",
-            )
+            self.paramValues().get(p) is not None for p in _BOUND_PARAMS
         ):
             return False
         if self.getCheckpointInterval() != -1:
@@ -600,6 +640,71 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
                     xs_h[lane], preps[f], iters_h[lane], hist_h[lane]
                 )
         return models
+
+    def supports_vectorized_ovr(self) -> bool:
+        """True when OneVsRest can run this classifier's K binary fits as
+        one vmapped program: binomial-compatible family, no bound
+        constraints, no mid-fit checkpointing."""
+        if self.getFamily() == "multinomial":
+            return False  # a 2-class softmax parameterization differs
+        if any(
+            self.paramValues().get(p) is not None for p in _BOUND_PARAMS
+        ):
+            return False
+        return self.getCheckpointInterval() == -1
+
+    def _fit_ovr_lanes(self, X, y, w, k, mesh):
+        """K one-vs-rest binary models fit in one device program (see
+        ``_lr_optimize_ovr``): the summarizer runs once (moments are
+        class-independent), per-class intercepts init to each class's
+        prior log odds, and lane c's labels are relabeled in-program."""
+        n, d = X.shape
+        xs, ys, _ = shard_batch(mesh, X, y.astype(np.int32))
+        ws = shard_weights(mesh, w, xs.shape[0])
+        std, inv_std, class_counts = self._moments_to_stats(
+            *_lr_summarize(xs, ys, ws, k)
+        )
+        w_sum = float(class_counts.sum())
+
+        fit_intercept = self.getFitIntercept()
+        vec = self._penalty_vectors(d, 2, True, inv_std)
+        n_int = vec["n_int"]
+
+        theta0_b = np.zeros((k, d + n_int), np.float32)
+        if fit_intercept:
+            # per-class prior log odds — what each sequential relabeled
+            # sub-fit's _grid_vectors init would compute
+            pos = class_counts / max(w_sum, 1e-12)
+            theta0_b[:, d] = np.log(
+                np.maximum(pos, 1e-12) / np.maximum(1.0 - pos, 1e-12)
+            )
+
+        res = _lr_optimize_ovr(
+            xs, ys, ws,
+            jnp.asarray(inv_std, jnp.float32),
+            jnp.asarray(vec["l2"]),
+            jnp.asarray(vec["pen_l2"]),
+            jnp.asarray(vec["l1_vec"]),
+            jnp.arange(k, dtype=jnp.int32),
+            jnp.asarray(theta0_b),
+            fit_intercept=fit_intercept,
+            max_iter=self.getMaxIter(),
+            tol=self.getTol(),
+            use_l1=bool(vec["use_l1"]),
+        )
+        xs_h = np.asarray(res.x)
+        iters_h = np.asarray(res.n_iters)
+        hist_h = np.asarray(res.history)
+        prep = {
+            "n": n, "d": d, "k": 2, "binomial": True,
+            "std": std, "inv_std": inv_std,
+        }
+        return [
+            self._theta_to_model(
+                xs_h[c], prep, iters_h[c], hist_h[c]
+            )
+            for c in range(k)
+        ]
 
     def _fit_grid(self, frame: Frame, param_maps):
         """Fit all ``param_maps`` over the SAME frame in (at most two)

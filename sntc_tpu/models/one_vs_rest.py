@@ -75,20 +75,17 @@ class OneVsRest(_OvrParams, ClassifierEstimator):
 
     def _fit_vectorized(self, X, y, w, k, frame):
         """All-classes-at-once fit when the base classifier supports riding
-        the grower's tree axis (GBT: K trees per boosting round over the
-        same binned features — SURVEY.md §7.2 item 4).  Returns None when
-        the classifier has no vectorized path or mid-fit checkpointing is
+        a batched class axis (GBT: K trees per boosting round over the
+        same binned features — SURVEY.md §7.2 item 4; LogisticRegression:
+        K binary LBFGS lanes relabeled in-program).  Returns None when the
+        classifier has no vectorized path or mid-fit checkpointing is
         requested (the sequential path owns that)."""
+        from sntc_tpu.models.logistic_regression import LogisticRegression
         from sntc_tpu.models.tree.gbt import GBTClassifier, fit_gbt_ovr_vectorized
         from sntc_tpu.parallel.context import get_default_mesh
 
-        if not isinstance(self.classifier, GBTClassifier):
-            return None
-        # sequential only when checkpointing would actually happen (both
-        # interval AND dir set — matching GBTClassifier._fit's own gate)
-        if (
-            self.classifier.getCheckpointInterval() > 0
-            and self.classifier.getCheckpointDir()
+        if not isinstance(
+            self.classifier, (LogisticRegression, GBTClassifier)
         ):
             return None
         # a weightCol set on the classifier itself (not this OvR) refers to
@@ -97,6 +94,18 @@ class OneVsRest(_OvrParams, ClassifierEstimator):
         if self.classifier.getWeightCol() and not self.getWeightCol():
             return None
         mesh = self._mesh or self.classifier._mesh or get_default_mesh()
+
+        if isinstance(self.classifier, LogisticRegression):
+            if not self.classifier.supports_vectorized_ovr():
+                return None
+            return self.classifier._fit_ovr_lanes(X, y, w, k, mesh)
+        # sequential only when checkpointing would actually happen (both
+        # interval AND dir set — matching GBTClassifier._fit's own gate)
+        if (
+            self.classifier.getCheckpointInterval() > 0
+            and self.classifier.getCheckpointDir()
+        ):
+            return None
         # validated boosting: the indicator column lives on the input frame
         vcol = self.classifier.getValidationIndicatorCol()
         val_mask = np.asarray(frame[vcol]).astype(bool) if vcol else None

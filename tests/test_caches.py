@@ -76,7 +76,6 @@ def test_assembler_memo_reuses_stack(monkeypatch):
 
 
 def test_assembler_memo_sweeps_dead_columns():
-    before = len(_ASSEMBLE_CACHE)
     big = np.random.default_rng(4).normal(size=(2000,)).astype(np.float64)
     f = Frame({"a": big, "b": big.copy()})
     va = VectorAssembler(inputCols=["a", "b"], outputCol="v",

@@ -298,3 +298,29 @@ def test_fit_grid_folds_matches_per_fold_fits(mesh8):
                 ref.coefficientMatrix,
                 atol=5e-3,
             )
+
+
+def test_ovr_lr_vectorized_matches_sequential(mesh8):
+    """OneVsRest(LogisticRegression) runs all K binary fits as one vmapped
+    program; models must match the sequential per-class fits."""
+    from sntc_tpu.models import OneVsRest
+
+    f = _data15(1200, seed=6, k=4)
+    base = LogisticRegression(mesh=mesh8, maxIter=25, regParam=1e-3)
+    vec = OneVsRest(classifier=base, mesh=mesh8).fit(f)
+    assert len(vec.models) == 4
+
+    # sequential reference: force family=binomial-incompatible gate off
+    seq_models = []
+    y = np.asarray(f["label"])
+    for c in range(4):
+        sub = f.with_column("bin", (y == c).astype(np.float64))
+        seq_models.append(
+            base.copy({"labelCol": "bin"}).fit(sub)
+        )
+    for vm, sm in zip(vec.models, seq_models):
+        np.testing.assert_allclose(
+            vm.coefficientMatrix, sm.coefficientMatrix, atol=5e-3
+        )
+    out = vec.transform(f)
+    assert (out["prediction"] == y).mean() > 0.8
