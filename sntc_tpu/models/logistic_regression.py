@@ -545,9 +545,7 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
             self.paramValues().get(p) is not None for p in _BOUND_PARAMS
         ):
             return False
-        if self.getCheckpointInterval() != -1:
-            return False
-        return True
+        return not self._would_checkpoint()
 
     def _fit_grid_folds(self, frame: Frame, param_maps, fold_of, num_folds):
         """CrossValidator's ENTIRE k-fold × grid sweep in (at most two)
@@ -651,7 +649,16 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
             self.paramValues().get(p) is not None for p in _BOUND_PARAMS
         ):
             return False
-        return self.getCheckpointInterval() == -1
+        return not self._would_checkpoint()
+
+    def _would_checkpoint(self) -> bool:
+        """True iff a fit would actually persist mid-fit state — the gate
+        ``run_segmented`` itself uses (interval AND dir set); batched
+        paths defer to the sequential fit only in that case."""
+        return (
+            self.getCheckpointInterval() > 0
+            and bool(self.getCheckpointDir())
+        )
 
     def _fit_ovr_lanes(self, X, y, w, k, mesh):
         """K one-vs-rest binary models fit in one device program (see

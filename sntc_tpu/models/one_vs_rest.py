@@ -23,6 +23,64 @@ from sntc_tpu.models.base import (
 )
 
 
+def _build_fused_ovr(models):
+    """A ``f(X) -> [N, K]`` fused raw-score closure for homogeneous
+    sub-models, or None (see ``OneVsRestModel._fused_raw``)."""
+    from sntc_tpu.models.logistic_regression import LogisticRegressionModel
+    from sntc_tpu.models.tree.gbt import GBTClassificationModel
+
+    if not models:
+        return None
+    if all(
+        isinstance(m, LogisticRegressionModel) and m.is_binomial
+        for m in models
+    ):
+        # [D, K] f32 once at build time; predict is one f32 host matmul
+        # (tiny weights, raw margins only — cheaper than K device round
+        # trips at any batch size, no f64 copy of the batch)
+        WT = np.stack(
+            [m.coefficientMatrix[1] for m in models]
+        ).T.astype(np.float32)
+        b = np.asarray(
+            [m.interceptVector[1] for m in models], np.float32
+        )
+
+        def lr_fused(X):
+            return X.astype(np.float32, copy=False) @ WT + b
+
+        return lr_fused
+    if all(isinstance(m, GBTClassificationModel) for m in models) and (
+        len({m.forest.max_depth for m in models}) == 1
+    ):
+        import jax.numpy as jnp
+
+        from sntc_tpu.models.tree.gbt import _ovr_fused_raw
+
+        feature = np.concatenate([m.forest.feature for m in models])
+        threshold = np.concatenate([m.forest.threshold for m in models])
+        leaf_stats = np.concatenate([m.forest.leaf_stats for m in models])
+        K = len(models)
+        M = feature.shape[0]
+        sel = np.zeros((K, M), np.float32)
+        off = 0
+        for c, m in enumerate(models):
+            t = m.forest.feature.shape[0]
+            sel[c, off : off + t] = m.treeWeights
+            off += t
+        max_depth = models[0].forest.max_depth
+        dev = tuple(
+            jnp.asarray(a) for a in (feature, threshold, leaf_stats, sel)
+        )
+
+        def gbt_fused(X):
+            return np.asarray(
+                _ovr_fused_raw(jnp.asarray(X), *dev, max_depth=max_depth)
+            )
+
+        return gbt_fused
+    return None
+
+
 class _OvrParams(ClassifierParams):
     parallelism = Param(
         "API parity only; inner fits already saturate the mesh",
@@ -127,6 +185,7 @@ class OneVsRestModel(_OvrParams, ClassificationModel):
     def __init__(self, models: Optional[List[ClassificationModel]] = None, **kwargs):
         super().__init__(**kwargs)
         self.models = list(models or [])
+        self._fused = None  # lazy fused-predict closure (or False: none)
 
     @property
     def num_classes(self) -> int:
@@ -141,7 +200,26 @@ class OneVsRestModel(_OvrParams, ClassificationModel):
         obj.setParams(**params)
         return obj
 
+    def _fused_raw(self):
+        """Fused per-class raw scores — K sub-model predicts collapse into
+        ONE pass when the sub-models are homogeneous:
+
+        * LogisticRegression: the K binary coefficient rows stack into a
+          single ``[K, D]`` matrix — raw is one matmul;
+        * GBT: the K forests concatenate along the TREE axis; one
+          traversal of all M trees + a ``[K, M]`` class-selection matmul
+          yields every class's margin (one device dispatch instead of K).
+
+        Mixed/unknown sub-model types fall back to the per-model loop.
+        """
+        if self._fused is None:
+            self._fused = _build_fused_ovr(self.models) or False
+        return self._fused or None
+
     def _raw_predict(self, X: np.ndarray) -> np.ndarray:
+        fused = self._fused_raw()
+        if fused is not None:
+            return fused(X)
         # per-class raw class-1 margin (Spark uses rawPrediction(1))
         cols = [m._raw_predict(X)[:, 1] for m in self.models]
         return np.stack(cols, axis=1)

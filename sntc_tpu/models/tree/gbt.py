@@ -376,6 +376,19 @@ def _gbt_margin(X, feature, threshold, leaf_stats, tree_weights, *, max_depth):
     return jnp.einsum("m,mn->n", tree_weights, values)
 
 
+@partial(jax.jit, static_argnames=("max_depth",))
+def _ovr_fused_raw(X, feature, threshold, leaf_stats, sel, *, max_depth):
+    """Fused OneVsRest(GBT) raw scores: ONE traversal of all K classes'
+    trees (concatenated on the tree axis) + a [K, M] class-selection
+    contraction — K device dispatches per serving batch become one."""
+    stats = forest_leaf_stats(
+        X, feature, threshold, leaf_stats, max_depth=max_depth
+    )  # [M, N, 3]
+    values = stats[..., 1] / jnp.maximum(stats[..., 0], 1e-12)  # [M, N]
+    margins = sel @ values  # [K, N]
+    return (2.0 * margins).T  # raw class-1 score = 2F
+
+
 @partial(jax.jit, static_argnames=("max_depth", "mode"))
 def _gbt_serve(
     X, feature, threshold, leaf_stats, tree_weights, thr, *, max_depth, mode

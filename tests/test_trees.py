@@ -258,3 +258,28 @@ def test_tree_serve_paths_agree(mesh8, monkeypatch):
         for col in ("rawPrediction", "probability"):
             np.testing.assert_allclose(out[col], ref[col], atol=1e-5)
         np.testing.assert_array_equal(out["prediction"], ref["prediction"])
+
+
+def test_ovr_fused_raw_matches_per_model_loop(mesh8):
+    """Fused OneVsRest serving (one pass over all classes) equals the
+    per-sub-model loop for both LR and GBT sub-models."""
+    from sntc_tpu.models import GBTClassifier, LogisticRegression, OneVsRest
+
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(800, 6)).astype(np.float32)
+    y = np.argmax(X[:, :3] + 0.6 * rng.normal(size=(800, 3)), axis=1).astype(
+        np.float64
+    )
+    f = Frame({"features": X, "label": y})
+    for base in (
+        LogisticRegression(mesh=mesh8, maxIter=15),
+        GBTClassifier(mesh=mesh8, maxIter=3, maxDepth=3, seed=0),
+    ):
+        m = OneVsRest(classifier=base, mesh=mesh8).fit(f)
+        fused = m._raw_predict(X)
+        assert m._fused_raw() is not None
+        loop = np.stack(
+            [sub._raw_predict(X)[:, 1] for sub in m.models], axis=1
+        )
+        np.testing.assert_allclose(fused, loop, atol=1e-4)
+        assert fused.shape == (800, 3)

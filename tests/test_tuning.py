@@ -307,7 +307,19 @@ def test_ovr_lr_vectorized_matches_sequential(mesh8):
 
     f = _data15(1200, seed=6, k=4)
     base = LogisticRegression(mesh=mesh8, maxIter=25, regParam=1e-3)
-    vec = OneVsRest(classifier=base, mesh=mesh8).fit(f)
+    calls = []
+    orig = LogisticRegression._fit_ovr_lanes
+
+    def spy(self, *a, **kw):
+        calls.append(1)
+        return orig(self, *a, **kw)
+
+    LogisticRegression._fit_ovr_lanes = spy
+    try:
+        vec = OneVsRest(classifier=base, mesh=mesh8).fit(f)
+    finally:
+        LogisticRegression._fit_ovr_lanes = orig
+    assert calls, "vectorized OvR path did not run (gate regressed?)"
     assert len(vec.models) == 4
 
     # sequential reference: force family=binomial-incompatible gate off
