@@ -24,6 +24,28 @@ import pyarrow as pa
 ColumnLike = Union[np.ndarray, Sequence]
 
 
+def _coerce_column(name: str, value: ColumnLike):
+    """Coerce one column to an array and validate its rank.
+
+    jax.Array columns are held AS-IS: a device-resident column (e.g.
+    StandardScalerModel's on-device output) flows to the next estimator
+    without a host round trip; any numpy-only op falls back through
+    ``__array__`` (which materializes).
+    """
+    import jax
+
+    arr = (
+        value
+        if isinstance(value, (np.ndarray, jax.Array))
+        else np.asarray(value)
+    )
+    if arr.ndim not in (1, 2):
+        raise ValueError(
+            f"column {name!r} must be 1-D or 2-D, got shape {arr.shape}"
+        )
+    return arr
+
+
 class Frame:
     """Immutable ordered mapping of column name -> numpy array.
 
@@ -34,24 +56,10 @@ class Frame:
     __slots__ = ("_columns", "_num_rows")
 
     def __init__(self, columns: Mapping[str, ColumnLike]):
-        import jax
-
         cols: Dict[str, np.ndarray] = {}
         num_rows: Optional[int] = None
         for name, value in columns.items():
-            # jax.Array columns are held AS-IS: a device-resident column
-            # (e.g. StandardScalerModel's on-device output) flows to the
-            # next estimator without a host round trip; any numpy-only op
-            # falls back through __array__ (which materializes)
-            arr = (
-                value
-                if isinstance(value, (np.ndarray, jax.Array))
-                else np.asarray(value)
-            )
-            if arr.ndim not in (1, 2):
-                raise ValueError(
-                    f"column {name!r} must be 1-D or 2-D, got shape {arr.shape}"
-                )
+            arr = _coerce_column(name, value)
             if num_rows is None:
                 num_rows = arr.shape[0]
             elif arr.shape[0] != num_rows:
@@ -61,6 +69,16 @@ class Frame:
             cols[name] = arr
         self._columns = cols
         self._num_rows = 0 if num_rows is None else int(num_rows)
+
+    @classmethod
+    def _wrap(cls, cols: Dict[str, np.ndarray], num_rows: int) -> "Frame":
+        """Trusted constructor for derived frames whose columns were already
+        validated by a prior ``__init__`` (select/drop/slice/... reuse or
+        uniformly re-index them) — skips the per-column validation pass."""
+        f = object.__new__(cls)
+        f._columns = cols
+        f._num_rows = num_rows
+        return f
 
     # -- basic accessors -------------------------------------------------------
 
@@ -96,33 +114,56 @@ class Frame:
     # -- transformations (each returns a new Frame) ----------------------------
 
     def with_column(self, name: str, value: ColumnLike) -> "Frame":
+        arr = _coerce_column(name, value)
+        if self._columns and arr.shape[0] != self._num_rows:
+            raise ValueError(
+                f"column {name!r} has {arr.shape[0]} rows, expected "
+                f"{self._num_rows}"
+            )
         cols = dict(self._columns)
-        cols[name] = value
-        return Frame(cols)
+        cols[name] = arr
+        return Frame._wrap(cols, int(arr.shape[0]))
 
     def select(self, names: Iterable[str]) -> "Frame":
-        return Frame({n: self[n] for n in names})
+        return Frame._wrap({n: self[n] for n in names}, self._num_rows)
 
     def drop(self, *names: str) -> "Frame":
-        return Frame({n: a for n, a in self._columns.items() if n not in names})
+        return Frame._wrap(
+            {n: a for n, a in self._columns.items() if n not in names},
+            self._num_rows,
+        )
 
     def rename(self, mapping: Mapping[str, str]) -> "Frame":
-        return Frame(
-            {mapping.get(n, n): a for n, a in self._columns.items()}
+        return Frame._wrap(
+            {mapping.get(n, n): a for n, a in self._columns.items()},
+            self._num_rows,
         )
 
     def filter(self, mask: np.ndarray) -> "Frame":
         mask = np.asarray(mask)
         if mask.dtype != np.bool_ or mask.shape != (self._num_rows,):
             raise ValueError("filter mask must be a boolean (N,) array")
-        return Frame({n: a[mask] for n, a in self._columns.items()})
+        n = int(np.count_nonzero(mask))
+        return Frame._wrap({k: a[mask] for k, a in self._columns.items()}, n)
 
     def take(self, indices: np.ndarray) -> "Frame":
         indices = np.asarray(indices)
-        return Frame({n: a[indices] for n, a in self._columns.items()})
+        if indices.dtype == np.bool_:  # boolean masks select, not index
+            return self.filter(indices)
+        if indices.ndim != 1:
+            raise ValueError(
+                f"take() indices must be 1-D, got shape {indices.shape}"
+            )
+        return Frame._wrap(
+            {n: a[indices] for n, a in self._columns.items()},
+            int(indices.shape[0]),
+        )
 
     def slice(self, start: int, stop: Optional[int] = None) -> "Frame":
-        return Frame({n: a[start:stop] for n, a in self._columns.items()})
+        n = len(range(*slice(start, stop).indices(self._num_rows)))
+        return Frame._wrap(
+            {k: a[start:stop] for k, a in self._columns.items()}, n
+        )
 
     def concat(self, other: "Frame") -> "Frame":
         return Frame.concat_all([self, other])
@@ -134,6 +175,8 @@ class Frame:
         if not frames:
             raise ValueError("concat_all requires at least one frame")
         first = frames[0]
+        if len(frames) == 1:
+            return first  # immutable — safe to share
         for f in frames[1:]:
             if f.columns != first.columns:
                 raise ValueError("concat requires identical column sets/order")

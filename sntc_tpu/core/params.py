@@ -155,13 +155,20 @@ class Params:
 
     @classmethod
     def params(cls) -> Dict[str, Param]:
-        """All declared params, walking the MRO (subclass overrides win)."""
-        out: Dict[str, Param] = {}
-        for klass in reversed(cls.__mro__):
-            for name, p in vars(klass).items():
-                if isinstance(p, Param):
-                    out[name] = p
-        return out
+        """All declared params, walking the MRO (subclass overrides win).
+
+        Cached per class (the declaration set is fixed at class creation);
+        callers must treat the returned dict as read-only.
+        """
+        cached = cls.__dict__.get("_sntc_params")
+        if cached is None:
+            cached = {}
+            for klass in reversed(cls.__mro__):
+                for name, p in vars(klass).items():
+                    if isinstance(p, Param):
+                        cached[name] = p
+            cls._sntc_params = cached
+        return cached
 
     def _param(self, param: Any) -> Param:
         if isinstance(param, Param):

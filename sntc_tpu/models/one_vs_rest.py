@@ -37,12 +37,16 @@ def _build_fused_ovr(models):
     ):
         # [D, K] f32 once at build time; predict is one f32 host matmul
         # (tiny weights, raw margins only — cheaper than K device round
-        # trips at any batch size, no f64 copy of the batch)
+        # trips at any batch size, no f64 copy of the batch).  Margin is
+        # the class-1/class-0 row DIFFERENCE — same as the per-model
+        # loop's raw(1), which never assumes row 0 is zero (it isn't for
+        # e.g. externally-constructed symmetric [-w, w] models)
         WT = np.stack(
-            [m.coefficientMatrix[1] for m in models]
+            [m.coefficientMatrix[1] - m.coefficientMatrix[0] for m in models]
         ).T.astype(np.float32)
         b = np.asarray(
-            [m.interceptVector[1] for m in models], np.float32
+            [m.interceptVector[1] - m.interceptVector[0] for m in models],
+            np.float32,
         )
 
         def lr_fused(X):
@@ -185,7 +189,10 @@ class OneVsRestModel(_OvrParams, ClassificationModel):
     def __init__(self, models: Optional[List[ClassificationModel]] = None, **kwargs):
         super().__init__(**kwargs)
         self.models = list(models or [])
-        self._fused = None  # lazy fused-predict closure (or False: none)
+        # lazy (models-identity-key, closure-or-False); keyed so mutating
+        # ``self.models`` (public list) invalidates instead of serving the
+        # stale fused weights
+        self._fused = None
 
     @property
     def num_classes(self) -> int:
@@ -212,9 +219,14 @@ class OneVsRestModel(_OvrParams, ClassificationModel):
 
         Mixed/unknown sub-model types fall back to the per-model loop.
         """
-        if self._fused is None:
-            self._fused = _build_fused_ovr(self.models) or False
-        return self._fused or None
+        # key on the model OBJECTS (kept alive by the tuple — identity
+        # comparison; id() alone could be reused after GC)
+        models = tuple(self.models)
+        if self._fused is None or len(self._fused[0]) != len(models) or any(
+            a is not b for a, b in zip(self._fused[0], models)
+        ):
+            self._fused = (models, _build_fused_ovr(self.models) or False)
+        return self._fused[1] or None
 
     def _raw_predict(self, X: np.ndarray) -> np.ndarray:
         fused = self._fused_raw()

@@ -89,3 +89,26 @@ def test_device_columns_round_trip():
     assert sliced.num_rows == 2
     filtered = f.filter(np.asarray(f["s"]) > 2.0)
     assert filtered.num_rows == 3
+
+
+def test_take_boolean_mask_selects_consistently():
+    """A boolean array passed to take() is a mask (numpy fancy-indexing
+    semantics), not positions — row count must match the selection."""
+    f = Frame({"a": np.arange(5.0), "b": np.arange(10.0).reshape(5, 2)})
+    mask = np.array([True, False, True, False, False])
+    g = f.take(mask)
+    assert g.num_rows == 2
+    assert np.array_equal(g["a"], np.array([0.0, 2.0]))
+    assert len(g) == 2
+    # derived frames keep consistent bookkeeping
+    assert g.filter(np.array([True, False])).num_rows == 1
+
+
+def test_derived_frames_keep_row_counts():
+    f = Frame({"a": np.arange(7.0)})
+    assert f.slice(2, 100).num_rows == 5
+    assert f.slice(0, None).num_rows == 7
+    assert f.take(np.array([6, 0, 3])).num_rows == 3
+    assert f.select(["a"]).num_rows == 7
+    assert f.drop("a").columns == []
+    assert Frame.concat_all([f]) is f

@@ -283,3 +283,23 @@ def test_ovr_fused_raw_matches_per_model_loop(mesh8):
         )
         np.testing.assert_allclose(fused, loop, atol=1e-4)
         assert fused.shape == (800, 3)
+
+
+def test_ovr_fused_cache_invalidates_on_model_mutation(mesh8):
+    """Mutating the public ``models`` list after a predict must not serve
+    the stale fused weight stack."""
+    from sntc_tpu.models import LogisticRegression, OneVsRest
+
+    rng = np.random.default_rng(21)
+    X = rng.normal(size=(400, 5)).astype(np.float32)
+    y = np.argmax(X[:, :3], axis=1).astype(np.float64)
+    f = Frame({"features": X, "label": y})
+    m = OneVsRest(
+        classifier=LogisticRegression(mesh=mesh8, maxIter=10), mesh=mesh8
+    ).fit(f)
+    before = m._raw_predict(X)
+    # swap class 0's sub-model for class 1's: column 0 must change
+    m.models[0] = m.models[1]
+    after = m._raw_predict(X)
+    np.testing.assert_allclose(after[:, 0], before[:, 1], atol=1e-6)
+    assert not np.allclose(after[:, 0], before[:, 0])
