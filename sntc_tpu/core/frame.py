@@ -115,7 +115,9 @@ class Frame:
 
     def with_column(self, name: str, value: ColumnLike) -> "Frame":
         arr = _coerce_column(name, value)
-        if self._columns and arr.shape[0] != self._num_rows:
+        # a frame with rows (or columns) pins the row count; only a truly
+        # empty frame (no columns, 0 rows) accepts any length
+        if (self._columns or self._num_rows) and arr.shape[0] != self._num_rows:
             raise ValueError(
                 f"column {name!r} has {arr.shape[0]} rows, expected "
                 f"{self._num_rows}"

@@ -63,9 +63,11 @@ class _SelectorParams:
     outputCol = Param("output vector column", default="selectedFeatures")
     labelCol = Param("label index column", default="label")
     selectorType = Param(
-        "selection mode: numTopFeatures | percentile | fpr",
+        "selection mode: numTopFeatures | percentile | fpr | fdr | fwe",
         default="numTopFeatures",
-        validator=validators.one_of("numTopFeatures", "percentile", "fpr"),
+        validator=validators.one_of(
+            "numTopFeatures", "percentile", "fpr", "fdr", "fwe"
+        ),
     )
     numTopFeatures = Param(
         "number of features to keep", default=50, validator=validators.gt(0)
@@ -75,6 +77,18 @@ class _SelectorParams:
     )
     fpr = Param(
         "highest p-value to keep", default=0.05, validator=validators.in_range(0, 1)
+    )
+    fdr = Param(
+        "upper bound on the expected false-discovery rate "
+        "(Benjamini-Hochberg)",
+        default=0.05,
+        validator=validators.in_range(0, 1),
+    )
+    fwe = Param(
+        "upper bound on the family-wise error rate: keep p < fwe / F "
+        "(Bonferroni)",
+        default=0.05,
+        validator=validators.in_range(0, 1),
     )
     maxBins = Param(
         "quantile bins for continuous features (rebuild-specific; Spark "
@@ -121,8 +135,19 @@ class ChiSqSelector(_SelectorParams, Estimator):
         elif mode == "percentile":
             k = max(1, int(X.shape[1] * self.getPercentile()))
             chosen = order[:k]
-        else:  # fpr
+        elif mode == "fpr":
             chosen = np.flatnonzero(p_values < self.getFpr())
+        elif mode == "fdr":
+            # Benjamini-Hochberg (Spark ChiSqSelector fdr semantics): keep
+            # the largest k where p_(k) <= k/F * fdr, then every feature
+            # with p-value at or below that cutoff
+            F = X.shape[1]
+            sorted_p = p_values[order]
+            thresholds = (np.arange(1, F + 1) / F) * self.getFdr()
+            below = np.flatnonzero(sorted_p <= thresholds)
+            chosen = order[: below[-1] + 1] if below.size else order[:0]
+        else:  # fwe — Bonferroni
+            chosen = np.flatnonzero(p_values < self.getFwe() / X.shape[1])
         selected = sorted(int(i) for i in chosen)
 
         model = ChiSqSelectorModel(selected_features=selected)

@@ -1,9 +1,10 @@
 """Native host components — ctypes bindings over sntc_tpu/native/*.cpp.
 
-The C++ NetFlow v5 parser is built on first use (g++ -O3 -shared; the
-toolchain is in-image) and cached next to the source.  A pure-Python
-``struct`` fallback keeps the feature available if no compiler exists;
-both implementations are cross-checked by tests/test_netflow.py.
+The C++ NetFlow v5 and pcap parsers are built on first use (g++ -O3
+-shared; the toolchain is in-image) and cached next to the source.
+Pure-Python ``struct`` fallbacks keep the features available if no
+compiler exists; both implementations are cross-checked by
+tests/test_netflow.py and tests/test_pcap.py.
 """
 
 from sntc_tpu.native.netflow import (
@@ -15,6 +16,16 @@ from sntc_tpu.native.netflow import (
     parse_stream,
     using_native,
 )
+from sntc_tpu.native.pcap import (
+    PCAP_FIELD_NAMES,
+    PCAP_FIELDS,
+    make_packet,
+    make_pcap,
+    packets_to_flow_frame,
+    parse_pcap,
+    pcap_to_flow_frame,
+)
+from sntc_tpu.native.pcap import using_native as using_native_pcap
 
 __all__ = [
     "NF5_FIELDS",
@@ -24,4 +35,12 @@ __all__ = [
     "make_datagram",
     "netflow_to_flow_frame",
     "using_native",
+    "PCAP_FIELDS",
+    "PCAP_FIELD_NAMES",
+    "parse_pcap",
+    "make_pcap",
+    "make_packet",
+    "packets_to_flow_frame",
+    "pcap_to_flow_frame",
+    "using_native_pcap",
 ]

@@ -1,0 +1,53 @@
+"""Shared build-and-load scaffolding for the native components.
+
+Each ``sntc_tpu/native/*.cpp`` translation unit is compiled on first use
+(``g++ -O3 -shared -fPIC``; the toolchain is in-image) and cached next to
+its source; a stale ``.so`` (older than the source) rebuilds.  Failures
+latch per-module so a missing toolchain costs one subprocess attempt, and
+callers fall back to their pure-Python parsers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+
+class NativeLib:
+    """Lazy ctypes loader for one .cpp/.so pair."""
+
+    def __init__(self, src: str, so: str):
+        self.src = src
+        self.so = so
+        self._lib: Optional[ctypes.CDLL] = None
+        self._failed = False
+
+    def _build(self) -> Optional[str]:
+        if os.path.exists(self.so) and os.path.getmtime(
+            self.so
+        ) >= os.path.getmtime(self.src):
+            return self.so
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", self.so, self.src],
+                check=True, capture_output=True, timeout=120,
+            )
+            return self.so
+        except (OSError, subprocess.SubprocessError):
+            return None
+
+    def get(self, configure) -> Optional[ctypes.CDLL]:
+        """The loaded library, building it on first call; ``configure(lib)``
+        declares argtypes/restypes once after a successful load."""
+        if self._lib is not None or self._failed:
+            return self._lib
+        so = self._build()
+        if so is None:
+            self._failed = True
+            return None
+        lib = ctypes.CDLL(so)
+        configure(lib)
+        self._lib = lib
+        return self._lib

@@ -13,17 +13,18 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
-import subprocess
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.data.schema import CICIDS2017_FEATURES
+from sntc_tpu.native._loader import NativeLib
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "netflow.cpp")
-_SO = os.path.join(_DIR, "libnetflow.so")
+_NATIVE = NativeLib(
+    os.path.join(_DIR, "netflow.cpp"), os.path.join(_DIR, "libnetflow.so")
+)
 
 NF5_FIELDS = 16
 NF5_FIELD_NAMES = [
@@ -36,32 +37,7 @@ NF5_FIELD_NAMES = [
 _HEADER = struct.Struct(">HHIIIIBBH")  # 24 bytes
 _RECORD = struct.Struct(">IIIHHIIIIHHBBBBHHBBH")  # 48 bytes
 
-_lib: Optional[ctypes.CDLL] = None
-_native_failed = False
-
-
-def _build() -> Optional[str]:
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
-            check=True, capture_output=True, timeout=120,
-        )
-        return _SO
-    except (OSError, subprocess.SubprocessError):
-        return None
-
-
-def _get_lib() -> Optional[ctypes.CDLL]:
-    global _lib, _native_failed
-    if _lib is not None or _native_failed:
-        return _lib
-    so = _build()
-    if so is None:
-        _native_failed = True
-        return None
-    lib = ctypes.CDLL(so)
+def _configure(lib: ctypes.CDLL) -> None:
     for name in ("nf5_count", "nf5_parse", "nf5_parse_stream"):
         fn = getattr(lib, name)
         fn.restype = ctypes.c_int
@@ -71,8 +47,10 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_double), ctypes.c_int,
         ]
-    _lib = lib
-    return _lib
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    return _NATIVE.get(_configure)
 
 
 def using_native() -> bool:

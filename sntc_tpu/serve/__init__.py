@@ -1,5 +1,10 @@
 from sntc_tpu.serve.transform import BatchPredictor
 from sntc_tpu.serve.fuse import compile_serving
+from sntc_tpu.serve.netflow_source import (
+    NetFlowDirSource,
+    PcapDirSource,
+    capture_udp,
+)
 from sntc_tpu.serve.streaming import (
     ConsoleSink,
     CsvDirSink,
@@ -18,4 +23,7 @@ __all__ = [
     "MemorySink",
     "CsvDirSink",
     "ConsoleSink",
+    "NetFlowDirSource",
+    "PcapDirSource",
+    "capture_udp",
 ]

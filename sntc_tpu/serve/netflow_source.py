@@ -21,10 +21,12 @@ from sntc_tpu.native import netflow_to_flow_frame, parse_stream
 from sntc_tpu.serve.streaming import StreamSource
 
 
-class NetFlowDirSource(StreamSource):
-    """Directory of NetFlow v5 capture files (``*.nf5``)."""
+class _CaptureDirSource(StreamSource):
+    """Shared machinery for capture-file directory sources: offset =
+    count of files in sorted order; one decoded Frame per file.
+    Subclasses implement ``_decode_file(bytes) -> Frame``."""
 
-    def __init__(self, path: str, pattern: str = "*.nf5"):
+    def __init__(self, path: str, pattern: str):
         self.path = path
         self.pattern = pattern
 
@@ -34,15 +36,27 @@ class NetFlowDirSource(StreamSource):
     def latest_offset(self) -> int:
         return len(self._files())
 
+    def _decode_file(self, data: bytes) -> Frame:
+        raise NotImplementedError
+
     def get_batch(self, start: int, end: int) -> Frame:
         frames = []
         for path in self._files()[start:end]:
             with open(path, "rb") as f:
-                records = parse_stream(f.read())
-            frames.append(netflow_to_flow_frame(records))
+                frames.append(self._decode_file(f.read()))
         if not frames:
             raise ValueError(f"empty batch range [{start}, {end})")
         return Frame.concat_all(frames)
+
+
+class NetFlowDirSource(_CaptureDirSource):
+    """Directory of NetFlow v5 capture files (``*.nf5``)."""
+
+    def __init__(self, path: str, pattern: str = "*.nf5"):
+        super().__init__(path, pattern)
+
+    def _decode_file(self, data: bytes) -> Frame:
+        return netflow_to_flow_frame(parse_stream(data))
 
 
 def capture_udp(
@@ -91,3 +105,42 @@ def capture_udp(
         if own_sock:
             sock.close()
     return captured
+
+
+class PcapDirSource(_CaptureDirSource):
+    """Directory of pcap capture files — the pcap half of [B:11]'s
+    "NetFlow/pcap micro-batches".  Each capture file's packets are
+    metered into CICIDS2017-schema flows (sntc_tpu/native/pcap.py)."""
+
+    def __init__(
+        self,
+        path: str,
+        pattern: str = "*.pcap",
+        flow_timeout: float = 120.0,
+        activity_timeout: float = 5.0,
+    ):
+        super().__init__(path, pattern)
+        self.flow_timeout = flow_timeout
+        self.activity_timeout = activity_timeout
+
+    def _decode_file(self, data: bytes) -> Frame:
+        from sntc_tpu.native import packets_to_flow_frame, parse_pcap
+
+        pkts = parse_pcap(data)
+        if pkts is None:
+            # A short/invalid header is most likely a partially-written
+            # capture (external writer race).  FAILING the batch is the
+            # lossless choice: the intent stays uncommitted in the WAL and
+            # the engine replays it next poll, when the file is complete —
+            # an empty-frame fallback would commit past the file and drop
+            # its flows forever.  Writers should create capture files
+            # atomically (write to .tmp, then rename) as capture_udp does.
+            raise ValueError(
+                "unreadable pcap capture (partial write? writers must "
+                "rename into place atomically); batch will be retried"
+            )
+        return packets_to_flow_frame(
+            pkts,
+            flow_timeout=self.flow_timeout,
+            activity_timeout=self.activity_timeout,
+        )

@@ -151,3 +151,29 @@ def test_chisq_selector_fpr_mode(mesh8):
         mesh=mesh8, selectorType="fpr", fpr=1e-6, labelCol="label"
     ).fit(Frame({"features": X, "label": y.astype(np.float64)}))
     assert model.selected_features == [0]
+
+
+
+def test_chisq_selector_fdr_and_fwe_modes(mesh8):
+    """fdr = Benjamini-Hochberg step-up on sorted p-values; fwe =
+    Bonferroni p < fwe/F (Spark ChiSqSelector selectorType parity)."""
+    rng = np.random.default_rng(4)
+    n = 3000
+    y = rng.integers(0, 2, size=n)
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    X[:, 1] += y * 3.0
+    X[:, 5] += y * 2.5
+    f = Frame({"features": X, "label": y.astype(np.float64)})
+    fdr_model = ChiSqSelector(
+        mesh=mesh8, selectorType="fdr", fdr=1e-4, labelCol="label"
+    ).fit(f)
+    assert fdr_model.selected_features == [1, 5]
+    fwe_model = ChiSqSelector(
+        mesh=mesh8, selectorType="fwe", fwe=1e-4, labelCol="label"
+    ).fit(f)
+    assert fwe_model.selected_features == [1, 5]
+    # BH with a loose budget keeps at least everything Bonferroni keeps
+    loose = ChiSqSelector(
+        mesh=mesh8, selectorType="fdr", fdr=0.5, labelCol="label"
+    ).fit(f)
+    assert set(loose.selected_features) >= {1, 5}
