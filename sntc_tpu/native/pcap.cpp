@@ -24,11 +24,6 @@
 
 namespace {
 
-inline uint16_t rd16(const uint8_t* p, bool swap) {
-  return swap ? static_cast<uint16_t>(p[0] | (p[1] << 8))
-              : static_cast<uint16_t>((p[0] << 8) | p[1]);
-}
-
 inline uint32_t rd32(const uint8_t* p, bool swap) {
   return swap ? (static_cast<uint32_t>(p[3]) << 24) |
                     (static_cast<uint32_t>(p[2]) << 16) |

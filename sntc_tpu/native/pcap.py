@@ -315,7 +315,6 @@ def packets_to_flow_frame(
     flags_s = flags[order]
     win_s = window[order]
     dport_pkt = dport[order]
-    sport_pkt = sport[order]
 
     dur = ts_s[np.append(starts[1:], n) - 1] - ts_s[starts]  # per segment, s
     dur_us = dur * 1e6

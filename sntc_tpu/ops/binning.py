@@ -7,33 +7,62 @@ is exactly what the TPU wants: the 2.8M×78 dataset becomes a device-resident
 uint8 tensor (~220 MB) and every histogram is a ``segment_sum`` feeding the
 MXU-friendly reductions (SURVEY.md §7.1 step 4).
 
-Edges are computed host-side on a sample (cheap, one pass) with static shape
+Edge computation is sample-based like Spark's ``findSplits`` (which draws
+``max(maxBins², 10000)`` rows); measured on the bench workload, macro-F1 is
+flat from 200k samples down to 10k, so the default sample scales with the
+bin count.  Host (numpy) inputs compute edges on host; device-resident
+columns (``jax.Array`` — e.g. handed down by a fitted scaler, or the 2.8M
+full-scale matrix already in HBM) compute them ON DEVICE with a jitted
+``jnp.quantile`` — no device→host round trip for the feature matrix.
+``bin_features`` is jitted and runs on device.  Static output shape
 ``[F, max_bins - 1]``; duplicate edges from low-cardinality features are
-harmless (empty bins).  ``bin_features`` is jitted and runs on device.
+harmless (empty bins).
 """
 
 from __future__ import annotations
 
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
+def _default_sample_rows(max_bins: int) -> int:
+    # Spark findSplits: max(maxBins * maxBins, 10000); we add headroom
+    return max(10_000, 4 * max_bins * max_bins)
+
+
+@partial(jax.jit, static_argnames=("max_bins", "stride"))
+def _edges_device(X: jnp.ndarray, *, max_bins: int, stride: int) -> jnp.ndarray:
+    qs = jnp.linspace(0.0, 1.0, max_bins + 1)[1:-1]
+    sample = X[::stride] if stride > 1 else X
+    return jnp.quantile(sample.astype(jnp.float32), qs, axis=0).T
+
+
 def quantile_bin_edges(
-    X: np.ndarray,
+    X,
     max_bins: int = 32,
-    sample_rows: int = 200_000,
+    sample_rows: Optional[int] = None,
     seed: int = 0,
-) -> np.ndarray:
+):
     """Per-feature quantile split thresholds, shape ``[F, max_bins - 1]``.
 
-    Mirrors Spark ``findSplits``: thresholds are quantiles of a row sample.
-    Features with < max_bins distinct sampled values get repeated edges
-    (empty bins) instead of a ragged bin count — static shapes for XLA.
+    Returns an ndarray matching the input's residency: numpy in → numpy
+    edges (host quantile of a ``seed``-driven random row sample);
+    ``jax.Array`` in → device edges from a STRIDED row sample (``seed``
+    is unused there — the stride is deterministic, and the feature matrix
+    never leaves the device).  With ``sample_rows >= n`` both paths use
+    every row and agree to float tolerance (tests/test_trees.py parity
+    test).
     """
     n, f = X.shape
+    if sample_rows is None:
+        sample_rows = _default_sample_rows(max_bins)
+    if isinstance(X, jax.Array):
+        stride = max(n // sample_rows, 1)
+        return _edges_device(X, max_bins=max_bins, stride=stride)
     if n > sample_rows:
         idx = np.random.default_rng(seed).choice(n, size=sample_rows, replace=False)
         sample = X[idx]

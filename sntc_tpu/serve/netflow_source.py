@@ -14,39 +14,24 @@ from __future__ import annotations
 import glob
 import os
 import socket
+import warnings
 from typing import List, Optional
 
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.native import netflow_to_flow_frame, parse_stream
-from sntc_tpu.serve.streaming import StreamSource
+from sntc_tpu.serve.streaming import DirStreamSource
 
 
-class _CaptureDirSource(StreamSource):
-    """Shared machinery for capture-file directory sources: offset =
-    count of files in sorted order; one decoded Frame per file.
+class _CaptureDirSource(DirStreamSource):
+    """Capture-file directory source: one decoded Frame per file.
     Subclasses implement ``_decode_file(bytes) -> Frame``."""
-
-    def __init__(self, path: str, pattern: str):
-        self.path = path
-        self.pattern = pattern
-
-    def _files(self) -> List[str]:
-        return sorted(glob.glob(os.path.join(self.path, self.pattern)))
-
-    def latest_offset(self) -> int:
-        return len(self._files())
 
     def _decode_file(self, data: bytes) -> Frame:
         raise NotImplementedError
 
-    def get_batch(self, start: int, end: int) -> Frame:
-        frames = []
-        for path in self._files()[start:end]:
-            with open(path, "rb") as f:
-                frames.append(self._decode_file(f.read()))
-        if not frames:
-            raise ValueError(f"empty batch range [{start}, {end})")
-        return Frame.concat_all(frames)
+    def _load_file(self, path: str) -> Frame:
+        with open(path, "rb") as f:
+            return self._decode_file(f.read())
 
 
 class NetFlowDirSource(_CaptureDirSource):
@@ -124,20 +109,35 @@ class PcapDirSource(_CaptureDirSource):
         self.activity_timeout = activity_timeout
 
     def _decode_file(self, data: bytes) -> Frame:
+        import numpy as np
+
+        from sntc_tpu.data.schema import CICIDS2017_FEATURES
         from sntc_tpu.native import packets_to_flow_frame, parse_pcap
 
         pkts = parse_pcap(data)
         if pkts is None:
-            # A short/invalid header is most likely a partially-written
-            # capture (external writer race).  FAILING the batch is the
-            # lossless choice: the intent stays uncommitted in the WAL and
-            # the engine replays it next poll, when the file is complete —
-            # an empty-frame fallback would commit past the file and drop
-            # its flows forever.  Writers should create capture files
-            # atomically (write to .tmp, then rename) as capture_udp does.
-            raise ValueError(
-                "unreadable pcap capture (partial write? writers must "
-                "rename into place atomically); batch will be retried"
+            if len(data) < 24:
+                # A short header is a partially-written capture (external
+                # writer race).  FAILING the batch is the lossless choice:
+                # the intent stays uncommitted in the WAL and the engine
+                # replays it next poll, when the file is complete — an
+                # empty-frame fallback would commit past the file and drop
+                # its flows forever.  Writers should create capture files
+                # atomically (write to .tmp, then rename) as capture_udp
+                # does.
+                raise ValueError(
+                    "truncated pcap capture (partial write? writers must "
+                    "rename into place atomically); batch will be retried"
+                )
+            # ≥24 bytes with a bad magic or unsupported linktype will never
+            # become readable — retrying would wedge the stream forever.
+            # Skip it (0 rows) and warn, like Spark's badRecordsPath.
+            warnings.warn(
+                "skipping unreadable capture file (bad magic or "
+                "unsupported linktype; only Ethernet/raw-IP are decoded)"
+            )
+            return Frame(
+                {n: np.zeros(0, np.float32) for n in CICIDS2017_FEATURES}
             )
         return packets_to_flow_frame(
             pkts,

@@ -44,11 +44,12 @@ class StreamSource:
         raise NotImplementedError
 
 
-class FileStreamSource(StreamSource):
-    """Directory of flow CSVs; offset = count of files in sorted order
-    (the ``readStream`` file-source analog: new files are new data)."""
+class DirStreamSource(StreamSource):
+    """Shared machinery for directory-watching sources: offset = count of
+    files in sorted order (the ``readStream`` file-source model: new files
+    are new data).  Subclasses implement ``_load_file(path) -> Frame``."""
 
-    def __init__(self, path: str, pattern: str = "*.csv"):
+    def __init__(self, path: str, pattern: str):
         self.path = path
         self.pattern = pattern
 
@@ -58,11 +59,24 @@ class FileStreamSource(StreamSource):
     def latest_offset(self) -> int:
         return len(self._files())
 
+    def _load_file(self, path: str) -> Frame:
+        raise NotImplementedError
+
     def get_batch(self, start: int, end: int) -> Frame:
         files = self._files()[start:end]
         if not files:
             raise ValueError(f"empty batch range [{start}, {end})")
-        return Frame.concat_all([load_csv(p) for p in files])
+        return Frame.concat_all([self._load_file(p) for p in files])
+
+
+class FileStreamSource(DirStreamSource):
+    """Directory of flow CSVs."""
+
+    def __init__(self, path: str, pattern: str = "*.csv"):
+        super().__init__(path, pattern)
+
+    def _load_file(self, path: str) -> Frame:
+        return load_csv(path)
 
 
 class MemorySource(StreamSource):

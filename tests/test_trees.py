@@ -303,3 +303,46 @@ def test_ovr_fused_cache_invalidates_on_model_mutation(mesh8):
     after = m._raw_predict(X)
     np.testing.assert_allclose(after[:, 0], before[:, 1], atol=1e-6)
     assert not np.allclose(after[:, 0], before[:, 0])
+
+
+def test_quantile_edges_device_host_parity():
+    """With sample_rows >= n both binning paths consume every row and must
+    agree; the device branch (jitted jnp.quantile over a strided sample)
+    otherwise has no small-data divergence from the host branch."""
+    import jax.numpy as jnp
+
+    from sntc_tpu.ops.binning import bin_features, quantile_bin_edges
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(4000, 7)).astype(np.float32)
+    host = quantile_bin_edges(X, max_bins=16, sample_rows=10_000)
+    dev = quantile_bin_edges(jnp.asarray(X), max_bins=16, sample_rows=10_000)
+    assert isinstance(host, np.ndarray)
+    assert host.shape == dev.shape == (7, 15)
+    np.testing.assert_allclose(np.asarray(dev), host, atol=1e-4)
+    # binned ids agree everywhere off the edge boundaries
+    bh = np.asarray(bin_features(jnp.asarray(X), jnp.asarray(host)))
+    bd = np.asarray(bin_features(jnp.asarray(X), dev))
+    assert (bh != bd).mean() < 1e-3
+
+
+def test_pcap_source_skips_permanently_bad_file(tmp_path):
+    """A complete-but-undecodable capture must not wedge the stream: it
+    decodes to 0 rows with a warning; a truncated header still raises
+    (retry until the writer finishes)."""
+    import warnings as _w
+
+    from sntc_tpu.serve import PcapDirSource
+
+    d = tmp_path / "caps"
+    d.mkdir()
+    (d / "bad.pcap").write_bytes(b"\x00" * 64)  # 64 bytes of junk
+    src = PcapDirSource(str(d))
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        f = src.get_batch(0, 1)
+    assert f.num_rows == 0
+    assert any("skipping unreadable" in str(r.message) for r in rec)
+    (d / "bad.pcap").write_bytes(b"\x01\x02")  # short header: partial write
+    with pytest.raises(ValueError):
+        src.get_batch(0, 1)
