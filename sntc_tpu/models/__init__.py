@@ -7,6 +7,10 @@ from sntc_tpu.models.mlp import (
     MultilayerPerceptronClassificationModel,
 )
 from sntc_tpu.models.tree import (
+    DecisionTreeClassifier,
+    DecisionTreeClassificationModel,
+    DecisionTreeRegressor,
+    DecisionTreeRegressionModel,
     GBTClassifier,
     GBTClassificationModel,
     RandomForestClassifier,
@@ -19,6 +23,10 @@ __all__ = [
     "RandomForestClassificationModel",
     "GBTClassifier",
     "GBTClassificationModel",
+    "DecisionTreeClassifier",
+    "DecisionTreeClassificationModel",
+    "DecisionTreeRegressor",
+    "DecisionTreeRegressionModel",
     "OneVsRest",
     "OneVsRestModel",
     "LogisticRegression",

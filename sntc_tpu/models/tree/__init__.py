@@ -3,10 +3,20 @@ from sntc_tpu.models.tree.random_forest import (
     RandomForestClassificationModel,
 )
 from sntc_tpu.models.tree.gbt import GBTClassifier, GBTClassificationModel
+from sntc_tpu.models.tree.decision_tree import (
+    DecisionTreeClassifier,
+    DecisionTreeClassificationModel,
+    DecisionTreeRegressor,
+    DecisionTreeRegressionModel,
+)
 
 __all__ = [
     "RandomForestClassifier",
     "RandomForestClassificationModel",
     "GBTClassifier",
     "GBTClassificationModel",
+    "DecisionTreeClassifier",
+    "DecisionTreeClassificationModel",
+    "DecisionTreeRegressor",
+    "DecisionTreeRegressionModel",
 ]

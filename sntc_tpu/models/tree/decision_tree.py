@@ -1,0 +1,312 @@
+"""DecisionTreeClassifier / DecisionTreeRegressor — single CART trees.
+
+Behavioral spec: upstream ``ml/classification/DecisionTreeClassifier.
+scala`` and ``ml/regression/DecisionTreeRegressor.scala`` [U] (the same
+``tree/impl/RandomForest.run`` machinery the ensembles use, with
+``numTrees=1``, every feature considered at every node, and no bagging —
+SURVEY.md §2.3 lists the regressor path as GBT's building block).
+
+TPU design: both are thin single-tree instantiations of the shared dense-
+heap grower (sntc_tpu/models/tree/grower.py): the forest tensors simply
+carry ``T=1``.  Classification leaves hold class-count vectors
+(probability = normalized counts, Spark ``predictRaw``/``predictProbability``
+semantics); regression leaves hold ``[w, wy, wy²]`` (prediction = wy/w,
+variance impurity).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sntc_tpu.core.base import Estimator, Model
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+from sntc_tpu.models.base import (
+    ClassificationModel,
+    ClassifierEstimator,
+    pack_serve_outputs,
+)
+from sntc_tpu.models.tree.grower import (
+    Forest,
+    ForestDeviceMixin,
+    forest_leaf_stats,
+    grow_forest,
+)
+from sntc_tpu.models.tree.random_forest import _one_hot_stats
+from sntc_tpu.ops.binning import bin_features, quantile_bin_edges
+from sntc_tpu.parallel.collectives import shard_batch, shard_weights
+from sntc_tpu.parallel.context import get_default_mesh
+
+
+def _grow_single_tree(estimator, X, y_or_stats, w, mesh, impurity):
+    """Shared fit body: bin, shard, grow one tree over every feature."""
+    n, F = X.shape
+    n_bins = estimator.getMaxBins()
+    edges = quantile_bin_edges(X, max_bins=n_bins, seed=estimator.getSeed())
+    if impurity == "variance":
+        xs, ys, _ = shard_batch(mesh, X, y_or_stats)  # ys: float targets
+        ws = shard_weights(mesh, w, xs.shape[0])
+        row_stats = jnp.stack([ws, ws * ys, ws * ys * ys], axis=1)
+    else:
+        xs, ys, _ = shard_batch(mesh, X, y_or_stats.astype(np.int32))
+        ws = shard_weights(mesh, w, xs.shape[0])
+        k = int(y_or_stats.max()) + 1 if n else 2
+        row_stats = _one_hot_stats(ys, ws, max(k, 2))
+    binned = bin_features(xs, jnp.asarray(edges))
+    w_trees = jax.device_put(
+        np.ones((1, xs.shape[0]), np.float32),
+        NamedSharding(mesh, P(None, mesh.axis_names[0])),
+    )
+    return grow_forest(
+        binned, row_stats, w_trees, edges,
+        n_bins=n_bins,
+        max_depth=estimator.getMaxDepth(),
+        min_instances_per_node=float(estimator.getMinInstancesPerNode()),
+        min_info_gain=float(estimator.getMinInfoGain()),
+        subset_k=F,  # a single Spark decision tree considers every feature
+        impurity=impurity,
+        seed=estimator.getSeed(),
+        mesh=mesh,
+    )
+
+
+class _SingleTreeParams:
+    """Spark's DecisionTree params — deliberately NOT the ensemble block:
+    a single Spark decision tree has no subsamplingRate/bagging."""
+
+    maxDepth = Param(
+        "max tree depth", default=5, validator=validators.in_range(0, 15)
+    )
+    maxBins = Param(
+        "max feature bins", default=32, validator=validators.in_range(2, 256)
+    )
+    minInstancesPerNode = Param(
+        "min (weighted) rows per child", default=1, validator=validators.gteq(1)
+    )
+    minInfoGain = Param("min split gain", default=0.0, validator=validators.gteq(0))
+    seed = Param("binning sample seed", default=0)
+
+
+def _realized_depth(forest: Forest) -> int:
+    """Depth of the deepest materialized node (Spark ``DecisionTreeModel.
+    depth``), not the heap capacity ``maxDepth``."""
+    exists = np.flatnonzero(forest.feature[0] >= -1)  # leaf or internal
+    if exists.size == 0:
+        return 0
+    return int(np.floor(np.log2(exists[-1] + 1)))
+
+
+class _DtClassifierParams(_SingleTreeParams):
+    impurity = Param(
+        "gini | entropy", default="gini",
+        validator=validators.one_of("gini", "entropy"),
+    )
+
+
+class DecisionTreeClassifier(_DtClassifierParams, ClassifierEstimator):
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+
+    def _fit(self, frame: Frame) -> "DecisionTreeClassificationModel":
+        mesh = self._mesh or get_default_mesh()
+        X, y, w = self._extract(frame)
+        forest = _grow_single_tree(self, X, y, w, mesh, self.getImpurity())
+        k = max(int(y.max()) + 1 if len(y) else 2, 2)
+        model = DecisionTreeClassificationModel(
+            forest=forest, n_classes=k, n_features=X.shape[1]
+        )
+        model.setParams(
+            **{k2: v for k2, v in self.paramValues().items() if model.hasParam(k2)}
+        )
+        return model
+
+
+@partial(jax.jit, static_argnames=("max_depth", "mode"))
+def _dt_serve(X, feature, threshold, leaf_stats, thr, *, max_depth, mode):
+    """Traverse + normalize + predict packed into one dispatch and one
+    device→host transfer per serving micro-batch (the [B:11] hot-path
+    contract every model honors)."""
+    raw = forest_leaf_stats(
+        X, feature, threshold, leaf_stats, max_depth=max_depth
+    )[0]  # [N, C] class counts — Spark DT rawPrediction
+    prob = raw / jnp.maximum(raw.sum(axis=1, keepdims=True), 1e-12)
+    return pack_serve_outputs(raw, prob, thr, mode)
+
+
+class DecisionTreeClassificationModel(
+    _DtClassifierParams, ForestDeviceMixin, ClassificationModel
+):
+    def __init__(self, forest: Forest, n_classes: int, n_features: int = 0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.forest = forest
+        self._n_classes = int(n_classes)
+        self._n_features = int(n_features)
+
+    @property
+    def num_classes(self) -> int:
+        return self._n_classes
+
+    @property
+    def depth(self) -> int:
+        return _realized_depth(self.forest)
+
+    @property
+    def featureImportances(self) -> np.ndarray:
+        n = self._n_features or int(self.forest.feature.max()) + 1
+        return self.forest.feature_importances(n)
+
+    def _predict_all_dev(self, X: np.ndarray):
+        mode, thr = self._threshold_mode()
+        return _dt_serve(
+            jnp.asarray(X),
+            *self._device_forest(),
+            jnp.asarray(thr),
+            max_depth=self.forest.max_depth,
+            mode=mode,
+        )
+
+    def _save_extra(self):
+        return (
+            {
+                "n_classes": self._n_classes,
+                "max_depth": self.forest.max_depth,
+                "n_features": self._n_features,
+            },
+            {
+                "feature": self.forest.feature,
+                "threshold": self.forest.threshold,
+                "leaf_stats": self.forest.leaf_stats,
+                "gain": self.forest.gain,
+                "count": self.forest.count,
+            },
+        )
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        forest = Forest(
+            arrays["feature"], arrays["threshold"], arrays["leaf_stats"],
+            int(extra["max_depth"]),
+            arrays.get("gain"), arrays.get("count"),
+        )
+        m = cls(
+            forest=forest,
+            n_classes=int(extra["n_classes"]),
+            n_features=int(extra.get("n_features", 0)),
+        )
+        m.setParams(**params)
+        return m
+
+    def _raw_predict(self, X: np.ndarray) -> np.ndarray:
+        # Spark DT rawPrediction is the leaf's class-count vector
+        return np.asarray(
+            forest_leaf_stats(
+                jnp.asarray(X, jnp.float32), *self._device_forest(),
+                max_depth=self.forest.max_depth,
+            )[0]
+        )
+
+    def _raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
+        return raw / np.maximum(raw.sum(axis=1, keepdims=True), 1e-12)
+
+
+class _DtRegressorParams(_SingleTreeParams):
+    featuresCol = Param("feature vector column", default="features")
+    labelCol = Param("target column", default="label")
+    predictionCol = Param("output prediction column", default="prediction")
+    impurity = Param(
+        "variance", default="variance", validator=validators.one_of("variance")
+    )
+
+
+class DecisionTreeRegressor(_DtRegressorParams, Estimator):
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+
+    def _fit(self, frame: Frame) -> "DecisionTreeRegressionModel":
+        mesh = self._mesh or get_default_mesh()
+        X = frame[self.getFeaturesCol()]
+        if X.ndim != 2:
+            raise ValueError(
+                f"featuresCol {self.getFeaturesCol()!r} must be a vector "
+                "column (use VectorAssembler)"
+            )
+        X = X.astype(np.float32, copy=False)
+        y = np.asarray(frame[self.getLabelCol()], np.float32)
+        w = np.ones(len(y), np.float32)
+        forest = _grow_single_tree(self, X, y, w, mesh, "variance")
+        model = DecisionTreeRegressionModel(
+            forest=forest, n_features=X.shape[1]
+        )
+        model.setParams(
+            **{k2: v for k2, v in self.paramValues().items() if model.hasParam(k2)}
+        )
+        return model
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def _dt_reg_predict(X, feature, threshold, leaf_stats, *, max_depth):
+    stats = forest_leaf_stats(
+        X, feature, threshold, leaf_stats, max_depth=max_depth
+    )[0]  # [N, 3] = [w, wy, wy²]
+    return stats[:, 1] / jnp.maximum(stats[:, 0], 1e-12)
+
+
+class DecisionTreeRegressionModel(
+    _DtRegressorParams, ForestDeviceMixin, Model
+):
+    def __init__(self, forest: Forest, n_features: int = 0, **kwargs):
+        super().__init__(**kwargs)
+        self.forest = forest
+        self._n_features = int(n_features)
+
+    @property
+    def depth(self) -> int:
+        return _realized_depth(self.forest)
+
+    @property
+    def featureImportances(self) -> np.ndarray:
+        n = self._n_features or int(self.forest.feature.max()) + 1
+        return self.forest.feature_importances(n)
+
+    def _save_extra(self):
+        return (
+            {"max_depth": self.forest.max_depth, "n_features": self._n_features},
+            {
+                "feature": self.forest.feature,
+                "threshold": self.forest.threshold,
+                "leaf_stats": self.forest.leaf_stats,
+                "gain": self.forest.gain,
+                "count": self.forest.count,
+            },
+        )
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        forest = Forest(
+            arrays["feature"], arrays["threshold"], arrays["leaf_stats"],
+            int(extra["max_depth"]),
+            arrays.get("gain"), arrays.get("count"),
+        )
+        m = cls(forest=forest, n_features=int(extra.get("n_features", 0)))
+        m.setParams(**params)
+        return m
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            _dt_reg_predict(
+                jnp.asarray(X, jnp.float32), *self._device_forest(),
+                max_depth=self.forest.max_depth,
+            )
+        ).astype(np.float64)
+
+    def transform(self, frame: Frame) -> Frame:
+        X = frame[self.getFeaturesCol()].astype(np.float32, copy=False)
+        return frame.with_column(self.getPredictionCol(), self.predict(X))
