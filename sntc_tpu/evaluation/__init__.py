@@ -3,9 +3,11 @@ from sntc_tpu.evaluation.multiclass import (
     MulticlassMetrics,
 )
 from sntc_tpu.evaluation.binary import BinaryClassificationEvaluator
+from sntc_tpu.evaluation.regression import RegressionEvaluator
 
 __all__ = [
     "MulticlassClassificationEvaluator",
     "MulticlassMetrics",
     "BinaryClassificationEvaluator",
+    "RegressionEvaluator",
 ]

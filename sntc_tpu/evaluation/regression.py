@@ -1,0 +1,76 @@
+"""RegressionEvaluator — rmse / mse / r2 / mae / var [B:2-adjacent].
+
+Behavioral spec: upstream ``ml/evaluation/RegressionEvaluator.scala`` ->
+``mllib/evaluation/RegressionMetrics.scala`` [U]: weighted residual
+moments over (prediction, label) pairs; ``r2`` uses the weighted total
+sum of squares about the weighted label mean; ``var`` is Spark's
+``explainedVariance`` (SS_reg/n: predictions about the weighted label
+mean).  ``isLargerBetter`` is False except for ``r2``/``var``.
+
+Host-side: five scalar reductions over two columns — no device program
+is worth the dispatch (SURVEY.md §2.4's "on host" rule for tiny metric
+tails).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sntc_tpu.core.frame import Frame
+
+
+class RegressionEvaluator:
+    _METRICS = ("rmse", "mse", "r2", "mae", "var")
+
+    def __init__(
+        self,
+        metricName: str = "rmse",
+        labelCol: str = "label",
+        predictionCol: str = "prediction",
+        weightCol: str = None,
+        throughOrigin: bool = False,
+    ):
+        if metricName not in self._METRICS:
+            raise ValueError(
+                f"unknown metricName {metricName!r}; one of {self._METRICS}"
+            )
+        self.metricName = metricName
+        self.labelCol = labelCol
+        self.predictionCol = predictionCol
+        self.weightCol = weightCol
+        self.throughOrigin = throughOrigin
+
+    def evaluate(self, frame: Frame) -> float:
+        y = np.asarray(frame[self.labelCol], np.float64)
+        pred = np.asarray(frame[self.predictionCol], np.float64)
+        w = (
+            np.asarray(frame[self.weightCol], np.float64)
+            if self.weightCol
+            else np.ones_like(y)
+        )
+        wsum = w.sum()
+        if wsum == 0:
+            return 0.0
+        resid = y - pred
+        mse = float((w * resid**2).sum() / wsum)
+        if self.metricName == "mse":
+            return mse
+        if self.metricName == "rmse":
+            return float(np.sqrt(mse))
+        if self.metricName == "mae":
+            return float((w * np.abs(resid)).sum() / wsum)
+        if self.metricName == "var":
+            # explainedVariance = SS_reg / n: weighted mean squared
+            # deviation of predictions about the weighted LABEL mean
+            ybar = (w * y).sum() / wsum
+            return float((w * (pred - ybar) ** 2).sum() / wsum)
+        # r2: 1 - SS_res / SS_tot (about 0 when throughOrigin)
+        ybar = 0.0 if self.throughOrigin else (w * y).sum() / wsum
+        ss_tot = float((w * (y - ybar) ** 2).sum())
+        ss_res = float((w * resid**2).sum())
+        if ss_tot == 0:
+            return 0.0
+        return 1.0 - ss_res / ss_tot
+
+    def isLargerBetter(self) -> bool:
+        return self.metricName in ("r2", "var")

@@ -2,6 +2,10 @@ from sntc_tpu.feature.vector_assembler import VectorAssembler
 from sntc_tpu.feature.string_indexer import IndexToString, StringIndexer, StringIndexerModel
 from sntc_tpu.feature.standard_scaler import StandardScaler, StandardScalerModel
 from sntc_tpu.feature.chisq_selector import ChiSqSelector, ChiSqSelectorModel
+from sntc_tpu.feature.univariate_selector import (
+    UnivariateFeatureSelector,
+    UnivariateFeatureSelectorModel,
+)
 
 __all__ = [
     "VectorAssembler",
@@ -12,4 +16,6 @@ __all__ = [
     "StandardScalerModel",
     "ChiSqSelector",
     "ChiSqSelectorModel",
+    "UnivariateFeatureSelector",
+    "UnivariateFeatureSelectorModel",
 ]

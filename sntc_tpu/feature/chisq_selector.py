@@ -24,6 +24,7 @@ import numpy as np
 from sntc_tpu.core.base import Estimator, Model
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
+from sntc_tpu.feature.selection import select_features_by_mode
 from sntc_tpu.ops.binning import bin_features, quantile_bin_edges
 from sntc_tpu.ops.histogram import (
     binned_contingency,
@@ -98,57 +99,51 @@ class _SelectorParams:
     )
 
 
+def chi2_scores(X: np.ndarray, y: np.ndarray, mesh, n_bins: int):
+    """``(stats [F], p_values [F])`` of the binned χ² test — the one chi2
+    scoring pipeline shared by ChiSqSelector and
+    UnivariateFeatureSelector's categorical/categorical mode."""
+    import jax
+
+    from sntc_tpu.ops.pallas_histogram import resolve_hist_impl
+
+    y = np.asarray(y).astype(np.int32)
+    n_classes = int(y.max()) + 1 if len(y) else 1
+    edges = quantile_bin_edges(X, max_bins=n_bins)
+    xs, ys, w = shard_batch(mesh, X, y)
+    on_tpu = jax.default_backend() == "tpu"
+    impl = resolve_hist_impl(1, n_bins, mesh)
+    observed = np.asarray(
+        _contingency_agg(mesh, n_bins, n_classes, impl, not on_tpu)(
+            xs, ys, w, jnp.asarray(edges)
+        )
+    )
+    stats, p_values, _ = chi_square(observed)
+    return stats, p_values
+
+
 class ChiSqSelector(_SelectorParams, Estimator):
     def __init__(self, mesh=None, **kwargs):
         super().__init__(**kwargs)
         self._mesh = mesh
 
     def _fit(self, frame: Frame) -> "ChiSqSelectorModel":
-        import jax
-
-        from sntc_tpu.ops.pallas_histogram import resolve_hist_impl
-
         mesh = self._mesh or get_default_mesh()
         X = frame[self.getFeaturesCol()].astype(np.float32)
-        y = frame[self.getLabelCol()].astype(np.int32)
-        n_bins = self.getMaxBins()
-        n_classes = int(y.max()) + 1 if len(y) else 1
-        edges = quantile_bin_edges(X, max_bins=n_bins)
+        y = frame[self.getLabelCol()]
+        stats, p_values = chi2_scores(X, y, mesh, self.getMaxBins())
 
-        xs, ys, w = shard_batch(mesh, X, y)
-
-        on_tpu = jax.default_backend() == "tpu"
-        impl = resolve_hist_impl(1, n_bins, mesh)
-
-        observed = np.asarray(
-            _contingency_agg(mesh, n_bins, n_classes, impl, not on_tpu)(
-                xs, ys, w, jnp.asarray(edges)
-            )
-        )
-        stats, p_values, _ = chi_square(observed)
-
-        order = np.lexsort((np.arange(len(stats)), -stats, p_values))
         mode = self.getSelectorType()
-        if mode == "numTopFeatures":
-            k = min(self.getNumTopFeatures(), X.shape[1])
-            chosen = order[:k]
-        elif mode == "percentile":
-            k = max(1, int(X.shape[1] * self.getPercentile()))
-            chosen = order[:k]
-        elif mode == "fpr":
-            chosen = np.flatnonzero(p_values < self.getFpr())
-        elif mode == "fdr":
-            # Benjamini-Hochberg (Spark ChiSqSelector fdr semantics): keep
-            # the largest k where p_(k) <= k/F * fdr, then every feature
-            # with p-value at or below that cutoff
-            F = X.shape[1]
-            sorted_p = p_values[order]
-            thresholds = (np.arange(1, F + 1) / F) * self.getFdr()
-            below = np.flatnonzero(sorted_p <= thresholds)
-            chosen = order[: below[-1] + 1] if below.size else order[:0]
-        else:  # fwe — Bonferroni
-            chosen = np.flatnonzero(p_values < self.getFwe() / X.shape[1])
-        selected = sorted(int(i) for i in chosen)
+        threshold = {
+            "numTopFeatures": self.getNumTopFeatures(),
+            "percentile": self.getPercentile(),
+            "fpr": self.getFpr(),
+            "fdr": self.getFdr(),
+            "fwe": self.getFwe(),
+        }[mode]
+        selected = select_features_by_mode(
+            stats, p_values, mode, threshold, X.shape[1]
+        )
 
         model = ChiSqSelectorModel(selected_features=selected)
         model.setParams(**self.paramValues())

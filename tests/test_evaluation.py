@@ -162,3 +162,45 @@ def test_by_label_metric_absent_class_and_negative(mesh8):
         MulticlassClassificationEvaluator(
             metricName="recallByLabel", metricLabel=-1
         )
+
+
+def test_regression_evaluator_matches_sklearn():
+    """rmse/mse/mae/r2 vs sklearn.metrics on random data, incl. weights;
+    var = Spark explainedVariance (SS_reg/n about the label mean)."""
+    from sklearn.metrics import (
+        mean_absolute_error,
+        mean_squared_error,
+        r2_score,
+    )
+
+    from sntc_tpu.evaluation import RegressionEvaluator
+
+    rng = np.random.default_rng(5)
+    y = rng.normal(size=500) * 3 + 1
+    pred = y + rng.normal(size=500) * 0.7
+    w = rng.uniform(0.5, 2.0, size=500)
+    f = Frame({"label": y, "prediction": pred, "w": w})
+
+    def ev(name, weight=None):
+        return RegressionEvaluator(
+            metricName=name, weightCol=weight
+        ).evaluate(f)
+
+    assert ev("mse") == pytest.approx(mean_squared_error(y, pred))
+    assert ev("rmse") == pytest.approx(np.sqrt(mean_squared_error(y, pred)))
+    assert ev("mae") == pytest.approx(mean_absolute_error(y, pred))
+    assert ev("r2") == pytest.approx(r2_score(y, pred))
+    assert ev("mse", "w") == pytest.approx(
+        mean_squared_error(y, pred, sample_weight=w)
+    )
+    assert ev("r2", "w") == pytest.approx(
+        r2_score(y, pred, sample_weight=w)
+    )
+    ybar = np.average(y, weights=w)
+    assert ev("var", "w") == pytest.approx(
+        np.average((pred - ybar) ** 2, weights=w)
+    )
+    assert not RegressionEvaluator(metricName="rmse").isLargerBetter()
+    assert RegressionEvaluator(metricName="r2").isLargerBetter()
+    with pytest.raises(ValueError):
+        RegressionEvaluator(metricName="nope")

@@ -177,3 +177,126 @@ def test_chisq_selector_fdr_and_fwe_modes(mesh8):
         mesh=mesh8, selectorType="fdr", fdr=0.5, labelCol="label"
     ).fit(f)
     assert set(loose.selected_features) >= {1, 5}
+
+
+# ---------------- UnivariateFeatureSelector ----------------
+
+def test_ufs_anova_matches_sklearn(mesh8):
+    from sklearn.feature_selection import f_classif as sk_f_classif
+
+    from sntc_tpu.feature import UnivariateFeatureSelector
+
+    rng = np.random.default_rng(6)
+    n = 4000
+    y = rng.integers(0, 3, size=n)
+    X = rng.normal(size=(n, 10)).astype(np.float32)
+    X[:, 3] += y * 1.5
+    X[:, 8] -= y * 2.0
+    f = Frame({"features": X, "label": y.astype(np.float64)})
+    sel = UnivariateFeatureSelector(
+        mesh=mesh8, featureType="continuous", labelType="categorical",
+        selectionMode="numTopFeatures", selectionThreshold=2,
+    ).fit(f)
+    assert sorted(sel.selected_features) == [3, 8]
+    # statistic parity with sklearn's f_classif
+    from sntc_tpu.feature.univariate_selector import (
+        _anova_moments_agg,
+        f_classif,
+    )
+    from sntc_tpu.parallel.collectives import shard_batch
+
+    xs, ys, w = shard_batch(mesh8, X, y.astype(np.int32))
+    F, p = f_classif(_anova_moments_agg(mesh8, 3)(xs, ys, w))
+    F_sk, p_sk = sk_f_classif(X.astype(np.float64), y)
+    np.testing.assert_allclose(F, F_sk, rtol=2e-3)
+    out = sel.transform(f)
+    assert out["selectedFeatures"].shape == (n, 2)
+
+
+def test_ufs_f_regression_matches_sklearn(mesh8):
+    from sklearn.feature_selection import f_regression as sk_f_regression
+
+    from sntc_tpu.feature import UnivariateFeatureSelector
+
+    rng = np.random.default_rng(7)
+    n = 3000
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    y = 2.0 * X[:, 1] - 1.0 * X[:, 6] + 0.5 * rng.normal(size=n)
+    f = Frame({"features": X, "label": y})
+    sel = UnivariateFeatureSelector(
+        mesh=mesh8, featureType="continuous", labelType="continuous",
+        selectionMode="numTopFeatures", selectionThreshold=2,
+    ).fit(f)
+    assert sorted(sel.selected_features) == [1, 6]
+    from sntc_tpu.feature.univariate_selector import (
+        _regression_moments_agg,
+        f_regression,
+    )
+    from sntc_tpu.parallel.collectives import shard_batch
+
+    xs, ys, w = shard_batch(mesh8, X, y.astype(np.float32))
+    F, p = f_regression(_regression_moments_agg(mesh8)(xs, ys, w))
+    F_sk, p_sk = sk_f_regression(X.astype(np.float64), y)
+    np.testing.assert_allclose(F, F_sk, rtol=5e-3)
+
+
+def test_ufs_chi2_mode_and_validation(mesh8):
+    from sntc_tpu.feature import ChiSqSelector, UnivariateFeatureSelector
+
+    rng = np.random.default_rng(8)
+    n = 2500
+    y = rng.integers(0, 2, size=n)
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    X[:, 2] += y * 3.0
+    f = Frame({"features": X, "label": y.astype(np.float64)})
+    # categorical/categorical == ChiSqSelector's χ² (binned continuous)
+    ufs = UnivariateFeatureSelector(
+        mesh=mesh8, featureType="categorical", labelType="categorical",
+        selectionMode="numTopFeatures", selectionThreshold=1,
+    ).fit(f)
+    chi = ChiSqSelector(mesh=mesh8, numTopFeatures=1).fit(f)
+    assert ufs.selected_features == chi.selected_features == [2]
+    with pytest.raises(ValueError, match="featureType and labelType"):
+        UnivariateFeatureSelector(mesh=mesh8).fit(f)
+    with pytest.raises(ValueError, match="no\\s+Spark score function"):
+        UnivariateFeatureSelector(
+            mesh=mesh8, featureType="categorical", labelType="continuous"
+        ).fit(f)
+
+
+def test_ufs_save_load(tmp_path, mesh8):
+    from sntc_tpu.feature import UnivariateFeatureSelector
+    from sntc_tpu.mlio import load_model, save_model
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(1000, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    X[:, 0] += 1.0
+    f = Frame({"features": X, "label": y})
+    m = UnivariateFeatureSelector(
+        mesh=mesh8, featureType="continuous", labelType="categorical",
+        selectionMode="fpr", selectionThreshold=1e-8,
+    ).fit(f)
+    save_model(m, str(tmp_path / "ufs"))
+    m2 = load_model(str(tmp_path / "ufs"))
+    assert m2.selected_features == m.selected_features == [0]
+
+
+def test_ufs_threshold_validation(mesh8):
+    from sntc_tpu.feature import UnivariateFeatureSelector
+
+    rng = np.random.default_rng(10)
+    f = Frame({
+        "features": rng.normal(size=(200, 4)).astype(np.float32),
+        "label": rng.integers(0, 2, 200).astype(np.float64),
+    })
+    with pytest.raises(ValueError, match="positive\\s+feature count"):
+        UnivariateFeatureSelector(
+            mesh=mesh8, featureType="continuous", labelType="categorical",
+            selectionMode="numTopFeatures", selectionThreshold=-3,
+        ).fit(f)
+    with pytest.raises(ValueError, match="in \\[0, 1\\]"):
+        UnivariateFeatureSelector(
+            mesh=mesh8, featureType="continuous", labelType="categorical",
+            selectionMode="fpr", selectionThreshold=3.0,
+        ).fit(f)
