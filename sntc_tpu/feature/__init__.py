@@ -6,6 +6,15 @@ from sntc_tpu.feature.univariate_selector import (
     UnivariateFeatureSelector,
     UnivariateFeatureSelectorModel,
 )
+from sntc_tpu.feature.scalers import (
+    Binarizer,
+    MaxAbsScaler,
+    MaxAbsScalerModel,
+    MinMaxScaler,
+    MinMaxScalerModel,
+    Normalizer,
+)
+from sntc_tpu.feature.pca import PCA, PCAModel
 
 __all__ = [
     "VectorAssembler",
@@ -18,4 +27,12 @@ __all__ = [
     "ChiSqSelectorModel",
     "UnivariateFeatureSelector",
     "UnivariateFeatureSelectorModel",
+    "MinMaxScaler",
+    "MinMaxScalerModel",
+    "MaxAbsScaler",
+    "MaxAbsScalerModel",
+    "Normalizer",
+    "Binarizer",
+    "PCA",
+    "PCAModel",
 ]

@@ -1,0 +1,118 @@
+"""PCA — principal component projection.
+
+Behavioral spec: upstream ``ml/feature/PCA.scala`` →
+``mllib/linalg/distributed/RowMatrix.computePrincipalComponentsAndExplainedVariance``
+[U]: fit eigen-decomposes the sample covariance of the input vectors and
+keeps the top-``k`` components (descending eigenvalue); ``transform``
+multiplies the RAW (uncentered) vector by the component matrix, exactly
+as Spark does; ``explainedVariance`` is the kept eigenvalues' fraction
+of the total variance.  Component sign is arbitrary (as in Spark and
+sklearn).
+
+TPU design: the covariance reduces to ``(Σx, X^T X, n)`` — one
+``tree_aggregate`` SPMD pass whose ``X^T X`` is a single MXU matmul per
+shard, ``psum``-reduced over ICI; the ``[D, D]`` eigh runs on host
+(78×78 — trivial).  The projection is one jitted matmul.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sntc_tpu.core.base import Estimator, Model
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+from sntc_tpu.parallel.collectives import make_tree_aggregate, shard_batch
+from sntc_tpu.parallel.context import get_default_mesh
+
+
+@lru_cache(maxsize=None)
+def _cov_agg(mesh):
+    def moments(xs, w, pilot):
+        # accumulate about a pilot point (a real data row): uncentered
+        # f32 X^T X catastrophically cancels when feature means are large
+        # relative to their spread — shifting keeps magnitudes O(spread)
+        xs = xs - pilot[None, :]
+        wx = xs * w[:, None]
+        return {
+            "sum": wx.sum(axis=0),
+            "xxt": jnp.einsum("nd,ne->de", xs, wx),
+            "count": w.sum(),
+        }
+
+    return make_tree_aggregate(moments, mesh, replicated_args=(2,))
+
+
+@jax.jit
+def _project(X, pc):
+    return X @ pc
+
+
+class _PcaParams:
+    inputCol = Param("input vector column", default="features")
+    outputCol = Param("output vector column", default="pcaFeatures")
+    k = Param("number of principal components", default=2,
+              validator=validators.gt(0))
+
+
+class PCA(_PcaParams, Estimator):
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+
+    def _fit(self, frame: Frame) -> "PCAModel":
+        mesh = self._mesh or get_default_mesh()
+        X = frame[self.getInputCol()]
+        d = X.shape[1]
+        k = self.getK()
+        if k > d:
+            raise ValueError(f"k={k} exceeds the feature width {d}")
+        if X.shape[0] == 0:
+            raise ValueError("PCA requires a non-empty dataset")
+        xs, w = shard_batch(mesh, X)
+        pilot = np.asarray(X[0], np.float32)
+        out = _cov_agg(mesh)(xs, w, jnp.asarray(pilot))
+        n = float(out["count"])
+        # moments are about the pilot; the covariance is shift-invariant
+        mean_s = np.asarray(out["sum"], np.float64) / n
+        cov = (
+            np.asarray(out["xxt"], np.float64) - n * np.outer(mean_s, mean_s)
+        ) / max(n - 1.0, 1.0)
+        eigvals, eigvecs = np.linalg.eigh(cov)  # ascending
+        order = np.argsort(eigvals)[::-1]
+        eigvals = np.maximum(eigvals[order], 0.0)
+        pc = eigvecs[:, order[:k]]
+        total = eigvals.sum()
+        explained = eigvals[:k] / total if total > 0 else np.zeros(k)
+        model = PCAModel(
+            pc=pc.astype(np.float32),
+            explainedVariance=explained.astype(np.float64),
+        )
+        model.setParams(**self.paramValues())
+        return model
+
+
+class PCAModel(_PcaParams, Model):
+    def __init__(self, pc: np.ndarray, explainedVariance: np.ndarray, **kwargs):
+        super().__init__(**kwargs)
+        self.pc = np.asarray(pc, np.float32)  # [D, k]
+        self.explainedVariance = np.asarray(explainedVariance, np.float64)
+
+    def _save_extra(self):
+        return {}, {"pc": self.pc, "explainedVariance": self.explainedVariance}
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        m = cls(pc=arrays["pc"], explainedVariance=arrays["explainedVariance"])
+        m.setParams(**params)
+        return m
+
+    def transform(self, frame: Frame) -> Frame:
+        X = frame[self.getInputCol()].astype(np.float32, copy=False)
+        # Spark projects the RAW vectors (no centering at transform time)
+        out = np.asarray(_project(jnp.asarray(X), jnp.asarray(self.pc)))
+        return frame.with_column(self.getOutputCol(), out)

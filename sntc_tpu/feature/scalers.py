@@ -1,0 +1,185 @@
+"""MinMaxScaler / MaxAbsScaler / Normalizer / Binarizer.
+
+Behavioral spec: upstream ``ml/feature/{MinMaxScaler,MaxAbsScaler,
+Normalizer,Binarizer}.scala`` [U] — the remaining standard Spark scaling
+stages a user of the reference stack expects next to StandardScaler:
+
+  * MinMaxScaler: fit per-feature (Emin, Emax); transform rescales to
+    ``[min, max]``; constant features map to ``(min + max) / 2``.
+  * MaxAbsScaler: fit per-feature max |x|; transform ``x / maxAbs``
+    (maxAbs = 0 → 0), preserving sparsity/sign.
+  * Normalizer: stateless row p-norm scaling (p ≥ 1, ``inf`` supported);
+    zero-norm rows pass through unchanged.
+  * Binarizer: stateless ``x > threshold → 1.0 else 0.0``.
+
+TPU design: the two fitted scalers reduce per-feature extrema with plain
+jitted ``jnp.min/max`` over the mesh-sharded matrix — XLA inserts the
+all-reduce-min/max collectives itself (no hand-rolled psum needed; the
+row-0 padding of ``shard_batch`` is extremum-neutral because row 0 is a
+real row).  Transforms are elementwise and fuse into downstream matmuls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sntc_tpu.core.base import Estimator, Model, Transformer
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+from sntc_tpu.parallel.collectives import shard_batch
+from sntc_tpu.parallel.context import get_default_mesh
+
+
+@jax.jit
+def _extrema(xs):
+    return jnp.min(xs, axis=0), jnp.max(xs, axis=0)
+
+
+@jax.jit
+def _max_abs(xs):
+    return jnp.max(jnp.abs(xs), axis=0)
+
+
+class _MinMaxParams:
+    inputCol = Param("input vector column", default="features")
+    outputCol = Param("output vector column", default="scaledFeatures")
+    min = Param("lower bound of the output range", default=0.0)
+    max = Param("upper bound of the output range", default=1.0)
+
+
+class MinMaxScaler(_MinMaxParams, Estimator):
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+
+    def _fit(self, frame: Frame) -> "MinMaxScalerModel":
+        if self.getMin() >= self.getMax():
+            raise ValueError("min must be < max")
+        mesh = self._mesh or get_default_mesh()
+        xs, _ = shard_batch(mesh, frame[self.getInputCol()])
+        lo, hi = _extrema(xs)
+        model = MinMaxScalerModel(
+            originalMin=np.asarray(lo), originalMax=np.asarray(hi)
+        )
+        model.setParams(**self.paramValues())
+        return model
+
+
+class MinMaxScalerModel(_MinMaxParams, Model):
+    def __init__(self, originalMin, originalMax, **kwargs):
+        super().__init__(**kwargs)
+        self.originalMin = np.asarray(originalMin, np.float32)
+        self.originalMax = np.asarray(originalMax, np.float32)
+
+    def _save_extra(self):
+        return {}, {"min": self.originalMin, "max": self.originalMax}
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        m = cls(originalMin=arrays["min"], originalMax=arrays["max"])
+        m.setParams(**params)
+        return m
+
+    def transform(self, frame: Frame) -> Frame:
+        X = frame[self.getInputCol()].astype(np.float32, copy=False)
+        lo, hi = self.originalMin, self.originalMax
+        span = hi - lo
+        out_lo, out_hi = self.getMin(), self.getMax()
+        scale = np.divide(
+            out_hi - out_lo, span, out=np.zeros_like(span), where=span > 0
+        )
+        scaled = (X - lo) * scale + out_lo
+        # Spark: constant features map to the midpoint of the output range
+        scaled = np.where(
+            span > 0, scaled, 0.5 * (out_lo + out_hi)
+        ).astype(np.float32)
+        return frame.with_column(self.getOutputCol(), scaled)
+
+
+class _MaxAbsParams:
+    inputCol = Param("input vector column", default="features")
+    outputCol = Param("output vector column", default="scaledFeatures")
+
+
+class MaxAbsScaler(_MaxAbsParams, Estimator):
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+
+    def _fit(self, frame: Frame) -> "MaxAbsScalerModel":
+        mesh = self._mesh or get_default_mesh()
+        xs, _ = shard_batch(mesh, frame[self.getInputCol()])
+        model = MaxAbsScalerModel(maxAbs=np.asarray(_max_abs(xs)))
+        model.setParams(**self.paramValues())
+        return model
+
+
+class MaxAbsScalerModel(_MaxAbsParams, Model):
+    def __init__(self, maxAbs, **kwargs):
+        super().__init__(**kwargs)
+        self.maxAbs = np.asarray(maxAbs, np.float32)
+
+    def _save_extra(self):
+        return {}, {"maxAbs": self.maxAbs}
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        m = cls(maxAbs=arrays["maxAbs"])
+        m.setParams(**params)
+        return m
+
+    def transform(self, frame: Frame) -> Frame:
+        X = frame[self.getInputCol()].astype(np.float32, copy=False)
+        inv = np.divide(
+            1.0, self.maxAbs,
+            out=np.zeros_like(self.maxAbs), where=self.maxAbs > 0,
+        )
+        return frame.with_column(self.getOutputCol(), X * inv)
+
+
+class Normalizer(Transformer):
+    """Row p-norm scaling — stateless (no fit)."""
+
+    inputCol = Param("input vector column", default="features")
+    outputCol = Param("output vector column", default="normFeatures")
+    p = Param(
+        "norm order (>= 1; float('inf') supported)",
+        default=2.0,
+        validator=validators.gteq(1.0),
+    )
+
+    def transform(self, frame: Frame) -> Frame:
+        X = frame[self.getInputCol()].astype(np.float32, copy=False)
+        p = float(self.getP())
+        if np.isinf(p):
+            norm = np.abs(X).max(axis=1)
+        elif p == 2.0:
+            norm = np.sqrt((X.astype(np.float64) ** 2).sum(axis=1))
+        elif p == 1.0:
+            norm = np.abs(X.astype(np.float64)).sum(axis=1)
+        else:
+            norm = (np.abs(X.astype(np.float64)) ** p).sum(axis=1) ** (1.0 / p)
+        inv = np.divide(
+            1.0, norm, out=np.zeros_like(norm, dtype=np.float64), where=norm > 0
+        )
+        out = (X * inv[:, None].astype(np.float32)).astype(np.float32)
+        # Spark leaves zero-norm rows unchanged
+        out = np.where((norm > 0)[:, None], out, X)
+        return frame.with_column(self.getOutputCol(), out)
+
+
+class Binarizer(Transformer):
+    """Thresholding — stateless (no fit)."""
+
+    inputCol = Param("input column (scalar or vector)", default="features")
+    outputCol = Param("output column", default="binarized")
+    threshold = Param("values > threshold become 1.0, else 0.0", default=0.0)
+
+    def transform(self, frame: Frame) -> Frame:
+        X = frame[self.getInputCol()]
+        out = (
+            np.asarray(X, np.float32) > float(self.getThreshold())
+        ).astype(np.float64 if X.ndim == 1 else np.float32)
+        return frame.with_column(self.getOutputCol(), out)

@@ -186,18 +186,20 @@ class UnivariateFeatureSelector(_UfsParams, Estimator):
         m = _regression_moments_agg(mesh)(xs, ys, w)
         return f_regression(m)
 
-    def _fit(self, frame: Frame) -> "UnivariateFeatureSelectorModel":
-        mesh = self._mesh or get_default_mesh()
-        X = frame[self.getFeaturesCol()].astype(np.float32, copy=False)
-        y = np.asarray(frame[self.getLabelCol()])
-        stats, p_values = self._score(X, y, mesh)
+    def _resolved_threshold(self):
+        """The mode's threshold, validated BEFORE any distributed scoring
+        (threshold semantics depend on the mode, so validation can't live
+        in a mode-blind Param validator)."""
         mode = self.getSelectionMode()
         threshold = self.getSelectionThreshold()
         if threshold is None:
             threshold = _MODE_DEFAULTS[mode]
-        # threshold semantics depend on the mode, so validation happens
-        # here rather than in a mode-blind Param validator
         if mode == "numTopFeatures":
+            if float(threshold) != int(threshold):
+                raise ValueError(
+                    f"selectionThreshold={threshold!r} must be an integer "
+                    "feature count for numTopFeatures (Spark IntParam)"
+                )
             if int(threshold) < 1:
                 raise ValueError(
                     f"selectionThreshold={threshold!r} must be a positive "
@@ -208,6 +210,14 @@ class UnivariateFeatureSelector(_UfsParams, Estimator):
                 f"selectionThreshold={threshold!r} must be in [0, 1] for "
                 f"selectionMode={mode!r}"
             )
+        return mode, threshold
+
+    def _fit(self, frame: Frame) -> "UnivariateFeatureSelectorModel":
+        mesh = self._mesh or get_default_mesh()
+        mode, threshold = self._resolved_threshold()  # fail fast
+        X = frame[self.getFeaturesCol()].astype(np.float32, copy=False)
+        y = np.asarray(frame[self.getLabelCol()])
+        stats, p_values = self._score(X, y, mesh)
         selected = select_features_by_mode(
             np.asarray(stats), np.asarray(p_values), mode, threshold,
             X.shape[1],

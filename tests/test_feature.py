@@ -300,3 +300,125 @@ def test_ufs_threshold_validation(mesh8):
             mesh=mesh8, featureType="continuous", labelType="categorical",
             selectionMode="fpr", selectionThreshold=3.0,
         ).fit(f)
+
+
+# ---------------- MinMax/MaxAbs/Normalizer/Binarizer/PCA ----------------
+
+def test_minmax_scaler_matches_sklearn(mesh8):
+    from sklearn.preprocessing import MinMaxScaler as SkMM
+
+    from sntc_tpu.feature import MinMaxScaler
+
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(1000, 5)).astype(np.float32) * 3
+    X[:, 4] = 2.0  # constant feature
+    f = Frame({"features": X})
+    m = MinMaxScaler(mesh=mesh8).fit(f)
+    out = np.asarray(m.transform(f)["scaledFeatures"])
+    sk = SkMM().fit_transform(X[:, :4])
+    np.testing.assert_allclose(out[:, :4], sk, atol=1e-5)
+    assert np.all(out[:, 4] == 0.5)  # Spark: constant -> midpoint
+    m2 = MinMaxScaler(mesh=mesh8, min=-1.0, max=3.0).fit(f)
+    out2 = np.asarray(m2.transform(f)["scaledFeatures"])
+    np.testing.assert_allclose(out2[:, :4], sk * 4.0 - 1.0, atol=2e-4)
+    assert np.all(out2[:, 4] == 1.0)
+    with pytest.raises(ValueError, match="min must be"):
+        MinMaxScaler(mesh=mesh8, min=2.0, max=1.0).fit(f)
+
+
+def test_maxabs_scaler(mesh8):
+    from sntc_tpu.feature import MaxAbsScaler
+
+    X = np.array([[2.0, -4.0, 0.0], [-1.0, 8.0, 0.0]], np.float32)
+    m = MaxAbsScaler(mesh=mesh8).fit(Frame({"features": X}))
+    np.testing.assert_allclose(m.maxAbs, [2.0, 8.0, 0.0])
+    out = m.transform(Frame({"features": X}))["scaledFeatures"]
+    np.testing.assert_allclose(
+        out, [[1.0, -0.5, 0.0], [-0.5, 1.0, 0.0]], atol=1e-6
+    )
+
+
+def test_normalizer_and_binarizer():
+    from sntc_tpu.feature import Binarizer, Normalizer
+
+    X = np.array([[3.0, 4.0], [0.0, 0.0], [1.0, 1.0]], np.float32)
+    f = Frame({"features": X})
+    out = Normalizer(inputCol="features", outputCol="n").transform(f)["n"]
+    np.testing.assert_allclose(out[0], [0.6, 0.8], atol=1e-6)
+    np.testing.assert_allclose(out[1], [0.0, 0.0])  # zero row unchanged
+    out1 = Normalizer(inputCol="features", outputCol="n", p=1.0).transform(f)["n"]
+    np.testing.assert_allclose(out1[2], [0.5, 0.5], atol=1e-6)
+    outi = Normalizer(
+        inputCol="features", outputCol="n", p=float("inf")
+    ).transform(f)["n"]
+    np.testing.assert_allclose(outi[0], [0.75, 1.0], atol=1e-6)
+    b = Binarizer(inputCol="features", outputCol="b", threshold=0.5).transform(f)
+    np.testing.assert_array_equal(
+        b["b"], [[1.0, 1.0], [0.0, 0.0], [1.0, 1.0]]
+    )
+
+
+def test_pca_matches_sklearn(mesh8):
+    from sklearn.decomposition import PCA as SkPCA
+
+    from sntc_tpu.feature import PCA
+    from sntc_tpu.mlio import load_model, save_model
+
+    rng = np.random.default_rng(13)
+    base = rng.normal(size=(2000, 2)).astype(np.float32)
+    mix = np.array([[1.0, 0.5, 0.1, 0.0], [0.0, 0.3, 1.0, 0.2]], np.float32)
+    X = base @ mix + 0.01 * rng.normal(size=(2000, 4)).astype(np.float32)
+    f = Frame({"features": X})
+    m = PCA(mesh=mesh8, k=2).fit(f)
+    sk = SkPCA(n_components=2).fit(X.astype(np.float64))
+    # components match up to sign
+    for j in range(2):
+        dot = abs(np.dot(m.pc[:, j], sk.components_[j]))
+        assert dot == pytest.approx(1.0, abs=1e-3)
+    np.testing.assert_allclose(
+        m.explainedVariance, sk.explained_variance_ratio_, atol=1e-4
+    )
+    # Spark projects raw (uncentered) vectors
+    out = np.asarray(m.transform(f)["pcaFeatures"])
+    np.testing.assert_allclose(out, X @ m.pc, atol=1e-4)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        save_model(m, d + "/pca")
+        m2 = load_model(d + "/pca")
+        np.testing.assert_allclose(m2.pc, m.pc)
+    with pytest.raises(ValueError, match="exceeds the feature width"):
+        PCA(mesh=mesh8, k=9).fit(f)
+
+
+def test_pca_large_mean_stability(mesh8):
+    """Covariance accumulates about a pilot row: large feature means must
+    not destroy the components (f32 cancellation hazard)."""
+    from sklearn.decomposition import PCA as SkPCA
+
+    from sntc_tpu.feature import PCA
+
+    rng = np.random.default_rng(14)
+    base = rng.normal(size=(20000, 2)).astype(np.float32)
+    mix = np.array([[1.0, 0.5, 0.1], [0.0, 0.3, 1.0]], np.float32)
+    X = (base @ mix + np.array([1e3, 5e3, 2e3], np.float32)).astype(np.float32)
+    m = PCA(mesh=mesh8, k=2).fit(Frame({"features": X}))
+    sk = SkPCA(n_components=2).fit(X.astype(np.float64))
+    for j in range(2):
+        assert abs(np.dot(m.pc[:, j], sk.components_[j])) > 0.999
+    np.testing.assert_allclose(
+        m.explainedVariance, sk.explained_variance_ratio_, atol=2e-3
+    )
+
+
+def test_ufs_rejects_fractional_top_k(mesh8):
+    from sntc_tpu.feature import UnivariateFeatureSelector
+
+    f = Frame({
+        "features": np.zeros((10, 3), np.float32),
+        "label": np.zeros(10),
+    })
+    with pytest.raises(ValueError, match="integer\\s+feature count"):
+        UnivariateFeatureSelector(
+            mesh=mesh8, featureType="continuous", labelType="categorical",
+            selectionMode="numTopFeatures", selectionThreshold=2.7,
+        ).fit(f)
