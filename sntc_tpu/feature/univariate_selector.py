@@ -41,23 +41,31 @@ from sntc_tpu.parallel.context import get_default_mesh
 
 @lru_cache(maxsize=None)
 def _anova_moments_agg(mesh, n_classes):
-    """Per-(feature, class) [count, sum, sumsq] in one SPMD pass."""
+    """Per-(feature, class) [count, sum, sumsq] in one SPMD pass,
+    accumulated about a pilot row (replicated arg): the F statistic is
+    shift-invariant, and raw f32 x² sums catastrophically cancel for
+    large-mean features."""
 
-    def moments(xs, ys, w):
+    def moments(xs, ys, w, pilot):
+        xs = xs - pilot[None, :]
         oh = jax.nn.one_hot(ys, n_classes, dtype=jnp.float32) * w[:, None]
         cnt = oh.sum(axis=0)  # weighted per-class count
         s = jnp.einsum("nf,nc->fc", xs, oh)
         sq = jnp.einsum("nf,nc->fc", xs * xs, oh)
         return cnt, s, sq
 
-    return make_tree_aggregate(moments, mesh)
+    return make_tree_aggregate(moments, mesh, replicated_args=(3,))
 
 
 @lru_cache(maxsize=None)
 def _regression_moments_agg(mesh):
-    """Per-feature [Σw, Σx, Σx², Σy, Σy², Σxy] in one SPMD pass."""
+    """Per-feature [Σw, Σx, Σx², Σy, Σy², Σxy] in one SPMD pass, about
+    per-variable pilots (the correlation is shift-invariant; raw f32
+    squares cancel for large means)."""
 
-    def moments(xs, ys, w):
+    def moments(xs, ys, w, pilot_x, pilot_y):
+        xs = xs - pilot_x[None, :]
+        ys = ys - pilot_y
         wx = xs * w[:, None]
         return (
             w.sum(),
@@ -68,7 +76,7 @@ def _regression_moments_agg(mesh):
             (ys[:, None] * wx).sum(axis=0),
         )
 
-    return make_tree_aggregate(moments, mesh)
+    return make_tree_aggregate(moments, mesh, replicated_args=(3, 4))
 
 
 def f_classif(X_moments, eps: float = 1e-12):
@@ -161,6 +169,10 @@ class UnivariateFeatureSelector(_UfsParams, Estimator):
         self._mesh = mesh
 
     def _score(self, X, y, mesh):
+        if X.shape[0] == 0:
+            raise ValueError(
+                "UnivariateFeatureSelector requires a non-empty dataset"
+            )
         ftype, ltype = self.getFeatureType(), self.getLabelType()
         if ftype is None or ltype is None:
             raise ValueError(
@@ -175,15 +187,21 @@ class UnivariateFeatureSelector(_UfsParams, Estimator):
         if ltype == "categorical":  # continuous features, ANOVA F
             n_classes = int(y.max()) + 1 if len(y) else 1
             xs, ys, w = shard_batch(mesh, X, y.astype(np.int32))
-            m = _anova_moments_agg(mesh, n_classes)(xs, ys, w)
+            pilot = jnp.asarray(np.asarray(X[0], np.float32))
+            m = _anova_moments_agg(mesh, n_classes)(xs, ys, w, pilot)
             return f_classif(m)
         if ftype == "categorical":
             raise ValueError(
                 "categorical features with a continuous label have no "
                 "Spark score function (Spark rejects this combination too)"
             )
-        xs, ys, w = shard_batch(mesh, X, y.astype(np.float32))
-        m = _regression_moments_agg(mesh)(xs, ys, w)
+        y32 = y.astype(np.float32)
+        xs, ys, w = shard_batch(mesh, X, y32)
+        m = _regression_moments_agg(mesh)(
+            xs, ys, w,
+            jnp.asarray(np.asarray(X[0], np.float32)),
+            jnp.float32(y32[0]),
+        )
         return f_regression(m)
 
     def _resolved_threshold(self):

@@ -16,6 +16,7 @@ from sntc_tpu.models.tree import (
     RandomForestClassifier,
     RandomForestClassificationModel,
 )
+from sntc_tpu.models.naive_bayes import NaiveBayes, NaiveBayesModel
 from sntc_tpu.models.one_vs_rest import OneVsRest, OneVsRestModel
 
 __all__ = [
@@ -27,6 +28,8 @@ __all__ = [
     "DecisionTreeClassificationModel",
     "DecisionTreeRegressor",
     "DecisionTreeRegressionModel",
+    "NaiveBayes",
+    "NaiveBayesModel",
     "OneVsRest",
     "OneVsRestModel",
     "LogisticRegression",

@@ -205,8 +205,10 @@ def test_ufs_anova_matches_sklearn(mesh8):
     )
     from sntc_tpu.parallel.collectives import shard_batch
 
+    import jax.numpy as jnp
+
     xs, ys, w = shard_batch(mesh8, X, y.astype(np.int32))
-    F, p = f_classif(_anova_moments_agg(mesh8, 3)(xs, ys, w))
+    F, p = f_classif(_anova_moments_agg(mesh8, 3)(xs, ys, w, jnp.asarray(X[0])))
     F_sk, p_sk = sk_f_classif(X.astype(np.float64), y)
     np.testing.assert_allclose(F, F_sk, rtol=2e-3)
     out = sel.transform(f)
@@ -234,8 +236,14 @@ def test_ufs_f_regression_matches_sklearn(mesh8):
     )
     from sntc_tpu.parallel.collectives import shard_batch
 
+    import jax.numpy as jnp
+
     xs, ys, w = shard_batch(mesh8, X, y.astype(np.float32))
-    F, p = f_regression(_regression_moments_agg(mesh8)(xs, ys, w))
+    F, p = f_regression(
+        _regression_moments_agg(mesh8)(
+            xs, ys, w, jnp.asarray(X[0]), jnp.float32(y[0])
+        )
+    )
     F_sk, p_sk = sk_f_regression(X.astype(np.float64), y)
     np.testing.assert_allclose(F, F_sk, rtol=5e-3)
 
