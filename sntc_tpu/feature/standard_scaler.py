@@ -37,7 +37,12 @@ class _ScalerParams:
     withStd = Param("scale to unit std", default=True, validator=validators.is_bool())
 
 
-def _moments(xs, w):
+def _moments(xs, w, pilot):
+    # accumulated about a pilot data row: raw f32 Σx² catastrophically
+    # cancels for features whose mean dwarfs their spread (the same
+    # hazard fixed in PCA/NaiveBayes); the variance is shift-invariant
+    # and the true mean is reconstructed in f64 by the caller
+    xs = xs - pilot[None, :]
     return {
         "sum": jnp.einsum("n,nd->d", w, xs),
         "sumsq": jnp.einsum("n,nd->d", w, xs * xs),
@@ -48,7 +53,23 @@ def _moments(xs, w):
 @lru_cache(maxsize=None)
 def _moments_agg(mesh):
     # one compiled program per (mesh, input shape) across ALL fits
-    return make_tree_aggregate(_moments, mesh)
+    return make_tree_aggregate(_moments, mesh, replicated_args=(2,))
+
+
+def standardization_moments(mesh, xs, w, X_first_row):
+    """``(count, mean, unbiased-ish var about the mean)`` of a sharded
+    matrix, pilot-shifted — shared by StandardScaler and LinearSVC's
+    internal standardization.  Returns f64 host arrays; ``var`` here is
+    the BIASED (1/n) variance; callers apply their own ddof."""
+    pilot = np.asarray(X_first_row, np.float32)
+    out = _moments_agg(mesh)(xs, w, jnp.asarray(pilot))
+    n = float(out["count"])
+    mean_sh = np.asarray(out["sum"], np.float64) / max(n, 1e-300)
+    mean = pilot.astype(np.float64) + mean_sh
+    var = (
+        np.asarray(out["sumsq"], np.float64) / max(n, 1e-300) - mean_sh**2
+    )
+    return n, mean, np.maximum(var, 0.0)
 
 
 @partial(jax.jit, static_argnames=("n",))
@@ -68,13 +89,11 @@ class StandardScaler(_ScalerParams, Estimator):
         X = frame[self.getInputCol()]
         xs, w = shard_batch(mesh, X)
 
-        out = _moments_agg(mesh)(xs, w)
-        n = float(out["count"])
-        mean = np.asarray(out["sum"], dtype=np.float64) / n
-        # unbiased variance, clamped: f32 sumsq can dip slightly negative
-        var = (np.asarray(out["sumsq"], dtype=np.float64) - n * mean**2) / max(
-            n - 1, 1
+        n, mean, var_biased = standardization_moments(
+            mesh, xs, w, np.asarray(X[0]) if X.shape[0] else np.zeros(X.shape[1])
         )
+        # unbiased variance (Spark ddof=1)
+        var = var_biased * n / max(n - 1, 1)
         std = np.sqrt(np.maximum(var, 0.0))
         model = StandardScalerModel(
             mean=mean.astype(np.float32), std=std.astype(np.float32)

@@ -1,0 +1,214 @@
+"""LinearSVC — linear SVM with hinge loss.
+
+Behavioral spec: upstream ``ml/classification/LinearSVC.scala`` +
+``ml/optim/aggregator/HingeAggregator.scala`` [U]: binary only; minimize
+``Σ wᵢ·max(0, 1 − (2yᵢ−1)·margin) / Σw + regParam·½‖coef‖²`` with LBFGS
+(hinge subgradient, exactly Breeze's treatment); features standardized
+internally with the penalty kept in the requested space
+(``standardization`` flag, LR-style); ``rawPrediction = [−m, +m]``;
+``prediction = m > threshold`` on the RAW margin (Spark thresholds raw,
+not probability — LinearSVC emits no probability column).
+
+TPU design: the whole fit is the same one-XLA-program shape as
+LogisticRegression — a module-level jitted ``minimize_lbfgs`` over
+mesh-sharded rows (hinge and its subgradient are elementwise + one
+matmul; XLA inserts the gradient all-reduce), margins ride the MXU with
+the scaling folded into the weights.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+from sntc_tpu.models.base import ClassificationModel, ClassifierEstimator
+from sntc_tpu.models.logistic_regression import LogisticRegressionSummary
+from sntc_tpu.ops.lbfgs import minimize_lbfgs
+from sntc_tpu.parallel.collectives import make_tree_aggregate, shard_batch, shard_weights
+from sntc_tpu.parallel.context import get_default_mesh
+
+
+@partial(
+    jax.jit,
+    static_argnames=("fit_intercept", "max_iter", "tol"),
+)
+def _svc_optimize(
+    xs, ys, ws, inv_std, mu, reg, pen_l2, theta0,
+    *, fit_intercept, max_iter, tol,
+):
+    """The whole hinge-LBFGS fit as one cached XLA program (sharded data
+    as arguments — compile once, fit many).
+
+    With an intercept the optimization runs on CENTERED+scaled features
+    (``mu`` nonzero): a pure reparametrization of the same objective —
+    the caller folds the shift back into the exported intercept — but
+    vastly better conditioned than Spark's scale-only internal space
+    when a feature's mean dwarfs its spread.  Centering happens BEFORE
+    the matmul (inside the fused elementwise prologue), because
+    ``x·w − μ·w`` computed as two large f32 dot products cancels."""
+    d = xs.shape[1]
+    w_sum = jnp.sum(ws)
+
+    def value_and_grad(theta):
+        def loss_fn(theta):
+            coef = theta[:d]
+            b = theta[d] if fit_intercept else jnp.zeros((), theta.dtype)
+            margins = (xs - mu[None, :]) @ (coef * inv_std) + b
+            y_signed = 2.0 * ys.astype(margins.dtype) - 1.0
+            hinge = jnp.maximum(0.0, 1.0 - y_signed * margins)
+            data = jnp.sum(ws * hinge) / w_sum
+            penalty = 0.5 * reg * jnp.sum(pen_l2 * coef**2)
+            return data + penalty
+
+        return jax.value_and_grad(loss_fn)(theta)
+
+    return minimize_lbfgs(
+        value_and_grad, theta0, max_iter=max_iter, tol=tol,
+    )
+
+
+class _SvcParams:
+    regParam = Param("L2 regularization", default=0.0, validator=validators.gteq(0))
+    maxIter = Param("max LBFGS iterations", default=100, validator=validators.gt(0))
+    tol = Param("convergence tolerance", default=1e-6, validator=validators.gt(0))
+    fitIntercept = Param("fit an intercept term", default=True,
+                         validator=validators.is_bool())
+    standardization = Param(
+        "standardize features internally (penalty follows the flag, as in "
+        "Spark)", default=True, validator=validators.is_bool())
+    threshold = Param(
+        "decision threshold applied to the RAW margin (Spark LinearSVC "
+        "semantics)", default=0.0)
+
+
+class LinearSVC(_SvcParams, ClassifierEstimator):
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+
+    def _fit(self, frame: Frame) -> "LinearSVCModel":
+        from sntc_tpu.feature.standard_scaler import standardization_moments
+
+        mesh = self._mesh or get_default_mesh()
+        X, y, w = self._extract(frame)
+        if len(y) and int(y.max()) > 1:
+            raise ValueError(
+                "LinearSVC is binary-only (Spark parity); use OneVsRest "
+                "for multiclass"
+            )
+        d = X.shape[1]
+        xs, ys, _ = shard_batch(mesh, X, y)
+        ws = shard_weights(mesh, w, xs.shape[0])
+
+        # feature moments for internal standardization (one SPMD pass —
+        # the same pilot-shifted aggregate StandardScaler uses; raw f32
+        # sumsq cancels for large-mean flow features)
+        n, mean, var = standardization_moments(
+            mesh, xs, ws, X[0] if X.shape[0] else np.zeros(d)
+        )
+        std = np.sqrt(np.maximum(var, 0.0))
+        inv_std = np.divide(
+            1.0, std, out=np.ones_like(std), where=std > 0
+        ).astype(np.float32)
+        # penalty space (Spark): standardization=True penalizes the
+        # STANDARDIZED coefficients (theta itself); =False penalizes the
+        # original-space coefficients theta*inv_std -> weight by inv_std²
+        pen = (
+            np.ones(d, np.float32)
+            if self.getStandardization()
+            else inv_std**2
+        )
+
+        fit_b = self.getFitIntercept()
+        # centering is a reparametrization ONLY when an intercept absorbs
+        # the shift; without one, optimize on raw (scaled) features
+        mu_opt = mean.astype(np.float32) if fit_b else np.zeros(d, np.float32)
+        theta0 = jnp.zeros((d + 1 if fit_b else d,), jnp.float32)
+        res = _svc_optimize(
+            xs, ys, ws, jnp.asarray(inv_std), jnp.asarray(mu_opt),
+            jnp.float32(self.getRegParam()), jnp.asarray(pen), theta0,
+            fit_intercept=fit_b,
+            max_iter=int(self.getMaxIter()), tol=float(self.getTol()),
+        )
+        theta = np.asarray(res.x, np.float64)
+        coef = (theta[:d] * inv_std).astype(np.float64)  # original space
+        # fold the centering shift back: margin = (x-mu)·coef + b
+        intercept = (
+            float(theta[d]) - float(mu_opt.astype(np.float64) @ coef)
+            if fit_b
+            else 0.0
+        )
+        model = LinearSVCModel(coefficients=coef, intercept=intercept)
+        model.setParams(
+            **{k2: v for k2, v in self.paramValues().items()
+               if model.hasParam(k2)}
+        )
+        n_it = int(res.n_iters)
+        model.summary = LogisticRegressionSummary(
+            np.asarray(res.history)[: n_it + 1], n_it
+        )
+        return model
+
+
+class LinearSVCModel(_SvcParams, ClassificationModel):
+    def __init__(self, coefficients: np.ndarray, intercept: float, **kwargs):
+        super().__init__(**kwargs)
+        self.coefficients = np.asarray(coefficients, np.float64)
+        self.coefficients.flags.writeable = False
+        self.intercept = float(intercept)
+        self.summary = None
+
+    @property
+    def num_classes(self) -> int:
+        return 2
+
+    def _save_extra(self):
+        return {"intercept": self.intercept}, {"coefficients": self.coefficients}
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        m = cls(
+            coefficients=arrays["coefficients"],
+            intercept=float(extra["intercept"]),
+        )
+        m.setParams(**params)
+        return m
+
+    def _margin(self, X: np.ndarray) -> np.ndarray:
+        return (
+            X.astype(np.float64, copy=False) @ self.coefficients
+            + self.intercept
+        )
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Margin-thresholded labels (the probabilistic base's predict
+        goes through probability, which LinearSVC does not define)."""
+        return (
+            self._margin(np.asarray(X)) > float(self.getThreshold())
+        ).astype(np.float64)
+
+    def _raw_predict(self, X: np.ndarray) -> np.ndarray:
+        m = self._margin(np.asarray(X))
+        return np.stack([-m, m], axis=1)
+
+    def transform(self, frame: Frame) -> Frame:
+        """rawPrediction + prediction only — Spark's LinearSVC emits no
+        probability column; the threshold applies to the raw margin."""
+        X = np.asarray(frame[self.getFeaturesCol()])
+        m = self._margin(X)
+        out = frame
+        if self.getRawPredictionCol():
+            out = out.with_column(
+                self.getRawPredictionCol(), np.stack([-m, m], axis=1)
+            )
+        if self.getPredictionCol():
+            out = out.with_column(
+                self.getPredictionCol(),
+                (m > float(self.getThreshold())).astype(np.float64),
+            )
+        return out

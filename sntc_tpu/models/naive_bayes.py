@@ -225,10 +225,17 @@ def _gaussian_raw(X, mu, var, log_pi):
     flow data).  Devices run f32 by default (no global x64), and NB
     prediction is two small matmuls — f64 on host is the accurate and
     cheap choice."""
-    X = np.asarray(X, np.float64)[:, None, :]  # [N, 1, F]
-    mu = np.asarray(mu, np.float64)[None]
-    var = np.asarray(var, np.float64)[None]
-    ll = -0.5 * (np.log(2.0 * np.pi * var) + (X - mu) ** 2 / var).sum(axis=2)
+    X = np.asarray(X, np.float64)  # [N, F]
+    mu = np.asarray(mu, np.float64)
+    var = np.asarray(var, np.float64)
+    C = mu.shape[0]
+    ll = np.empty((X.shape[0], C), np.float64)
+    # per-class loop keeps peak memory at O(N·F), not O(N·C·F) — the
+    # full broadcast would be ~26 GB f64 at CICIDS scale (2.8M×15×78)
+    for c in range(C):
+        ll[:, c] = -0.5 * (
+            np.log(2.0 * np.pi * var[c]) + (X - mu[c]) ** 2 / var[c]
+        ).sum(axis=1)
     return ll + np.asarray(log_pi, np.float64)[None, :]
 
 

@@ -1,0 +1,109 @@
+"""LinearSVC oracle tests vs sklearn's LinearSVC/SVC(linear)."""
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.mlio import load_model, save_model
+from sntc_tpu.models import LinearSVC, OneVsRest
+
+
+def _binary(seed=0, n=3000, d=6, margin=2.0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=d)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ w + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+    return Frame({"features": X, "label": y}), X, y
+
+
+def test_matches_sklearn_accuracy_and_direction(mesh8):
+    from sklearn.svm import LinearSVC as SkSVC
+
+    f, X, y = _binary()
+    m = LinearSVC(mesh=mesh8, regParam=0.01, maxIter=100).fit(f)
+    # sklearn C = 1/(n*regParam) for the same objective scaling
+    sk = SkSVC(C=1.0 / (len(y) * 0.01), loss="hinge", max_iter=20000).fit(X, y)
+    out = m.transform(f)
+    acc = (np.asarray(out["prediction"]) == y).mean()
+    sk_acc = (sk.predict(X) == y).mean()
+    # objective matches sklearn to ~1e-6 on this data; accuracy is
+    # noise-bound (~0.91 for both), so parity — not absolute level —
+    # is the assertion
+    assert acc > 0.88
+    assert abs(acc - sk_acc) < 0.005
+    # same separating direction (cosine similarity)
+    cos = np.dot(m.coefficients, sk.coef_[0]) / (
+        np.linalg.norm(m.coefficients) * np.linalg.norm(sk.coef_[0])
+    )
+    assert cos > 0.99
+    # raw = [-m, +m]; prediction thresholds raw at 0
+    raw = np.asarray(out["rawPrediction"])
+    np.testing.assert_allclose(raw[:, 0], -raw[:, 1])
+    np.testing.assert_array_equal(
+        np.asarray(out["prediction"]), (raw[:, 1] > 0).astype(np.float64)
+    )
+    assert "probability" not in out.columns  # Spark: no probability col
+    assert m.summary.totalIterations > 0
+
+
+def test_threshold_and_weights(mesh8):
+    f, X, y = _binary(seed=1)
+    m = LinearSVC(mesh=mesh8, regParam=0.01).fit(f)
+    hi = m.copy({"threshold": 1e9}).transform(f)
+    assert np.asarray(hi["prediction"]).sum() == 0  # nothing clears it
+    # zero-weighting the attack rows flips the fit toward all-benign
+    w = (y == 0).astype(np.float32)
+    fw = Frame({"features": X, "label": y, "w": w})
+    mw = LinearSVC(mesh=mesh8, regParam=0.01, weightCol="w").fit(fw)
+    assert np.asarray(mw.transform(f)["prediction"]).sum() < len(y) * 0.05
+
+
+def test_multiclass_rejected_and_ovr_works(mesh8):
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(1500, 5)).astype(np.float32)
+    y = np.argmax(X[:, :3], axis=1).astype(np.float64)
+    f = Frame({"features": X, "label": y})
+    with pytest.raises(ValueError, match="binary-only"):
+        LinearSVC(mesh=mesh8).fit(f)
+    ovr = OneVsRest(classifier=LinearSVC(mesh=mesh8, regParam=0.01), mesh=mesh8).fit(f)
+    acc = (np.asarray(ovr.transform(f)["prediction"]) == y).mean()
+    assert acc > 0.85
+
+
+def test_standardization_flag_and_save_load(mesh8, tmp_path):
+    f, X, y = _binary(seed=3)
+    # scale one feature: standardization should absorb it
+    X2 = X.copy(); X2[:, 0] *= 1e4
+    f2 = Frame({"features": X2, "label": y})
+    m_std = LinearSVC(mesh=mesh8, regParam=0.1, standardization=True).fit(f2)
+    m_raw = LinearSVC(mesh=mesh8, regParam=0.1, standardization=False).fit(f2)
+    a_std = (np.asarray(m_std.transform(f2)["prediction"]) == y).mean()
+    assert a_std > 0.9
+    # different penalty spaces -> different coefficients
+    assert not np.allclose(m_std.coefficients, m_raw.coefficients)
+    save_model(m_std, str(tmp_path / "svc"))
+    m2 = load_model(str(tmp_path / "svc"))
+    np.testing.assert_allclose(m2.coefficients, m_std.coefficients)
+    np.testing.assert_array_equal(
+        np.asarray(m2.transform(f2)["prediction"]),
+        np.asarray(m_std.transform(f2)["prediction"]),
+    )
+
+
+def test_standardization_survives_large_mean_features(mesh8):
+    """mean ~1e6, std ~1 features: the pilot-shifted moments must not
+    cancel the spread away (raw f32 sumsq estimated std = 0 here,
+    silently skipping standardization)."""
+    rng = np.random.default_rng(5)
+    n = 4000
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    X2 = X.copy()
+    X2[:, 0] = X2[:, 0] + 1e6  # huge mean, std 1 — carries the signal
+    f = Frame({"features": X2, "label": y})
+    m = LinearSVC(mesh=mesh8, regParam=0.001).fit(f)
+    acc = (m.predict(X2) == y).mean()
+    assert acc > 0.9
+    # predict() convenience works and matches transform
+    out = m.transform(f)
+    np.testing.assert_array_equal(np.asarray(out["prediction"]), m.predict(X2))
