@@ -30,9 +30,12 @@ TRAIN_DEFAULT_LAYERS = "78,64,15"
 
 def _build_estimator(name: str, mesh, args):
     from sntc_tpu.models import (
+        DecisionTreeClassifier,
         GBTClassifier,
+        LinearSVC,
         LogisticRegression,
         MultilayerPerceptronClassifier,
+        NaiveBayes,
         OneVsRest,
         RandomForestClassifier,
     )
@@ -60,7 +63,23 @@ def _build_estimator(name: str, mesh, args):
             ),
             featuresCol=args.features_col,
         )
-    raise SystemExit(f"unknown estimator {name!r} (lr|mlp|rf|gbt)")
+    if name == "dt":
+        return DecisionTreeClassifier(
+            mesh=mesh, maxDepth=args.max_depth, maxBins=args.max_bins,
+            seed=args.seed,
+        )
+    if name == "nb":
+        return NaiveBayes(mesh=mesh, modelType="gaussian")
+    if name == "svc":
+        return OneVsRest(
+            classifier=LinearSVC(
+                mesh=mesh, maxIter=args.max_iter, regParam=args.reg_param
+            ),
+            featuresCol=args.features_col,
+        )
+    raise SystemExit(
+        f"unknown estimator {name!r} (lr|mlp|rf|gbt|dt|nb|svc)"
+    )
 
 
 def _feature_stages(mesh, args, with_scaler: bool):
@@ -120,7 +139,7 @@ def cmd_train(args) -> int:
     train, test = df.random_split(
         [1 - args.test_fraction, args.test_fraction], seed=args.seed
     )
-    with_scaler = args.estimator in ("lr", "mlp")
+    with_scaler = args.estimator in ("lr", "mlp", "svc")
     # the column the estimator reads = whatever the LAST feature stage
     # writes: chisq/scaler write --features-col, a bare assembler leaves
     # "rawFeatures" (trees consume unscaled features, as the reference does)
@@ -265,7 +284,7 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("train", help="fit a pipeline, report held-out metric")
     common(p)
-    p.add_argument("--estimator", default="mlp", choices=["lr", "mlp", "rf", "gbt"])
+    p.add_argument("--estimator", default="mlp", choices=["lr", "mlp", "rf", "gbt", "dt", "nb", "svc"])
     p.add_argument("--model-out", default=None)
     p.add_argument("--test-fraction", type=float, default=0.2)
     p.add_argument("--max-iter", type=int, default=100)

@@ -57,10 +57,10 @@ def _moments_agg(mesh):
 
 
 def standardization_moments(mesh, xs, w, X_first_row):
-    """``(count, mean, unbiased-ish var about the mean)`` of a sharded
+    """``(count, mean, BIASED 1/n variance about the mean)`` of a sharded
     matrix, pilot-shifted — shared by StandardScaler and LinearSVC's
-    internal standardization.  Returns f64 host arrays; ``var`` here is
-    the BIASED (1/n) variance; callers apply their own ddof."""
+    internal standardization.  Returns f64 host arrays; callers apply
+    their own ddof correction (Spark's scaler uses ddof=1)."""
     pilot = np.asarray(X_first_row, np.float32)
     out = _moments_agg(mesh)(xs, w, jnp.asarray(pilot))
     n = float(out["count"])

@@ -26,11 +26,21 @@ from sntc_tpu.models.base import (
 def _build_fused_ovr(models):
     """A ``f(X) -> [N, K]`` fused raw-score closure for homogeneous
     sub-models, or None (see ``OneVsRestModel._fused_raw``)."""
+    from sntc_tpu.models.linear_svc import LinearSVCModel
     from sntc_tpu.models.logistic_regression import LogisticRegressionModel
     from sntc_tpu.models.tree.gbt import GBTClassificationModel
 
     if not models:
         return None
+    if all(isinstance(m, LinearSVCModel) for m in models):
+        # margins stack into one [D, K] f32 matmul, exactly the LR case
+        WT = np.stack([m.coefficients for m in models]).T.astype(np.float32)
+        b = np.asarray([m.intercept for m in models], np.float32)
+
+        def svc_fused(X):
+            return X.astype(np.float32, copy=False) @ WT + b
+
+        return svc_fused
     if all(
         isinstance(m, LogisticRegressionModel) and m.is_binomial
         for m in models

@@ -89,3 +89,16 @@ def test_train_rf_default_and_chisq_mlp(data_dir, capsys):
     with pytest.raises(SystemExit):
         main(["train", "--data", data_dir, "--estimator", "mlp",
               "--chisq-top", "20", "--layers", "40,8,15"])
+
+
+def test_train_new_estimators(data_dir, capsys):
+    """dt/nb/svc ride the same train script surface."""
+    for est, extra in (
+        ("dt", ["--max-depth", "4"]),
+        ("nb", []),
+        ("svc", ["--binary", "--max-iter", "20"]),
+    ):
+        rc = main(["train", "--data", data_dir, "--estimator", est] + extra)
+        assert rc == 0, est
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert 0.0 <= out["macroF1"] <= 1.0, est

@@ -68,6 +68,12 @@ def test_multiclass_rejected_and_ovr_works(mesh8):
     ovr = OneVsRest(classifier=LinearSVC(mesh=mesh8, regParam=0.01), mesh=mesh8).fit(f)
     acc = (np.asarray(ovr.transform(f)["prediction"]) == y).mean()
     assert acc > 0.85
+    # fused serving path engages for homogeneous SVC sub-models and
+    # matches the per-model loop
+    assert ovr._fused_raw() is not None
+    fused = ovr._raw_predict(X)
+    loop = np.stack([m._raw_predict(X)[:, 1] for m in ovr.models], axis=1)
+    np.testing.assert_allclose(fused, loop, atol=1e-4)
 
 
 def test_standardization_flag_and_save_load(mesh8, tmp_path):
