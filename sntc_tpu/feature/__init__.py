@@ -15,6 +15,12 @@ from sntc_tpu.feature.scalers import (
     Normalizer,
 )
 from sntc_tpu.feature.pca import PCA, PCAModel
+from sntc_tpu.feature.discretizers import (
+    Bucketizer,
+    Imputer,
+    ImputerModel,
+    QuantileDiscretizer,
+)
 
 __all__ = [
     "VectorAssembler",
@@ -35,4 +41,8 @@ __all__ = [
     "Binarizer",
     "PCA",
     "PCAModel",
+    "Bucketizer",
+    "QuantileDiscretizer",
+    "Imputer",
+    "ImputerModel",
 ]

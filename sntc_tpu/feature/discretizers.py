@@ -1,0 +1,202 @@
+"""Bucketizer / QuantileDiscretizer / Imputer.
+
+Behavioral spec: upstream ``ml/feature/{Bucketizer,QuantileDiscretizer,
+Imputer}.scala`` [U]:
+
+  * Bucketizer: stateless mapping of a scalar column into bucket indices
+    by explicit ``splits`` (len ≥ 3, strictly increasing; −inf/+inf
+    allowed).  ``handleInvalid`` governs NaN ONLY — error (default) /
+    keep (extra bucket) / skip; values outside [splits[0], splits[-1]]
+    always raise, exactly as Spark's Bucketizer does.
+  * QuantileDiscretizer: fit learns ``numBuckets`` quantile splits of the
+    input column (duplicate quantiles collapse, like Spark's
+    approxQuantile path), producing a ``Bucketizer``-shaped model.
+  * Imputer: fit learns per-column mean or median of the non-missing
+    values; transform replaces ``missingValue`` (default NaN) with it.
+    Multi-column (``inputCols``/``outputCols``) like Spark 2.2+.
+
+TPU note: these are host-side column ops (one pass each over 1-D
+columns); they prepare data for the device-resident stages and need no
+SPMD machinery — matching SURVEY.md §1's "host relational work stays on
+the host data plane".
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from sntc_tpu.core.base import Estimator, Model, Transformer
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+
+
+def _bucketize(
+    values: np.ndarray, splits: np.ndarray, handle_invalid: str, what: str
+):
+    """(indices f64, keep-mask) under Spark Bucketizer semantics: buckets
+    are [s_i, s_{i+1}) with the LAST bucket closed on the right;
+    ``handleInvalid`` applies to NaN only — out-of-range values always
+    raise (Spark: "values outside the splits are always treated as
+    errors")."""
+    n_buckets = len(splits) - 1
+    idx = np.searchsorted(splits, values, side="right") - 1.0
+    idx = np.where(values == splits[-1], n_buckets - 1.0, idx)
+    nan = np.isnan(values)
+    out_of_range = (~nan) & ((values < splits[0]) | (values > splits[-1]))
+    if out_of_range.any():
+        raise ValueError(
+            f"{what}: value outside the splits range "
+            f"[{splits[0]}, {splits[-1]}] (use -inf/+inf end splits for "
+            "open-ended buckets)"
+        )
+    if nan.any():
+        if handle_invalid == "error":
+            raise ValueError(
+                f"{what}: NaN values in the input column (set "
+                "handleInvalid='keep' or 'skip')"
+            )
+        if handle_invalid == "keep":
+            return np.where(nan, float(n_buckets), idx), None
+        return idx, ~nan  # skip
+    return idx, None
+
+
+class Bucketizer(Model):
+    """Explicit-splits binning — stateless (a Model so QuantileDiscretizer
+    can return it from fit, exactly as Spark does)."""
+
+    inputCol = Param("input scalar column", default="input")
+    outputCol = Param("output bucket-index column", default="bucketed")
+    splits = Param(
+        "strictly-increasing bucket boundaries (len >= 3; use -inf/+inf "
+        "for open ends)",
+        default=None,
+    )
+    handleInvalid = Param(
+        "NaN handling: error | keep (extra bucket) | skip (drop rows); "
+        "out-of-range values always error (Spark semantics)",
+        default="error",
+        validator=validators.one_of("error", "keep", "skip"),
+    )
+
+    def _splits(self) -> np.ndarray:
+        s = self.getSplits()
+        if s is None or len(s) < 3:
+            raise ValueError("splits must have at least 3 boundaries")
+        arr = np.asarray(s, np.float64)
+        if not np.all(np.diff(arr) > 0):
+            raise ValueError("splits must be strictly increasing")
+        return arr
+
+    def transform(self, frame: Frame) -> Frame:
+        splits = self._splits()
+        values = np.asarray(frame[self.getInputCol()], np.float64)
+        idx, keep = _bucketize(
+            values, splits, self.getHandleInvalid(), "Bucketizer"
+        )
+        out = frame.with_column(self.getOutputCol(), idx)
+        return out if keep is None else out.filter(keep)
+
+
+class QuantileDiscretizer(Estimator):
+    inputCol = Param("input scalar column", default="input")
+    outputCol = Param("output bucket-index column", default="bucketed")
+    numBuckets = Param(
+        "number of quantile buckets", default=2, validator=validators.gt(1)
+    )
+    handleInvalid = Param(
+        "out-of-range/NaN handling: error | keep | skip",
+        default="error",
+        validator=validators.one_of("error", "keep", "skip"),
+    )
+
+    def _fit(self, frame: Frame) -> "Bucketizer":
+        values = np.asarray(frame[self.getInputCol()], np.float64)
+        values = values[~np.isnan(values)]
+        if values.size == 0:
+            raise ValueError(
+                f"QuantileDiscretizer: column {self.getInputCol()!r} has "
+                "no non-NaN values to fit quantiles on"
+            )
+        qs = np.linspace(0.0, 1.0, self.getNumBuckets() + 1)[1:-1]
+        inner = np.unique(np.quantile(values, qs))
+        splits = np.concatenate([[-np.inf], inner, [np.inf]])
+        model = Bucketizer(
+            inputCol=self.getInputCol(),
+            outputCol=self.getOutputCol(),
+            splits=[float(v) for v in splits],
+            handleInvalid=self.getHandleInvalid(),
+        )
+        return model
+
+
+class _ImputerParams:
+    inputCols = Param("input scalar columns", default=None)
+    outputCols = Param("output columns (same length)", default=None)
+    strategy = Param(
+        "mean | median", default="mean",
+        validator=validators.one_of("mean", "median"),
+    )
+    missingValue = Param(
+        "the value treated as missing (NaN compares by isnan)",
+        default=float("nan"),
+    )
+
+
+class Imputer(_ImputerParams, Estimator):
+    def _cols(self):
+        ins = self.getInputCols()
+        outs = self.getOutputCols()
+        if not ins:
+            raise ValueError("inputCols is required")
+        outs = outs or ins
+        if len(ins) != len(outs):
+            raise ValueError("inputCols and outputCols lengths differ")
+        return ins, outs
+
+    def _fit(self, frame: Frame) -> "ImputerModel":
+        ins, outs = self._cols()
+        mv = float(self.getMissingValue())
+        surrogates = []
+        for c in ins:
+            v = np.asarray(frame[c], np.float64)
+            ok = ~np.isnan(v) if np.isnan(mv) else (v != mv) & ~np.isnan(v)
+            good = v[ok]
+            if good.size == 0:
+                raise ValueError(f"Imputer: column {c!r} has no valid values")
+            surrogates.append(
+                float(np.mean(good))
+                if self.getStrategy() == "mean"
+                else float(np.median(good))
+            )
+        model = ImputerModel(surrogates=surrogates)
+        model.setParams(**self.paramValues())
+        return model
+
+
+class ImputerModel(_ImputerParams, Model):
+    def __init__(self, surrogates: Sequence[float] = (), **kwargs):
+        super().__init__(**kwargs)
+        self.surrogates = [float(v) for v in surrogates]
+
+    def _save_extra(self):
+        return {"surrogates": self.surrogates}, {}
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        m = cls(surrogates=extra["surrogates"])
+        m.setParams(**params)
+        return m
+
+    def transform(self, frame: Frame) -> Frame:
+        ins = self.getOrDefault("inputCols")
+        outs = self.getOrDefault("outputCols") or ins
+        mv = float(self.getOrDefault("missingValue"))
+        out = frame
+        for c, o, s in zip(ins, outs, self.surrogates):
+            v = np.asarray(out[c], np.float64)
+            miss = np.isnan(v) if np.isnan(mv) else (v == mv) | np.isnan(v)
+            out = out.with_column(o, np.where(miss, s, v))
+        return out

@@ -430,3 +430,76 @@ def test_ufs_rejects_fractional_top_k(mesh8):
             mesh=mesh8, featureType="continuous", labelType="categorical",
             selectionMode="numTopFeatures", selectionThreshold=2.7,
         ).fit(f)
+
+
+# ---------------- Bucketizer / QuantileDiscretizer / Imputer ----------------
+
+def test_bucketizer_spark_semantics():
+    from sntc_tpu.feature import Bucketizer
+
+    f = Frame({"x": np.array([-1.0, 0.0, 0.5, 1.0, 2.0, 3.0])})
+    b = Bucketizer(inputCol="x", outputCol="b", splits=[0.0, 1.0, 2.0, 3.0])
+    # out-of-range ALWAYS errors regardless of handleInvalid (Spark)
+    with pytest.raises(ValueError, match="outside the splits"):
+        b.transform(f)
+    with pytest.raises(ValueError, match="outside the splits"):
+        b.copy({"handleInvalid": "keep"}).transform(f)
+    fin = Frame({"x": np.array([0.0, 0.5, 1.0, 2.0, 3.0, np.nan])})
+    with pytest.raises(ValueError, match="NaN"):
+        b.transform(fin)
+    kept = b.copy({"handleInvalid": "keep"}).transform(fin)
+    # last bucket closed on the right: 3.0 -> bucket 2; NaN -> extra 3
+    np.testing.assert_array_equal(kept["b"], [0.0, 0.0, 1.0, 2.0, 2.0, 3.0])
+    skipped = b.copy({"handleInvalid": "skip"}).transform(fin)
+    assert skipped.num_rows == 5
+    with pytest.raises(ValueError, match="strictly increasing"):
+        Bucketizer(inputCol="x", outputCol="b", splits=[0.0, 0.0, 1.0]).transform(f)
+
+
+def test_quantile_discretizer_matches_quantiles():
+    from sntc_tpu.feature import QuantileDiscretizer
+
+    with pytest.raises(ValueError, match="no non-NaN values"):
+        QuantileDiscretizer(inputCol="x", numBuckets=3).fit(
+            Frame({"x": np.array([np.nan, np.nan])})
+        )
+
+    rng = np.random.default_rng(15)
+    x = rng.normal(size=5000)
+    f = Frame({"x": x})
+    model = QuantileDiscretizer(
+        inputCol="x", outputCol="q", numBuckets=4
+    ).fit(f)
+    out = model.transform(f)
+    counts = np.bincount(np.asarray(out["q"], np.int64))
+    # quartile buckets are balanced
+    assert counts.size == 4 and counts.min() > 0.2 * len(x)
+    # open ends: extreme values don't error
+    far = model.transform(Frame({"x": np.array([-1e9, 1e9])}))
+    np.testing.assert_array_equal(far["q"], [0.0, 3.0])
+
+
+def test_imputer_mean_median_roundtrip(tmp_path):
+    from sntc_tpu.feature import Imputer
+    from sntc_tpu.mlio import load_model, save_model
+
+    a = np.array([1.0, np.nan, 3.0, np.nan])
+    b = np.array([10.0, 20.0, -1.0, 40.0])
+    f = Frame({"a": a, "b": b})
+    m = Imputer(inputCols=["a", "b"], outputCols=["a2", "b2"]).fit(f)
+    out = m.transform(f)
+    np.testing.assert_allclose(out["a2"], [1.0, 2.0, 3.0, 2.0])
+    np.testing.assert_allclose(out["b2"], b)  # no NaN in b
+    med = Imputer(
+        inputCols=["b"], strategy="median", missingValue=-1.0
+    ).fit(f)
+    np.testing.assert_allclose(
+        med.surrogates, [np.median([10.0, 20.0, 40.0])]
+    )
+    out2 = med.transform(f)
+    assert out2["b"][2] == 20.0
+    save_model(m, str(tmp_path / "imp"))
+    m2 = load_model(str(tmp_path / "imp"))
+    np.testing.assert_allclose(
+        np.asarray(m2.transform(f)["a2"]), np.asarray(out["a2"])
+    )
