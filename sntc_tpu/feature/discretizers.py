@@ -32,6 +32,12 @@ from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
 
 
+def _missing_mask(v: np.ndarray, mv: float) -> np.ndarray:
+    """True where a value counts as missing — ONE definition shared by
+    Imputer fit (complement) and ImputerModel transform."""
+    return np.isnan(v) if np.isnan(mv) else (v == mv) | np.isnan(v)
+
+
 def _bucketize(
     values: np.ndarray, splits: np.ndarray, handle_invalid: str, what: str
 ):
@@ -162,8 +168,7 @@ class Imputer(_ImputerParams, Estimator):
         surrogates = []
         for c in ins:
             v = np.asarray(frame[c], np.float64)
-            ok = ~np.isnan(v) if np.isnan(mv) else (v != mv) & ~np.isnan(v)
-            good = v[ok]
+            good = v[~_missing_mask(v, mv)]
             if good.size == 0:
                 raise ValueError(f"Imputer: column {c!r} has no valid values")
             surrogates.append(
@@ -197,6 +202,6 @@ class ImputerModel(_ImputerParams, Model):
         out = frame
         for c, o, s in zip(ins, outs, self.surrogates):
             v = np.asarray(out[c], np.float64)
-            miss = np.isnan(v) if np.isnan(mv) else (v == mv) | np.isnan(v)
+            miss = _missing_mask(v, mv)
             out = out.with_column(o, np.where(miss, s, v))
         return out

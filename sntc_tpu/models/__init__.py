@@ -16,6 +16,7 @@ from sntc_tpu.models.tree import (
     RandomForestClassifier,
     RandomForestClassificationModel,
 )
+from sntc_tpu.models.linear_regression import LinearRegression, LinearRegressionModel
 from sntc_tpu.models.linear_svc import LinearSVC, LinearSVCModel
 from sntc_tpu.models.naive_bayes import NaiveBayes, NaiveBayesModel
 from sntc_tpu.models.one_vs_rest import OneVsRest, OneVsRestModel
@@ -29,6 +30,8 @@ __all__ = [
     "DecisionTreeClassificationModel",
     "DecisionTreeRegressor",
     "DecisionTreeRegressionModel",
+    "LinearRegression",
+    "LinearRegressionModel",
     "LinearSVC",
     "LinearSVCModel",
     "NaiveBayes",

@@ -257,12 +257,12 @@ def _lr_summarize_folds(xs, ys, ws_b, k):
     return jax.vmap(lambda ws: _lr_summarize_impl(xs, ys, ws, k))(ws_b)
 
 
-class LogisticRegressionSummary:
-    """Training summary (the ``LogisticRegressionTrainingSummary`` analog)."""
+from sntc_tpu.models.summary import TrainingSummary
 
-    def __init__(self, objective_history, total_iterations: int):
-        self.objectiveHistory = [float(v) for v in objective_history]
-        self.totalIterations = int(total_iterations)
+
+class LogisticRegressionSummary(TrainingSummary):
+    """Training summary (the ``LogisticRegressionTrainingSummary`` analog —
+    the shared :class:`TrainingSummary` under its Spark-parity name)."""
 
 
 class _LrParams:

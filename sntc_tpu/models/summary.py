@@ -1,0 +1,14 @@
+"""Shared training-summary container (the ``TrainingSummary`` analog).
+
+One generic (objectiveHistory, totalIterations) record used by every
+iteratively-fitted model — LogisticRegression keeps its Spark-named
+alias for API parity (``LogisticRegressionTrainingSummary`` upstream).
+"""
+
+from __future__ import annotations
+
+
+class TrainingSummary:
+    def __init__(self, objective_history, total_iterations: int):
+        self.objectiveHistory = [float(v) for v in objective_history]
+        self.totalIterations = int(total_iterations)

@@ -1,0 +1,115 @@
+"""LinearRegression oracle tests vs sklearn (same objective family)."""
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.evaluation import RegressionEvaluator
+from sntc_tpu.mlio import load_model, save_model
+from sntc_tpu.models import LinearRegression
+
+
+def _data(seed=0, n=3000, d=8, noise=0.3):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=d)
+    X = rng.normal(size=(n, d)).astype(np.float32) * rng.uniform(0.5, 3, d)
+    y = (X @ w + 1.7 + noise * rng.normal(size=n)).astype(np.float32)
+    return Frame({"features": X, "label": y}), X, y, w
+
+
+def test_ols_matches_sklearn_exactly(mesh8):
+    from sklearn.linear_model import LinearRegression as SkOLS
+
+    f, X, y, w = _data()
+    m = LinearRegression(mesh=mesh8).fit(f)  # auto -> normal solver
+    sk = SkOLS().fit(X, y)
+    np.testing.assert_allclose(m.coefficients, sk.coef_, atol=1e-4)
+    assert m.intercept == pytest.approx(sk.intercept_, abs=1e-3)
+    pred = m.transform(f)
+    r2 = RegressionEvaluator(metricName="r2").evaluate(pred)
+    assert r2 > 0.98
+
+
+def test_ridge_matches_sklearn(mesh8):
+    from sklearn.linear_model import Ridge
+
+    f, X, y, w = _data(seed=1)
+    lam = 0.1
+    m = LinearRegression(
+        mesh=mesh8, regParam=lam, standardization=False
+    ).fit(f)
+    # Spark objective 1/(2n)||r||^2 + lam/2||w||^2  == sklearn Ridge with
+    # alpha = n * lam on the same unscaled loss
+    sk = Ridge(alpha=len(y) * lam).fit(X, y)
+    np.testing.assert_allclose(m.coefficients, sk.coef_, rtol=1e-4, atol=1e-5)
+    assert m.intercept == pytest.approx(sk.intercept_, abs=1e-3)
+
+
+def test_elastic_net_matches_sklearn(mesh8):
+    from sklearn.linear_model import ElasticNet
+
+    f, X, y, w = _data(seed=2)
+    lam, alpha = 0.05, 0.5
+    m = LinearRegression(
+        mesh=mesh8, regParam=lam, elasticNetParam=alpha,
+        standardization=False, maxIter=300, tol=1e-9,
+    ).fit(f)
+    sk = ElasticNet(alpha=lam, l1_ratio=alpha, max_iter=50000, tol=1e-10).fit(X, y)
+    np.testing.assert_allclose(m.coefficients, sk.coef_, atol=2e-3)
+    assert m.intercept == pytest.approx(sk.intercept_, abs=5e-3)
+    # lasso component produces genuine sparsity agreement
+    assert np.array_equal(
+        np.abs(m.coefficients) < 1e-6, np.abs(sk.coef_) < 1e-6
+    )
+
+
+def test_solver_rules_and_no_intercept(mesh8):
+    from sklearn.linear_model import LinearRegression as SkOLS
+
+    f, X, y, w = _data(seed=3)
+    with pytest.raises(ValueError, match="no L1"):
+        LinearRegression(
+            mesh=mesh8, solver="normal", regParam=0.1, elasticNetParam=0.5
+        ).fit(f)
+    m = LinearRegression(mesh=mesh8, fitIntercept=False).fit(f)
+    sk = SkOLS(fit_intercept=False).fit(X, y)
+    np.testing.assert_allclose(m.coefficients, sk.coef_, atol=1e-3)
+    assert m.intercept == 0.0
+    # l-bfgs solver agrees with the normal solver
+    m2 = LinearRegression(mesh=mesh8, solver="l-bfgs", maxIter=300).fit(f)
+    mn = LinearRegression(mesh=mesh8, solver="normal").fit(f)
+    np.testing.assert_allclose(m2.coefficients, mn.coefficients, atol=2e-3)
+
+
+def test_weights_and_save_load(mesh8, tmp_path):
+    from sklearn.linear_model import LinearRegression as SkOLS
+
+    f, X, y, w_true = _data(seed=4)
+    rng = np.random.default_rng(9)
+    w = rng.uniform(0.2, 2.0, size=len(y)).astype(np.float32)
+    fw = Frame({"features": X, "label": y, "w": w})
+    m = LinearRegression(mesh=mesh8, weightCol="w").fit(fw)
+    sk = SkOLS().fit(X, y, sample_weight=w)
+    np.testing.assert_allclose(m.coefficients, sk.coef_, atol=1e-3)
+    save_model(m, str(tmp_path / "lin"))
+    m2 = load_model(str(tmp_path / "lin"))
+    np.testing.assert_allclose(m2.coefficients, m.coefficients)
+    np.testing.assert_allclose(
+        np.asarray(m2.transform(f)["prediction"]),
+        np.asarray(m.transform(f)["prediction"]),
+    )
+
+
+def test_singular_gram_falls_back_to_lstsq(mesh8):
+    """Duplicated + constant features: the normal solver must not crash
+    (minimum-norm lstsq fallback) and predictions stay accurate."""
+    rng = np.random.default_rng(6)
+    n = 2000
+    a = rng.normal(size=n).astype(np.float32)
+    X = np.stack([a, a, np.full(n, 7.0, np.float32)], axis=1)  # dup + const
+    y = 2.0 * a + 1.0
+    f = Frame({"features": X, "label": y.astype(np.float32)})
+    m = LinearRegression(mesh=mesh8).fit(f)  # auto -> normal, singular
+    pred = np.asarray(m.transform(f)["prediction"])
+    assert np.sqrt(np.mean((pred - y) ** 2)) < 1e-2
+    assert isinstance(m.summary.objectiveHistory, list)
