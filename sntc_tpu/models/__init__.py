@@ -15,6 +15,8 @@ from sntc_tpu.models.tree import (
     GBTClassificationModel,
     RandomForestClassifier,
     RandomForestClassificationModel,
+    RandomForestRegressor,
+    RandomForestRegressionModel,
 )
 from sntc_tpu.models.linear_regression import LinearRegression, LinearRegressionModel
 from sntc_tpu.models.linear_svc import LinearSVC, LinearSVCModel
@@ -24,6 +26,8 @@ from sntc_tpu.models.one_vs_rest import OneVsRest, OneVsRestModel
 __all__ = [
     "RandomForestClassifier",
     "RandomForestClassificationModel",
+    "RandomForestRegressor",
+    "RandomForestRegressionModel",
     "GBTClassifier",
     "GBTClassificationModel",
     "DecisionTreeClassifier",

@@ -146,6 +146,27 @@ class LinearRegression(_LinRegParams, Estimator):
 
         xs, ys, _ = shard_batch(mesh, X, y)
         ws = shard_weights(mesh, w, xs.shape[0])
+        fit_b = self.getFitIntercept()
+        if not use_normal:
+            # the iterative path needs only count/mean/var — the lighter
+            # moments pass, not the O(N·D²) Gram the normal solver uses
+            from sntc_tpu.feature.standard_scaler import (
+                standardization_moments,
+            )
+
+            n_w, mean, var = standardization_moments(
+                mesh, xs, ws,
+                np.asarray(X[0]) if X.shape[0] else np.zeros(d),
+            )
+            std = np.sqrt(np.maximum(var, 0.0))
+            inv_std = np.divide(
+                1.0, std, out=np.ones_like(std), where=std > 0
+            )
+            y_mean = float(np.average(y, weights=w)) if len(y) else 0.0
+            pen = np.ones(d) if self.getStandardization() else inv_std**2
+            return self._fit_lbfgs(
+                xs, ys, ws, inv_std, mean, y_mean, lam, alpha, pen, d, fit_b
+            )
         px = np.asarray(X[0], np.float32) if X.shape[0] else np.zeros(d, np.float32)
         qy = np.float32(y[0]) if len(y) else np.float32(0.0)
         m = _normal_agg(mesh)(xs, ys, ws, jnp.asarray(px), qy)
@@ -164,45 +185,36 @@ class LinearRegression(_LinRegParams, Estimator):
         var = np.maximum(np.diag(gram_c) / n, 0.0)
         std = np.sqrt(var)
         inv_std = np.divide(1.0, std, out=np.ones_like(std), where=std > 0)
-        # penalty space: standardized coefs when standardization=True,
-        # original-space otherwise (weight by std² in standardized space)
-        pen = np.ones(d) if self.getStandardization() else inv_std**2
-
-        fit_b = self.getFitIntercept()
-        if use_normal:
-            # [D, D] host f64 solve of the (regularized) normal equations;
-            # penalty in ORIGINAL coefficient space: λ·std²
-            # (standardization=True penalizes θ = w·std) or λ·I
-            pen_orig = std**2 if self.getStandardization() else np.ones(d)
-            if fit_b:
-                A = gram_c / n
-                b_vec = xy_c / n
-            else:
-                # uncentered moments from the centered ones, exactly:
-                # Σw·x·xᵀ = gram_c + n·μμᵀ ;  Σw·x·y = xy_c + n·ȳ·μ
-                A = gram_c / n + np.outer(mean, mean)
-                b_vec = xy_c / n + y_mean * mean
-            A_reg = A + lam * np.diag(pen_orig)
-            try:
-                coef = np.linalg.solve(A_reg, b_vec)
-            except np.linalg.LinAlgError:
-                # singular Gram (duplicated/constant features): take the
-                # minimum-norm least-squares solution — the Spark auto
-                # solver's own fallback behavior
-                coef = np.linalg.lstsq(A_reg, b_vec, rcond=None)[0]
-            intercept = y_mean - float(mean @ coef) if fit_b else 0.0
-            model = LinearRegressionModel(
-                coefficients=coef, intercept=intercept
-            )
-            model.setParams(
-                **{k2: v for k2, v in self.paramValues().items()
-                   if model.hasParam(k2)}
-            )
-            model.summary = TrainingSummary([0.0], 0)
-            return model
-        return self._fit_lbfgs(
-            xs, ys, ws, inv_std, mean, y_mean, lam, alpha, pen, d, fit_b
+        # [D, D] host f64 solve of the (regularized) normal equations;
+        # penalty in ORIGINAL coefficient space: λ·std²
+        # (standardization=True penalizes θ = w·std) or λ·I
+        pen_orig = std**2 if self.getStandardization() else np.ones(d)
+        if fit_b:
+            A = gram_c / n
+            b_vec = xy_c / n
+        else:
+            # uncentered moments from the centered ones, exactly:
+            # Σw·x·xᵀ = gram_c + n·μμᵀ ;  Σw·x·y = xy_c + n·ȳ·μ
+            A = gram_c / n + np.outer(mean, mean)
+            b_vec = xy_c / n + y_mean * mean
+        A_reg = A + lam * np.diag(pen_orig)
+        try:
+            coef = np.linalg.solve(A_reg, b_vec)
+        except np.linalg.LinAlgError:
+            # singular Gram (duplicated/constant features): take the
+            # minimum-norm least-squares solution — the Spark auto
+            # solver's own fallback behavior
+            coef = np.linalg.lstsq(A_reg, b_vec, rcond=None)[0]
+        intercept = y_mean - float(mean @ coef) if fit_b else 0.0
+        model = LinearRegressionModel(
+            coefficients=coef, intercept=intercept
         )
+        model.setParams(
+            **{k2: v for k2, v in self.paramValues().items()
+               if model.hasParam(k2)}
+        )
+        model.summary = TrainingSummary([0.0], 0)
+        return model
 
     def _fit_lbfgs(
         self, xs, ys, ws, inv_std, mean, y_mean, lam, alpha, pen, d, fit_b

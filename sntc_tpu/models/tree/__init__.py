@@ -3,6 +3,10 @@ from sntc_tpu.models.tree.random_forest import (
     RandomForestClassificationModel,
 )
 from sntc_tpu.models.tree.gbt import GBTClassifier, GBTClassificationModel
+from sntc_tpu.models.tree.random_forest_regressor import (
+    RandomForestRegressor,
+    RandomForestRegressionModel,
+)
 from sntc_tpu.models.tree.decision_tree import (
     DecisionTreeClassifier,
     DecisionTreeClassificationModel,
@@ -15,6 +19,8 @@ __all__ = [
     "RandomForestClassificationModel",
     "GBTClassifier",
     "GBTClassificationModel",
+    "RandomForestRegressor",
+    "RandomForestRegressionModel",
     "DecisionTreeClassifier",
     "DecisionTreeClassificationModel",
     "DecisionTreeRegressor",

@@ -34,6 +34,7 @@ from sntc_tpu.models.base import (
 from sntc_tpu.models.tree.grower import (
     Forest,
     ForestDeviceMixin,
+    ForestPersistenceMixin,
     forest_leaf_stats,
     grow_forest,
 )
@@ -140,7 +141,8 @@ def _dt_serve(X, feature, threshold, leaf_stats, thr, *, max_depth, mode):
 
 
 class DecisionTreeClassificationModel(
-    _DtClassifierParams, ForestDeviceMixin, ClassificationModel
+    _DtClassifierParams, ForestPersistenceMixin, ForestDeviceMixin,
+    ClassificationModel,
 ):
     def __init__(self, forest: Forest, n_classes: int, n_features: int = 0,
                  **kwargs):
@@ -157,11 +159,6 @@ class DecisionTreeClassificationModel(
     def depth(self) -> int:
         return _realized_depth(self.forest)
 
-    @property
-    def featureImportances(self) -> np.ndarray:
-        n = self._n_features or int(self.forest.feature.max()) + 1
-        return self.forest.feature_importances(n)
-
     def _predict_all_dev(self, X: np.ndarray):
         mode, thr = self._threshold_mode()
         return _dt_serve(
@@ -172,36 +169,16 @@ class DecisionTreeClassificationModel(
             mode=mode,
         )
 
-    def _save_extra(self):
-        return (
-            {
-                "n_classes": self._n_classes,
-                "max_depth": self.forest.max_depth,
-                "n_features": self._n_features,
-            },
-            {
-                "feature": self.forest.feature,
-                "threshold": self.forest.threshold,
-                "leaf_stats": self.forest.leaf_stats,
-                "gain": self.forest.gain,
-                "count": self.forest.count,
-            },
-        )
+    def _extra_meta(self):
+        return {"n_classes": self._n_classes}
 
     @classmethod
-    def _load_from(cls, params, extra, arrays):
-        forest = Forest(
-            arrays["feature"], arrays["threshold"], arrays["leaf_stats"],
-            int(extra["max_depth"]),
-            arrays.get("gain"), arrays.get("count"),
-        )
-        m = cls(
+    def _from_forest(cls, forest, extra):
+        return cls(
             forest=forest,
             n_classes=int(extra["n_classes"]),
             n_features=int(extra.get("n_features", 0)),
         )
-        m.setParams(**params)
-        return m
 
     def _raw_predict(self, X: np.ndarray) -> np.ndarray:
         # Spark DT rawPrediction is the leaf's class-count vector
@@ -260,7 +237,7 @@ def _dt_reg_predict(X, feature, threshold, leaf_stats, *, max_depth):
 
 
 class DecisionTreeRegressionModel(
-    _DtRegressorParams, ForestDeviceMixin, Model
+    _DtRegressorParams, ForestPersistenceMixin, ForestDeviceMixin, Model
 ):
     def __init__(self, forest: Forest, n_features: int = 0, **kwargs):
         super().__init__(**kwargs)
@@ -270,34 +247,6 @@ class DecisionTreeRegressionModel(
     @property
     def depth(self) -> int:
         return _realized_depth(self.forest)
-
-    @property
-    def featureImportances(self) -> np.ndarray:
-        n = self._n_features or int(self.forest.feature.max()) + 1
-        return self.forest.feature_importances(n)
-
-    def _save_extra(self):
-        return (
-            {"max_depth": self.forest.max_depth, "n_features": self._n_features},
-            {
-                "feature": self.forest.feature,
-                "threshold": self.forest.threshold,
-                "leaf_stats": self.forest.leaf_stats,
-                "gain": self.forest.gain,
-                "count": self.forest.count,
-            },
-        )
-
-    @classmethod
-    def _load_from(cls, params, extra, arrays):
-        forest = Forest(
-            arrays["feature"], arrays["threshold"], arrays["leaf_stats"],
-            int(extra["max_depth"]),
-            arrays.get("gain"), arrays.get("count"),
-        )
-        m = cls(forest=forest, n_features=int(extra.get("n_features", 0)))
-        m.setParams(**params)
-        return m
 
     def predict(self, X: np.ndarray) -> np.ndarray:
         return np.asarray(

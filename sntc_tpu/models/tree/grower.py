@@ -90,6 +90,73 @@ def heap_offset(depth: int) -> int:
     return (1 << depth) - 1
 
 
+class ForestPersistenceMixin:
+    """Shared save/load payload + featureImportances for every model that
+    is just a dense-heap forest plus ``_n_features`` (DT/RF, both tasks).
+    Subclasses with extra identity (the classifiers' ``n_classes``)
+    override ``_extra_meta``/``_from_forest``."""
+
+    _per_tree_normalization = True  # RF semantics; GBT passes False
+
+    def _extra_meta(self) -> dict:
+        return {}
+
+    @classmethod
+    def _from_forest(cls, forest: "Forest", extra: dict):
+        return cls(forest=forest, n_features=int(extra.get("n_features", 0)))
+
+    def _save_extra(self):
+        meta = {
+            "max_depth": self.forest.max_depth,
+            "n_features": self._n_features,
+        }
+        meta.update(self._extra_meta())
+        return meta, {
+            "feature": self.forest.feature,
+            "threshold": self.forest.threshold,
+            "leaf_stats": self.forest.leaf_stats,
+            "gain": self.forest.gain,
+            "count": self.forest.count,
+        }
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        forest = Forest(
+            arrays["feature"], arrays["threshold"], arrays["leaf_stats"],
+            int(extra["max_depth"]),
+            arrays.get("gain"), arrays.get("count"),
+        )
+        m = cls._from_forest(forest, extra)
+        m.setParams(**params)
+        return m
+
+    @property
+    def featureImportances(self) -> np.ndarray:
+        n = self._n_features or int(self.forest.feature.max()) + 1
+        return self.forest.feature_importances(
+            n, per_tree_normalization=self._per_tree_normalization
+        )
+
+
+def make_bagging_weights(rng, bootstrap: bool, rate: float, T: int, n: int,
+                         mesh):
+    """Per-tree row weights, device-put sharded on the row axis — the ONE
+    definition of the Spark bagging semantics (Poisson(subsamplingRate)
+    with replacement; Bernoulli masks without — a documented deviation
+    from Spark's exact sampling) shared by both forests."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if bootstrap:
+        w = rng.poisson(rate, size=(T, n)).astype(np.float32)
+    elif rate < 1.0:
+        w = (rng.random((T, n)) < rate).astype(np.float32)
+    else:
+        w = np.ones((T, n), np.float32)
+    return jax.device_put(
+        w, NamedSharding(mesh, P(None, mesh.axis_names[0]))
+    )
+
+
 class ForestDeviceMixin:
     """Lazy device-resident copies of the dense forest tensors: model
     parameters upload once per process, not once per serving micro-batch

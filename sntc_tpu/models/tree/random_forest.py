@@ -29,8 +29,10 @@ from sntc_tpu.models.base import ClassificationModel, ClassifierEstimator
 from sntc_tpu.models.tree.grower import (
     Forest,
     ForestDeviceMixin,
+    ForestPersistenceMixin,
     forest_leaf_stats,
     grow_forest,
+    make_bagging_weights,
     resolve_feature_subset_k,
 )
 from sntc_tpu.ops.binning import bin_features, quantile_bin_edges
@@ -91,16 +93,9 @@ class RandomForestClassifier(_RfParams, ClassifierEstimator):
         binned = bin_features(xs, jnp.asarray(edges))
         row_stats = _one_hot_stats(ys, ws, k)
 
-        rng = np.random.default_rng(self.getSeed())
-        rate = self.getSubsamplingRate()
-        if self.getBootstrap():
-            w_trees = rng.poisson(rate, size=(T, xs.shape[0])).astype(np.float32)
-        elif rate < 1.0:
-            w_trees = (rng.random((T, xs.shape[0])) < rate).astype(np.float32)
-        else:
-            w_trees = np.ones((T, xs.shape[0]), np.float32)
-        w_trees = jax.device_put(
-            w_trees, NamedSharding(mesh, P(None, axis))
+        w_trees = make_bagging_weights(
+            np.random.default_rng(self.getSeed()), self.getBootstrap(),
+            self.getSubsamplingRate(), T, xs.shape[0], mesh,
         )
 
         subset_k = resolve_feature_subset_k(
@@ -149,7 +144,9 @@ def _rf_serve(X, feature, threshold, leaf_stats, thr, *, max_depth, mode):
     return pack_serve_outputs(raw, prob, thr, mode)
 
 
-class RandomForestClassificationModel(_RfParams, ForestDeviceMixin, ClassificationModel):
+class RandomForestClassificationModel(
+    _RfParams, ForestPersistenceMixin, ForestDeviceMixin, ClassificationModel
+):
     def __init__(self, forest: Forest, n_classes: int, n_features: int = 0,
                  **kwargs):
         super().__init__(**kwargs)
@@ -165,41 +162,16 @@ class RandomForestClassificationModel(_RfParams, ForestDeviceMixin, Classificati
     def trees(self) -> Forest:
         return self.forest
 
-    def _save_extra(self):
-        return (
-            {
-                "n_classes": self._n_classes,
-                "max_depth": self.forest.max_depth,
-                "n_features": self._n_features,
-            },
-            {
-                "feature": self.forest.feature,
-                "threshold": self.forest.threshold,
-                "leaf_stats": self.forest.leaf_stats,
-                "gain": self.forest.gain,
-                "count": self.forest.count,
-            },
-        )
+    def _extra_meta(self):
+        return {"n_classes": self._n_classes}
 
     @classmethod
-    def _load_from(cls, params, extra, arrays):
-        forest = Forest(
-            arrays["feature"], arrays["threshold"], arrays["leaf_stats"],
-            int(extra["max_depth"]),
-            arrays.get("gain"), arrays.get("count"),
-        )
-        m = cls(
+    def _from_forest(cls, forest, extra):
+        return cls(
             forest=forest,
             n_classes=int(extra["n_classes"]),
             n_features=int(extra.get("n_features", 0)),
         )
-        m.setParams(**params)
-        return m
-
-    @property
-    def featureImportances(self) -> np.ndarray:
-        n = self._n_features or int(self.forest.feature.max()) + 1
-        return self.forest.feature_importances(n)
 
     def _raw_predict(self, X: np.ndarray) -> np.ndarray:
         return np.asarray(

@@ -1,0 +1,142 @@
+"""RandomForestRegressor — averaged variance-impurity CART forest.
+
+Behavioral spec: upstream ``ml/regression/RandomForestRegressor.scala``
+[U]: the classification forest's machinery (quantile binning, Poisson
+bagging, level-wise all-trees-per-pass growth, ``featureSubsetStrategy``
+— whose ``auto`` default is onethird for regression) with variance
+impurity and ``prediction = mean over trees of the leaf mean``.
+
+TPU design: identical to RandomForestClassifier — the shared dense-heap
+grower with regression stats ``[w, wy, wy²]`` (sntc_tpu/models/tree/
+grower.py); serving is one traversal + mean, packed into a single
+dispatch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sntc_tpu.core.base import Estimator, Model
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+from sntc_tpu.models.tree.grower import (
+    Forest,
+    ForestDeviceMixin,
+    ForestPersistenceMixin,
+    forest_leaf_stats,
+    grow_forest,
+    make_bagging_weights,
+    resolve_feature_subset_k,
+)
+from sntc_tpu.models.tree.random_forest import _TreeEnsembleParams
+from sntc_tpu.ops.binning import bin_features, quantile_bin_edges
+from sntc_tpu.parallel.collectives import shard_batch, shard_weights
+from sntc_tpu.parallel.context import get_default_mesh
+
+
+class _RfRegParams(_TreeEnsembleParams):
+    featuresCol = Param("feature vector column", default="features")
+    labelCol = Param("target column", default="label")
+    predictionCol = Param("output prediction column", default="prediction")
+    numTrees = Param("number of trees", default=20, validator=validators.gt(0))
+    impurity = Param(
+        "variance", default="variance", validator=validators.one_of("variance")
+    )
+    featureSubsetStrategy = Param(
+        "auto | all | sqrt | log2 | onethird | int | fraction string",
+        default="auto",
+    )
+    bootstrap = Param("Poisson bootstrap bagging", default=True,
+                      validator=validators.is_bool())
+
+
+class RandomForestRegressor(_RfRegParams, Estimator):
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+
+    def _fit(self, frame: Frame) -> "RandomForestRegressionModel":
+        mesh = self._mesh or get_default_mesh()
+        X = frame[self.getFeaturesCol()]
+        if X.ndim != 2:
+            raise ValueError(
+                f"featuresCol {self.getFeaturesCol()!r} must be a vector "
+                "column (use VectorAssembler)"
+            )
+        X = X.astype(np.float32, copy=False)
+        y = np.asarray(frame[self.getLabelCol()], np.float32)
+        n, F = X.shape
+        T = self.getNumTrees()
+        n_bins = self.getMaxBins()
+
+        edges = quantile_bin_edges(X, max_bins=n_bins, seed=self.getSeed())
+        xs, ys, _ = shard_batch(mesh, X, y)
+        ws = shard_weights(
+            mesh, np.ones(n, np.float32), xs.shape[0]
+        )
+        binned = bin_features(xs, jnp.asarray(edges))
+        row_stats = jnp.stack([ws, ws * ys, ws * ys * ys], axis=1)
+
+        w_trees = make_bagging_weights(
+            np.random.default_rng(self.getSeed()), self.getBootstrap(),
+            self.getSubsamplingRate(), T, xs.shape[0], mesh,
+        )
+
+        subset_k = resolve_feature_subset_k(
+            self.getFeatureSubsetStrategy(), F, T, is_classification=False
+        )
+        forest = grow_forest(
+            binned, row_stats, w_trees, edges,
+            n_bins=n_bins,
+            max_depth=self.getMaxDepth(),
+            min_instances_per_node=float(self.getMinInstancesPerNode()),
+            min_info_gain=float(self.getMinInfoGain()),
+            subset_k=subset_k,
+            impurity="variance",
+            seed=self.getSeed(),
+            mesh=mesh,
+        )
+        model = RandomForestRegressionModel(forest=forest, n_features=F)
+        model.setParams(
+            **{k2: v for k2, v in self.paramValues().items()
+               if model.hasParam(k2)}
+        )
+        return model
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def _rf_reg_predict(X, feature, threshold, leaf_stats, *, max_depth):
+    stats = forest_leaf_stats(
+        X, feature, threshold, leaf_stats, max_depth=max_depth
+    )  # [T, N, 3] = [w, wy, wy²]
+    means = stats[:, :, 1] / jnp.maximum(stats[:, :, 0], 1e-12)
+    return means.mean(axis=0)  # average over trees (Spark)
+
+
+class RandomForestRegressionModel(
+    _RfRegParams, ForestPersistenceMixin, ForestDeviceMixin, Model
+):
+    def __init__(self, forest: Forest, n_features: int = 0, **kwargs):
+        super().__init__(**kwargs)
+        self.forest = forest
+        self._n_features = int(n_features)
+
+    @property
+    def trees(self) -> Forest:
+        return self.forest
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            _rf_reg_predict(
+                jnp.asarray(X, jnp.float32), *self._device_forest(),
+                max_depth=self.forest.max_depth,
+            )
+        ).astype(np.float64)
+
+    def transform(self, frame: Frame) -> Frame:
+        X = frame[self.getFeaturesCol()].astype(np.float32, copy=False)
+        return frame.with_column(self.getPredictionCol(), self.predict(X))

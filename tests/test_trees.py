@@ -442,3 +442,49 @@ def test_decision_tree_depth_and_fused_serve(mesh8):
     np.testing.assert_allclose(
         out["probability"], ref["probability"], atol=1e-5
     )
+
+
+def test_random_forest_regressor_vs_sklearn(mesh8):
+    """Averaged regression forest tracks sklearn's RandomForestRegressor
+    behaviorally on a smooth target; save/load round-trips; importances
+    find the signal features."""
+    import tempfile
+
+    from sklearn.ensemble import RandomForestRegressor as SkRF
+
+    from sntc_tpu.models import (
+        RandomForestRegressionModel,
+        RandomForestRegressor,
+    )
+
+    rng = np.random.default_rng(17)
+    n = 5000
+    X = rng.uniform(-2, 2, size=(n, 6)).astype(np.float32)
+    y = (
+        2.0 * X[:, 1]
+        + np.sin(2.0 * X[:, 4])
+        + 0.1 * rng.normal(size=n)
+    ).astype(np.float32)
+    f = Frame({"features": X, "label": y})
+    # featureSubsetStrategy="all" to match sklearn's regression default
+    # (Spark's regression "auto" is onethird — sklearn at max_features=1/3
+    # does WORSE than our onethird: 1.20 vs 0.66 rmse on this data)
+    m = RandomForestRegressor(
+        mesh=mesh8, numTrees=15, maxDepth=6, maxBins=64, seed=0,
+        featureSubsetStrategy="all",
+    ).fit(f)
+    pred = np.asarray(m.transform(f)["prediction"])
+    rmse = np.sqrt(np.mean((pred - y) ** 2))
+    sk = SkRF(n_estimators=15, max_depth=6, random_state=0).fit(X, y)
+    sk_rmse = np.sqrt(np.mean((sk.predict(X) - y) ** 2))
+    assert rmse < sk_rmse + 0.05  # histogram splits vs exact splits
+    assert rmse < 0.15 * y.std()
+    imp = m.featureImportances
+    assert set(np.argsort(imp)[-2:]) == {1, 4}
+    with tempfile.TemporaryDirectory() as d:
+        save_model(m, d + "/rfr")
+        m2 = load_model(d + "/rfr")
+        assert isinstance(m2, RandomForestRegressionModel)
+        np.testing.assert_allclose(
+            np.asarray(m2.transform(f)["prediction"]), pred, atol=1e-6
+        )
