@@ -13,6 +13,8 @@ from sntc_tpu.models.tree import (
     DecisionTreeRegressionModel,
     GBTClassifier,
     GBTClassificationModel,
+    GBTRegressor,
+    GBTRegressionModel,
     RandomForestClassifier,
     RandomForestClassificationModel,
     RandomForestRegressor,
@@ -30,6 +32,8 @@ __all__ = [
     "RandomForestRegressionModel",
     "GBTClassifier",
     "GBTClassificationModel",
+    "GBTRegressor",
+    "GBTRegressionModel",
     "DecisionTreeClassifier",
     "DecisionTreeClassificationModel",
     "DecisionTreeRegressor",

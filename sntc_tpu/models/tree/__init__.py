@@ -3,6 +3,7 @@ from sntc_tpu.models.tree.random_forest import (
     RandomForestClassificationModel,
 )
 from sntc_tpu.models.tree.gbt import GBTClassifier, GBTClassificationModel
+from sntc_tpu.models.tree.gbt_regressor import GBTRegressor, GBTRegressionModel
 from sntc_tpu.models.tree.random_forest_regressor import (
     RandomForestRegressor,
     RandomForestRegressionModel,
@@ -19,6 +20,8 @@ __all__ = [
     "RandomForestClassificationModel",
     "GBTClassifier",
     "GBTClassificationModel",
+    "GBTRegressor",
+    "GBTRegressionModel",
     "RandomForestRegressor",
     "RandomForestRegressionModel",
     "DecisionTreeClassifier",

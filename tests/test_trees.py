@@ -488,3 +488,67 @@ def test_random_forest_regressor_vs_sklearn(mesh8):
         np.testing.assert_allclose(
             np.asarray(m2.transform(f)["prediction"]), pred, atol=1e-6
         )
+
+
+def test_gbt_regressor_vs_sklearn(mesh8):
+    """Boosted regression matches sklearn's GradientBoostingRegressor
+    behaviorally; save/load round-trips; absolute loss works."""
+    import tempfile
+
+    from sklearn.ensemble import GradientBoostingRegressor as SkGBR
+
+    from sntc_tpu.models import GBTRegressionModel, GBTRegressor
+
+    rng = np.random.default_rng(19)
+    n = 4000
+    X = rng.uniform(-2, 2, size=(n, 5)).astype(np.float32)
+    y = (X[:, 0] ** 2 + 2.0 * X[:, 3] + 0.1 * rng.normal(size=n)).astype(
+        np.float32
+    )
+    f = Frame({"features": X, "label": y})
+    m = GBTRegressor(
+        mesh=mesh8, maxIter=25, maxDepth=3, stepSize=0.3, maxBins=64, seed=0
+    ).fit(f)
+    pred = np.asarray(m.transform(f)["prediction"])
+    rmse = np.sqrt(np.mean((pred - y) ** 2))
+    sk = SkGBR(n_estimators=25, max_depth=3, learning_rate=0.3).fit(X, y)
+    sk_rmse = np.sqrt(np.mean((sk.predict(X) - y) ** 2))
+    # histogram splits + Spark's weight-1.0 first tree (sklearn scales
+    # every tree by the learning rate) cost a modest constant
+    assert rmse < sk_rmse + 0.15
+    assert rmse < 0.2 * y.std()
+    ab = GBTRegressor(
+        mesh=mesh8, maxIter=25, maxDepth=3, stepSize=0.3, maxBins=64,
+        lossType="absolute", seed=0,
+    ).fit(f)
+    ab_rmse = np.sqrt(np.mean((np.asarray(ab.transform(f)["prediction"]) - y) ** 2))
+    assert ab_rmse < 0.5 * y.std()
+    with tempfile.TemporaryDirectory() as d:
+        save_model(m, d + "/gbr")
+        m2 = load_model(d + "/gbr")
+        assert isinstance(m2, GBTRegressionModel)
+        np.testing.assert_allclose(
+            np.asarray(m2.transform(f)["prediction"]), pred, atol=1e-6
+        )
+        assert m2.numTrees == m.numTrees and m2.treeWeights == m.treeWeights
+
+
+def test_gbt_regressor_validated_early_stop(mesh8):
+    """A plateauing validation split halts boosting with numTrees <
+    maxIter (runWithValidation semantics)."""
+    from sntc_tpu.models import GBTRegressor
+
+    rng = np.random.default_rng(20)
+    n = 3000
+    X = rng.uniform(-2, 2, size=(n, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.8 * rng.normal(size=n)).astype(np.float32)  # noisy
+    is_val = np.zeros(n, bool)
+    is_val[::3] = True
+    f = Frame({
+        "features": X, "label": y, "isVal": is_val.astype(np.float64)
+    })
+    m = GBTRegressor(
+        mesh=mesh8, maxIter=60, maxDepth=4, stepSize=0.5, seed=0,
+        validationIndicatorCol="isVal", validationTol=0.0,
+    ).fit(f)
+    assert m.numTrees < 60
