@@ -4,10 +4,12 @@ from sntc_tpu.evaluation.multiclass import (
 )
 from sntc_tpu.evaluation.binary import BinaryClassificationEvaluator
 from sntc_tpu.evaluation.regression import RegressionEvaluator
+from sntc_tpu.evaluation.clustering import ClusteringEvaluator
 
 __all__ = [
     "MulticlassClassificationEvaluator",
     "MulticlassMetrics",
     "BinaryClassificationEvaluator",
     "RegressionEvaluator",
+    "ClusteringEvaluator",
 ]

@@ -21,6 +21,12 @@ from sntc_tpu.feature.discretizers import (
     ImputerModel,
     QuantileDiscretizer,
 )
+from sntc_tpu.feature.encoders import (
+    ElementwiseProduct,
+    OneHotEncoder,
+    OneHotEncoderModel,
+    VectorSlicer,
+)
 
 __all__ = [
     "VectorAssembler",
@@ -45,4 +51,8 @@ __all__ = [
     "QuantileDiscretizer",
     "Imputer",
     "ImputerModel",
+    "OneHotEncoder",
+    "OneHotEncoderModel",
+    "VectorSlicer",
+    "ElementwiseProduct",
 ]

@@ -20,6 +20,7 @@ from sntc_tpu.models.tree import (
     RandomForestRegressor,
     RandomForestRegressionModel,
 )
+from sntc_tpu.models.kmeans import KMeans, KMeansModel
 from sntc_tpu.models.linear_regression import LinearRegression, LinearRegressionModel
 from sntc_tpu.models.linear_svc import LinearSVC, LinearSVCModel
 from sntc_tpu.models.naive_bayes import NaiveBayes, NaiveBayesModel
@@ -38,6 +39,8 @@ __all__ = [
     "DecisionTreeClassificationModel",
     "DecisionTreeRegressor",
     "DecisionTreeRegressionModel",
+    "KMeans",
+    "KMeansModel",
     "LinearRegression",
     "LinearRegressionModel",
     "LinearSVC",

@@ -503,3 +503,57 @@ def test_imputer_mean_median_roundtrip(tmp_path):
     np.testing.assert_allclose(
         np.asarray(m2.transform(f)["a2"]), np.asarray(out["a2"])
     )
+
+
+# ---------------- OneHotEncoder / VectorSlicer / ElementwiseProduct ---------
+
+def test_one_hot_encoder_spark_semantics(tmp_path):
+    from sntc_tpu.feature import OneHotEncoder
+    from sntc_tpu.mlio import load_model, save_model
+
+    f = Frame({"cat": np.array([0.0, 1.0, 2.0, 1.0])})
+    m = OneHotEncoder(inputCols=["cat"]).fit(f)
+    assert m.categorySizes == [3]
+    out = m.transform(f)["cat_ohe"]
+    # dropLast: category 2 encodes as all-zeros, width 2
+    np.testing.assert_array_equal(
+        out, [[1, 0], [0, 1], [0, 0], [0, 1]]
+    )
+    full = m.copy({"dropLast": False}).transform(f)["cat_ohe"]
+    np.testing.assert_array_equal(
+        full, [[1, 0, 0], [0, 1, 0], [0, 0, 1], [0, 1, 0]]
+    )
+    unseen = Frame({"cat": np.array([0.0, 5.0])})
+    with pytest.raises(ValueError, match="outside"):
+        m.transform(unseen)
+    kept = m.copy({"handleInvalid": "keep", "dropLast": False}).transform(
+        unseen
+    )["cat_ohe"]
+    # keep: extra invalid slot appended
+    np.testing.assert_array_equal(kept, [[1, 0, 0, 0], [0, 0, 0, 1]])
+    save_model(m, str(tmp_path / "ohe"))
+    m2 = load_model(str(tmp_path / "ohe"))
+    np.testing.assert_array_equal(
+        np.asarray(m2.transform(f)["cat_ohe"]), np.asarray(out)
+    )
+    with pytest.raises(ValueError, match="non-negative"):
+        OneHotEncoder(inputCols=["cat"]).fit(
+            Frame({"cat": np.array([0.5, 1.0])})
+        )
+
+
+def test_vector_slicer_and_elementwise_product():
+    from sntc_tpu.feature import ElementwiseProduct, VectorSlicer
+
+    X = np.arange(12, dtype=np.float32).reshape(3, 4)
+    f = Frame({"features": X})
+    out = VectorSlicer(indices=[3, 0]).transform(f)["sliced"]
+    np.testing.assert_array_equal(out, X[:, [3, 0]])
+    with pytest.raises(ValueError, match="out of range"):
+        VectorSlicer(indices=[9]).transform(f)
+    ew = ElementwiseProduct(scalingVec=[1.0, 0.0, 2.0, -1.0]).transform(f)
+    np.testing.assert_allclose(
+        ew["scaled"], X * np.array([1.0, 0.0, 2.0, -1.0])
+    )
+    with pytest.raises(ValueError, match="length"):
+        ElementwiseProduct(scalingVec=[1.0]).transform(f)
