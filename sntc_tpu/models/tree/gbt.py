@@ -236,10 +236,19 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
         # completed boosting rounds, restoring trees and margins
         ckpt_dir = self.getCheckpointDir()
         interval = self.getCheckpointInterval()
+        # NOTE: keep in lockstep with GBTRegressor._fit's checkpoint block
+        # (gbt_regressor.py).  n_shards: saved arrays are padded to the
+        # mesh size — a resume on a different mesh must restart cleanly.
         fingerprint = {
             "algo": "gbt", "maxIter": n_rounds, "maxDepth": self.getMaxDepth(),
+            "n_shards": int(mesh.shape[axis]),
             "stepSize": step, "seed": self.getSeed(), "n_rows": n,
-            "maxBins": n_bins, "validation": bool(val_col),
+            "maxBins": n_bins,
+            "subsamplingRate": float(self.getSubsamplingRate()),
+            "minInstancesPerNode": float(self.getMinInstancesPerNode()),
+            "minInfoGain": float(self.getMinInfoGain()),
+            "featureSubsetStrategy": str(self.getFeatureSubsetStrategy()),
+            "validation": bool(val_col),
             "validationTol": float(self.getValidationTol()),
         }
         tracker = (

@@ -25,6 +25,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sntc_tpu.core.base import Estimator, Model
+from sntc_tpu.models.base import CheckpointParams
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
 from sntc_tpu.models.tree.grower import (
@@ -96,7 +97,7 @@ class _GbtRegParams(_TreeEnsembleParams):
     )
 
 
-class GBTRegressor(_GbtRegParams, Estimator):
+class GBTRegressor(_GbtRegParams, CheckpointParams, Estimator):
     def __init__(self, mesh=None, **kwargs):
         super().__init__(**kwargs)
         self._mesh = mesh
@@ -151,6 +152,7 @@ class GBTRegressor(_GbtRegParams, Estimator):
                 mask[None, :], NamedSharding(mesh, P(None, axis))
             )
 
+        from sntc_tpu.mlio import optimizer_checkpoint as _ckpt
         from sntc_tpu.models.tree.gbt import _ValidationTracker
 
         init = float(np.mean(y)) if n else 0.0
@@ -167,7 +169,52 @@ class GBTRegressor(_GbtRegParams, Estimator):
         features, thresholds, leaves = [], [], []
         gains, counts = [], []
         weights = []
-        for m in range(n_rounds):
+
+        # mid-fit round checkpointing (SURVEY.md §5.4), mirroring the
+        # classifier: resume skips completed boosting rounds
+        ckpt_dir = self.getCheckpointDir()
+        interval = self.getCheckpointInterval()
+        # NOTE: keep this block in lockstep with GBTClassifier._fit's
+        # checkpoint machinery (sntc_tpu/models/tree/gbt.py) — same
+        # fingerprint keys, same save-before-break ordering.  n_shards
+        # matters because the saved device arrays are PADDED to the mesh
+        # size: a resume on a different mesh must restart, not splice.
+        fingerprint = {
+            "algo": "gbt_reg", "maxIter": n_rounds,
+            "n_shards": int(mesh.shape[axis]),
+            "maxDepth": self.getMaxDepth(), "stepSize": step,
+            "seed": seed, "n_rows": n, "maxBins": n_bins, "loss": loss,
+            "subsamplingRate": float(rate),
+            "minInstancesPerNode": float(self.getMinInstancesPerNode()),
+            "minInfoGain": float(self.getMinInfoGain()),
+            "featureSubsetStrategy": str(self.getFeatureSubsetStrategy()),
+            "validation": bool(val_col),
+            "validationTol": float(self.getValidationTol()),
+        }
+        start_round = 0
+        if ckpt_dir and interval > 0:
+            saved = _ckpt.load_state(ckpt_dir, fingerprint)
+            if saved is not None and int(saved["round"]) > 0:
+                start_round = int(saved["round"])
+                features = list(saved["feature"])
+                thresholds = list(saved["threshold"])
+                leaves = list(saved["leaf_stats"])
+                gains = list(saved["gain"])
+                counts = list(saved["count"])
+                weights = [float(v) for v in saved["tree_weights"]]
+                pred = jnp.asarray(saved["pred"])
+                if val_col:
+                    pred_val = np.asarray(saved["val_pred"], np.float64)
+                    tracker.best_err = np.asarray(
+                        saved["val_best_err"], np.float64
+                    ).reshape(1)
+                    tracker.best_m = np.asarray(
+                        saved["val_best_m"], np.int64
+                    ).reshape(1)
+                    tracker.done = np.asarray(saved["val_done"], bool).reshape(1)
+                    if tracker.done[0]:
+                        start_round = n_rounds
+        for m in range(start_round, n_rounds):
             row_stats = resid_fn(ys, ws, pred)
             forest = grow_forest(
                 binned, row_stats, round_weights(m), edges,
@@ -208,9 +255,35 @@ class GBTRegressor(_GbtRegParams, Estimator):
                 )
                 # the classifier's Spark runWithValidation bookkeeping —
                 # one stop rule for both GBTs
-                if tracker.update(m, err):
-                    break
+                stopped = tracker.update(m, err)
+            else:
+                stopped = False
+            # save BEFORE honoring the stop so a resume sees done=True
+            # (the classifier's ordering)
+            if ckpt_dir and interval > 0 and (m + 1) % interval == 0:
+                state = {
+                    "round": np.int64(m + 1),
+                    "feature": np.stack(features),
+                    "threshold": np.stack(thresholds),
+                    "leaf_stats": np.stack(leaves),
+                    "gain": np.stack(gains),
+                    "count": np.stack(counts),
+                    "tree_weights": np.asarray(weights, np.float64),
+                    "pred": np.asarray(pred),
+                }
+                if val_col:
+                    state["val_pred"] = pred_val
+                    state["val_best_err"] = tracker.best_err
+                    state["val_best_m"] = tracker.best_m
+                    state["val_done"] = tracker.done
+                _ckpt.save_state(ckpt_dir, state, fingerprint)
+            if stopped:
+                break
 
+        # a COMPLETED fit owns no checkpoint: leftover state would make a
+        # later fit with the same dir silently return this model
+        if ckpt_dir and interval > 0:
+            _ckpt.clear_state(ckpt_dir)
         # validated boosting always trims to the best round, whether the
         # loop broke early or ran to maxIter (Spark keeps bestM trees)
         keep = int(tracker.best_m[0]) if tracker else len(features)

@@ -156,3 +156,68 @@ def test_gbt_resume_skips_completed_rounds(mesh8, tmp_path):
     np.testing.assert_array_equal(
         resumed.transform(f)["prediction"], full.transform(f)["prediction"]
     )
+
+
+def test_gbt_regressor_round_checkpoint_resume(tmp_path, mesh8):
+    """A CRASH mid-boosting resumes from the last saved round (only the
+    missing rounds are grown) and matches an uninterrupted fit; a
+    completed fit clears its checkpoint so a rerun regrows from scratch."""
+    from sntc_tpu.core.frame import Frame
+    from sntc_tpu.models import GBTRegressor
+    import sntc_tpu.models.tree.gbt_regressor as gbr
+
+    rng = np.random.default_rng(23)
+    X = rng.uniform(-2, 2, size=(1500, 4)).astype(np.float32)
+    y = (X[:, 0] ** 2 + X[:, 2] + 0.1 * rng.normal(size=1500)).astype(
+        np.float32
+    )
+    f = Frame({"features": X, "label": y})
+    ck = str(tmp_path / "gbt_reg_ck")
+    kw = dict(
+        mesh=mesh8, maxIter=6, maxDepth=3, stepSize=0.3, maxBins=32, seed=0,
+        checkpointDir=ck, checkpointInterval=2,
+    )
+    calls = []
+    orig = gbr.grow_forest
+
+    class Boom(RuntimeError):
+        pass
+
+    def crashing(*a, **k):
+        calls.append(1)
+        if len(calls) > 4:  # crash after round 4 (checkpoint at round 4)
+            raise Boom()
+        return orig(*a, **k)
+
+    gbr.grow_forest = crashing
+    try:
+        with pytest.raises(Boom):
+            GBTRegressor(**kw).fit(f)
+    finally:
+        gbr.grow_forest = orig
+
+    def counting(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    calls.clear()
+    gbr.grow_forest = counting
+    try:
+        resumed = GBTRegressor(**kw).fit(f)  # only rounds 5-6 grow
+        n_resumed = len(calls)
+        calls.clear()
+        rerun = GBTRegressor(**kw).fit(f)  # checkpoint cleared: full fit
+        n_rerun = len(calls)
+    finally:
+        gbr.grow_forest = orig
+    assert n_resumed == 2, n_resumed
+    assert n_rerun == 6, n_rerun
+    full = GBTRegressor(
+        mesh=mesh8, maxIter=6, maxDepth=3, stepSize=0.3, maxBins=32, seed=0
+    ).fit(f)
+    np.testing.assert_allclose(resumed.forest.feature, full.forest.feature)
+    np.testing.assert_allclose(
+        np.asarray(resumed.transform(f)["prediction"]),
+        np.asarray(full.transform(f)["prediction"]),
+        atol=1e-5,
+    )
