@@ -38,12 +38,48 @@ import numpy as np
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 BASELINE_CACHE = os.path.join(REPO, "baseline_proxy.json")
+RUNS_JOURNAL = os.path.join(REPO, "bench_runs.jsonl")
+
+
+def _git_sha() -> str:
+    import subprocess
+
+    try:
+        return subprocess.run(
+            ["git", "-C", REPO, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _journal_run(cfg: str, line: dict) -> None:
+    """Append the full machine-written record of this invocation to the
+    COMMITTED ``bench_runs.jsonl`` — the auditable raw evidence behind
+    every BASELINE.md table row (config, cold+warm, platform, quality,
+    timestamp, git SHA).  Opt-out: ``BENCH_NO_JOURNAL=1``."""
+    if os.environ.get("BENCH_NO_JOURNAL"):
+        return
+    record = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_sha": _git_sha(),
+        "config": cfg,
+        "bench_rows_env": os.environ.get("BENCH_ROWS"),
+        **line,
+    }
+    with open(RUNS_JOURNAL, "a") as f:
+        f.write(json.dumps(record) + "\n")
 
 SEED = 7
 MLP_LAYERS = [78, 64, 15]
 MLP_MAX_ITER = 100
 LR_MAX_ITER = 100
-RF_TREES, RF_DEPTH = 20, 5
+# depth 10: on 80%-benign 15-class data a depth-5 greedy forest cannot
+# exceed macro-F1 ~0.35 no matter how separable the classes are (it
+# spends its split budget on the large classes), so the config-3 quality
+# bar would certify nothing; at depth 10 both our RF and the proxy land
+# ~0.8 — a discriminative regime where a broken grower shows
+RF_TREES, RF_DEPTH = 20, 10
 CHISQ_TOP = 40
 GBT_ROUNDS, GBT_DEPTH = 10, 4
 # 128 quantile bins ≈ sklearn's exact splits in macro-F1 on this workload
@@ -62,7 +98,11 @@ DEFAULT_ROWS = {
 def _dataset(n_rows: int, binary: bool = False):
     from sntc_tpu.data import clean_flows, generate_frame
 
-    df = clean_flows(generate_frame(n_rows, seed=SEED))
+    # 0.5% tail-class floor: at bench scale every class has enough rows
+    # to be learnable (real CICIDS2017 at 2.8M rows gives Bot/Web-attack
+    # classes a comparable share), so macro-F1 differences are real
+    df = clean_flows(generate_frame(n_rows, seed=SEED,
+                                    min_class_fraction=0.005))
     if binary:
         df = df.with_column(
             "Label",
@@ -251,20 +291,29 @@ def bench_config5(n_rows, mesh):
         q0.process_available()
         src = MemorySource(batches)
         sink = MemorySink()
+        # append-mode WAL: one flushed JSONL append per batch instead of
+        # two file creates — the engine's high-throughput journal
         q = StreamingQuery(
             serve_model, src, sink, os.path.join(tmp, "ckpt"),
-            max_batch_offsets=1,
+            max_batch_offsets=1, wal_mode="append",
         )
         t0 = time.perf_counter()
         n_done = q.process_available()
         dt = time.perf_counter() - t0
+        lat = np.asarray(
+            [p["durationMs"] for p in q.recentProgress], np.float64
+        )
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     rows = sum(f.num_rows for f in sink.frames)
     return {
         "metric": "cicids2017_streaming_inference_rows_per_s",
         "value": rows / dt, "unit": "rows/s",
-        "quality": {"micro_batches": n_done},
+        "quality": {
+            "micro_batches": n_done,
+            "latency_ms_p50": float(np.percentile(lat, 50)),
+            "latency_ms_p99": float(np.percentile(lat, 99)),
+        },
         "n_rows": rows,
     }
 
@@ -276,6 +325,132 @@ BENCHES = {
     "4": bench_config4,
     "5": bench_config5,
 }
+
+
+# ---------------------------------------------------------------------------
+# --mfu: absolute utilization accounting (VERDICT r2 item 3) — answers
+# "actually fast?" independently of the 1-core sklearn proxy
+# ---------------------------------------------------------------------------
+
+# single-chip peak dense-matmul FLOP/s by platform.  TPU v5e: 197 TFLOP/s
+# bf16 (public spec); f32 matmuls under JAX's DEFAULT precision also feed
+# the MXU bf16 inputs (with f32 accumulate), so the same peak applies to
+# both computeDtype settings.  Override with BENCH_PEAK_FLOPS.
+_PEAK_FLOPS = {"tpu": 1.97e14, "axon": 1.97e14}
+
+
+def _peak_flops(platform: str):
+    env = os.environ.get("BENCH_PEAK_FLOPS")
+    if env:
+        return float(env)
+    return _PEAK_FLOPS.get(platform)
+
+
+def bench_mfu(n_rows, mesh):
+    """Measured FLOP/s vs chip peak for the two compute cores:
+
+    (a) the flagship MLP LBFGS fit (configs 2): analytic gemm FLOPs —
+        fwd 2·N·Σ(fan_in·fan_out), bwd 2× that — times 2
+        objective+gradient evals per LBFGS iteration (1 Armijo accept +
+        1 gradient refresh; a LOWER bound when backtracking re-evals),
+        over the measured warm fit; run at BOTH computeDtype settings,
+        so the bf16-vs-f32 claim (mlp.py) is measured, not asserted;
+    (b) the Pallas one-hot histogram kernel at config-3 level-pass
+        shapes: executed (padded) one-hot-matmul FLOPs over measured
+        kernel time — MXU-bound or not, in absolute terms.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from sntc_tpu.models import MultilayerPerceptronClassifier
+
+    platform = jax.devices()[0].platform
+    peak = _peak_flops(platform)
+    train, _ = _dataset(n_rows)
+    out = {"metric": "mfu_accounting", "n_rows": None, "unit": "mfu",
+           "platform": platform, "peak_flops": peak}
+
+    # ---- (a) MLP fit at f32 and bf16 ----
+    stages = _feature_stages(mesh)
+    feat = train
+    for st in stages:
+        fitted = st.fit(feat) if hasattr(st, "fit") else st
+        feat = fitted.transform(feat)
+    N = feat.num_rows
+    out["n_rows"] = N
+    gemm_macs = sum(
+        a * b for a, b in zip(MLP_LAYERS[:-1], MLP_LAYERS[1:])
+    )
+    flops_per_eval = 6.0 * N * gemm_macs  # fwd 2x + bwd 4x MACs
+    for dtype in ("float32", "bfloat16"):
+        def build():
+            return MultilayerPerceptronClassifier(
+                mesh=mesh, layers=MLP_LAYERS, maxIter=MLP_MAX_ITER,
+                seed=0, computeDtype=dtype,
+            )
+
+        model, warm, cold = _timed_fit(build, feat)
+        iters = model.summary.totalIterations
+        total_flops = flops_per_eval * 2.0 * iters
+        key = "f32" if dtype == "float32" else "bf16"
+        out[f"mlp_{key}_fit_s"] = round(warm, 4)
+        out[f"mlp_{key}_iters"] = iters
+        out[f"mlp_{key}_flops_per_s"] = total_flops / warm
+        if peak:
+            out[f"mlp_{key}_mfu"] = round(total_flops / warm / peak, 5)
+    out["bf16_speedup_vs_f32"] = round(
+        out["mlp_f32_fit_s"] / out["mlp_bf16_fit_s"], 3
+    )
+
+    # ---- (b) histogram kernel at config-3 level shapes ----
+    from sntc_tpu.ops.pallas_histogram import (
+        hist_fits_pallas,
+        level_histogram_pallas,
+    )
+
+    F, B, S = CHISQ_TOP, 32, 3
+    n_nodes = 2 ** (RF_DEPTH - 1)  # deepest (widest) level
+    if hist_fits_pallas(n_nodes, B) and platform != "cpu":
+        rng = np.random.default_rng(0)
+        n_loc = min(N, 200_000)
+        binned_t = jnp.asarray(
+            rng.integers(0, B, size=(F, n_loc), dtype=np.int32)
+        )
+        node_idx = jnp.asarray(
+            rng.integers(0, n_nodes, size=n_loc, dtype=np.int32)
+        )
+        stats = jnp.asarray(rng.random((n_loc, S), np.float32))
+        call = jax.jit(
+            lambda bt, ni, st: level_histogram_pallas(
+                bt, ni, st, n_nodes=n_nodes, n_bins=B
+            )
+        )
+        call(binned_t, node_idx, stats).block_until_ready()  # compile
+        reps = 10
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = call(binned_t, node_idx, stats)
+        r.block_until_ready()
+        dt = (time.perf_counter() - t0) / reps
+        # executed dense FLOPs: one-hot [tile, nb_pad]ᵀ @ stats
+        # [tile, s_pad] per feature block — padded widths are what the
+        # MXU really runs
+        nb_pad = -(-max(n_nodes * B + 1, 128) // 128) * 128
+        s_pad = -(-S // 8) * 8
+        hist_flops = 2.0 * n_loc * nb_pad * s_pad * F
+        out["hist_kernel_shapes"] = (
+            f"N={n_loc} F={F} nodes={n_nodes} bins={B}"
+        )
+        out["hist_kernel_s"] = round(dt, 5)
+        out["hist_flops_per_s"] = hist_flops / dt
+        if peak:
+            out["hist_mfu"] = round(hist_flops / dt / peak, 5)
+    else:
+        out["hist_kernel_s"] = None  # pallas path unavailable here
+
+    out["value"] = out.get("mlp_f32_mfu") or out["mlp_f32_flops_per_s"]
+    out["vs_baseline"] = None
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -544,6 +719,11 @@ def main():
     ap.add_argument("--rows", type=int, default=None)
     ap.add_argument("--measure-baseline", action="store_true")
     ap.add_argument(
+        "--mfu", action="store_true",
+        help="utilization accounting: measured FLOP/s vs chip peak for "
+        "the MLP LBFGS fit (f32 AND bf16) + the Pallas histogram kernel",
+    )
+    ap.add_argument(
         "--platform", default=os.environ.get("BENCH_PLATFORM"),
         help="force a JAX platform (e.g. 'cpu' for local validation when "
         "the TPU tunnel is unavailable); the host sitecustomize pins "
@@ -575,10 +755,26 @@ def main():
 
         jax.config.update("jax_platforms", platform)
 
+    from sntc_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    if args.mfu:
+        from sntc_tpu.parallel.context import get_default_mesh
+
+        line = bench_mfu(
+            args.rows or DEFAULT_ROWS["2"], get_default_mesh()
+        )
+        _journal_run("mfu", line)
+        print(json.dumps(line), flush=True)
+        return
+
     # flagship (config 2) last so the driver's final line is the headline
     ordered = sorted(configs, key=lambda c: (c == "2", c))
     for cfg in ordered:
-        print(json.dumps(run_config(cfg, args.rows)), flush=True)
+        line = run_config(cfg, args.rows)
+        _journal_run(cfg, line)
+        print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":

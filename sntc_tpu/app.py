@@ -328,6 +328,11 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_synth)
 
     args = ap.parse_args(argv)
+    # Spark pays no per-process compile; neither should a CLI user on
+    # their second run (SURVEY.md §3.5 cold-start — docs/PARITY.md)
+    from sntc_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     return args.fn(args)
 
 

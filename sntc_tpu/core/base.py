@@ -95,6 +95,19 @@ class Estimator(PipelineStage):
         raise NotImplementedError
 
 
+class Evaluator(PipelineStage):
+    """Metric computer over a predictions Frame (Spark's
+    ``ml/evaluation/Evaluator`` [U]).  A Params stage like every other
+    pipeline piece, so tuning results persist/restore their evaluator
+    spec (``CrossValidatorModel.save`` round-trips it)."""
+
+    def evaluate(self, frame: Frame) -> float:
+        raise NotImplementedError
+
+    def isLargerBetter(self) -> bool:
+        return True
+
+
 class Model(Transformer):
     """A fitted Transformer produced by ``Estimator.fit``."""
 

@@ -14,7 +14,9 @@ from __future__ import annotations
 
 import numpy as np
 
+from sntc_tpu.core.base import Evaluator
 from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
 
 
 def _curves(labels: np.ndarray, scores: np.ndarray, weights: np.ndarray = None):
@@ -54,32 +56,25 @@ def area_under_pr(labels, scores, weights=None) -> float:
     return float(np.trapezoid(precision, recall))
 
 
-class BinaryClassificationEvaluator:
+class BinaryClassificationEvaluator(Evaluator):
     _METRICS = ("areaUnderROC", "areaUnderPR")
 
-    def __init__(
-        self,
-        metricName: str = "areaUnderROC",
-        labelCol: str = "label",
-        rawPredictionCol: str = "rawPrediction",
-        weightCol: str = None,
-    ):
-        if metricName not in self._METRICS:
-            raise ValueError(
-                f"unknown metricName {metricName!r}; one of {self._METRICS}"
-            )
-        self.metricName = metricName
-        self.labelCol = labelCol
-        self.rawPredictionCol = rawPredictionCol
-        self.weightCol = weightCol
+    metricName = Param("metric to compute", default="areaUnderROC",
+                       validator=validators.one_of(*_METRICS))
+    labelCol = Param("true-label column", default="label")
+    rawPredictionCol = Param("margins / score column",
+                             default="rawPrediction")
+    weightCol = Param("optional row-weight column", default=None)
 
     def evaluate(self, frame: Frame) -> float:
-        raw = frame[self.rawPredictionCol]
+        raw = frame[self.getRawPredictionCol()]
         scores = raw[:, 1] if raw.ndim == 2 else raw
-        labels = frame[self.labelCol]
-        w = frame[self.weightCol] if self.weightCol else None
-        fn = area_under_roc if self.metricName == "areaUnderROC" else area_under_pr
+        labels = frame[self.getLabelCol()]
+        weight_col = self.getWeightCol()
+        w = frame[weight_col] if weight_col else None
+        fn = (
+            area_under_roc
+            if self.getMetricName() == "areaUnderROC"
+            else area_under_pr
+        )
         return fn(labels, scores, w)
-
-    def isLargerBetter(self) -> bool:
-        return True

@@ -8,7 +8,10 @@ point's mean distance to every cluster without any pairwise pass:
 
   Σ_q∈c ‖p − q‖² = n_c‖p‖² − 2 p·Σx_c + Σ‖x‖²_c
 
-``a(i)`` divides by ``n_c − 1`` (own cluster, excluding the point);
+``a(i)`` divides by ``n_c − 1`` (own cluster, excluding the point —
+Spark's raw ``averageDistanceToCluster`` divides by ``n_c``, but its
+``pointSilhouetteCoefficient`` then multiplies by ``n_c/(n_c−1)``, so
+the two agree; see docs/PARITY.md for the denominator note);
 ``b(i)`` is the min over other clusters of the mean; singleton clusters
 score 0; the metric is the unweighted mean of ``(b−a)/max(a,b)``.
 ``isLargerBetter`` is True.
@@ -20,7 +23,9 @@ from __future__ import annotations
 
 import numpy as np
 
+from sntc_tpu.core.base import Evaluator
 from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
 
 
 def _silhouette(X, labels, k, cosine):
@@ -70,36 +75,22 @@ def _silhouette(X, labels, k, cosine):
     return float(s.mean())
 
 
-class ClusteringEvaluator:
+class ClusteringEvaluator(Evaluator):
     _METRICS = ("silhouette",)
 
-    def __init__(
-        self,
-        metricName: str = "silhouette",
-        featuresCol: str = "features",
-        predictionCol: str = "prediction",
-        distanceMeasure: str = "squaredEuclidean",
-    ):
-        if metricName not in self._METRICS:
-            raise ValueError(
-                f"unknown metricName {metricName!r}; one of {self._METRICS}"
-            )
-        if distanceMeasure not in ("squaredEuclidean", "cosine"):
-            raise ValueError(
-                "distanceMeasure must be squaredEuclidean or cosine"
-            )
-        self.metricName = metricName
-        self.featuresCol = featuresCol
-        self.predictionCol = predictionCol
-        self.distanceMeasure = distanceMeasure
+    metricName = Param("metric to compute", default="silhouette",
+                       validator=validators.one_of(*_METRICS))
+    featuresCol = Param("feature vector column", default="features")
+    predictionCol = Param("cluster-id column", default="prediction")
+    distanceMeasure = Param(
+        "squaredEuclidean | cosine", default="squaredEuclidean",
+        validator=validators.one_of("squaredEuclidean", "cosine"),
+    )
 
     def evaluate(self, frame: Frame) -> float:
-        X = np.asarray(frame[self.featuresCol], np.float64)
-        labels = np.asarray(frame[self.predictionCol], np.int64)
+        X = np.asarray(frame[self.getFeaturesCol()], np.float64)
+        labels = np.asarray(frame[self.getPredictionCol()], np.int64)
         k = int(labels.max()) + 1 if len(labels) else 0
         return _silhouette(
-            X, labels, k, self.distanceMeasure == "cosine"
+            X, labels, k, self.getDistanceMeasure() == "cosine"
         )
-
-    def isLargerBetter(self) -> bool:
-        return True

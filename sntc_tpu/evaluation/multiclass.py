@@ -22,6 +22,7 @@ from __future__ import annotations
 import jax
 import numpy as np
 
+from sntc_tpu.core.base import Evaluator
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
 from sntc_tpu.parallel.collectives import (
@@ -163,12 +164,13 @@ class MulticlassMetrics:
         return float(f1[present].mean()) if present.any() else 0.0
 
 
-class MulticlassClassificationEvaluator:
+class MulticlassClassificationEvaluator(Evaluator):
     """Spark-parity evaluator facade over :class:`MulticlassMetrics`.
 
     ``metricLabel`` selects the class for the ``...ByLabel`` metrics;
     ``logLoss`` reads ``probabilityCol`` (Spark semantics: −log of the
-    true-class probability, clamped by ``eps``)."""
+    true-class probability, clamped by ``eps``).  A Params stage
+    (SURVEY.md §5.6), so tuning results persist the evaluator spec."""
 
     _METRICS = (
         "f1",
@@ -190,44 +192,31 @@ class MulticlassClassificationEvaluator:
     _SMALLER_IS_BETTER = ("logLoss", "hammingLoss", "weightedFalsePositiveRate",
                           "falsePositiveRateByLabel")
 
-    def __init__(
-        self,
-        metricName: str = "f1",
-        labelCol: str = "label",
-        predictionCol: str = "prediction",
-        probabilityCol: str = "probability",
-        metricLabel: float = 0.0,
-        beta: float = 1.0,
-        eps: float = 1e-15,
-        weightCol: str = None,
-        mesh=None,
-    ):
-        if metricName not in self._METRICS:
-            raise ValueError(
-                f"unknown metricName {metricName!r}; one of {self._METRICS}"
-            )
-        if metricName.endswith("ByLabel") and metricLabel < 0:
-            raise ValueError(
-                f"metricLabel must be a class index >= 0, got {metricLabel}"
-            )
-        self.metricName = metricName
-        self.labelCol = labelCol
-        self.predictionCol = predictionCol
-        self.probabilityCol = probabilityCol
-        self.metricLabel = metricLabel
-        self.beta = beta
-        self.eps = eps
-        self.weightCol = weightCol
+    metricName = Param("metric to compute", default="f1",
+                       validator=validators.one_of(*_METRICS))
+    labelCol = Param("true-label column", default="label")
+    predictionCol = Param("prediction column", default="prediction")
+    probabilityCol = Param("class-probability column (logLoss)",
+                           default="probability")
+    metricLabel = Param("class index for the ...ByLabel metrics",
+                        default=0.0, validator=validators.gteq(0))
+    beta = Param("F-measure beta", default=1.0, validator=validators.gt(0))
+    eps = Param("logLoss probability clamp", default=1e-15,
+                validator=validators.in_range(0, 0.5))
+    weightCol = Param("optional row-weight column", default=None)
+
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
         self._mesh = mesh
 
     def metrics(self, frame: Frame) -> MulticlassMetrics:
         # by-label metrics: size the confusion matrix to cover metricLabel
         # so a class absent from this frame reads as 0 (the 0/0 -> 0
         # convention) instead of an IndexError mid-tuning
-        labels = frame[self.labelCol]
-        preds = frame[self.predictionCol]
+        labels = frame[self.getLabelCol()]
+        preds = frame[self.getPredictionCol()]
         num_classes = None
-        if self.metricName.endswith("ByLabel"):
+        if self.getMetricName().endswith("ByLabel"):
             # size the matrix up-front (cheap host max) so the device
             # confusion-matrix reduction runs exactly once
             observed = int(
@@ -235,30 +224,34 @@ class MulticlassClassificationEvaluator:
                     np.max(labels, initial=-1.0), np.max(preds, initial=-1.0)
                 )
             ) + 1
-            num_classes = max(observed, int(self.metricLabel) + 1)
-        weights = frame[self.weightCol] if self.weightCol else None
+            num_classes = max(observed, int(self.getMetricLabel()) + 1)
+        weight_col = self.getWeightCol()
+        weights = frame[weight_col] if weight_col else None
         return MulticlassMetrics(
             labels, preds, weights=weights, num_classes=num_classes,
             mesh=self._mesh,
         )
 
     def _log_loss(self, frame: Frame) -> float:
-        prob = np.asarray(frame[self.probabilityCol], np.float64)
-        y = np.asarray(frame[self.labelCol]).astype(np.int64)
+        prob = np.asarray(frame[self.getProbabilityCol()], np.float64)
+        y = np.asarray(frame[self.getLabelCol()]).astype(np.int64)
         p_true = prob[np.arange(len(y)), y]
+        eps = self.getEps()
         # Spark clamps to [eps, 1-eps] on both sides (MulticlassMetrics.logLoss)
-        losses = -np.log(np.clip(p_true, self.eps, 1.0 - self.eps))
-        if self.weightCol:
-            w = np.asarray(frame[self.weightCol], np.float64)
+        losses = -np.log(np.clip(p_true, eps, 1.0 - eps))
+        weight_col = self.getWeightCol()
+        if weight_col:
+            w = np.asarray(frame[weight_col], np.float64)
             return float(np.sum(w * losses) / np.sum(w))
         return float(np.mean(losses))
 
     def evaluate(self, frame: Frame) -> float:
-        name = self.metricName
+        name = self.getMetricName()
         if name == "logLoss":
             return self._log_loss(frame)
         m = self.metrics(frame)
-        lbl = int(self.metricLabel)
+        lbl = int(self.getMetricLabel())
+        beta = self.getBeta()
         if name == "f1":
             return m.weighted_f_measure()
         if name == "accuracy":
@@ -270,7 +263,7 @@ class MulticlassClassificationEvaluator:
         if name == "weightedFalsePositiveRate":
             return m.weighted_false_positive_rate()
         if name == "weightedFMeasure":
-            return m.weighted_f_measure(self.beta)
+            return m.weighted_f_measure(beta)
         if name == "truePositiveRateByLabel":
             return float(m.recall_by_label()[lbl])
         if name == "falsePositiveRateByLabel":
@@ -280,10 +273,10 @@ class MulticlassClassificationEvaluator:
         if name == "recallByLabel":
             return float(m.recall_by_label()[lbl])
         if name == "fMeasureByLabel":
-            return float(m.f_measure_by_label(self.beta)[lbl])
+            return float(m.f_measure_by_label(beta)[lbl])
         if name == "hammingLoss":
             return m.hamming_loss()
         return m.macro_f1()
 
     def isLargerBetter(self) -> bool:
-        return self.metricName not in self._SMALLER_IS_BETTER
+        return self.getMetricName() not in self._SMALLER_IS_BETTER

@@ -16,36 +16,30 @@ from __future__ import annotations
 
 import numpy as np
 
+from sntc_tpu.core.base import Evaluator
 from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
 
 
-class RegressionEvaluator:
+class RegressionEvaluator(Evaluator):
     _METRICS = ("rmse", "mse", "r2", "mae", "var")
 
-    def __init__(
-        self,
-        metricName: str = "rmse",
-        labelCol: str = "label",
-        predictionCol: str = "prediction",
-        weightCol: str = None,
-        throughOrigin: bool = False,
-    ):
-        if metricName not in self._METRICS:
-            raise ValueError(
-                f"unknown metricName {metricName!r}; one of {self._METRICS}"
-            )
-        self.metricName = metricName
-        self.labelCol = labelCol
-        self.predictionCol = predictionCol
-        self.weightCol = weightCol
-        self.throughOrigin = throughOrigin
+    metricName = Param("metric to compute", default="rmse",
+                       validator=validators.one_of(*_METRICS))
+    labelCol = Param("true-label column", default="label")
+    predictionCol = Param("prediction column", default="prediction")
+    weightCol = Param("optional row-weight column", default=None)
+    throughOrigin = Param("r2 about 0 instead of the label mean",
+                          default=False, validator=validators.is_bool())
 
     def evaluate(self, frame: Frame) -> float:
-        y = np.asarray(frame[self.labelCol], np.float64)
-        pred = np.asarray(frame[self.predictionCol], np.float64)
+        metric = self.getMetricName()
+        y = np.asarray(frame[self.getLabelCol()], np.float64)
+        pred = np.asarray(frame[self.getPredictionCol()], np.float64)
+        weight_col = self.getWeightCol()
         w = (
-            np.asarray(frame[self.weightCol], np.float64)
-            if self.weightCol
+            np.asarray(frame[weight_col], np.float64)
+            if weight_col
             else np.ones_like(y)
         )
         wsum = w.sum()
@@ -53,19 +47,19 @@ class RegressionEvaluator:
             return 0.0
         resid = y - pred
         mse = float((w * resid**2).sum() / wsum)
-        if self.metricName == "mse":
+        if metric == "mse":
             return mse
-        if self.metricName == "rmse":
+        if metric == "rmse":
             return float(np.sqrt(mse))
-        if self.metricName == "mae":
+        if metric == "mae":
             return float((w * np.abs(resid)).sum() / wsum)
-        if self.metricName == "var":
+        if metric == "var":
             # explainedVariance = SS_reg / n: weighted mean squared
             # deviation of predictions about the weighted LABEL mean
             ybar = (w * y).sum() / wsum
             return float((w * (pred - ybar) ** 2).sum() / wsum)
         # r2: 1 - SS_res / SS_tot (about 0 when throughOrigin)
-        ybar = 0.0 if self.throughOrigin else (w * y).sum() / wsum
+        ybar = 0.0 if self.getThroughOrigin() else (w * y).sum() / wsum
         ss_tot = float((w * (y - ybar) ** 2).sum())
         ss_res = float((w * resid**2).sum())
         if ss_tot == 0:
@@ -73,4 +67,4 @@ class RegressionEvaluator:
         return 1.0 - ss_res / ss_tot
 
     def isLargerBetter(self) -> bool:
-        return self.metricName in ("r2", "var")
+        return self.getMetricName() in ("r2", "var")

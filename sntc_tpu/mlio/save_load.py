@@ -120,7 +120,7 @@ def load_model(path: str) -> PipelineStage:
         stages = [
             load_model(os.path.join(path, d)) for d in meta.get("stage_dirs", [])
         ]
-        obj = cls._from_sub_stages(stages, params)
+        obj = cls._from_sub_stages(stages, params, extra)
     elif hasattr(cls, "_load_from"):
         obj = cls._load_from(params, extra, arrays)
     else:

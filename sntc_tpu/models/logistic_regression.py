@@ -257,12 +257,21 @@ def _lr_summarize_folds(xs, ys, ws_b, k):
     return jax.vmap(lambda ws: _lr_summarize_impl(xs, ys, ws, k))(ws_b)
 
 
-from sntc_tpu.models.summary import TrainingSummary
+from sntc_tpu.models.summary import (
+    BinaryClassificationSummary,
+    BinaryClassificationTrainingSummary,
+    ClassificationSummary,
+    ClassificationTrainingSummary,
+    TrainingSummary,
+)
 
-
-class LogisticRegressionSummary(TrainingSummary):
-    """Training summary (the ``LogisticRegressionTrainingSummary`` analog —
-    the shared :class:`TrainingSummary` under its Spark-parity name)."""
+# Spark-parity names (upstream LogisticRegression.scala summary classes):
+# multinomial fits carry per-class metrics + objectiveHistory; binomial
+# fits add the threshold curves (roc/pr/fMeasureByThreshold)
+LogisticRegressionTrainingSummary = ClassificationTrainingSummary
+BinaryLogisticRegressionTrainingSummary = BinaryClassificationTrainingSummary
+LogisticRegressionSummary = ClassificationSummary
+BinaryLogisticRegressionSummary = BinaryClassificationSummary
 
 
 class _LrParams:
@@ -420,6 +429,8 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
             "xs": xs, "ys": ys, "ws": ws, "n": n, "d": d, "k": k,
             "binomial": binomial, "std": std,
             "inv_std": inv_std, "class_counts": class_counts,
+            # kept for the training summary (lazy predictions frame)
+            "frame": frame, "mesh": mesh,
         }
 
     def _penalty_vectors(self, d: int, k: int, binomial: bool, inv_std):
@@ -515,8 +526,21 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
                 if model.hasParam(name)
             }
         )
-        model.summary = LogisticRegressionSummary(
-            np.asarray(history)[: n_iters + 1], n_iters
+        hist = np.asarray(history)[: n_iters + 1]
+        if prep.get("frame") is None:
+            # fold/grid lane sub-models (preps built without the source
+            # frame) keep the lightweight record — per-class metrics on
+            # throwaway sub-models would only pin extra frame references
+            model.summary = TrainingSummary(hist, n_iters)
+            return model
+        summary_cls = (
+            BinaryClassificationTrainingSummary
+            if binomial
+            else ClassificationTrainingSummary
+        )
+        model.summary = summary_cls(
+            hist, n_iters, model, prep["frame"],
+            labelCol=self.getLabelCol(), mesh=prep.get("mesh"),
         )
         return model
 
@@ -906,6 +930,16 @@ class LogisticRegressionModel(_LrParams, ClassificationModel):
                 jnp.asarray(self.interceptVector),
             )
         return self._dev_params
+
+    def evaluate(self, frame: Frame):
+        """Metrics summary on ``frame`` (Spark ``model.evaluate(dataset)``)
+        — the training summary's surface minus objectiveHistory, lazy."""
+        cls = (
+            BinaryClassificationSummary
+            if self.is_binomial
+            else ClassificationSummary
+        )
+        return cls(self, frame, labelCol=self.getLabelCol())
 
     def _save_extra(self):
         return (

@@ -248,11 +248,12 @@ class MultilayerPerceptronClassifier(_MlpParams, CheckpointParams, ClassifierEst
         model.setParams(
             **{k: v for k, v in self.paramValues().items() if model.hasParam(k)}
         )
-        from sntc_tpu.models.logistic_regression import LogisticRegressionSummary
+        from sntc_tpu.models.summary import ClassificationTrainingSummary
 
         n_iters = int(res.n_iters)
-        model.summary = LogisticRegressionSummary(
-            np.asarray(res.history)[: n_iters + 1], n_iters
+        model.summary = ClassificationTrainingSummary(
+            np.asarray(res.history)[: n_iters + 1], n_iters, model, frame,
+            labelCol=self.getLabelCol(), mesh=mesh,
         )
         return model
 
@@ -297,6 +298,12 @@ class MultilayerPerceptronClassificationModel(_MlpParams, ClassificationModel):
         if self._dev_weights is None:
             self._dev_weights = jnp.asarray(self.weights)
         return self._dev_weights
+
+    def evaluate(self, frame: Frame):
+        """Metrics summary on ``frame`` (Spark ``model.evaluate(dataset)``)."""
+        from sntc_tpu.models.summary import ClassificationSummary
+
+        return ClassificationSummary(self, frame, labelCol=self.getLabelCol())
 
     def _save_extra(self):
         return {}, {"weights": self.weights}

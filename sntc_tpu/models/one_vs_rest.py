@@ -189,7 +189,7 @@ class OneVsRest(_OvrParams, ClassifierEstimator):
         return [self.classifier]
 
     @classmethod
-    def _from_sub_stages(cls, stages, params):
+    def _from_sub_stages(cls, stages, params, extra=None):
         obj = cls(classifier=stages[0])
         obj.setParams(**params)
         return obj
@@ -212,7 +212,7 @@ class OneVsRestModel(_OvrParams, ClassificationModel):
         return self.models
 
     @classmethod
-    def _from_sub_stages(cls, stages, params):
+    def _from_sub_stages(cls, stages, params, extra=None):
         obj = cls(models=stages)
         obj.setParams(**params)
         return obj

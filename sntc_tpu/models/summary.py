@@ -1,14 +1,240 @@
-"""Shared training-summary container (the ``TrainingSummary`` analog).
+"""Training summaries — the ``TrainingSummary`` family (SURVEY.md §5.5).
 
-One generic (objectiveHistory, totalIterations) record used by every
-iteratively-fitted model — LogisticRegression keeps its Spark-named
-alias for API parity (``LogisticRegressionTrainingSummary`` upstream).
+Spark parity: ``LogisticRegressionTrainingSummary`` (upstream
+``ml/classification/LogisticRegression.scala`` summary classes [U])
+carries the TRAINING-set predictions DataFrame plus per-class metrics
+(``precisionByLabel``, ``recallByLabel``, ``fMeasureByLabel``, TPR/FPR
+by label, the weighted aggregates, ``accuracy``) and, for binomial
+models, the threshold curves (``roc``, ``areaUnderROC``, ``pr``,
+``fMeasureByThreshold``, ``precisionByThreshold``,
+``recallByThreshold``).  The same lazy design as Spark: the predictions
+frame is produced on first access (one ``model.transform`` over the
+training frame), and every metric derives from the one confusion matrix
+/ threshold sweep, computed once and cached.
+
+``TrainingSummary`` (objectiveHistory, totalIterations) stays the
+lightweight record used by every iteratively-fitted model; classifiers
+whose fit keeps the training frame get the full classification summary.
 """
 
 from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
 
 
 class TrainingSummary:
     def __init__(self, objective_history, total_iterations: int):
         self.objectiveHistory = [float(v) for v in objective_history]
         self.totalIterations = int(total_iterations)
+
+
+class ClassificationSummary:
+    """Per-class metrics over a predictions frame (Spark's
+    ``ClassificationSummary`` trait).  Lazy: ``model.transform(frame)``
+    runs on first access of :attr:`predictions`/any metric."""
+
+    def __init__(
+        self,
+        model,
+        frame,
+        labelCol: str = "label",
+        weightCol: Optional[str] = None,
+        mesh=None,
+    ):
+        self._model = model
+        self._frame = frame
+        self.labelCol = labelCol
+        self.predictionCol = model.getPredictionCol()
+        self.probabilityCol = (
+            model.getProbabilityCol()
+            if model.hasParam("probabilityCol")
+            else None
+        )
+        self.weightCol = weightCol
+        self._mesh = mesh
+        self._predictions = None
+        self._metrics = None
+
+    # -- lazy plumbing ----------------------------------------------------
+
+    @property
+    def predictions(self):
+        if self._predictions is None:
+            self._predictions = self._model.transform(self._frame)
+        return self._predictions
+
+    def _m(self):
+        if self._metrics is None:
+            from sntc_tpu.evaluation.multiclass import MulticlassMetrics
+
+            out = self.predictions
+            self._metrics = MulticlassMetrics(
+                out[self.labelCol],
+                out[self.predictionCol],
+                weights=out[self.weightCol] if self.weightCol else None,
+                mesh=self._mesh,
+            )
+        return self._metrics
+
+    # -- Spark ClassificationSummary surface ------------------------------
+
+    @property
+    def labels(self) -> np.ndarray:
+        """Class indices in ascending order (Spark ``labels``)."""
+        return np.arange(self._m().num_classes, dtype=np.float64)
+
+    @property
+    def accuracy(self) -> float:
+        return self._m().accuracy
+
+    @property
+    def precisionByLabel(self) -> np.ndarray:
+        return self._m().precision_by_label()
+
+    @property
+    def recallByLabel(self) -> np.ndarray:
+        return self._m().recall_by_label()
+
+    @property
+    def truePositiveRateByLabel(self) -> np.ndarray:
+        return self._m().recall_by_label()
+
+    @property
+    def falsePositiveRateByLabel(self) -> np.ndarray:
+        return self._m().false_positive_rate_by_label()
+
+    def fMeasureByLabel(self, beta: float = 1.0) -> np.ndarray:
+        return self._m().f_measure_by_label(beta)
+
+    @property
+    def weightedPrecision(self) -> float:
+        return self._m().weighted_precision()
+
+    @property
+    def weightedRecall(self) -> float:
+        return self._m().weighted_recall()
+
+    @property
+    def weightedTruePositiveRate(self) -> float:
+        return self._m().weighted_true_positive_rate()
+
+    @property
+    def weightedFalsePositiveRate(self) -> float:
+        return self._m().weighted_false_positive_rate()
+
+    def weightedFMeasure(self, beta: float = 1.0) -> float:
+        return self._m().weighted_f_measure(beta)
+
+
+class BinaryClassificationSummary(ClassificationSummary):
+    """Adds the threshold curves (Spark
+    ``BinaryLogisticRegressionSummary``).  Curves sweep the
+    positive-class score with ties grouped, exactly the evaluator's
+    semantics (``sntc_tpu/evaluation/binary.py``)."""
+
+    def _curve_inputs(self):
+        out = self.predictions
+        raw = out[self._model.getRawPredictionCol()]
+        scores = raw[:, 1] if raw.ndim == 2 else raw
+        w = out[self.weightCol] if self.weightCol else None
+        return np.asarray(out[self.labelCol], np.float64), scores, w
+
+    def _sweep(self):
+        """(thresholds, tp, fp, total_p, total_n) at distinct-score
+        boundaries, cached."""
+        if not hasattr(self, "_sweep_cache"):
+            from sntc_tpu.evaluation.binary import _curves
+
+            y, s, w = self._curve_inputs()
+            order = np.argsort(-np.asarray(s, np.float64), kind="stable")
+            s_sorted = np.asarray(s, np.float64)[order]
+            boundary = (
+                np.flatnonzero(np.diff(s_sorted))
+                if len(s_sorted)
+                else np.array([], np.int64)
+            )
+            ends = (
+                np.concatenate([boundary, [len(s_sorted) - 1]])
+                if len(s_sorted)
+                else boundary
+            )
+            tp, fp, p, n = _curves(y, s, w)
+            self._sweep_cache = (s_sorted[ends], tp, fp, p, n)
+        return self._sweep_cache
+
+    @property
+    def roc(self):
+        """Frame with ``FPR``/``TPR`` columns, anchored at (0,0), (1,1)."""
+        from sntc_tpu.core.frame import Frame
+
+        _, tp, fp, p, n = self._sweep()
+        tpr = np.concatenate([[0.0], tp / max(p, 1e-300), [1.0]])
+        fpr = np.concatenate([[0.0], fp / max(n, 1e-300), [1.0]])
+        return Frame({"FPR": fpr, "TPR": tpr})
+
+    @property
+    def areaUnderROC(self) -> float:
+        from sntc_tpu.evaluation.binary import area_under_roc
+
+        return area_under_roc(*self._curve_inputs())
+
+    @property
+    def pr(self):
+        """Frame with ``recall``/``precision`` columns (Spark ``pr``)."""
+        from sntc_tpu.core.frame import Frame
+
+        _, tp, fp, p, _ = self._sweep()
+        recall = tp / max(p, 1e-300)
+        precision = tp / np.maximum(tp + fp, 1e-300)
+        return Frame({
+            "recall": np.concatenate([[0.0], recall]),
+            "precision": np.concatenate([[precision[0] if len(precision) else 1.0],
+                                         precision]),
+        })
+
+    def _by_threshold(self, values):
+        from sntc_tpu.core.frame import Frame
+
+        thr, *_ = self._sweep()
+        return Frame({"threshold": thr, "metric": values})
+
+    @property
+    def precisionByThreshold(self):
+        _, tp, fp, _, _ = self._sweep()
+        return self._by_threshold(tp / np.maximum(tp + fp, 1e-300))
+
+    @property
+    def recallByThreshold(self):
+        _, tp, _, p, _ = self._sweep()
+        return self._by_threshold(tp / max(p, 1e-300))
+
+    def fMeasureByThreshold(self, beta: float = 1.0):
+        _, tp, fp, p, _ = self._sweep()
+        prec = tp / np.maximum(tp + fp, 1e-300)
+        rec = tp / max(p, 1e-300)
+        b2 = beta * beta
+        denom = np.maximum(b2 * prec + rec, 1e-300)
+        return self._by_threshold((1 + b2) * prec * rec / denom)
+
+
+class ClassificationTrainingSummary(ClassificationSummary, TrainingSummary):
+    def __init__(self, objective_history, total_iterations, model, frame,
+                 labelCol="label", weightCol=None, mesh=None):
+        TrainingSummary.__init__(self, objective_history, total_iterations)
+        ClassificationSummary.__init__(
+            self, model, frame, labelCol=labelCol, weightCol=weightCol,
+            mesh=mesh,
+        )
+
+
+class BinaryClassificationTrainingSummary(
+    BinaryClassificationSummary, ClassificationTrainingSummary
+):
+    def __init__(self, objective_history, total_iterations, model, frame,
+                 labelCol="label", weightCol=None, mesh=None):
+        ClassificationTrainingSummary.__init__(
+            self, objective_history, total_iterations, model, frame,
+            labelCol=labelCol, weightCol=weightCol, mesh=mesh,
+        )

@@ -1,11 +1,13 @@
 """GBTRegressor — gradient-boosted regression trees.
 
 Behavioral spec: upstream ``ml/regression/GBTRegressor.scala`` →
-``tree/impl/GradientBoostedTrees`` [U]: start from the (weighted) target
-mean; each round fits a variance-impurity tree to the loss's negative
-gradient — squared loss: ``r = y − F`` (leaf = mean residual); absolute
-loss: ``r = sign(y − F)`` with mean-of-sign leaves, exactly Spark's
-treatment — then ``F += stepSize · tree(x)``.  ``validationIndicatorCol``
+``tree/impl/GradientBoostedTrees`` [U]: the FIRST tree fits the raw
+labels with weight 1.0 for both losses (we fit the raw residuals of the
+constant mean init, which is equivalent); each later round fits a
+variance-impurity tree to the loss's negative gradient — squared loss:
+``r = y − F`` (leaf = mean residual); absolute loss: ``r = sign(y − F)``
+with mean-of-sign leaves, exactly Spark's treatment — then
+``F += stepSize · tree(x)``.  ``validationIndicatorCol``
 / ``validationTol`` stop boosting on a validation plateau
 (``runWithValidation`` semantics, as in the classifier).
 
@@ -115,6 +117,11 @@ class GBTRegressor(_GbtRegParams, CheckpointParams, Estimator):
         val_col = self.getValidationIndicatorCol()
         if val_col:
             val_mask = np.asarray(frame[val_col]).astype(bool)
+            if not val_mask.any() or val_mask.all():
+                raise ValueError(
+                    "validationIndicatorCol must mark a non-empty proper "
+                    "subset of rows"
+                )
             X_train, y = X[~val_mask], y_all[~val_mask]
             X_val, y_val = X[val_mask], y_all[val_mask]
         else:
@@ -180,7 +187,7 @@ class GBTRegressor(_GbtRegParams, CheckpointParams, Estimator):
         # matters because the saved device arrays are PADDED to the mesh
         # size: a resume on a different mesh must restart, not splice.
         fingerprint = {
-            "algo": "gbt_reg", "maxIter": n_rounds,
+            "algo": "gbt_reg", "boost_v": 2, "maxIter": n_rounds,
             "n_shards": int(mesh.shape[axis]),
             "maxDepth": self.getMaxDepth(), "stepSize": step,
             "seed": seed, "n_rows": n, "maxBins": n_bins, "loss": loss,
@@ -215,7 +222,16 @@ class GBTRegressor(_GbtRegParams, CheckpointParams, Estimator):
                     if tracker.done[0]:
                         start_round = n_rounds
         for m in range(start_round, n_rounds):
-            row_stats = resid_fn(ys, ws, pred)
+            # Spark boost() fits the FIRST tree to the raw labels with
+            # weight 1.0 for BOTH losses; fitting the raw residuals of the
+            # constant init is equivalent (variance splits are
+            # shift-invariant, leaf means shift by init).  Sign residuals
+            # (absolute loss) apply only from the second tree on.
+            row_stats = (
+                _sq_residual_stats(ys, ws, pred)
+                if m == 0
+                else resid_fn(ys, ws, pred)
+            )
             forest = grow_forest(
                 binned, row_stats, round_weights(m), edges,
                 seed=seed + m, mesh=mesh, **grow_kwargs,
@@ -226,10 +242,7 @@ class GBTRegressor(_GbtRegParams, CheckpointParams, Estimator):
                 jnp.asarray(forest.leaf_stats),
                 max_depth=forest.max_depth,
             )
-            # Spark's first squared-loss tree carries weight 1.0 (it fits
-            # the raw residuals of the constant init); every later tree —
-            # and every absolute-loss sign tree — is scaled by stepSize
-            tree_w = 1.0 if (m == 0 and loss == "squared") else step
+            tree_w = 1.0 if m == 0 else step
             pred = pred + tree_w * contrib
             features.append(forest.feature[0])
             thresholds.append(forest.threshold[0])

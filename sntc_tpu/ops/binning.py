@@ -34,10 +34,23 @@ def _default_sample_rows(max_bins: int) -> int:
     return max(10_000, 4 * max_bins * max_bins)
 
 
-@partial(jax.jit, static_argnames=("max_bins", "stride"))
-def _edges_device(X: jnp.ndarray, *, max_bins: int, stride: int) -> jnp.ndarray:
+@partial(jax.jit, static_argnames=("max_bins", "sample_rows"))
+def _edges_device(
+    X: jnp.ndarray, seed: jnp.ndarray, *, max_bins: int, sample_rows: int
+) -> jnp.ndarray:
     qs = jnp.linspace(0.0, 1.0, max_bins + 1)[1:-1]
-    sample = X[::stride] if stride > 1 else X
+    n = X.shape[0]
+    if sample_rows < n:
+        # seed-keyed uniform sample without replacement, matching the
+        # host path's semantics: a strided X[::k] sample would bias the
+        # edges on device matrices with periodic/sorted row structure
+        # (flow data ordered by time or label)
+        idx = jax.random.choice(
+            jax.random.PRNGKey(seed), n, shape=(sample_rows,), replace=False
+        )
+        sample = X[idx]
+    else:
+        sample = X
     return jnp.quantile(sample.astype(jnp.float32), qs, axis=0).T
 
 
@@ -51,18 +64,20 @@ def quantile_bin_edges(
 
     Returns an ndarray matching the input's residency: numpy in → numpy
     edges (host quantile of a ``seed``-driven random row sample);
-    ``jax.Array`` in → device edges from a STRIDED row sample (``seed``
-    is unused there — the stride is deterministic, and the feature matrix
-    never leaves the device).  With ``sample_rows >= n`` both paths use
-    every row and agree to float tolerance (tests/test_trees.py parity
-    test).
+    ``jax.Array`` in → device edges from a ``seed``-keyed
+    ``jax.random.choice`` row sample (without replacement) — the feature
+    matrix never leaves the device.  With ``sample_rows >= n`` both paths
+    use every row and agree to float tolerance (tests/test_trees.py
+    parity test).
     """
     n, f = X.shape
     if sample_rows is None:
         sample_rows = _default_sample_rows(max_bins)
     if isinstance(X, jax.Array):
-        stride = max(n // sample_rows, 1)
-        return _edges_device(X, max_bins=max_bins, stride=stride)
+        return _edges_device(
+            X, jnp.uint32(seed & 0xFFFFFFFF),
+            max_bins=max_bins, sample_rows=min(int(sample_rows), n),
+        )
     if n > sample_rows:
         idx = np.random.default_rng(seed).choice(n, size=sample_rows, replace=False)
         sample = X[idx]

@@ -66,6 +66,8 @@ class DirStreamSource(StreamSource):
         files = self._files()[start:end]
         if not files:
             raise ValueError(f"empty batch range [{start}, {end})")
+        if len(files) == 1:  # common micro-batch case: skip the concat copy
+            return self._load_file(files[0])
         return Frame.concat_all([self._load_file(p) for p in files])
 
 
@@ -92,6 +94,8 @@ class MemorySource(StreamSource):
         return len(self._frames)
 
     def get_batch(self, start: int, end: int) -> Frame:
+        if end - start == 1:  # skip the concat copy for 1-frame batches
+            return self._frames[start]
         return Frame.concat_all(self._frames[start:end])
 
 
@@ -159,6 +163,8 @@ class StreamingQuery:
     query, which re-scans the log.
     """
 
+    _PROGRESS_KEEP = 100  # Spark keeps the last 100 progress records
+
     def __init__(
         self,
         model: Transformer,
@@ -167,6 +173,7 @@ class StreamingQuery:
         checkpoint_dir: str,
         max_batch_offsets: Optional[int] = None,
         pipeline_depth: int = 2,
+        wal_mode: str = "files",
     ):
         self.predictor = BatchPredictor(model)
         self.source = source
@@ -184,16 +191,67 @@ class StreamingQuery:
         self.pipeline_depth = max(1, int(pipeline_depth))
         self._in_flight: List[tuple] = []
         self._stopped = False
+        # last _PROGRESS_KEEP committed batches' timing/rows (the
+        # ``StreamingQueryProgress``/``recentProgress`` analog); durationMs
+        # is WAL-intent→commit, i.e. true per-batch latency including
+        # pipeline queue wait
+        self.recentProgress: List[dict] = []
+        if wal_mode not in ("files", "append"):
+            raise ValueError("wal_mode must be 'files' or 'append'")
+        self.wal_mode = wal_mode
         self._offsets_dir = os.path.join(checkpoint_dir, "offsets")
         self._commits_dir = os.path.join(checkpoint_dir, "commits")
-        os.makedirs(self._offsets_dir, exist_ok=True)
-        os.makedirs(self._commits_dir, exist_ok=True)
-        # recover bookkeeping from the log ONCE; afterwards the engine tracks
-        # it in memory (the WAL files are still written per batch — the
-        # directory scan per batch was pure overhead, not durability)
-        self._last_committed = self._scan_last_committed()
-        self._end_offset = self._read_committed_end(self._last_committed)
+        if wal_mode == "append":
+            self._init_append_wal(checkpoint_dir)
+        else:
+            os.makedirs(self._offsets_dir, exist_ok=True)
+            os.makedirs(self._commits_dir, exist_ok=True)
+            self._pending_intents = None
+            # recover bookkeeping from the log ONCE; afterwards the engine
+            # tracks it in memory (the WAL files are still written per
+            # batch — the directory scan per batch was pure overhead, not
+            # durability)
+            self._last_committed = self._scan_last_committed()
+            self._end_offset = self._read_committed_end(self._last_committed)
         self._next_start = self._end_offset
+
+    def _init_append_wal(self, checkpoint_dir: str) -> None:
+        """``wal_mode='append'``: one JSONL log per side (intents /
+        commits) with a single flushed append write per batch — the
+        high-throughput WAL.  Same recovery contract as the per-file
+        format (uncommitted logged intents replay on restart); the two
+        formats are per-checkpoint-dir exclusive."""
+        if os.path.isdir(self._offsets_dir) or os.path.isdir(
+            self._commits_dir
+        ):
+            raise ValueError(
+                f"checkpoint dir {checkpoint_dir!r} was written in "
+                "'files' WAL mode; pick a fresh dir for 'append' mode"
+            )
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        offsets_path = os.path.join(checkpoint_dir, "offsets.log")
+        commits_path = os.path.join(checkpoint_dir, "commits.log")
+
+        def read_log(path):
+            if not os.path.exists(path):
+                return {}
+            out = {}
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rec = json.loads(line)
+                        out[int(rec["batch_id"])] = rec
+            return out
+
+        self._pending_intents = read_log(offsets_path)
+        commits = read_log(commits_path)
+        self._last_committed = max(commits) if commits else -1
+        self._end_offset = (
+            commits[self._last_committed]["end"] if commits else 0
+        )
+        self._offsets_log = open(offsets_path, "a")
+        self._commits_log = open(commits_path, "a")
 
     # -- checkpoint bookkeeping -------------------------------------------
 
@@ -220,11 +278,35 @@ class StreamingQuery:
         return self._end_offset
 
     def _pending_intent(self, batch_id: int):
+        if self._pending_intents is not None:  # append mode: in-memory
+            return self._pending_intents.get(batch_id)
         path = os.path.join(self._offsets_dir, f"{batch_id}.json")
         if os.path.exists(path):
             with open(path) as f:
                 return json.load(f)
         return None
+
+    def _wal_intent(self, batch_id: int, intent: dict) -> None:
+        if self.wal_mode == "append":
+            self._offsets_log.write(json.dumps(intent) + "\n")
+            self._offsets_log.flush()
+            self._pending_intents[batch_id] = intent
+        else:
+            with open(
+                os.path.join(self._offsets_dir, f"{batch_id}.json"), "w"
+            ) as f:
+                json.dump(intent, f)
+
+    def _wal_commit(self, batch_id: int, intent: dict) -> None:
+        if self.wal_mode == "append":
+            self._commits_log.write(json.dumps(intent) + "\n")
+            self._commits_log.flush()
+            self._pending_intents.pop(batch_id, None)
+        else:
+            with open(
+                os.path.join(self._commits_dir, f"{batch_id}.json"), "w"
+            ) as f:
+                json.dump(intent, f)
 
     # -- engine ------------------------------------------------------------
 
@@ -243,14 +325,13 @@ class StreamingQuery:
                 end = min(end, start + self.max_batch_offsets)
             intent = {"batch_id": batch_id, "start": start, "end": end}
             # intent WAL before any processing (OffsetSeqLog)
-            with open(
-                os.path.join(self._offsets_dir, f"{batch_id}.json"), "w"
-            ) as f:
-                json.dump(intent, f)
+            self._wal_intent(batch_id, intent)
 
+        t0 = time.perf_counter()
         frame = self.source.get_batch(intent["start"], intent["end"])
         finalize = self.predictor.predict_frame_async(frame)
-        self._in_flight.append((batch_id, intent, finalize))
+        self._in_flight.append((batch_id, intent, finalize, t0,
+                                frame.num_rows))
         self._next_start = intent["end"]
         return True
 
@@ -262,15 +343,21 @@ class StreamingQuery:
         ``process_available`` retries it from its WAL'd intent — popping
         first would silently skip the batch and shift every later
         ``batch_id`` (exactly-once violation)."""
-        batch_id, intent, finalize = self._in_flight[0]
+        batch_id, intent, finalize, t0, n_rows = self._in_flight[0]
         self.sink.add_batch(batch_id, finalize())
-        with open(
-            os.path.join(self._commits_dir, f"{batch_id}.json"), "w"
-        ) as f:
-            json.dump(intent, f)
+        self._wal_commit(batch_id, intent)
         self._in_flight.pop(0)
         self._last_committed = batch_id
         self._end_offset = intent["end"]
+        dur = time.perf_counter() - t0
+        self.recentProgress.append({
+            "batchId": batch_id,
+            "numInputRows": int(n_rows),
+            "durationMs": dur * 1e3,
+            "processedRowsPerSecond": (n_rows / dur) if dur > 0 else 0.0,
+        })
+        if len(self.recentProgress) > self._PROGRESS_KEEP:
+            del self.recentProgress[0]
 
     def _run_one_batch(self) -> bool:
         """Advance the pipeline by one committed batch; returns False when
@@ -311,3 +398,6 @@ class StreamingQuery:
 
     def stop(self) -> None:
         self._stopped = True
+        if self.wal_mode == "append":
+            self._offsets_log.close()
+            self._commits_log.close()

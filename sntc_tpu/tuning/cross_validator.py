@@ -182,30 +182,84 @@ class CrossValidator(_TuningParams, Estimator):
             avgMetrics=avg.tolist(),
             bestIndex=best_idx,
             subModels=sub_models,
+            estimator=self.estimator,
+            evaluator=self.evaluator,
+            estimatorParamMaps=grid,
         )
+
+    # -- persistence: a saved CrossValidator round-trips its full spec
+    # (estimator + evaluator stages, grid in JSON), Spark ReadWrite parity
+
+    def _sub_stages(self):
+        return [self.estimator, self.evaluator]
+
+    def _save_extra(self):
+        return {"estimatorParamMaps": self.estimatorParamMaps}, {}
+
+    @classmethod
+    def _from_sub_stages(cls, stages, params, extra=None):
+        obj = cls(
+            estimator=stages[0], evaluator=stages[1],
+            estimatorParamMaps=(extra or {}).get("estimatorParamMaps")
+            or [{}],
+        )
+        obj.setParams(**params)
+        return obj
 
 
 class CrossValidatorModel(Model):
+    """Best-model wrapper; carries ``avgMetrics`` per grid point and —
+    for Spark save/load parity — the tuning spec (``estimator``,
+    ``evaluator``, ``estimatorParamMaps``), all of which round-trip
+    through ``save``/``load`` so a loaded result can re-run the search.
+    ``subModels`` are in-memory only (not persisted)."""
+
     def __init__(self, bestModel: Model = None, avgMetrics: List[float] = None,
-                 bestIndex: int = 0, subModels=None, **kwargs):
+                 bestIndex: int = 0, subModels=None, estimator=None,
+                 evaluator=None, estimatorParamMaps=None, **kwargs):
         super().__init__(**kwargs)
         self.bestModel = bestModel
         self.avgMetrics = avgMetrics or []
         self.bestIndex = bestIndex
         self.subModels = subModels
+        self.estimator = estimator
+        self.evaluator = evaluator
+        self.estimatorParamMaps = estimatorParamMaps or []
 
     def transform(self, frame: Frame) -> Frame:
         return self.bestModel.transform(frame)
 
+    def _has_spec(self) -> bool:
+        return self.estimator is not None and self.evaluator is not None
+
     def _sub_stages(self):
-        return [self.bestModel]
+        stages = [self.bestModel]
+        if self._has_spec():
+            stages += [self.estimator, self.evaluator]
+        return stages
 
     def _save_extra(self):
-        return {"avgMetrics": self.avgMetrics, "bestIndex": self.bestIndex}, {}
+        return {
+            "avgMetrics": self.avgMetrics,
+            "bestIndex": self.bestIndex,
+            "estimatorParamMaps": self.estimatorParamMaps or None,
+            "has_spec": self._has_spec(),
+        }, {}
 
     @classmethod
-    def _from_sub_stages(cls, stages, params):
-        obj = cls(bestModel=stages[0])
+    def _from_sub_stages(cls, stages, params, extra=None):
+        extra = extra or {}
+        est = ev = None
+        if extra.get("has_spec") and len(stages) >= 3:
+            est, ev = stages[1], stages[2]
+        obj = cls(
+            bestModel=stages[0],
+            avgMetrics=extra.get("avgMetrics") or [],
+            bestIndex=int(extra.get("bestIndex", 0)),
+            estimator=est,
+            evaluator=ev,
+            estimatorParamMaps=extra.get("estimatorParamMaps"),
+        )
         obj.setParams(**params)
         return obj
 
@@ -259,32 +313,77 @@ class TrainValidationSplit(_TvsParams, Estimator):
         return TrainValidationSplitModel(
             bestModel=best_model, validationMetrics=metrics,
             bestIndex=best_idx, subModels=sub_models,
+            estimator=self.estimator, evaluator=self.evaluator,
+            estimatorParamMaps=grid,
         )
+
+    def _sub_stages(self):
+        return [self.estimator, self.evaluator]
+
+    def _save_extra(self):
+        return {"estimatorParamMaps": self.estimatorParamMaps}, {}
+
+    @classmethod
+    def _from_sub_stages(cls, stages, params, extra=None):
+        obj = cls(
+            estimator=stages[0], evaluator=stages[1],
+            estimatorParamMaps=(extra or {}).get("estimatorParamMaps")
+            or [{}],
+        )
+        obj.setParams(**params)
+        return obj
 
 
 class TrainValidationSplitModel(Model):
+    """Best-model wrapper; persistence mirrors
+    :class:`CrossValidatorModel` (spec + metrics round-trip,
+    ``subModels`` in-memory only)."""
+
     def __init__(self, bestModel: Model = None, validationMetrics=None,
-                 bestIndex: int = 0, subModels=None, **kwargs):
+                 bestIndex: int = 0, subModels=None, estimator=None,
+                 evaluator=None, estimatorParamMaps=None, **kwargs):
         super().__init__(**kwargs)
         self.bestModel = bestModel
         self.validationMetrics = validationMetrics or []
         self.bestIndex = bestIndex
         self.subModels = subModels
+        self.estimator = estimator
+        self.evaluator = evaluator
+        self.estimatorParamMaps = estimatorParamMaps or []
 
     def transform(self, frame: Frame) -> Frame:
         return self.bestModel.transform(frame)
 
+    def _has_spec(self) -> bool:
+        return self.estimator is not None and self.evaluator is not None
+
     def _sub_stages(self):
-        return [self.bestModel]
+        stages = [self.bestModel]
+        if self._has_spec():
+            stages += [self.estimator, self.evaluator]
+        return stages
 
     def _save_extra(self):
         return {
             "validationMetrics": self.validationMetrics,
             "bestIndex": self.bestIndex,
+            "estimatorParamMaps": self.estimatorParamMaps or None,
+            "has_spec": self._has_spec(),
         }, {}
 
     @classmethod
-    def _from_sub_stages(cls, stages, params):
-        obj = cls(bestModel=stages[0])
+    def _from_sub_stages(cls, stages, params, extra=None):
+        extra = extra or {}
+        est = ev = None
+        if extra.get("has_spec") and len(stages) >= 3:
+            est, ev = stages[1], stages[2]
+        obj = cls(
+            bestModel=stages[0],
+            validationMetrics=extra.get("validationMetrics") or [],
+            bestIndex=int(extra.get("bestIndex", 0)),
+            estimator=est,
+            evaluator=ev,
+            estimatorParamMaps=extra.get("estimatorParamMaps"),
+        )
         obj.setParams(**params)
         return obj

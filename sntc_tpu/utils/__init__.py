@@ -1,3 +1,4 @@
+from sntc_tpu.utils.compile_cache import enable_persistent_cache
 from sntc_tpu.utils.logging import MetricsLogger
 from sntc_tpu.utils.profiling import profile_trace, StepTimer
 

@@ -1,0 +1,42 @@
+"""Persistent XLA compilation cache (SURVEY.md §3.5 cold-start).
+
+Spark pays no per-process compile; JAX pays full XLA compilation on the
+first fit of every process (~8-13× the warm fit on the bench configs).
+JAX's persistent compilation cache closes most of that gap: compiled
+executables are written to a directory keyed by (HLO, flags, platform),
+so the SECOND process's "cold" fit only pays trace + cache lookup.
+
+Opt-out with ``SNTC_NO_COMPILE_CACHE=1``; the directory defaults to
+``~/.cache/sntc_tpu_xla`` and can be moved with
+``JAX_COMPILATION_CACHE_DIR`` (the stock JAX env var wins if set, since
+``jax.config`` reads it at import).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
+    """Turn on JAX's on-disk compilation cache; returns the dir (or None
+    when disabled).  Safe to call more than once and before/after other
+    jax.config updates; must run before the first compilation to help."""
+    if os.environ.get("SNTC_NO_COMPILE_CACHE"):
+        return None
+    import jax
+
+    cache_dir = (
+        cache_dir
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or os.path.join(
+            os.path.expanduser("~"), ".cache", "sntc_tpu_xla"
+        )
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # default min compile time is 1s, which skips most of the small
+    # per-stage programs (binning, scaler aggregates) whose compiles
+    # still add up across a pipeline; cache everything non-trivial
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return cache_dir

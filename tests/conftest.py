@@ -17,6 +17,9 @@ if "--xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# tests invoke bench.py helpers (smoke tests); the committed run journal
+# must hold only real bench invocations
+os.environ["BENCH_NO_JOURNAL"] = "1"
 
 import jax  # noqa: E402
 import pytest  # noqa: E402

@@ -1,3 +1,5 @@
+import os
+
 import numpy as np
 
 from sntc_tpu.parallel import global_mesh, initialize, process_info
@@ -33,3 +35,75 @@ def test_global_mesh_covers_all_devices(mesh8):
 def test_process_info_single():
     info = process_info()
     assert info["process_count"] == 1 and info["process_index"] == 0
+
+
+_WORKER = """
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from sntc_tpu.parallel.distributed import (
+    global_mesh, initialize, process_info,
+)
+
+pid, port = int(sys.argv[1]), sys.argv[2]
+assert initialize(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=2,
+    process_id=pid,
+)
+info = process_info()
+assert info["process_count"] == 2, info
+assert info["process_index"] == pid, info
+assert info["global_devices"] == 4, info
+mesh = global_mesh()
+assert mesh.devices.size == 4
+
+# a REAL cross-process collective: allgather each process's scalar
+from jax.experimental import multihost_utils
+
+g = multihost_utils.process_allgather(np.array([float(pid + 1)]))
+assert g.reshape(-1).tolist() == [1.0, 2.0], g
+print("DIST_OK", flush=True)
+"""
+
+
+def test_two_process_initialize(tmp_path):
+    """jax.distributed.initialize exercised for REAL: two coordinated
+    processes (2 virtual CPU devices each), global mesh over all 4
+    devices, one cross-process allgather (SURVEY.md §5.8)."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=repo,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+        assert "DIST_OK" in out

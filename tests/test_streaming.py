@@ -249,3 +249,58 @@ def test_pipelined_sink_failure_retries_not_skips(model, tmp_path):
     assert q.process_available() == 3
     assert [i for i, _ in sink.batches] == [0, 1, 2, 3]
     assert q.last_committed() == 3
+
+
+def test_append_wal_resume_and_replay(model, tmp_path):
+    """wal_mode='append': same exactly-once recovery contract as the
+    per-file WAL — committed batches don't reprocess; a crash between
+    intent and commit replays exactly the logged range."""
+    ckpt = str(tmp_path / "ckpt")
+    src = MemorySource([_batch(40, 1)])
+    sink1 = MemorySink()
+    q1 = StreamingQuery(model, src, sink1, ckpt, wal_mode="append")
+    assert q1.process_available() == 1
+    q1.stop()
+
+    sink2 = MemorySink()
+    q2 = StreamingQuery(model, src, sink2, ckpt, wal_mode="append")
+    assert q2.process_available() == 0  # committed data not reprocessed
+    src.add(_batch(25, 2))
+    assert q2.process_available() == 1
+    assert [f.num_rows for f in sink2.frames] == [25]
+    q2.stop()
+
+    # crash-after-intent: hand-write an uncommitted intent line
+    ckpt2 = str(tmp_path / "ckpt2")
+    os.makedirs(ckpt2)
+    with open(os.path.join(ckpt2, "offsets.log"), "w") as f:
+        f.write(json.dumps({"batch_id": 0, "start": 0, "end": 1}) + "\n")
+    src3 = MemorySource([_batch(10, 1), _batch(20, 2)])
+    sink3 = MemorySink()
+    q3 = StreamingQuery(model, src3, sink3, ckpt2, wal_mode="append",
+                        )
+    assert q3.process_available() == 2
+    assert [f.num_rows for f in sink3.frames] == [10, 20]
+
+
+def test_append_wal_rejects_files_mode_dir(model, tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    src = MemorySource([_batch(10, 1)])
+    q = StreamingQuery(model, src, MemorySink(), ckpt)  # files mode
+    q.process_available()
+    q.stop()
+    with pytest.raises(ValueError, match="files"):
+        StreamingQuery(model, src, MemorySink(), ckpt, wal_mode="append")
+
+
+def test_recent_progress_records(model, tmp_path):
+    src = MemorySource([_batch(5, i) for i in range(3)])
+    sink = MemorySink()
+    q = StreamingQuery(model, src, sink, str(tmp_path / "ckpt"),
+                       max_batch_offsets=1)
+    q.process_available()
+    assert [p["batchId"] for p in q.recentProgress] == [0, 1, 2]
+    for p in q.recentProgress:
+        assert p["numInputRows"] == 5
+        assert p["durationMs"] > 0
+        assert p["processedRowsPerSecond"] > 0
