@@ -1,0 +1,85 @@
+"""Stage-by-stage profile of bench config 1 (VERDICT r2 item 6).
+
+Times each pipeline stage's fit and transform separately (warm, after a
+same-shape warmup round), so the remaining gap to the sklearn proxy has
+an address: indexer? assembler? scaler fit? scaler transform? LR fit?
+
+Usage:  python scripts/profile_config1.py [--rows 250000] [--platform cpu]
+Prints one JSON line per stage plus a total.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=250_000)
+    ap.add_argument("--platform", default=os.environ.get("BENCH_PLATFORM"))
+    args = ap.parse_args()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    import jax
+
+    from sntc_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    import numpy as np
+
+    from bench import SEED, LR_MAX_ITER, _dataset, _feature_stages
+    from sntc_tpu.models import LogisticRegression
+    from sntc_tpu.parallel.context import get_default_mesh
+
+    mesh = get_default_mesh()
+    train, _ = _dataset(args.rows, binary=True)
+
+    def run_once(record):
+        stages = _feature_stages(mesh) + [
+            LogisticRegression(mesh=mesh, maxIter=LR_MAX_ITER,
+                               regParam=1e-4)
+        ]
+        frame = train
+        total0 = time.perf_counter()
+        for st in stages:
+            name = type(st).__name__
+            t0 = time.perf_counter()
+            fitted = st.fit(frame) if hasattr(st, "_fit") else st
+            t_fit = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            if not isinstance(st, LogisticRegression):
+                frame = fitted.transform(frame)
+            t_tr = time.perf_counter() - t0
+            if record is not None:
+                record.append({
+                    "stage": name,
+                    "fit_s": round(t_fit, 4),
+                    "transform_s": round(t_tr, 4),
+                })
+        if record is not None:
+            record.append({
+                "stage": "TOTAL",
+                "fit_s": round(time.perf_counter() - total0, 4),
+                "platform": jax.devices()[0].platform,
+                "n_rows": train.num_rows,
+            })
+
+    run_once(None)  # warmup: compile + device caches
+    rec: list = []
+    run_once(rec)
+    for row in rec:
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -27,7 +27,7 @@ import numpy as np
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
 from sntc_tpu.models.base import ClassificationModel, ClassifierEstimator
-from sntc_tpu.models.summary import TrainingSummary
+from sntc_tpu.models.summary import BinaryClassificationTrainingSummary
 from sntc_tpu.ops.lbfgs import minimize_lbfgs
 from sntc_tpu.parallel.collectives import make_tree_aggregate, shard_batch, shard_weights
 from sntc_tpu.parallel.context import get_default_mesh
@@ -149,8 +149,11 @@ class LinearSVC(_SvcParams, ClassifierEstimator):
                if model.hasParam(k2)}
         )
         n_it = int(res.n_iters)
-        model.summary = TrainingSummary(
-            np.asarray(res.history)[: n_it + 1], n_it
+        # Spark's LinearSVCTrainingSummary: per-class metrics + threshold
+        # curves over the training predictions (binary), lazily computed
+        model.summary = BinaryClassificationTrainingSummary(
+            np.asarray(res.history)[: n_it + 1], n_it, model, frame,
+            labelCol=self.getLabelCol(), mesh=mesh,
         )
         return model
 

@@ -373,6 +373,16 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
         model.setParams(
             **{k2: v for k2, v in self.paramValues().items() if model.hasParam(k2)}
         )
+        # Spark 3.1+ BinaryGBTClassifierTrainingSummary (GBT is
+        # binary-only upstream and here; OvR wraps it for 15 classes)
+        from sntc_tpu.models.summary import (
+            BinaryClassificationTrainingSummary,
+        )
+
+        model.summary = BinaryClassificationTrainingSummary(
+            [], len(weights), model, frame,
+            labelCol=self.getLabelCol(), mesh=mesh,
+        )
         return model
 
 

@@ -236,6 +236,23 @@ def _stat_count(stats: jnp.ndarray, impurity: str) -> jnp.ndarray:
     return stats.sum(axis=-1)
 
 
+def node_group_size(T: int, F: int, n_bins: int, S: int) -> int:
+    """Nodes per histogram pass, bounded so the level working set
+    (histogram + cumsum + left/right slices + gain tensor, ~5× the raw
+    histogram) stays under ``SNTC_TREE_NODE_GROUP_MB`` (default 512 MB;
+    Spark's ``maxMemoryInMB=256`` bounds its node groups the same way
+    [U] — we default 2× that, HBM being roomier than a 2010s JVM heap).
+    Deep levels evaluate in several passes over the binned data instead
+    of materializing a multi-GB ``[T, 2^d, F, B, S]`` tensor — the
+    memory/compute tradeoff Spark makes."""
+    import os
+
+    budget = float(os.environ.get("SNTC_TREE_NODE_GROUP_MB", 512))
+    per_node = 5.0 * T * F * n_bins * S * 4
+    raw = max(1, int(budget * 1024 * 1024 / per_node))
+    return 1 << (raw.bit_length() - 1)  # pow2: levels split evenly
+
+
 def _level_core(
     binned,  # [N, F] int32, row-sharded
     binned_t,  # [F, N] int32, row-sharded on axis 1 (pallas layout)
@@ -252,17 +269,139 @@ def _level_core(
     n_bins: int,
     impurity: str,
     subset_k: int,
+    group: int,
     hist_impl: str = "segment",
     mesh=None,
     interpret: bool = False,
     route: bool = True,
 ):
-    """One level's histogram + split evaluation + (optional) row routing.
-    Traced inside :func:`_grow_fused`'s unrolled level loop."""
+    """One level's histogram + split evaluation + (optional) row routing,
+    with the node axis evaluated in memory-bounded groups of ``group``
+    nodes (Spark's maxMemoryInMB node-group analog; resolved ONCE in
+    :func:`grow_forest` so it participates in the jit cache key).  Traced
+    inside :func:`_grow_fused`'s unrolled level loop."""
+    n, F = binned.shape
+    S = row_stats.shape[-1]
+    T = w_trees.shape[0]
+
+    # feature subsetting drawn ONCE for the level (tiny [T, nodes, F]),
+    # so the chosen subsets don't depend on how the nodes are grouped
+    fmask = None
+    if subset_k < F:
+        r = jax.random.uniform(key, (T, n_nodes, F))
+        kth = -jax.lax.top_k(-r, subset_k)[0][..., -1]  # kth smallest
+        fmask = r <= kth[..., None]
+
+    if n_nodes <= group:
+        out = _eval_node_group(
+            binned, binned_t, row_stats, w_trees, node_idx, fmask,
+            min_instances,
+            lo=jnp.int32(0), g=n_nodes, n_bins=n_bins,
+            impurity=impurity, hist_impl=hist_impl, mesh=mesh,
+            interpret=interpret,
+        )
+    else:
+        # groups share shapes (pow2 group divides the pow2 level), so the
+        # whole level is ONE lax.map over group offsets: one trace, and
+        # only one group's histogram working set live at a time
+        n_groups = n_nodes // group
+        los = jnp.arange(n_groups, dtype=jnp.int32) * group
+        if fmask is None:
+            args = los
+
+            def one(lo_t):
+                return _eval_node_group(
+                    binned, binned_t, row_stats, w_trees, node_idx, None,
+                    min_instances,
+                    lo=lo_t, g=group, n_bins=n_bins, impurity=impurity,
+                    hist_impl=hist_impl, mesh=mesh, interpret=interpret,
+                )
+        else:
+            fmask_g = fmask.reshape(T, n_groups, group, F).transpose(
+                1, 0, 2, 3
+            )
+            args = (los, fmask_g)
+
+            def one(a):
+                return _eval_node_group(
+                    binned, binned_t, row_stats, w_trees, node_idx, a[1],
+                    min_instances,
+                    lo=a[0], g=group, n_bins=n_bins, impurity=impurity,
+                    hist_impl=hist_impl, mesh=mesh, interpret=interpret,
+                )
+
+        stacked = jax.lax.map(one, args)  # each: [n_groups, T, group, ...]
+        out = {
+            k: jnp.moveaxis(v, 0, 1).reshape(
+                (T, n_nodes) + v.shape[3:]
+            )
+            for k, v in stacked.items()
+        }
+
+    best_feat = out["best_feat"]
+    best_bin = out["best_bin"]
+    best_gain = out["best_gain"]
+    parent_cnt = out["parent_count"]
+    has_rows = parent_cnt > 0
+    do_split = has_rows & jnp.isfinite(best_gain) & (best_gain > min_info_gain)
+    # Spark treats minInfoGain=0 as "any strictly positive gain"
+    do_split = do_split & (best_gain > 0)
+
+    # ---- route rows to children (skipped at the last level) ----------------
+    if route:
+        idx = jnp.where(node_idx >= 0, node_idx, 0)  # [T, N]
+        splits = jnp.take_along_axis(do_split, idx, axis=1)  # [T, N]
+        feats = jnp.take_along_axis(best_feat, idx, axis=1)  # [T, N]
+        bins_thr = jnp.take_along_axis(best_bin, idx, axis=1)  # [T, N]
+        row_bins = jax.vmap(
+            lambda f_t: jnp.take_along_axis(binned, f_t[:, None], axis=1)[:, 0]
+        )(feats)  # [T, N]
+        go_right = (row_bins > bins_thr).astype(jnp.int32)
+        child = 2 * idx + go_right
+        new_node_idx = jnp.where(
+            (node_idx >= 0) & splits, child, -1
+        ).astype(jnp.int32)
+    else:
+        new_node_idx = node_idx
+
+    return {
+        "best_feat": best_feat,
+        "best_bin": best_bin,
+        "best_gain": best_gain,
+        "do_split": do_split,
+        "has_rows": has_rows,
+        "parent_stats": out["parent_stats"],
+        "parent_count": parent_cnt,
+        "left_stats": out["left_stats"],
+        "right_stats": out["right_stats"],
+        "new_node_idx": new_node_idx,
+    }
+
+
+def _eval_node_group(
+    binned, binned_t, row_stats, w_trees, node_idx, fmask,
+    min_instances,
+    *,
+    lo,  # traced int32 scalar: first node id of the group
+    g: int,
+    n_bins: int,
+    impurity: str,
+    hist_impl: str,
+    mesh,
+    interpret: bool,
+):
+    """Histogram + best-split evaluation for the ``g`` nodes starting at
+    level-local offset ``lo`` (a traced scalar, so a whole level's groups
+    run as one ``lax.map``); rows whose node lies outside the group are
+    masked inactive (id −1), exactly like dead rows."""
     n, F = binned.shape
     S = row_stats.shape[-1]
     T = w_trees.shape[0]
     per_tree_stats = row_stats.ndim == 3
+    n_nodes = g  # group-local histogram width
+    node_idx = jnp.where(
+        (node_idx >= lo) & (node_idx < lo + g), node_idx - lo, -1
+    )
 
     # ---- histogram: [T, nodes, F, B, S] ------------------------------------
     if hist_impl == "pallas":
@@ -349,11 +488,7 @@ def _level_core(
         (_stat_count(left, impurity) >= min_instances)
         & (_stat_count(right, impurity) >= min_instances)
     )
-    # feature subsetting per (tree, node): mask all but k random features
-    if subset_k < F:
-        r = jax.random.uniform(key, (T, n_nodes, F))
-        kth = -jax.lax.top_k(-r, subset_k)[0][..., -1]  # kth smallest
-        fmask = r <= kth[..., None]
+    if fmask is not None:  # per-(tree,node) feature subset, level-drawn
         valid = valid & fmask[:, :, :, None]
     gain = jnp.where(valid, gain, -jnp.inf)
 
@@ -363,11 +498,6 @@ def _level_core(
     best_feat = (best // (n_bins - 1)).astype(jnp.int32)
     best_bin = (best % (n_bins - 1)).astype(jnp.int32)
 
-    has_rows = parent_cnt > 0
-    do_split = has_rows & jnp.isfinite(best_gain) & (best_gain > min_info_gain)
-    # Spark treats minInfoGain=0 as "any strictly positive gain"
-    do_split = do_split & (best_gain > 0)
-
     # children stats of the chosen split (used directly at the last level)
     bf = best_feat[..., None, None, None]
     take_f = jnp.take_along_axis(left, bf.clip(0), axis=2)[:, :, 0]  # [T,nodes,B-1,S]
@@ -376,34 +506,14 @@ def _level_core(
     )[:, :, 0]  # [T, nodes, S]
     br = parent - bl
 
-    # ---- route rows to children (skipped at the last level) ----------------
-    if route:
-        idx = jnp.where(node_idx >= 0, node_idx, 0)  # [T, N]
-        splits = jnp.take_along_axis(do_split, idx, axis=1)  # [T, N]
-        feats = jnp.take_along_axis(best_feat, idx, axis=1)  # [T, N]
-        bins_thr = jnp.take_along_axis(best_bin, idx, axis=1)  # [T, N]
-        row_bins = jax.vmap(
-            lambda f_t: jnp.take_along_axis(binned, f_t[:, None], axis=1)[:, 0]
-        )(feats)  # [T, N]
-        go_right = (row_bins > bins_thr).astype(jnp.int32)
-        child = 2 * idx + go_right
-        new_node_idx = jnp.where(
-            (node_idx >= 0) & splits, child, -1
-        ).astype(jnp.int32)
-    else:
-        new_node_idx = node_idx
-
     return {
         "best_feat": best_feat,
         "best_bin": best_bin,
         "best_gain": best_gain,
-        "do_split": do_split,
-        "has_rows": has_rows,
         "parent_stats": parent,
         "parent_count": parent_cnt,
         "left_stats": bl,
         "right_stats": br,
-        "new_node_idx": new_node_idx,
     }
 
 
@@ -444,10 +554,16 @@ def grow_forest(
     from sntc_tpu.ops.pallas_histogram import resolve_hist_impl
 
     on_tpu = jax.default_backend() == "tpu"
+    # per-level histogram width is bounded by the node-group size
+    # (Spark maxMemoryInMB analog), so deep levels can keep the pallas
+    # kernel: its VMEM test sees the group width, not 2^d
+    group = node_group_size(
+        w_trees.shape[0], binned.shape[1], n_bins, row_stats.shape[-1]
+    )
     hist_impls = tuple(
         hist_impl
         if hist_impl is not None
-        else resolve_hist_impl(1 << d, n_bins, mesh)
+        else resolve_hist_impl(min(1 << d, group), n_bins, mesh)
         for d in range(max(max_depth, 1))
     )
     if mesh is None:
@@ -476,7 +592,7 @@ def grow_forest(
         binned, binned_t, row_stats, w_trees, jnp.asarray(edges), keys,
         jnp.float32(min_instances_per_node), jnp.float32(min_info_gain),
         max_depth=max_depth, n_bins=n_bins, impurity=impurity,
-        subset_k=subset_k, hist_impls=hist_impls, mesh=mesh,
+        subset_k=subset_k, group=group, hist_impls=hist_impls, mesh=mesh,
         interpret=interpret,
     )
     feature, threshold, leaf_stats, gain_arr, count_arr = (
@@ -489,14 +605,15 @@ def grow_forest(
 @partial(
     jax.jit,
     static_argnames=(
-        "max_depth", "n_bins", "impurity", "subset_k", "hist_impls",
-        "mesh", "interpret",
+        "max_depth", "n_bins", "impurity", "subset_k", "group",
+        "hist_impls", "mesh", "interpret",
     ),
 )
 def _grow_fused(
     binned, binned_t, row_stats, w_trees, edges_dev, keys,
     min_instances, min_info_gain,
-    *, max_depth, n_bins, impurity, subset_k, hist_impls, mesh, interpret,
+    *, max_depth, n_bins, impurity, subset_k, group, hist_impls, mesh,
+    interpret,
 ):
     """The WHOLE level-wise growth as one XLA program: the depth loop is
     unrolled at trace time, so every level keeps its exact node count
@@ -523,7 +640,8 @@ def _grow_fused(
             binned, binned_t, row_stats, w_trees, node_idx, keys[depth],
             min_instances, min_info_gain,
             n_nodes=n_nodes, n_bins=n_bins, impurity=impurity,
-            subset_k=subset_k, hist_impl=hist_impls[depth], mesh=mesh,
+            subset_k=subset_k, group=group,
+            hist_impl=hist_impls[depth], mesh=mesh,
             interpret=interpret,
             route=depth < max_depth - 1,
         )

@@ -118,6 +118,23 @@ class RandomForestClassifier(_RfParams, ClassifierEstimator):
         model.setParams(
             **{k2: v for k2, v in self.paramValues().items() if model.hasParam(k2)}
         )
+        # Spark 3.1+ RandomForestClassificationTrainingSummary: per-class
+        # metrics over the training predictions (objectiveHistory is
+        # empty — forests have no optimization trace), lazy; binary fits
+        # get the threshold-curve variant, as upstream
+        from sntc_tpu.models.summary import (
+            BinaryClassificationTrainingSummary,
+            ClassificationTrainingSummary,
+        )
+
+        summary_cls = (
+            BinaryClassificationTrainingSummary
+            if k == 2
+            else ClassificationTrainingSummary
+        )
+        model.summary = summary_cls(
+            [], 0, model, frame, labelCol=self.getLabelCol(), mesh=mesh
+        )
         return model
 
 

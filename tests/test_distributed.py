@@ -41,6 +41,11 @@ _WORKER = """
 import os, sys
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+# the host sitecustomize pins jax_platforms to the TPU tunnel at import
+# time; the env var alone is ignored (same dance as tests/conftest.py)
+jax.config.update("jax_platforms", "cpu")
 import numpy as np
 from sntc_tpu.parallel.distributed import (
     global_mesh, initialize, process_info,

@@ -201,7 +201,7 @@ def test_pipelined_crash_replays_inflight_intents(model, tmp_path):
     assert q._run_one_batch()
     assert q.last_committed() == 0
     assert len(q._in_flight) == 2
-    pending = [i for (_, i, _) in q._in_flight]
+    pending = [t[1] for t in q._in_flight]
     del q  # crash: in-flight batches lost, intents remain in the WAL
 
     sink2 = MemorySink()

@@ -69,7 +69,8 @@ def test_binary_lr_training_summary(mesh8, binary_frame):
     assert roc["FPR"][0] == 0.0 and roc["TPR"][-1] == 1.0
     assert np.all(np.diff(roc["FPR"]) >= -1e-12)
     pr = s.pr
-    assert pr.num_rows == roc.num_rows
+    # roc carries both (0,0) and (1,1) anchors; pr prepends one point
+    assert pr.num_rows == roc.num_rows - 1
     f_thr = s.fMeasureByThreshold()
     assert f_thr.num_rows > 1
     assert float(np.max(f_thr["metric"])) <= 1.0
@@ -92,6 +93,38 @@ def test_multinomial_lr_and_mlp_summary(mesh8, multi_frame):
     assert isinstance(s2, ClassificationTrainingSummary)
     assert s2.totalIterations > 0
     assert s2.recallByLabel.shape == (3,)
+
+
+def test_linear_svc_training_summary(mesh8, binary_frame):
+    from sntc_tpu.models import LinearSVC
+
+    m = LinearSVC(mesh=mesh8, maxIter=25).fit(binary_frame)
+    s = m.summary
+    assert isinstance(s, BinaryClassificationTrainingSummary)
+    assert s.totalIterations > 0
+    assert s.precisionByLabel.shape == (2,)
+    assert 0.5 < s.areaUnderROC <= 1.0
+
+
+def test_tree_classifier_summaries(mesh8, binary_frame, multi_frame):
+    from sntc_tpu.models import GBTClassifier, RandomForestClassifier
+
+    rf = RandomForestClassifier(
+        mesh=mesh8, numTrees=4, maxDepth=4, seed=0
+    ).fit(multi_frame)
+    s = rf.summary
+    assert isinstance(s, ClassificationTrainingSummary)
+    assert s.objectiveHistory == [] and s.totalIterations == 0
+    assert s.precisionByLabel.shape == (3,)
+    assert 0.0 < s.accuracy <= 1.0
+
+    gbt = GBTClassifier(
+        mesh=mesh8, maxIter=5, maxDepth=3, seed=0
+    ).fit(binary_frame)
+    s2 = gbt.summary
+    assert isinstance(s2, BinaryClassificationTrainingSummary)
+    assert s2.totalIterations == 5
+    assert 0.5 < s2.areaUnderROC <= 1.0
 
 
 def test_model_evaluate(mesh8, binary_frame, multi_frame):

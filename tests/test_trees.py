@@ -552,3 +552,40 @@ def test_gbt_regressor_validated_early_stop(mesh8):
         validationIndicatorCol="isVal", validationTol=0.0,
     ).fit(f)
     assert m.numTrees < 60
+
+
+@pytest.mark.parametrize("subset", ["all", "sqrt"])
+def test_node_group_batching_identical_forest(mesh8, monkeypatch, subset):
+    """The memory-bounded node-group path (Spark maxMemoryInMB analog)
+    must produce EXACTLY the forest the single-pass path grows — the
+    grouping is a pure execution-schedule choice.  ``group`` is resolved
+    in grow_forest and passed as a STATIC jit arg, so the env override
+    retraces rather than silently reusing the cached single-pass program
+    (both branches — shared fmask=None and the per-group fmask slices of
+    'sqrt' — are exercised)."""
+    from sntc_tpu.models import RandomForestClassifier
+    from sntc_tpu.models.tree.grower import node_group_size
+
+    rng = np.random.default_rng(3)
+    n = 4000
+    X = rng.normal(size=(n, 12)).astype(np.float32)
+    y = ((X[:, 0] > 0) * 2 + (X[:, 3] > 0.5)).astype(np.float64)
+    f = Frame({"features": X, "label": y})
+
+    def grow():
+        m = RandomForestClassifier(
+            mesh=mesh8, numTrees=4, maxDepth=6, seed=0,
+            featureSubsetStrategy=subset,
+        ).fit(f)
+        fo = m.forest
+        return fo.feature.copy(), fo.threshold.copy(), fo.leaf_stats.copy()
+
+    monkeypatch.delenv("SNTC_TREE_NODE_GROUP_MB", raising=False)
+    base = grow()
+    assert node_group_size(4, 12, 32, 4) >= 32  # default: one group
+
+    monkeypatch.setenv("SNTC_TREE_NODE_GROUP_MB", "0.2")
+    assert node_group_size(4, 12, 32, 4) < 32  # forces multiple groups
+    grouped = grow()
+    for a, b in zip(base, grouped):
+        np.testing.assert_array_equal(a, b)
