@@ -29,6 +29,10 @@ from sntc_tpu.core.params import Param, validators
 # ``SNTC_DEVICE_CACHE_MB=0`` kill switch with the device cache.
 _ASSEMBLE_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
 _ASSEMBLE_CACHE_MAX = 4
+# memoize only fit-scale stacks: serving micro-batches (a fresh small
+# frame per batch) would churn insert+sweep on the [B:11] hot path for
+# entries that can never hit again
+_ASSEMBLE_MEMO_MIN_BYTES = 8 << 20
 
 
 class VectorAssembler(Transformer):
@@ -49,13 +53,18 @@ class VectorAssembler(Transformer):
         cols = [frame[name] for name in names]
         mode = self.getHandleInvalid()
 
-        memo_on = _device_cache_max_bytes() > 0
-        # sweep entries whose input columns were garbage-collected
-        for k in [
-            k for k, e in _ASSEMBLE_CACHE.items()
-            if any(r() is None for r in e[0])
-        ]:
-            del _ASSEMBLE_CACHE[k]
+        widths = [1 if c.ndim == 1 else c.shape[1] for c in cols]
+        memo_on = (
+            _device_cache_max_bytes() > 0
+            and frame.num_rows * sum(widths) * 4 >= _ASSEMBLE_MEMO_MIN_BYTES
+        )
+        if _ASSEMBLE_CACHE:
+            # sweep entries whose input columns were garbage-collected
+            for k in [
+                k for k, e in _ASSEMBLE_CACHE.items()
+                if any(r() is None for r in e[0])
+            ]:
+                del _ASSEMBLE_CACHE[k]
         key = (tuple(id(c) for c in cols), mode)
         hit = _ASSEMBLE_CACHE.get(key) if memo_on else None
         if hit is not None and all(
@@ -64,17 +73,25 @@ class VectorAssembler(Transformer):
             _ASSEMBLE_CACHE.move_to_end(key)
             X, invalid = hit[1], hit[2]
         else:
-            widths = [1 if c.ndim == 1 else c.shape[1] for c in cols]
-            # single allocation, cast-on-assign — no per-column intermediate
-            # copies (this runs per micro-batch on the serving hot path [B:11])
-            X = np.empty((frame.num_rows, sum(widths)), np.float32)
-            off = 0
-            for col, w in zip(cols, widths):
-                if col.ndim == 1:
-                    X[:, off] = col
-                else:
-                    X[:, off : off + w] = col
-                off += w
+            if cols and all(c.ndim == 1 for c in cols):
+                # all-1-D-columns fast path: ONE C-level stack+cast (4×
+                # the per-column assign loop — this runs per micro-batch
+                # on the serving hot path [B:11]); the transposed view
+                # multiplies/converts downstream at full speed, so no
+                # contiguity copy.  (N, 1) 2-D columns must take the
+                # assign loop: np.array would stack them to 3-D
+                X = np.array(cols, dtype=np.float32).T
+            else:
+                # single allocation, cast-on-assign — no per-column
+                # intermediate copies
+                X = np.empty((frame.num_rows, sum(widths)), np.float32)
+                off = 0
+                for col, w in zip(cols, widths):
+                    if col.ndim == 1:
+                        X[:, off] = col
+                    else:
+                        X[:, off : off + w] = col
+                    off += w
 
             invalid = None
             if mode != "keep":

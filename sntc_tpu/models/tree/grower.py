@@ -239,15 +239,17 @@ def _stat_count(stats: jnp.ndarray, impurity: str) -> jnp.ndarray:
 def node_group_size(T: int, F: int, n_bins: int, S: int) -> int:
     """Nodes per histogram pass, bounded so the level working set
     (histogram + cumsum + left/right slices + gain tensor, ~5× the raw
-    histogram) stays under ``SNTC_TREE_NODE_GROUP_MB`` (default 512 MB;
+    histogram) stays under ``SNTC_TREE_NODE_GROUP_MB`` (default 2 GB;
     Spark's ``maxMemoryInMB=256`` bounds its node groups the same way
-    [U] — we default 2× that, HBM being roomier than a 2010s JVM heap).
+    [U] — we default 8× that, HBM being roomier than a 2010s JVM heap;
+    measured on the depth-10 bench config, 2 GB more than halves deep-
+    level wall-clock vs 512 MB and going past it buys nothing).
     Deep levels evaluate in several passes over the binned data instead
     of materializing a multi-GB ``[T, 2^d, F, B, S]`` tensor — the
     memory/compute tradeoff Spark makes."""
     import os
 
-    budget = float(os.environ.get("SNTC_TREE_NODE_GROUP_MB", 512))
+    budget = float(os.environ.get("SNTC_TREE_NODE_GROUP_MB", 2048))
     per_node = 5.0 * T * F * n_bins * S * 4
     raw = max(1, int(budget * 1024 * 1024 / per_node))
     return 1 << (raw.bit_length() - 1)  # pow2: levels split evenly

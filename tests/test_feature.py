@@ -68,6 +68,18 @@ def test_vector_assembler_stacks_in_order():
     )
 
 
+def test_vector_assembler_n_by_1_columns_use_assign_path():
+    """(N, 1) 2-D columns must NOT hit the all-1-D np.array fast path
+    (np.array would stack them into 3-D)."""
+    f = Frame({
+        "a": np.array([[1.0], [2.0], [3.0]]),
+        "b": np.array([[4.0], [5.0], [6.0]]),
+    })
+    out = VectorAssembler(inputCols=["a", "b"]).transform(f)
+    assert out["features"].shape == (3, 2)
+    np.testing.assert_array_equal(out["features"], [[1, 4], [2, 5], [3, 6]])
+
+
 def test_vector_assembler_handle_invalid():
     f = Frame({"a": np.array([1.0, np.nan, 3.0])})
     with pytest.raises(ValueError, match="NaN/Inf"):
