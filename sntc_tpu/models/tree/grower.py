@@ -28,6 +28,7 @@ split_bin]``); serving traverses on raw floats with the stored thresholds.
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 from typing import NamedTuple, Tuple
 
@@ -247,8 +248,6 @@ def node_group_size(T: int, F: int, n_bins: int, S: int) -> int:
     Deep levels evaluate in several passes over the binned data instead
     of materializing a multi-GB ``[T, 2^d, F, B, S]`` tensor — the
     memory/compute tradeoff Spark makes."""
-    import os
-
     budget = float(os.environ.get("SNTC_TREE_NODE_GROUP_MB", 2048))
     per_node = 5.0 * T * F * n_bins * S * 4
     raw = max(1, int(budget * 1024 * 1024 / per_node))
@@ -553,7 +552,10 @@ def grow_forest(
     the kernel's VMEM budget fall back to segment_sum while shallow levels
     keep the MXU path.  Overridable via the ``SNTC_TREE_HIST`` env var.
     """
-    from sntc_tpu.ops.pallas_histogram import resolve_hist_impl
+    from sntc_tpu.ops.pallas_histogram import (
+        hist_fits_pallas,
+        resolve_hist_impl,
+    )
 
     on_tpu = jax.default_backend() == "tpu"
     # per-level histogram width is bounded by the node-group size
@@ -562,6 +564,20 @@ def grow_forest(
     group = node_group_size(
         w_trees.shape[0], binned.shape[1], n_bins, row_stats.shape[-1]
     )
+    if (
+        on_tpu
+        and mesh is not None
+        and hist_impl is None
+        and "SNTC_TREE_HIST" not in os.environ
+        and "SNTC_TREE_NODE_GROUP_MB" not in os.environ
+    ):
+        # on TPU a group whose node×bin width overflows the kernel's
+        # VMEM budget would silently fall back to segment_sum — and
+        # scatter-adds SERIALIZE there (profiled 2.75–15× slower), which
+        # costs far more than extra group passes.  Shrink the group until
+        # every level rides the MXU.
+        while group > 1 and not hist_fits_pallas(group, n_bins):
+            group //= 2
     hist_impls = tuple(
         hist_impl
         if hist_impl is not None

@@ -589,3 +589,30 @@ def test_node_group_batching_identical_forest(mesh8, monkeypatch, subset):
     grouped = grow()
     for a, b in zip(base, grouped):
         np.testing.assert_array_equal(a, b)
+
+
+def test_gbt_regressor_absolute_loss_wide_range_targets(mesh8):
+    """Advisor r2 (medium): with lossType='absolute', the FIRST tree must
+    fit the raw residuals with weight 1.0 (Spark boost()); the old
+    sign-residual first tree bounded predictions to
+    init ± ~maxIter·stepSize, which is grossly wrong when the target
+    spread dwarfs that (y spanning [0, 1000] here)."""
+    from sntc_tpu.models import GBTRegressor
+
+    rng = np.random.default_rng(23)
+    n = 3000
+    X = rng.uniform(-2, 2, size=(n, 4)).astype(np.float32)
+    y = (500.0 + 250.0 * X[:, 0] + 5.0 * rng.normal(size=n)).astype(
+        np.float32
+    )  # spread ~1000 >> maxIter * stepSize
+    f = Frame({"features": X, "label": y})
+    m = GBTRegressor(
+        mesh=mesh8, maxIter=20, maxDepth=3, stepSize=0.3, seed=0,
+        lossType="absolute",
+    ).fit(f)
+    pred = np.asarray(m.transform(f)["prediction"])
+    # the first weight-1.0 raw-residual tree captures the bulk of the
+    # spread; the old behavior left rmse ≈ y.std() (~250)
+    rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+    assert rmse < 0.25 * float(y.std()), rmse
+    assert m.treeWeights[0] == 1.0
