@@ -21,6 +21,10 @@ from sntc_tpu.models.tree import (
     RandomForestRegressionModel,
 )
 from sntc_tpu.models.kmeans import KMeans, KMeansModel
+from sntc_tpu.models.glm import (
+    GeneralizedLinearRegression,
+    GeneralizedLinearRegressionModel,
+)
 from sntc_tpu.models.linear_regression import LinearRegression, LinearRegressionModel
 from sntc_tpu.models.linear_svc import LinearSVC, LinearSVCModel
 from sntc_tpu.models.naive_bayes import NaiveBayes, NaiveBayesModel
@@ -41,6 +45,8 @@ __all__ = [
     "DecisionTreeRegressionModel",
     "KMeans",
     "KMeansModel",
+    "GeneralizedLinearRegression",
+    "GeneralizedLinearRegressionModel",
     "LinearRegression",
     "LinearRegressionModel",
     "LinearSVC",

@@ -1,0 +1,382 @@
+"""GeneralizedLinearRegression — IRLS GLMs on the MXU.
+
+Behavioral spec: upstream ``ml/regression/GeneralizedLinearRegression.
+scala`` [U] (Spark ML breadth beyond the reference's four estimators,
+like KMeans/PCA): family × link GLMs fit by iteratively reweighted least
+squares — per iteration, working response ``z = η + (y − μ)·g′(μ)`` and
+weights ``W = w / (Var(μ)·g′(μ)²)`` feed one weighted normal-equation
+solve.  Spark's supported (family, link) grid for the four families
+implemented here; ``regParam`` is L2 (Spark GLR supports only L2).
+
+TPU design: the WHOLE IRLS loop is one jitted ``lax.while_loop`` over
+mesh-sharded rows — each iteration is two MXU contractions
+(``Xᵀ(WX)`` [D+1, D+1] and ``Xᵀ(Wz)``) whose row-sums XLA all-reduces
+over the mesh, plus an O(D³) host-free solve of a tiny system.  No
+per-iteration host involvement (the Spark driver runs its WLS solve per
+iteration on collected aggregates).
+
+Summary parity: ``model.summary`` carries deviance / nullDeviance /
+dispersion / residual degrees of freedom and ``totalIterations`` (the
+``GeneralizedLinearRegressionTrainingSummary`` core surface; AIC is not
+computed).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sntc_tpu.core.base import Estimator, Model
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+from sntc_tpu.parallel.collectives import shard_batch, shard_weights
+from sntc_tpu.parallel.context import get_default_mesh
+
+_EPS = 1e-10
+# probability clip must survive f32: 1 − 1e-10 rounds to exactly 1.0
+# in f32 (log(1−μ) → −inf); 1e-6 is the tightest safely-representable gap
+_MU_EPS = 1e-6
+
+_FAMILIES = ("gaussian", "binomial", "poisson", "gamma")
+_LINKS = ("identity", "log", "logit", "inverse", "sqrt", "cloglog", "probit")
+_DEFAULT_LINK = {
+    "gaussian": "identity",
+    "binomial": "logit",
+    "poisson": "log",
+    "gamma": "inverse",
+}
+# Spark's supported (family, link) grid
+_SUPPORTED = {
+    "gaussian": ("identity", "log", "inverse"),
+    "binomial": ("logit", "probit", "cloglog", "log"),
+    "poisson": ("log", "identity", "sqrt"),
+    "gamma": ("inverse", "identity", "log"),
+}
+
+
+def _link_fns(link: str):
+    """(g, g_inv, g_prime) for η = g(μ)."""
+    sn = jax.scipy.stats.norm
+    if link == "identity":
+        return (lambda m: m, lambda e: e, lambda m: jnp.ones_like(m))
+    if link == "log":
+        return (jnp.log, jnp.exp, lambda m: 1.0 / m)
+    if link == "logit":
+        return (
+            lambda m: jnp.log(m / (1.0 - m)),
+            jax.nn.sigmoid,
+            lambda m: 1.0 / (m * (1.0 - m)),
+        )
+    if link == "inverse":
+        return (lambda m: 1.0 / m, lambda e: 1.0 / e, lambda m: -1.0 / m**2)
+    if link == "sqrt":
+        return (jnp.sqrt, lambda e: e**2, lambda m: 0.5 / jnp.sqrt(m))
+    if link == "cloglog":
+        return (
+            lambda m: jnp.log(-jnp.log1p(-m)),
+            lambda e: -jnp.expm1(-jnp.exp(e)),
+            lambda m: -1.0 / ((1.0 - m) * jnp.log1p(-m)),
+        )
+    if link == "probit":
+        return (
+            sn.ppf,
+            sn.cdf,
+            lambda m: 1.0 / jnp.maximum(sn.pdf(sn.ppf(m)), _EPS),
+        )
+    raise ValueError(f"unknown link {link!r}")
+
+
+def _variance(family: str, mu):
+    if family == "gaussian":
+        return jnp.ones_like(mu)
+    if family == "binomial":
+        return mu * (1.0 - mu)
+    if family == "poisson":
+        return mu
+    return mu**2  # gamma
+
+
+def _clip_mu(family: str, mu):
+    if family == "binomial":
+        return jnp.clip(mu, _MU_EPS, 1.0 - _MU_EPS)
+    if family in ("poisson", "gamma"):
+        return jnp.maximum(mu, _EPS)
+    return mu
+
+
+def _deviance(family: str, y, mu, w):
+    """Unit deviance summed with weights (Spark/R semantics)."""
+    if family == "gaussian":
+        return jnp.sum(w * (y - mu) ** 2)
+    if family == "binomial":
+        yc = jnp.clip(y, _MU_EPS, 1.0 - _MU_EPS)
+        # zero-coefficient terms guarded: 0 · log(·) must not see an inf
+        t1 = jnp.where(y > 0, y * jnp.log(yc / mu), 0.0)
+        t0 = jnp.where(
+            y < 1, (1.0 - y) * jnp.log((1.0 - yc) / (1.0 - mu)), 0.0
+        )
+        return 2.0 * jnp.sum(w * (t1 + t0))
+    if family == "poisson":
+        ylog = jnp.where(y > 0, y * jnp.log(jnp.maximum(y, _EPS) / mu), 0.0)
+        return 2.0 * jnp.sum(w * (ylog - (y - mu)))
+    # gamma
+    return 2.0 * jnp.sum(
+        w * (-jnp.log(jnp.maximum(y, _EPS) / mu) + (y - mu) / mu)
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("family", "link", "fit_intercept", "max_iter"),
+)
+def _irls(xs, ys, ws, beta0, *, family, link, fit_intercept, max_iter,
+          tol, reg):
+    """Whole-fit IRLS: ``lax.while_loop`` whose body is two sharded MXU
+    contractions + one tiny solve.  ``xs`` is AUGMENTED with a ones
+    column when ``fit_intercept`` (the intercept is just another
+    coefficient, unpenalized)."""
+    g, g_inv, g_prime = _link_fns(link)
+    d_aug = xs.shape[1]
+    # λ applies to the weight-AVERAGED Gram (Spark WeightedLeastSquares /
+    # models/linear_regression.py convention): scale the diagonal by Σw
+    # since A below is the raw weighted Gram
+    pen = (reg * jnp.sum(ws)) * jnp.ones(d_aug)
+    if fit_intercept:
+        pen = pen.at[-1].set(0.0)
+
+    def eta_mu(beta):
+        eta = xs @ beta
+        return eta, _clip_mu(family, g_inv(eta))
+
+    def cond(state):
+        _, it, delta = state
+        return (it < max_iter) & (delta > tol)
+
+    def body(state):
+        beta, it, _ = state
+        eta, mu = eta_mu(beta)
+        gp = g_prime(mu)
+        z = eta + (ys - mu) * gp
+        wls = ws / jnp.maximum(_variance(family, mu) * gp**2, _EPS)
+        xw = xs * wls[:, None]
+        A = xs.T @ xw + jnp.diag(pen)  # [D+1, D+1]; XLA psums row-shards
+        b = xw.T @ z
+        beta_new = jax.scipy.linalg.solve(A, b, assume_a="pos")
+        delta = jnp.max(jnp.abs(beta_new - beta)) / jnp.maximum(
+            jnp.max(jnp.abs(beta)), 1.0
+        )
+        return beta_new, it + 1, delta
+
+    beta, n_iter, _ = jax.lax.while_loop(
+        cond, body, (beta0, jnp.int32(0), jnp.float32(jnp.inf))
+    )
+    _, mu = eta_mu(beta)
+    dev = _deviance(family, ys, mu, ws)
+    # null deviance: intercept-only model -> mu = weighted mean response
+    ybar = jnp.sum(ws * ys) / jnp.maximum(jnp.sum(ws), _EPS)
+    mu0 = _clip_mu(family, jnp.broadcast_to(ybar, ys.shape))
+    dev0 = _deviance(family, ys, mu0, ws)
+    # Pearson chi² (dispersion numerator)
+    pearson = jnp.sum(
+        ws * (ys - mu) ** 2 / jnp.maximum(_variance(family, mu), _EPS)
+    )
+    return beta, n_iter, dev, dev0, pearson
+
+
+class _GlrParams:
+    featuresCol = Param("feature vector column", default="features")
+    labelCol = Param("target column", default="label")
+    predictionCol = Param("output prediction column", default="prediction")
+    linkPredictionCol = Param(
+        "optional output column for the link-scale prediction η",
+        default=None,
+    )
+    family = Param(
+        "gaussian | binomial | poisson | gamma", default="gaussian",
+        validator=validators.one_of(*_FAMILIES),
+    )
+    link = Param(
+        "identity | log | logit | inverse | sqrt | cloglog | probit "
+        "(default: the family's canonical link)",
+        default=None,
+    )
+    maxIter = Param("max IRLS iterations", default=25,
+                    validator=validators.gt(0))
+    tol = Param("relative coefficient-change tolerance", default=1e-6,
+                validator=validators.gt(0))
+    regParam = Param("L2 regularization (Spark GLR is L2-only)",
+                     default=0.0, validator=validators.gteq(0))
+    fitIntercept = Param("fit an intercept", default=True,
+                         validator=validators.is_bool())
+    weightCol = Param("optional row weight column", default=None)
+
+
+class GeneralizedLinearRegressionTrainingSummary:
+    def __init__(self, *, deviance, null_deviance, pearson, n, rank,
+                 family, total_iterations):
+        self.deviance = float(deviance)
+        self.nullDeviance = float(null_deviance)
+        self.residualDegreeOfFreedom = int(n - rank)
+        self.residualDegreeOfFreedomNull = int(n - 1)
+        self.totalIterations = int(total_iterations)
+        # Spark: dispersion is 1 for binomial/poisson, Pearson χ² / dof
+        # otherwise
+        self.dispersion = (
+            1.0
+            if family in ("binomial", "poisson")
+            else float(pearson) / max(n - rank, 1)
+        )
+
+    @property
+    def objectiveHistory(self):  # API-compat shim (IRLS keeps no trace)
+        return []
+
+
+class GeneralizedLinearRegression(_GlrParams, Estimator):
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+
+    def _resolved_link(self) -> str:
+        family = self.getFamily()
+        link = self.getLink() or _DEFAULT_LINK[family]
+        if link not in _LINKS:
+            raise ValueError(f"unknown link {link!r}; one of {_LINKS}")
+        if link not in _SUPPORTED[family]:
+            raise ValueError(
+                f"link {link!r} is not supported for family {family!r} "
+                f"(Spark grid: {_SUPPORTED[family]})"
+            )
+        return link
+
+    def _fit(self, frame: Frame) -> "GeneralizedLinearRegressionModel":
+        mesh = self._mesh or get_default_mesh()
+        family = self.getFamily()
+        link = self._resolved_link()
+        X = frame[self.getFeaturesCol()]
+        if X.ndim != 2:
+            raise ValueError(
+                f"featuresCol {self.getFeaturesCol()!r} must be a vector "
+                "column (use VectorAssembler)"
+            )
+        X = X.astype(np.float32, copy=False)
+        y = np.asarray(frame[self.getLabelCol()], np.float32)
+        if family == "binomial" and not np.all((y == 0) | (y == 1)):
+            raise ValueError("binomial family needs labels in {0, 1}")
+        if family in ("poisson", "gamma") and (y < 0).any():
+            raise ValueError(f"{family} family needs non-negative labels")
+        if family == "gamma" and (y == 0).any():
+            raise ValueError("gamma family needs strictly positive labels")
+        wcol = self.getWeightCol()
+        w = (
+            np.asarray(frame[wcol], np.float32)
+            if wcol
+            else np.ones(len(y), np.float32)
+        )
+        n, d = X.shape
+        fit_b = self.getFitIntercept()
+        Xa = np.concatenate([X, np.ones((n, 1), np.float32)], axis=1) if fit_b else X
+        xs, ys, _ = shard_batch(mesh, Xa, y)
+        ws = shard_weights(mesh, w, xs.shape[0])
+
+        # init: zero coefficients, intercept at g(weighted mean response)
+        # (μ starts at the sample mean — safe for every supported link)
+        beta0 = np.zeros(Xa.shape[1], np.float32)
+        g, _, _ = _link_fns(link)
+        ybar = float(np.average(y, weights=w)) if n else 0.0
+        # clamp by what the LINK's domain needs, not just the family —
+        # gaussian+log on a ≤0-mean response must not seed a NaN intercept
+        if link in ("logit", "cloglog", "probit"):
+            ybar = min(max(ybar, 1e-6), 1.0 - 1e-6)
+        elif link in ("log", "inverse", "sqrt"):
+            ybar = max(ybar, 1e-6)
+        if fit_b:
+            beta0[-1] = float(g(jnp.float32(ybar)))
+
+        beta, n_iter, dev, dev0, pearson = _irls(
+            xs, ys, ws, jnp.asarray(beta0),
+            family=family, link=link, fit_intercept=fit_b,
+            max_iter=int(self.getMaxIter()),
+            tol=jnp.float32(self.getTol()),
+            reg=jnp.float32(self.getRegParam()),
+        )
+        beta = np.asarray(beta, np.float64)
+        coef = beta[:d] if fit_b else beta
+        intercept = float(beta[-1]) if fit_b else 0.0
+        model = GeneralizedLinearRegressionModel(
+            coefficients=coef, intercept=intercept
+        )
+        model.setParams(
+            **{k: v for k, v in self.paramValues().items()
+               if model.hasParam(k)}
+        )
+        model.set("link", link)  # persist the RESOLVED link
+        rank = d + (1 if fit_b else 0)
+        model.summary = GeneralizedLinearRegressionTrainingSummary(
+            deviance=dev, null_deviance=dev0, pearson=pearson, n=n,
+            rank=rank, family=family, total_iterations=int(n_iter),
+        )
+        return model
+
+
+@partial(jax.jit, static_argnames=("link",))
+def _glm_predict(X, coef, intercept, *, link):
+    _, g_inv, _ = _link_fns(link)
+    eta = X @ coef + intercept
+    return eta, g_inv(eta)
+
+
+class GeneralizedLinearRegressionModel(_GlrParams, Model):
+    def __init__(self, coefficients=None, intercept: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.coefficients = np.asarray(
+            coefficients if coefficients is not None else [], np.float64
+        )
+        self.intercept = float(intercept)
+        self.summary: Optional[
+            GeneralizedLinearRegressionTrainingSummary
+        ] = None
+
+    def _save_extra(self):
+        return (
+            {"intercept": self.intercept},
+            {"coefficients": self.coefficients},
+        )
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        m = cls(
+            coefficients=arrays["coefficients"],
+            intercept=float(extra.get("intercept", 0.0)),
+        )
+        m.setParams(**params)
+        return m
+
+    def transform(self, frame: Frame) -> Frame:
+        X = frame[self.getFeaturesCol()].astype(np.float32, copy=False)
+        eta, mu = _glm_predict(
+            jnp.asarray(X),
+            jnp.asarray(self.coefficients, jnp.float32),
+            jnp.float32(self.intercept),
+            link=self.getLink() or _DEFAULT_LINK[self.getFamily()],
+        )
+        out = frame.with_column(
+            self.getPredictionCol(), np.asarray(mu, np.float64)
+        )
+        link_col = self.getLinkPredictionCol()
+        if link_col:
+            out = out.with_column(link_col, np.asarray(eta, np.float64))
+        return out
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        _, mu = _glm_predict(
+            jnp.asarray(np.asarray(X, np.float32)),
+            jnp.asarray(self.coefficients, jnp.float32),
+            jnp.float32(self.intercept),
+            link=self.getLink() or _DEFAULT_LINK[self.getFamily()],
+        )
+        return np.asarray(mu, np.float64)

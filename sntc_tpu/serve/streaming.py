@@ -396,8 +396,67 @@ class StreamingQuery:
                 time.sleep(poll_interval)
         return done
 
+    # -- background lifecycle (Spark StreamingQuery surface) ---------------
+
+    def start(self, poll_interval: float = 1.0) -> "StreamingQuery":
+        """Run the micro-batch loop on a daemon thread and return
+        immediately (Spark's ``writeStream.start()``); pair with
+        :meth:`awaitTermination`/:meth:`stop`.  The engine stays a
+        single writer — all batch work happens on the one loop thread;
+        ``stop()`` flips the flag, JOINS the loop thread, and only then
+        closes the append-WAL handles (never under the loop's feet)."""
+        import threading
+
+        if getattr(self, "_thread", None) is not None and self._thread.is_alive():
+            raise RuntimeError("query already started")
+        if self._stopped:
+            raise RuntimeError("query was stopped; construct a new one")
+
+        def _loop():
+            try:
+                self.run(poll_interval=poll_interval)
+            except BaseException as e:  # surfaced by awaitTermination
+                self._exception = e
+
+        self._exception: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=_loop, name="sntc-streaming-query", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    @property
+    def isActive(self) -> bool:
+        t = getattr(self, "_thread", None)
+        return t is not None and t.is_alive()
+
+    @property
+    def lastProgress(self) -> Optional[dict]:
+        return self.recentProgress[-1] if self.recentProgress else None
+
+    def awaitTermination(self, timeout: Optional[float] = None) -> bool:
+        """Block until the query stops (or ``timeout`` seconds pass);
+        returns True if it terminated.  Re-raises a crash from the loop
+        thread, as Spark's ``awaitTermination`` does."""
+        t = getattr(self, "_thread", None)
+        if t is not None:
+            t.join(timeout)
+            if not t.is_alive() and self._exception is not None:
+                raise self._exception
+            return not t.is_alive()
+        return self._stopped
+
     def stop(self) -> None:
+        was_active = self.isActive
         self._stopped = True
-        if self.wal_mode == "append":
-            self._offsets_log.close()
-            self._commits_log.close()
+        try:
+            if was_active:
+                # the loop thread still uses the WAL handles; wait for it
+                # to exit its current batch before closing them
+                self._thread.join()
+                if self._exception is not None:
+                    raise self._exception
+        finally:
+            if self.wal_mode == "append":
+                self._offsets_log.close()
+                self._commits_log.close()

@@ -304,3 +304,42 @@ def test_recent_progress_records(model, tmp_path):
         assert p["numInputRows"] == 5
         assert p["durationMs"] > 0
         assert p["processedRowsPerSecond"] > 0
+
+
+def test_start_await_termination_lifecycle(model, tmp_path):
+    """writeStream.start() analog: background loop drains arriving data;
+    stop() joins the thread; lastProgress/isActive surface state."""
+    import time as _time
+
+    src = MemorySource([_batch(20, 1)])
+    sink = MemorySink()
+    q = StreamingQuery(model, src, sink, str(tmp_path / "ckpt"),
+                       max_batch_offsets=1)
+    q.start(poll_interval=0.02)
+    assert q.isActive
+    deadline = _time.time() + 30
+    while _time.time() < deadline and len(sink.frames) < 1:
+        _time.sleep(0.02)
+    src.add(_batch(10, 2))  # arrives while running
+    while _time.time() < deadline and len(sink.frames) < 2:
+        _time.sleep(0.02)
+    assert [f.num_rows for f in sink.frames] == [20, 10]
+    assert not q.awaitTermination(timeout=0.05)  # still polling
+    assert q.lastProgress["numInputRows"] == 10
+    q.stop()
+    assert not q.isActive
+    assert q.awaitTermination(timeout=1.0)
+    with pytest.raises(RuntimeError, match="stopped"):
+        q.start()
+
+
+def test_await_termination_reraises_loop_crash(model, tmp_path):
+    class BoomSink(MemorySink):
+        def add_batch(self, batch_id, frame):
+            raise RuntimeError("sink boom")
+
+    src = MemorySource([_batch(10, 1)])
+    q = StreamingQuery(model, src, BoomSink(), str(tmp_path / "ckpt"))
+    q.start(poll_interval=0.02)
+    with pytest.raises(RuntimeError, match="sink boom"):
+        q.awaitTermination(timeout=30)
