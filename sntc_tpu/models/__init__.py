@@ -21,6 +21,12 @@ from sntc_tpu.models.tree import (
     RandomForestRegressionModel,
 )
 from sntc_tpu.models.kmeans import KMeans, KMeansModel
+from sntc_tpu.models.fm import (
+    FMClassificationModel,
+    FMClassifier,
+    FMRegressionModel,
+    FMRegressor,
+)
 from sntc_tpu.models.glm import (
     GeneralizedLinearRegression,
     GeneralizedLinearRegressionModel,
@@ -45,6 +51,10 @@ __all__ = [
     "DecisionTreeRegressionModel",
     "KMeans",
     "KMeansModel",
+    "FMClassificationModel",
+    "FMClassifier",
+    "FMRegressionModel",
+    "FMRegressor",
     "GeneralizedLinearRegression",
     "GeneralizedLinearRegressionModel",
     "LinearRegression",
