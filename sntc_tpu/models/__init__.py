@@ -27,6 +27,10 @@ from sntc_tpu.models.fm import (
     FMRegressionModel,
     FMRegressor,
 )
+from sntc_tpu.models.gaussian_mixture import (
+    GaussianMixture,
+    GaussianMixtureModel,
+)
 from sntc_tpu.models.glm import (
     GeneralizedLinearRegression,
     GeneralizedLinearRegressionModel,
@@ -55,6 +59,8 @@ __all__ = [
     "FMClassifier",
     "FMRegressionModel",
     "FMRegressor",
+    "GaussianMixture",
+    "GaussianMixtureModel",
     "GeneralizedLinearRegression",
     "GeneralizedLinearRegressionModel",
     "LinearRegression",
