@@ -33,3 +33,26 @@ def test_bench_config_emits_contract_line(cfg):
     for key in ("metric", "value", "unit", "vs_baseline", "platform"):
         assert key in rec, rec
     assert rec["value"] > 0
+
+
+def test_bench_mfu_emits_contract_line():
+    env = dict(
+        os.environ,
+        BENCH_ROWS="2000",
+        BENCH_PLATFORM="cpu",
+        BENCH_PROBE_TIMEOUT_S="0",
+        BENCH_NO_JOURNAL="1",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--mfu"],
+        env=env, capture_output=True, text=True, timeout=500,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "mlp_f32_fit_s", "mlp_bf16_fit_s",
+                "bf16_speedup_vs_f32", "platform"):
+        assert key in rec, rec
+    assert rec["mlp_f32_iters"] > 0
