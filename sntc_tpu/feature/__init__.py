@@ -21,6 +21,7 @@ from sntc_tpu.feature.discretizers import (
     ImputerModel,
     QuantileDiscretizer,
 )
+from sntc_tpu.feature.expansion import Interaction, PolynomialExpansion
 from sntc_tpu.feature.encoders import (
     ElementwiseProduct,
     OneHotEncoder,
@@ -45,6 +46,8 @@ __all__ = [
     "MaxAbsScalerModel",
     "Normalizer",
     "Binarizer",
+    "Interaction",
+    "PolynomialExpansion",
     "PCA",
     "PCAModel",
     "Bucketizer",

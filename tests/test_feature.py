@@ -569,3 +569,44 @@ def test_vector_slicer_and_elementwise_product():
     )
     with pytest.raises(ValueError, match="length"):
         ElementwiseProduct(scalingVec=[1.0]).transform(f)
+
+
+# ---------------- PolynomialExpansion / Interaction ----------------
+
+def test_polynomial_expansion_spark_order():
+    from sntc_tpu.feature import PolynomialExpansion
+    from sntc_tpu.feature.expansion import _expansion_plan
+    from math import comb
+
+    # Spark's documented degree-2 order for [x1, x2]:
+    # x1, x1², x2, x1x2, x2²
+    f = Frame({"v": np.array([[2.0, 3.0], [1.0, -1.0]])})
+    out = PolynomialExpansion(inputCol="v", outputCol="p").transform(f)["p"]
+    np.testing.assert_allclose(
+        out, [[2, 4, 3, 6, 9], [1, 1, -1, -1, 1]]
+    )
+    # width = C(n+d, d) - 1 for several shapes
+    for n, d in ((3, 2), (4, 3), (5, 2)):
+        assert len(_expansion_plan(n, d)) == comb(n + d, d) - 1
+    # degree-3 prefix for one variable: x1, x1², x1³
+    plan = _expansion_plan(2, 3)
+    assert plan[:3] == ((0,), (0, 0), (0, 0, 0))
+
+
+def test_interaction_layout_and_scalars():
+    from sntc_tpu.feature import Interaction
+
+    f = Frame({
+        "a": np.array([2.0, 3.0]),
+        "v": np.array([[1.0, 10.0], [2.0, 20.0]]),
+        "w": np.array([[5.0, 7.0], [1.0, 1.0]]),
+    })
+    out = Interaction(inputCols=["a", "v", "w"], outputCol="i").transform(f)
+    # width = 1*2*2; LAST input varies fastest
+    np.testing.assert_allclose(
+        out["i"],
+        [[2 * 1 * 5, 2 * 1 * 7, 2 * 10 * 5, 2 * 10 * 7],
+         [3 * 2 * 1, 3 * 2 * 1, 3 * 20 * 1, 3 * 20 * 1]],
+    )
+    with pytest.raises(ValueError, match="at least two"):
+        Interaction(inputCols=["a"]).transform(f)
