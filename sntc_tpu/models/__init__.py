@@ -20,6 +20,10 @@ from sntc_tpu.models.tree import (
     RandomForestRegressor,
     RandomForestRegressionModel,
 )
+from sntc_tpu.models.isotonic import (
+    IsotonicRegression,
+    IsotonicRegressionModel,
+)
 from sntc_tpu.models.kmeans import KMeans, KMeansModel
 from sntc_tpu.models.fm import (
     FMClassificationModel,
@@ -53,6 +57,8 @@ __all__ = [
     "DecisionTreeClassificationModel",
     "DecisionTreeRegressor",
     "DecisionTreeRegressionModel",
+    "IsotonicRegression",
+    "IsotonicRegressionModel",
     "KMeans",
     "KMeansModel",
     "FMClassificationModel",

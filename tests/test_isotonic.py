@@ -1,0 +1,60 @@
+"""IsotonicRegression oracle tests vs sklearn (exact PAVA agreement)."""
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.mlio import load_model, save_model
+from sntc_tpu.models import IsotonicRegression, IsotonicRegressionModel
+
+
+def test_matches_sklearn_pava():
+    from sklearn.isotonic import IsotonicRegression as SkIso
+
+    rng = np.random.default_rng(0)
+    n = 2000
+    x = rng.uniform(0, 10, size=n)
+    y = np.sin(x / 3.5) * 3 + x * 0.4 + rng.normal(size=n)
+    w = rng.uniform(0.5, 2.0, size=n)
+    f = Frame({"features": x, "label": y, "w": w})
+    m = IsotonicRegression(weightCol="w").fit(f)
+    ours = m.predict(x)
+    sk = SkIso(out_of_bounds="clip").fit(x, y, sample_weight=w)
+    np.testing.assert_allclose(ours, sk.predict(x), atol=1e-8)
+
+
+def test_antitonic_and_vector_feature_index():
+    rng = np.random.default_rng(1)
+    n = 1000
+    x = rng.uniform(0, 5, size=n)
+    y = -2.0 * x + rng.normal(size=n)
+    X = np.stack([rng.normal(size=n), x], axis=1)
+    f = Frame({"features": X, "label": y})
+    m = IsotonicRegression(isotonic=False, featureIndex=1).fit(f)
+    pred = np.asarray(m.transform(f)["prediction"])
+    assert np.corrcoef(pred, y)[0, 1] > 0.9
+    # monotone decreasing in x
+    order = np.argsort(x)
+    assert np.all(np.diff(pred[order]) <= 1e-12)
+
+
+def test_interpolation_and_clamp():
+    f = Frame({
+        "features": np.array([1.0, 2.0, 3.0, 4.0]),
+        "label": np.array([1.0, 3.0, 3.0, 7.0]),
+    })
+    m = IsotonicRegression().fit(f)
+    # between boundaries: linear; outside: clamped (Spark predict)
+    assert m.predict(np.array([1.5]))[0] == pytest.approx(2.0)
+    assert m.predict(np.array([0.0]))[0] == pytest.approx(1.0)
+    assert m.predict(np.array([99.0]))[0] == pytest.approx(7.0)
+
+
+def test_save_load(tmp_path):
+    rng = np.random.default_rng(2)
+    x = rng.uniform(0, 1, size=300)
+    f = Frame({"features": x, "label": x + rng.normal(size=300) * 0.1})
+    m = IsotonicRegression().fit(f)
+    m2 = load_model(save_model(m, str(tmp_path / "iso")))
+    assert isinstance(m2, IsotonicRegressionModel)
+    np.testing.assert_allclose(m2.predict(x), m.predict(x))
