@@ -13,6 +13,8 @@ from sntc_tpu.feature.scalers import (
     MinMaxScaler,
     MinMaxScalerModel,
     Normalizer,
+    RobustScaler,
+    RobustScalerModel,
 )
 from sntc_tpu.feature.pca import PCA, PCAModel
 from sntc_tpu.feature.discretizers import (
@@ -22,6 +24,12 @@ from sntc_tpu.feature.discretizers import (
     QuantileDiscretizer,
 )
 from sntc_tpu.feature.expansion import Interaction, PolynomialExpansion
+from sntc_tpu.feature.lsh import (
+    BucketedRandomProjectionLSH,
+    BucketedRandomProjectionLSHModel,
+    MinHashLSH,
+    MinHashLSHModel,
+)
 from sntc_tpu.feature.encoders import (
     ElementwiseProduct,
     OneHotEncoder,

@@ -1,0 +1,334 @@
+"""Locality-sensitive hashing — ``BucketedRandomProjectionLSH`` (Euclidean)
+and ``MinHashLSH`` (Jaccard).
+
+Behavioral spec: upstream ``ml/feature/{LSH,BucketedRandomProjectionLSH,
+MinHashLSH}.scala`` [U]:
+
+* fit draws ``numHashTables`` random hash functions (seeded);
+* ``transform`` appends one hash value per table;
+* ``approxNearestNeighbors(dataset, key, k)``: prefilter to rows sharing a
+  hash bucket with the key in ANY table, exact ``keyDistance`` on the
+  candidates, top-k ascending (Spark's single-probe mode; like Spark, the
+  result can hold fewer than k rows when the buckets are sparse);
+* ``approxSimilarityJoin(A, B, threshold)``: candidate pairs share a
+  bucket in at least one table, kept where ``keyDistance < threshold``.
+
+TPU design: hashing is the MXU/VPU bulk op — BRP is ONE ``[N,F] @ [F,L]``
+matmul + floor; MinHash is an F-step ``fori_loop`` of masked mins over the
+precomputed ``((1+j)·a + b) mod p`` table (no ``[N,L,F]`` blow-up).  Exact
+candidate distances run on-device (Euclidean via the
+``‖a‖²+‖b‖²−2a·b`` matmul identity).  The bucket group-by of the join —
+pure integer key matching, no FLOPs — is host work, exactly the Spark
+shuffle stage's role.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sntc_tpu.core.base import Estimator, Model
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+
+#: Spark's MinHash prime (``MinHashLSH.HASH_PRIME`` [U]).
+HASH_PRIME = 2038074743
+
+
+class _LSHParams:
+    inputCol = Param("input vector column", default="features")
+    outputCol = Param("output hashes column", default="hashes")
+    numHashTables = Param(
+        "number of hash tables", default=1, validator=validators.gteq(1)
+    )
+    seed = Param("random seed", default=0)
+
+
+@jax.jit
+def _brp_hash(X, R, inv_bucket):
+    return jnp.floor((X @ R.T) * inv_bucket)
+
+
+@jax.jit
+def _minhash(active, vals):
+    """``active [N, F]`` bool, ``vals [L, F]`` precomputed hash of each
+    index → per-row per-table min over active indices, ``[N, L]``.
+    int32 throughout — hash values reach ~2e9, beyond f32's 24-bit
+    mantissa (observed error ±8), but inside int32."""
+    n, f = active.shape
+    big = jnp.int32(HASH_PRIME)  # all real hashes are < HASH_PRIME
+
+    def body(j, acc):
+        cand = jnp.where(active[:, j, None], vals[None, :, j], big)
+        return jnp.minimum(acc, cand)
+
+    init = jnp.full((n, vals.shape[0]), big, jnp.int32)
+    return jax.lax.fori_loop(0, f, body, init)
+
+
+@jax.jit
+def _sq_dists(Xa, Xb):
+    """Pairwise squared Euclidean via the matmul identity, ``[Na, Nb]``."""
+    aa = (Xa * Xa).sum(axis=1)[:, None]
+    bb = (Xb * Xb).sum(axis=1)[None, :]
+    return jnp.maximum(aa + bb - 2.0 * (Xa @ Xb.T), 0.0)
+
+
+def _matrix(col: np.ndarray) -> np.ndarray:
+    """Promote a 1-D column to ``[N, 1]`` (fit accepts either rank; every
+    hash/distance path works on matrices)."""
+    col = np.asarray(col)
+    return col[:, None] if col.ndim == 1 else col
+
+
+class _LSHModel(Model):
+    """Shared LSH model surface: transform + the two approx queries."""
+
+    def _hash(self, X: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def keyDistance(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform(self, frame: Frame) -> Frame:
+        X = _matrix(frame[self.getInputCol()]).astype(np.float32, copy=False)
+        return frame.with_column(self.getOutputCol(), self._hash(X))
+
+    def approxNearestNeighbors(
+        self,
+        frame: Frame,
+        key: np.ndarray,
+        numNearestNeighbors: int,
+        distCol: str = "distCol",
+    ) -> Frame:
+        X = _matrix(frame[self.getInputCol()]).astype(np.float32, copy=False)
+        key = np.asarray(key, np.float32).reshape(1, -1)
+        h_data = self._hash(X)
+        h_key = self._hash(key)[0]
+        cand = np.nonzero((h_data == h_key[None, :]).any(axis=1))[0]
+        if cand.size == 0:
+            return frame.slice(0, 0).with_column(
+                distCol, np.zeros(0, np.float64)
+            )
+        # paired (broadcast) form: exact differences — the a²+b²−2ab
+        # identity loses ~1e-3 on near-zero distances in f32, enough to
+        # misrank close neighbors
+        d = self.keyDistance(X[cand], key, paired=True).ravel()
+        order = np.argsort(d, kind="stable")[:numNearestNeighbors]
+        out = frame.take(cand[order])
+        return out.with_column(distCol, d[order].astype(np.float64))
+
+    #: rows of A processed per distance chunk inside one bucket — bounds
+    #: peak memory when skewed data collapses into one giant bucket
+    _JOIN_CHUNK_A = 4096
+
+    def approxSimilarityJoin(
+        self,
+        frameA: Frame,
+        frameB: Frame,
+        threshold: float,
+        distCol: str = "distCol",
+    ) -> Frame:
+        Xa = _matrix(frameA[self.getInputCol()]).astype(np.float32, copy=False)
+        Xb = _matrix(frameB[self.getInputCol()]).astype(np.float32, copy=False)
+        ha, hb = self._hash(Xa), self._hash(Xb)
+        # vectorized bucket group-by per table (the Spark shuffle stage):
+        # shared unique-value coding, then cartesian pairs per shared
+        # bucket, distance-thresholded chunk by chunk — only SURVIVING
+        # pairs are ever materialized, so a skewed all-one-bucket input
+        # costs time, not memory
+        ia_parts, ib_parts, d_parts = [], [], []
+        for t in range(ha.shape[1]):
+            uniq, codes = np.unique(
+                np.concatenate([ha[:, t], hb[:, t]]), return_inverse=True
+            )
+            ca, cb = codes[: len(ha)], codes[len(ha):]
+            order_b = np.argsort(cb, kind="stable")
+            sorted_cb = cb[order_b]
+            starts = np.searchsorted(sorted_cb, np.arange(len(uniq)), "left")
+            ends = np.searchsorted(sorted_cb, np.arange(len(uniq)), "right")
+            for v in np.unique(ca):
+                jb = order_b[starts[v]:ends[v]]
+                if jb.size == 0:
+                    continue
+                ja = np.nonzero(ca == v)[0]
+                for s in range(0, ja.size, self._JOIN_CHUNK_A):
+                    chunk = ja[s:s + self._JOIN_CHUNK_A]
+                    # pairwise prefilter (matmul identity, ~1e-3 f32 slack
+                    # near zero) with a margin, then exact paired recheck
+                    # so borderline pairs don't flip on rounding
+                    d = self.keyDistance(Xa[chunk], Xb[jb])
+                    ii, jj = np.nonzero(d < threshold * 1.001 + 1e-3)
+                    if ii.size == 0:
+                        continue
+                    d_ex = self.keyDistance(
+                        Xa[chunk[ii]], Xb[jb[jj]], paired=True
+                    )
+                    keep = d_ex < threshold
+                    if keep.any():
+                        ia_parts.append(chunk[ii[keep]])
+                        ib_parts.append(jb[jj[keep]])
+                        d_parts.append(d_ex[keep])
+        if not ia_parts:
+            ia = np.zeros(0, np.int64)
+            ib = np.zeros(0, np.int64)
+            d = np.zeros(0, np.float64)
+        else:
+            ia = np.concatenate(ia_parts).astype(np.int64)
+            ib = np.concatenate(ib_parts).astype(np.int64)
+            d = np.concatenate(d_parts).astype(np.float64)
+            # a pair sharing buckets in several tables appears once per
+            # table — dedup on the packed pair id
+            packed = ia * len(Xb) + ib
+            _, first = np.unique(packed, return_index=True)
+            first.sort()
+            ia, ib, d = ia[first], ib[first], d[first]
+        out = {"idA": ia, "idB": ib, distCol: d.astype(np.float64)}
+        return Frame(out)
+
+
+class BucketedRandomProjectionLSH(_LSHParams, Estimator):
+    """Euclidean-distance LSH [U]: ``h(x) = floor(x·r / bucketLength)``
+    with unit-norm Gaussian projections ``r``."""
+
+    bucketLength = Param(
+        "bucket width of each hash", default=None,
+        validator=lambda v: v is None or v > 0,
+    )
+
+    def _fit(self, frame: Frame) -> "BucketedRandomProjectionLSHModel":
+        if self.getBucketLength() is None:
+            raise ValueError("bucketLength must be set")
+        X = frame[self.getInputCol()]
+        f = X.shape[1] if X.ndim == 2 else 1
+        rng = np.random.default_rng(self.getSeed())
+        R = rng.normal(size=(int(self.getNumHashTables()), f))
+        R /= np.linalg.norm(R, axis=1, keepdims=True)
+        model = BucketedRandomProjectionLSHModel(randUnitVectors=R)
+        model.setParams(**self.paramValues())
+        return model
+
+
+class BucketedRandomProjectionLSHModel(_LSHParams, _LSHModel):
+    bucketLength = BucketedRandomProjectionLSH.bucketLength
+
+    def __init__(self, randUnitVectors, **kwargs):
+        super().__init__(**kwargs)
+        self.randUnitVectors = np.asarray(randUnitVectors, np.float32)
+
+    def _save_extra(self):
+        return {}, {"randUnitVectors": self.randUnitVectors}
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        m = cls(randUnitVectors=arrays["randUnitVectors"])
+        m.setParams(**params)
+        return m
+
+    def _hash(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            _brp_hash(
+                jnp.asarray(X),
+                jnp.asarray(self.randUnitVectors),
+                jnp.float32(1.0 / float(self.getBucketLength())),
+            )
+        )
+
+    def keyDistance(self, a, b, paired: bool = False) -> np.ndarray:
+        if paired:
+            return np.sqrt(
+                np.asarray(
+                    _sq_dists_paired(jnp.asarray(a), jnp.asarray(b)),
+                    np.float64,
+                )
+            )
+        return np.sqrt(
+            np.asarray(_sq_dists(jnp.asarray(a), jnp.asarray(b)), np.float64)
+        )
+
+
+@jax.jit
+def _sq_dists_paired(Xa, Xb):
+    d = Xa - Xb
+    return jnp.maximum((d * d).sum(axis=1), 0.0)
+
+
+class MinHashLSH(_LSHParams, Estimator):
+    """Jaccard-distance LSH over binary vectors [U]: ``h(x) = min over
+    active indices j of ((1 + j)·a + b) mod HASH_PRIME``."""
+
+    def _fit(self, frame: Frame) -> "MinHashLSHModel":
+        X = frame[self.getInputCol()]
+        f = X.shape[1] if X.ndim == 2 else 1
+        if f > HASH_PRIME:
+            raise ValueError("input dimension must be < HASH_PRIME")
+        rng = np.random.default_rng(self.getSeed())
+        L = int(self.getNumHashTables())
+        coeffs = np.stack(
+            [
+                rng.integers(1, HASH_PRIME, size=L),
+                rng.integers(0, HASH_PRIME, size=L),
+            ],
+            axis=1,
+        )
+        model = MinHashLSHModel(randCoefficients=coeffs)
+        model.setParams(**self.paramValues())
+        return model
+
+
+class MinHashLSHModel(_LSHParams, _LSHModel):
+    def __init__(self, randCoefficients, **kwargs):
+        super().__init__(**kwargs)
+        self.randCoefficients = np.asarray(randCoefficients, np.int64)
+
+    def _save_extra(self):
+        return {}, {"randCoefficients": self.randCoefficients}
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        m = cls(randCoefficients=arrays["randCoefficients"])
+        m.setParams(**params)
+        return m
+
+    def _hash_table(self, f: int) -> np.ndarray:
+        """``[L, F]`` hash of every index — int64 products on host (the
+        a·j products overflow int32), reduced mod HASH_PRIME into int32
+        for the on-device masked-min."""
+        j = np.arange(1, f + 1, dtype=np.int64)[None, :]
+        a = self.randCoefficients[:, 0][:, None]
+        b = self.randCoefficients[:, 1][:, None]
+        return ((j * a + b) % HASH_PRIME).astype(np.int32)
+
+    def _hash(self, X: np.ndarray) -> np.ndarray:
+        if np.any((X != 0) & (X != 1)):
+            raise ValueError("MinHashLSH requires binary (0/1) vectors")
+        if not np.asarray(X != 0).any(axis=1).all():
+            raise ValueError(
+                "MinHashLSH: every vector needs at least one nonzero "
+                "entry (Spark raises on empty sets too)"
+            )
+        vals = self._hash_table(X.shape[1])
+        return np.asarray(
+            _minhash(jnp.asarray(X != 0), jnp.asarray(vals)), np.int64
+        )
+
+    def keyDistance(self, a, b, paired: bool = False) -> np.ndarray:
+        """Jaccard distance ``1 − |A∩B| / |A∪B|``."""
+        a = np.asarray(a, bool)
+        b = np.asarray(b, bool)
+        if paired:
+            inter = (a & b).sum(axis=1).astype(np.float64)
+            union = (a | b).sum(axis=1).astype(np.float64)
+        else:
+            af = jnp.asarray(a, jnp.float32)
+            bf = jnp.asarray(b, jnp.float32)
+            inter = np.asarray(af @ bf.T, np.float64)
+            union = (
+                a.sum(axis=1)[:, None] + b.sum(axis=1)[None, :] - inter
+            ).astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            d = 1.0 - inter / union
+        return np.where(union > 0, d, 0.0)

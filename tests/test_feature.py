@@ -346,6 +346,50 @@ def test_minmax_scaler_matches_sklearn(mesh8):
         MinMaxScaler(mesh=mesh8, min=2.0, max=1.0).fit(f)
 
 
+def test_robust_scaler_matches_sklearn(mesh8):
+    from sklearn.preprocessing import RobustScaler as SkRS
+
+    from sntc_tpu.feature import RobustScaler
+
+    rng = np.random.default_rng(21)
+    X = rng.lognormal(1.0, 2.0, size=(1001, 4)).astype(np.float32)
+    X[:, 3] = 7.0  # zero-IQR feature
+    f = Frame({"features": X})
+    # default: scale only, no centering (Spark's defaults)
+    m = RobustScaler().fit(f)
+    out = np.asarray(m.transform(f)["scaledFeatures"])
+    sk = SkRS(with_centering=False).fit_transform(X[:, :3])
+    np.testing.assert_allclose(out[:, :3], sk, rtol=2e-4)
+    assert np.all(out[:, 3] == 0.0)  # zero range -> 0 (Spark std=0 rule)
+    # centered + custom quantile range
+    m2 = RobustScaler(
+        withCentering=True, lower=0.1, upper=0.9
+    ).fit(f)
+    out2 = np.asarray(m2.transform(f)["scaledFeatures"])
+    sk2 = SkRS(quantile_range=(10, 90)).fit_transform(
+        X[:, :3].astype(np.float64)
+    )
+    np.testing.assert_allclose(out2[:, :3], sk2, atol=2e-3)
+    with pytest.raises(ValueError, match="lower must be"):
+        RobustScaler(lower=0.8, upper=0.2).fit(f)
+
+
+def test_robust_scaler_save_load(mesh8, tmp_path):
+    from sntc_tpu.feature import RobustScaler
+    from sntc_tpu.mlio.save_load import load_model, save_model
+
+    X = np.random.default_rng(3).normal(size=(256, 3)).astype(np.float32)
+    f = Frame({"features": X})
+    m = RobustScaler(withCentering=True).fit(f)
+    save_model(m, str(tmp_path / "rs"))
+    m2 = load_model(str(tmp_path / "rs"))
+    np.testing.assert_allclose(m2.median, m.median)
+    np.testing.assert_allclose(m2.range, m.range)
+    np.testing.assert_allclose(
+        m2.transform(f)["scaledFeatures"], m.transform(f)["scaledFeatures"]
+    )
+
+
 def test_maxabs_scaler(mesh8):
     from sntc_tpu.feature import MaxAbsScaler
 

@@ -1,0 +1,195 @@
+"""sntc_tpu.stat vs scipy/sklearn oracles (SURVEY.md §4.2 oracle idiom:
+every statistic checked against an independent reference implementation
+on the same data)."""
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.stat import (
+    ANOVATest,
+    ChiSquareTest,
+    Correlation,
+    FValueTest,
+    KolmogorovSmirnovTest,
+    Summarizer,
+)
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.default_rng(7)
+    n, f = 4_003, 6  # non-multiple of 8: exercises the padding path
+    X = rng.lognormal(1.0, 1.5, size=(n, f)).astype(np.float32)
+    X[:, 2] = rng.integers(0, 4, size=n)  # a categorical-ish column
+    y = rng.integers(0, 3, size=n)
+    X[:, 0] += 3.0 * y  # give ANOVA/χ² something to find
+    return X, y
+
+
+def test_pearson_matches_numpy(mesh8, xy):
+    X, _ = xy
+    m = Correlation.corr(Frame({"features": X}), "features")["pearson"]
+    expected = np.corrcoef(X.astype(np.float64), rowvar=False)
+    np.testing.assert_allclose(m, expected, atol=1e-4)
+    assert m.shape == (X.shape[1], X.shape[1])
+
+
+def test_pearson_constant_feature_nan(mesh8):
+    X = np.ones((64, 2), dtype=np.float32)
+    X[:, 1] = np.arange(64)
+    m = Correlation.corr(Frame({"features": X}), "features")["pearson"]
+    # Spark: zero-variance rows/cols are NaN, diagonal is 1
+    assert np.isnan(m[0, 1]) and np.isnan(m[1, 0])
+    np.testing.assert_allclose(np.diag(m), 1.0)
+
+
+def test_spearman_matches_scipy(mesh8, xy):
+    from scipy.stats import spearmanr
+
+    X, _ = xy
+    m = Correlation.corr(Frame({"features": X}), "features", "spearman")
+    expected = spearmanr(X).statistic
+    np.testing.assert_allclose(m["spearman"], expected, atol=1e-4)
+
+
+def test_chisquare_matches_scipy(mesh8, xy):
+    from scipy.stats import chi2_contingency
+
+    X, y = xy
+    cats = np.stack(
+        [X[:, 2], (X[:, 0] > np.median(X[:, 0])).astype(np.float32)], axis=1
+    )
+    out = ChiSquareTest.test(Frame({"f": cats, "label": y}), "f", "label")
+    for j in range(2):
+        table = np.zeros((len(np.unique(cats[:, j])), 3))
+        for v_i, v in enumerate(np.unique(cats[:, j])):
+            for c in range(3):
+                table[v_i, c] = ((cats[:, j] == v) & (y == c)).sum()
+        ref = chi2_contingency(table, correction=False)
+        assert out["statistics"][0, j] == pytest.approx(ref.statistic, rel=1e-6)
+        assert out["pValues"][0, j] == pytest.approx(ref.pvalue, abs=1e-9)
+        assert out["degreesOfFreedom"][0, j] == ref.dof
+
+
+def test_chisquare_flatten_shape(mesh8, xy):
+    X, y = xy
+    out = ChiSquareTest.test(
+        Frame({"f": X[:, 2], "label": y}), "f", "label", flatten=True
+    )
+    assert out.num_rows == 1
+    assert set(out.columns) == {
+        "featureIndex", "pValue", "degreesOfFreedom", "statistic",
+    }
+
+
+def test_chisquare_rejects_continuous(mesh8):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=20_000).astype(np.float32)
+    y = rng.integers(0, 2, size=20_000)
+    with pytest.raises(ValueError, match="distinct"):
+        ChiSquareTest.test(Frame({"f": X, "label": y}), "f", "label")
+
+
+def test_anova_matches_sklearn(mesh8, xy):
+    from sklearn.feature_selection import f_classif as sk_f_classif
+
+    X, y = xy
+    out = ANOVATest.test(Frame({"features": X, "label": y}), "features", "label")
+    F_ref, p_ref = sk_f_classif(X.astype(np.float64), y)
+    np.testing.assert_allclose(out["statistics"][0], F_ref, rtol=1e-3)
+    np.testing.assert_allclose(out["pValues"][0], p_ref, atol=1e-6)
+
+
+def test_fvalue_matches_sklearn(mesh8, xy):
+    from sklearn.feature_selection import f_regression as sk_f_regression
+
+    X, y = xy
+    target = (X[:, 0] * 0.5 + np.random.default_rng(1).normal(size=len(y))).astype(
+        np.float32
+    )
+    out = FValueTest.test(
+        Frame({"features": X, "y": target}), "features", "y"
+    )
+    F_ref, p_ref = sk_f_regression(X.astype(np.float64), target.astype(np.float64))
+    np.testing.assert_allclose(out["statistics"][0], F_ref, rtol=1e-3)
+    np.testing.assert_allclose(out["pValues"][0], p_ref, atol=1e-6)
+
+
+def test_ks_matches_scipy(mesh8):
+    from scipy.stats import kstest
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(2.0, 3.0, size=10_001)
+    out = KolmogorovSmirnovTest.test(Frame({"s": x}), "s", "norm", 2.0, 3.0)
+    ref = kstest(x, "norm", args=(2.0, 3.0))
+    assert out["statistic"][0] == pytest.approx(ref.statistic, abs=1e-9)
+    # scipy's default uses the exact distribution; ours is the asymptotic
+    # Kolmogorov form (Spark/commons-math) — agree loosely at n=10k
+    assert out["pValue"][0] == pytest.approx(ref.pvalue, abs=5e-3)
+    out_bad = KolmogorovSmirnovTest.test(Frame({"s": x}), "s", "norm")
+    assert out_bad["pValue"][0] < 1e-10  # wrong null → rejected
+
+
+def test_summarizer_unweighted(mesh8, xy):
+    X, _ = xy
+    out = Summarizer.metrics(
+        "mean", "variance", "count", "min", "max", "normL1", "normL2",
+        "numNonZeros", "std", "sum", "weightSum",
+    ).summary(Frame({"features": X}), "features")
+    X64 = X.astype(np.float64)
+    np.testing.assert_allclose(out["mean"][0], X64.mean(axis=0), rtol=1e-5)
+    np.testing.assert_allclose(
+        out["variance"][0], X64.var(axis=0, ddof=1), rtol=1e-3
+    )
+    assert out["count"][0] == len(X)
+    assert out["weightSum"][0] == pytest.approx(len(X))
+    np.testing.assert_allclose(out["min"][0], X.min(axis=0), rtol=1e-6)
+    np.testing.assert_allclose(out["max"][0], X.max(axis=0), rtol=1e-6)
+    np.testing.assert_allclose(out["normL1"][0], np.abs(X64).sum(axis=0), rtol=1e-5)
+    np.testing.assert_allclose(
+        out["normL2"][0], np.sqrt((X64**2).sum(axis=0)), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        out["numNonZeros"][0], (X != 0).sum(axis=0), rtol=1e-6
+    )
+
+
+def test_summarizer_weighted_matches_replication(mesh8):
+    """weightCol ≡ integer row replication — the Spark weighted-stats
+    contract the rest of the framework pins (e.g. GLM weightCol)."""
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(501, 3)).astype(np.float32)
+    w = rng.integers(1, 4, size=501).astype(np.float32)
+    rep = np.repeat(X, w.astype(int), axis=0)
+    out_w = Summarizer.metrics("mean", "variance", "weightSum").summary(
+        Frame({"features": X, "w": w}), "features", weightCol="w"
+    )
+    out_r = Summarizer.metrics("mean", "variance", "weightSum").summary(
+        Frame({"features": rep}), "features"
+    )
+    np.testing.assert_allclose(out_w["mean"][0], out_r["mean"][0], atol=1e-5)
+    np.testing.assert_allclose(
+        out_w["variance"][0], out_r["variance"][0], rtol=1e-4
+    )
+    assert out_w["weightSum"][0] == pytest.approx(out_r["weightSum"][0])
+
+
+def test_summarizer_zero_weight_rows_excluded(mesh8):
+    """Spark's SummarizerBuffer skips weight-0 instances: they must not
+    leak into extrema or count."""
+    X = np.array([[100.0], [1.0], [2.0]], dtype=np.float32)
+    w = np.array([0.0, 1.0, 1.0], dtype=np.float32)
+    out = Summarizer.metrics("min", "max", "count", "mean").summary(
+        Frame({"features": X, "w": w}), "features", weightCol="w"
+    )
+    assert out["max"][0, 0] == 2.0
+    assert out["min"][0, 0] == 1.0
+    assert out["count"][0] == 2
+    assert out["mean"][0, 0] == pytest.approx(1.5)
+
+
+def test_summarizer_single_metric_shorthand(mesh8, xy):
+    X, _ = xy
+    out = Summarizer.mean(Frame({"features": X}), "features")
+    assert out.columns == ["mean"]
