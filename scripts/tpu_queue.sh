@@ -71,7 +71,14 @@ while [ "$pos" -lt "${#QUEUE[@]}" ]; do
   RC=$?
   ON_TPU=false
   grep -q '"platform": "tpu"' "$OUT_FILE" && ON_TPU=true
-  rm -f "$OUT_FILE"
+  if [ $RC -eq 0 ] && $ON_TPU; then
+    rm -f "$OUT_FILE"
+    OUT_KEPT=null
+  else
+    # keep failed-run output for diagnosis (a skipped item's error story
+    # must survive); path recorded in the log line
+    OUT_KEPT="\"$OUT_FILE\""
+  fi
   attempts=$((attempts + 1))
   ADV=false
   if $ON_TPU && [ $RC -eq 0 ]; then
@@ -79,7 +86,7 @@ while [ "$pos" -lt "${#QUEUE[@]}" ]; do
   elif [ $attempts -ge $MAX_ATTEMPTS ]; then
     ADV=true  # give up on this item; don't starve the rest
   fi
-  echo "{\"ts\": \"$TS\", \"item\": \"$ITEM\", \"rc\": $RC, \"on_tpu\": $ON_TPU, \"attempt\": $attempts, \"advanced\": $ADV}" >> $QLOG
+  echo "{\"ts\": \"$TS\", \"item\": \"$ITEM\", \"rc\": $RC, \"on_tpu\": $ON_TPU, \"attempt\": $attempts, \"advanced\": $ADV, \"output\": $OUT_KEPT}" >> $QLOG
   if $ADV; then
     pos=$((pos + 1))
     echo "$pos" > "$POS_FILE"

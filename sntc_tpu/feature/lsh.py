@@ -125,6 +125,12 @@ class _LSHModel(Model):
     #: peak memory when skewed data collapses into one giant bucket
     _JOIN_CHUNK_A = 4096
 
+    def _prefilter_slack(self, Xa, Xb) -> float:
+        """Upper bound on the pairwise-distance error of ``keyDistance``'s
+        fast path, in distance units.  0 where the fast path is exact
+        (MinHash: f32 matmuls of 0/1 counts)."""
+        return 0.0
+
     def approxSimilarityJoin(
         self,
         frameA: Frame,
@@ -146,22 +152,31 @@ class _LSHModel(Model):
                 np.concatenate([ha[:, t], hb[:, t]]), return_inverse=True
             )
             ca, cb = codes[: len(ha)], codes[len(ha):]
+            # argsort+searchsorted on BOTH sides: O(N log N) bucket
+            # indexing (a per-unique-value linear scan of ca would be
+            # O(U·N) host work)
+            order_a = np.argsort(ca, kind="stable")
             order_b = np.argsort(cb, kind="stable")
-            sorted_cb = cb[order_b]
-            starts = np.searchsorted(sorted_cb, np.arange(len(uniq)), "left")
-            ends = np.searchsorted(sorted_cb, np.arange(len(uniq)), "right")
-            for v in np.unique(ca):
-                jb = order_b[starts[v]:ends[v]]
-                if jb.size == 0:
-                    continue
-                ja = np.nonzero(ca == v)[0]
+            sca, scb = ca[order_a], cb[order_b]
+            vals = np.arange(len(uniq))
+            a_lo = np.searchsorted(sca, vals, "left")
+            a_hi = np.searchsorted(sca, vals, "right")
+            b_lo = np.searchsorted(scb, vals, "left")
+            b_hi = np.searchsorted(scb, vals, "right")
+            shared = np.nonzero((a_hi > a_lo) & (b_hi > b_lo))[0]
+            for v in shared:
+                jb = order_b[b_lo[v]:b_hi[v]]
+                ja = order_a[a_lo[v]:a_hi[v]]
                 for s in range(0, ja.size, self._JOIN_CHUNK_A):
                     chunk = ja[s:s + self._JOIN_CHUNK_A]
-                    # pairwise prefilter (matmul identity, ~1e-3 f32 slack
-                    # near zero) with a margin, then exact paired recheck
-                    # so borderline pairs don't flip on rounding
+                    # pairwise prefilter with a MAGNITUDE-SCALED margin
+                    # (the f32 a²+b²−2ab identity's error scales with
+                    # ‖x‖², so a fixed slack drops true pairs on
+                    # large-magnitude features), then exact paired
+                    # recheck so over-included pairs cost compute only
                     d = self.keyDistance(Xa[chunk], Xb[jb])
-                    ii, jj = np.nonzero(d < threshold * 1.001 + 1e-3)
+                    slack = self._prefilter_slack(Xa[chunk], Xb[jb])
+                    ii, jj = np.nonzero(d < threshold + slack)
                     if ii.size == 0:
                         continue
                     d_ex = self.keyDistance(
@@ -248,6 +263,16 @@ class BucketedRandomProjectionLSHModel(_LSHParams, _LSHModel):
         return np.sqrt(
             np.asarray(_sq_dists(jnp.asarray(a), jnp.asarray(b)), np.float64)
         )
+
+    def _prefilter_slack(self, Xa, Xb) -> float:
+        """The a²+b²−2ab identity accumulates f32 error up to
+        ~F·eps·(‖a‖²+‖b‖²); convert that squared-distance bound into
+        distance units via √ (conservative near zero, and over-inclusion
+        only costs the exact recheck)."""
+        eps = float(np.finfo(np.float32).eps)
+        aa = float((Xa.astype(np.float64) ** 2).sum(axis=1).max())
+        bb = float((Xb.astype(np.float64) ** 2).sum(axis=1).max())
+        return float(np.sqrt(4.0 * Xa.shape[1] * eps * (aa + bb)))
 
 
 @jax.jit

@@ -41,6 +41,14 @@ from sntc_tpu.models.glm import (
 )
 from sntc_tpu.models.linear_regression import LinearRegression, LinearRegressionModel
 from sntc_tpu.models.linear_svc import LinearSVC, LinearSVCModel
+from sntc_tpu.models.bisecting_kmeans import (
+    BisectingKMeans,
+    BisectingKMeansModel,
+)
+from sntc_tpu.models.aft import (
+    AFTSurvivalRegression,
+    AFTSurvivalRegressionModel,
+)
 from sntc_tpu.models.naive_bayes import NaiveBayes, NaiveBayesModel
 from sntc_tpu.models.one_vs_rest import OneVsRest, OneVsRestModel
 

@@ -1,0 +1,112 @@
+"""BisectingKMeans: cluster-recovery oracles on separable blobs,
+tree-descent prediction semantics, minDivisibleClusterSize gating,
+save/load."""
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.models import BisectingKMeans
+from sntc_tpu.mlio.save_load import load_model, save_model
+
+
+def _blobs(n_per=400, centers=None, seed=0, spread=0.3):
+    rng = np.random.default_rng(seed)
+    centers = centers if centers is not None else np.array(
+        [[0.0, 0.0], [8.0, 0.0], [0.0, 8.0], [8.0, 8.0]]
+    )
+    X = np.concatenate(
+        [c + spread * rng.normal(size=(n_per, centers.shape[1]))
+         for c in centers]
+    ).astype(np.float32)
+    y = np.repeat(np.arange(len(centers)), n_per)
+    return X, y
+
+
+def test_recovers_separated_blobs(mesh8):
+    X, y = _blobs()
+    m = BisectingKMeans(k=4, seed=1).fit(Frame({"features": X}))
+    assert len(m.clusterCenters) == 4
+    pred = m.predict(X).astype(int)
+    # every true blob maps to one predicted cluster (perfect separation)
+    for c in range(4):
+        assert len(np.unique(pred[y == c])) == 1
+    assert len(np.unique(pred)) == 4
+    # centers match blob means: nearest found center per true center
+    true = np.array([[0, 0], [8, 0], [0, 8], [8, 8]], np.float64)
+    d = np.linalg.norm(
+        true[:, None, :] - m.clusterCenters[None, :, :], axis=2
+    )
+    nearest = d.argmin(axis=1)
+    assert len(np.unique(nearest)) == 4  # a distinct center per blob
+    assert d[np.arange(4), nearest].max() < 0.15
+
+
+def test_transform_and_cost(mesh8):
+    X, _ = _blobs(n_per=100)
+    f = Frame({"features": X})
+    m = BisectingKMeans(k=4, seed=0).fit(f)
+    out = m.transform(f)
+    assert out["prediction"].shape == (400,)
+    cost = m.computeCost(f)
+    assert cost == pytest.approx(m.summary.trainingCost, rel=1e-9)
+    # within-cluster sq distances of tight blobs: small vs total spread
+    assert cost < 0.25 * ((X - X.mean(0)) ** 2).sum()
+
+
+def test_min_divisible_cluster_size(mesh8):
+    # one big and one tiny blob: with min size above the tiny blob, only
+    # the big one may split, capping the leaf count below k
+    rng = np.random.default_rng(2)
+    X = np.concatenate([
+        rng.normal(size=(900, 2)),
+        np.array([[50.0, 50.0]]) + 0.01 * rng.normal(size=(60, 2)),
+    ]).astype(np.float32)
+    m = BisectingKMeans(
+        k=6, minDivisibleClusterSize=100, seed=0
+    ).fit(Frame({"features": X}))
+    # the 60-row blob can never split; leaves over it stay at 1
+    pred = m.predict(X).astype(int)
+    tiny_clusters = np.unique(pred[900:])
+    assert len(tiny_clusters) == 1
+    m2 = BisectingKMeans(
+        k=6, minDivisibleClusterSize=0.5, seed=0
+    ).fit(Frame({"features": X}))
+    # fraction 0.5 of 960 rows = 480: after the first split no leaf is
+    # divisible except possibly the big side once — fewer than k leaves
+    assert len(m2.clusterCenters) < 6
+
+
+def test_fewer_than_k_on_degenerate_data(mesh8):
+    X = np.ones((64, 3), np.float32)  # identical points can't split
+    m = BisectingKMeans(k=4).fit(Frame({"features": X}))
+    assert len(m.clusterCenters) == 1
+    assert (m.predict(X) == 0).all()
+
+
+def test_cosine_distance(mesh8):
+    # rays from the origin: cosine clusters by direction, not magnitude
+    rng = np.random.default_rng(5)
+    dirs = np.array([[1.0, 0.0], [0.0, 1.0]])
+    rows = []
+    for d in dirs:
+        scale = rng.uniform(0.5, 20.0, size=200)[:, None]
+        rows.append(scale * (d + 0.02 * rng.normal(size=(200, 2))))
+    X = np.concatenate(rows).astype(np.float32)
+    m = BisectingKMeans(k=2, distanceMeasure="cosine", seed=0).fit(
+        Frame({"features": X})
+    )
+    pred = m.predict(X).astype(int)
+    assert len(np.unique(pred[:200])) == 1
+    assert len(np.unique(pred[200:])) == 1
+    assert pred[0] != pred[200]
+
+
+def test_save_load(mesh8, tmp_path):
+    X, _ = _blobs(n_per=50)
+    f = Frame({"features": X})
+    m = BisectingKMeans(k=3, seed=4).fit(f)
+    save_model(m, str(tmp_path / "bkm"))
+    m2 = load_model(str(tmp_path / "bkm"))
+    np.testing.assert_allclose(m2.clusterCenters, m.clusterCenters)
+    np.testing.assert_array_equal(m2.predict(X), m.predict(X))
