@@ -24,6 +24,17 @@ from sntc_tpu.feature.discretizers import (
     QuantileDiscretizer,
 )
 from sntc_tpu.feature.expansion import Interaction, PolynomialExpansion
+from sntc_tpu.feature.text import (
+    CountVectorizer,
+    CountVectorizerModel,
+    HashingTF,
+    IDF,
+    IDFModel,
+    NGram,
+    RegexTokenizer,
+    StopWordsRemover,
+    Tokenizer,
+)
 from sntc_tpu.feature.lsh import (
     BucketedRandomProjectionLSH,
     BucketedRandomProjectionLSHModel,

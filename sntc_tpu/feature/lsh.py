@@ -49,7 +49,13 @@ class _LSHParams:
 
 @jax.jit
 def _brp_hash(X, R, inv_bucket):
-    return jnp.floor((X @ R.T) * inv_bucket)
+    # HIGHEST matmul precision: on TPU the default is bf16 passes, which
+    # would move points across floor() bucket boundaries relative to the
+    # f32 semantics the tests and the slack bound assume
+    return jnp.floor(
+        jnp.matmul(X, R.T, precision=jax.lax.Precision.HIGHEST)
+        * inv_bucket
+    )
 
 
 @jax.jit
@@ -71,10 +77,14 @@ def _minhash(active, vals):
 
 @jax.jit
 def _sq_dists(Xa, Xb):
-    """Pairwise squared Euclidean via the matmul identity, ``[Na, Nb]``."""
+    """Pairwise squared Euclidean via the matmul identity, ``[Na, Nb]``.
+    HIGHEST precision: the prefilter slack bound assumes f32 error, not
+    the TPU default bf16 passes (~2^15 larger — true pairs would drop
+    before the exact recheck could save them)."""
     aa = (Xa * Xa).sum(axis=1)[:, None]
     bb = (Xb * Xb).sum(axis=1)[None, :]
-    return jnp.maximum(aa + bb - 2.0 * (Xa @ Xb.T), 0.0)
+    cross = jnp.matmul(Xa, Xb.T, precision=jax.lax.Precision.HIGHEST)
+    return jnp.maximum(aa + bb - 2.0 * cross, 0.0)
 
 
 def _matrix(col: np.ndarray) -> np.ndarray:

@@ -41,6 +41,7 @@ from sntc_tpu.models.glm import (
 )
 from sntc_tpu.models.linear_regression import LinearRegression, LinearRegressionModel
 from sntc_tpu.models.linear_svc import LinearSVC, LinearSVCModel
+from sntc_tpu.models.pic import PowerIterationClustering
 from sntc_tpu.models.bisecting_kmeans import (
     BisectingKMeans,
     BisectingKMeansModel,

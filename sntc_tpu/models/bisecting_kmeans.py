@@ -150,7 +150,8 @@ class BisectingKMeans(_BisectingParams, Estimator):
             leaf_centers, cosine,
         )
         cost = float(d[np.arange(n), assign.astype(int)].sum())
-        model.summary = TrainingSummary([cost], len(model.clusterCenters))
+        n_splits = (len(centers) - 1) // 2  # bisections performed
+        model.summary = TrainingSummary([cost], n_splits)
         model.summary.trainingCost = cost
         return model
 

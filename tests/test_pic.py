@@ -1,0 +1,79 @@
+"""PowerIterationClustering: block-structured-graph recovery, id
+preservation, init modes, input validation."""
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.models import PowerIterationClustering
+
+
+def _two_block_graph(n_per=30, p_in=0.9, p_out=0.02, seed=0, id_offset=0):
+    """Edges of a two-community random graph; ids offset to prove the
+    result reports original ids."""
+    rng = np.random.default_rng(seed)
+    n = 2 * n_per
+    src, dst, w = [], [], []
+    for i in range(n):
+        for j in range(i + 1, n):
+            same = (i < n_per) == (j < n_per)
+            if rng.random() < (p_in if same else p_out):
+                src.append(i + id_offset)
+                dst.append(j + id_offset)
+                w.append(1.0 if same else 0.1)
+    return (
+        np.array(src, np.int64), np.array(dst, np.int64),
+        np.array(w, np.float64), n_per,
+    )
+
+
+def test_recovers_two_blocks(mesh8):
+    src, dst, w, n_per = _two_block_graph(id_offset=100)
+    f = Frame({"src": src, "dst": dst, "weight": w})
+    pic = PowerIterationClustering(
+        k=2, maxIter=30, weightCol="weight", seed=1
+    )
+    out = pic.assignClusters(f)
+    ids = np.asarray(out["id"])
+    cl = np.asarray(out["cluster"])
+    assert ids.min() == 100  # original ids preserved
+    by_id = dict(zip(ids.tolist(), cl.tolist()))
+    a = [by_id[100 + i] for i in range(n_per)]
+    b = [by_id[100 + n_per + i] for i in range(n_per)]
+    # each block lands (almost) entirely in one cluster, and the two
+    # blocks differ
+    a_major = max(set(a), key=a.count)
+    b_major = max(set(b), key=b.count)
+    assert a_major != b_major
+    assert a.count(a_major) >= 0.9 * n_per
+    assert b.count(b_major) >= 0.9 * n_per
+
+
+def test_degree_init(mesh8):
+    src, dst, w, n_per = _two_block_graph(seed=3)
+    f = Frame({"src": src, "dst": dst, "weight": w})
+    out = PowerIterationClustering(
+        k=2, maxIter=30, weightCol="weight", initMode="degree", seed=0
+    ).assignClusters(f)
+    assert len(np.unique(out["cluster"])) == 2
+
+
+def test_default_weight_is_one(mesh8):
+    src = np.array([0, 1, 3, 4], np.int64)
+    dst = np.array([1, 2, 4, 5], np.int64)
+    out = PowerIterationClustering(k=2, maxIter=10).assignClusters(
+        Frame({"src": src, "dst": dst})
+    )
+    assert out.num_rows == 6  # two 3-chains
+
+
+def test_validation(mesh8):
+    f_neg = Frame({
+        "src": np.array([0]), "dst": np.array([1]),
+        "weight": np.array([-1.0]),
+    })
+    with pytest.raises(ValueError, match="non-negative"):
+        PowerIterationClustering(weightCol="weight").assignClusters(f_neg)
+    f_loop = Frame({"src": np.array([2]), "dst": np.array([2])})
+    with pytest.raises(ValueError, match="self-loop"):
+        PowerIterationClustering().assignClusters(f_loop)

@@ -1,0 +1,174 @@
+"""Text stages: murmur3 vs sklearn's independent implementation,
+CountVectorizer vs sklearn on identical token streams, IDF vs the Spark
+formula recomputed in numpy, tokenizer/stopword/ngram semantics."""
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.feature.text import (
+    CountVectorizer,
+    HashingTF,
+    IDF,
+    NGram,
+    RegexTokenizer,
+    StopWordsRemover,
+    Tokenizer,
+    murmur3_32,
+)
+from sntc_tpu.mlio.save_load import load_model, save_model
+
+DOCS = [
+    "TCP syn flood detected from host alpha",
+    "benign http GET from host beta",
+    "udp scan scan scan from gamma",
+    "",
+]
+
+
+def _tok_frame():
+    return Tokenizer(inputCol="text", outputCol="tokens").transform(
+        Frame({"text": np.array(DOCS, dtype=object)})
+    )
+
+
+def test_murmur3_matches_sklearn():
+    from sklearn.utils.murmurhash import murmurhash3_32
+
+    for term in ["", "a", "abc", "hello", "flow", "синтаксис", "長い語"]:
+        for seed in (0, 42):
+            ours = murmur3_32(term.encode("utf-8"), seed)
+            ref = murmurhash3_32(term.encode("utf-8"), seed=seed,
+                                 positive=True)
+            assert ours == ref, (term, seed)
+
+
+def test_tokenizer_and_regex():
+    f = _tok_frame()
+    assert f["tokens"][0] == [
+        "tcp", "syn", "flood", "detected", "from", "host", "alpha",
+    ]
+    assert f["tokens"][3] == []
+    rt = RegexTokenizer(
+        inputCol="text", outputCol="tokens", pattern=r"[a-z]+",
+        gaps=False, minTokenLength=4,
+    ).transform(Frame({"text": np.array(DOCS, dtype=object)}))
+    assert rt["tokens"][0] == ["flood", "detected", "from", "host", "alpha"]
+
+
+def test_stopwords_and_ngram():
+    f = _tok_frame()
+    sw = StopWordsRemover(
+        inputCol="tokens", outputCol="filtered"
+    ).transform(f)
+    assert "from" not in sw["filtered"][0]
+    assert "tcp" in sw["filtered"][0]
+    ng = NGram(inputCol="tokens", outputCol="ngrams", n=2).transform(f)
+    assert ng["ngrams"][0][0] == "tcp syn"
+    assert len(ng["ngrams"][0]) == 6
+    assert ng["ngrams"][3] == []
+
+
+def test_hashingtf_bucket_parity_and_counts():
+    from sklearn.utils.murmurhash import murmurhash3_32
+
+    f = _tok_frame()
+    tf = HashingTF(inputCol="tokens", outputCol="tf", numFeatures=64)
+    out = tf.transform(f)["tf"]
+    assert out.shape == (4, 64)
+    # Spark indexOf semantics: signed murmur3(seed 42), nonNegativeMod
+    for term in ["tcp", "scan", "host"]:
+        h = murmurhash3_32(term.encode("utf-8"), seed=42, positive=False)
+        assert tf.indexOf(term) == ((h % 64) + 64) % 64
+    assert out[2, tf.indexOf("scan")] == 3.0
+    assert out[3].sum() == 0.0
+    binary = HashingTF(
+        inputCol="tokens", outputCol="tf", numFeatures=64, binary=True
+    ).transform(f)["tf"]
+    assert binary[2, tf.indexOf("scan")] == 1.0
+
+
+def test_hashingtf_dense_guard():
+    f = _tok_frame()
+    with pytest.raises(ValueError, match="dense"):
+        HashingTF(inputCol="tokens", outputCol="tf").transform(
+            Frame({"tokens": np.array([["x"]] * 10_000, dtype=object)})
+        )
+
+
+def test_count_vectorizer_matches_sklearn(mesh8):
+    from sklearn.feature_extraction.text import CountVectorizer as SkCV
+
+    f = _tok_frame()
+    cv = CountVectorizer(inputCol="tokens", outputCol="counts").fit(f)
+    out = cv.transform(f)["counts"]
+    sk = SkCV(analyzer=lambda d: d)
+    ref = sk.fit_transform([list(t) for t in f["tokens"]]).toarray()
+    ref_vocab = sk.vocabulary_
+    assert set(cv.vocabulary) == set(ref_vocab)
+    for t, j in ref_vocab.items():
+        np.testing.assert_array_equal(
+            out[:, cv.vocabulary.index(t)], ref[:, j]
+        )
+    # frequency-desc, term-asc ordering is deterministic: 'scan' (3
+    # occurrences) first, then 'from' (3) — tie broken by term
+    assert cv.vocabulary[0] in ("from", "scan")
+    assert sorted(cv.vocabulary[:2]) == cv.vocabulary[:2]
+
+
+def test_count_vectorizer_df_bounds_and_mintf():
+    f = _tok_frame()
+    cv = CountVectorizer(
+        inputCol="tokens", outputCol="counts", minDF=2.0
+    ).fit(f)
+    assert set(cv.vocabulary) == {"from", "host"}  # in ≥2 docs
+    cv2 = CountVectorizer(
+        inputCol="tokens", outputCol="counts", maxDF=2.0
+    ).fit(f)
+    assert "from" not in cv2.vocabulary  # df=3 > 2
+    out = CountVectorizer(
+        inputCol="tokens", outputCol="counts", minTF=2.0
+    ).fit(f).transform(f)["counts"]
+    # only 'scan' (count 3 in doc 2) survives minTF=2
+    assert out.sum() == 3.0
+
+
+def test_idf_matches_formula(mesh8):
+    f = _tok_frame()
+    counts = CountVectorizer(inputCol="tokens", outputCol="counts").fit(
+        f
+    ).transform(f)
+    idf_model = IDF(inputCol="counts", outputCol="tfidf").fit(counts)
+    X = counts["counts"]
+    m = X.shape[0]
+    df = (X > 0).sum(axis=0).astype(np.float64)
+    np.testing.assert_allclose(
+        idf_model.idf, np.log((m + 1.0) / (df + 1.0)), rtol=1e-12
+    )
+    out = idf_model.transform(counts)["tfidf"]
+    np.testing.assert_allclose(
+        out, X * idf_model.idf[None, :].astype(np.float32), rtol=1e-6
+    )
+    # minDocFreq zeroes rare terms
+    idf2 = IDF(inputCol="counts", outputCol="tfidf", minDocFreq=2).fit(
+        counts
+    )
+    assert (idf2.idf[df < 2] == 0).all()
+    assert (idf2.idf[df >= 2] > 0).all()
+
+
+def test_text_save_load(mesh8, tmp_path):
+    f = _tok_frame()
+    cv = CountVectorizer(inputCol="tokens", outputCol="counts").fit(f)
+    save_model(cv, str(tmp_path / "cv"))
+    cv2 = load_model(str(tmp_path / "cv"))
+    assert cv2.vocabulary == cv.vocabulary
+    np.testing.assert_array_equal(
+        cv2.transform(f)["counts"], cv.transform(f)["counts"]
+    )
+    counts = cv.transform(f)
+    idf = IDF(inputCol="counts", outputCol="tfidf").fit(counts)
+    save_model(idf, str(tmp_path / "idf"))
+    idf2 = load_model(str(tmp_path / "idf"))
+    np.testing.assert_allclose(idf2.idf, idf.idf)
+    assert idf2.numDocs == idf.numDocs
