@@ -24,6 +24,18 @@ import pyarrow as pa
 ColumnLike = Union[np.ndarray, Sequence]
 
 
+def object_column(values: Sequence) -> np.ndarray:
+    """1-D object column of ragged values (token lists, itemsets).
+
+    ``np.array(list_of_lists, dtype=object)`` silently builds a 2-D
+    array when every inner list shares a length — the explicit fill
+    keeps the column rank-1 regardless."""
+    col = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        col[i] = v
+    return col
+
+
 def _coerce_column(name: str, value: ColumnLike):
     """Coerce one column to an array and validate its rank.
 

@@ -24,6 +24,7 @@ from sntc_tpu.feature.discretizers import (
     QuantileDiscretizer,
 )
 from sntc_tpu.feature.expansion import Interaction, PolynomialExpansion
+from sntc_tpu.feature.word2vec import Word2Vec, Word2VecModel
 from sntc_tpu.feature.text import (
     CountVectorizer,
     CountVectorizerModel,
@@ -49,6 +50,23 @@ from sntc_tpu.feature.encoders import (
 )
 
 __all__ = [
+    "BucketedRandomProjectionLSH",
+    "BucketedRandomProjectionLSHModel",
+    "CountVectorizer",
+    "CountVectorizerModel",
+    "HashingTF",
+    "IDF",
+    "IDFModel",
+    "MinHashLSH",
+    "MinHashLSHModel",
+    "NGram",
+    "RegexTokenizer",
+    "RobustScaler",
+    "RobustScalerModel",
+    "StopWordsRemover",
+    "Tokenizer",
+    "Word2Vec",
+    "Word2VecModel",
     "VectorAssembler",
     "StringIndexer",
     "StringIndexerModel",

@@ -38,7 +38,7 @@ from typing import List, Sequence
 import numpy as np
 
 from sntc_tpu.core.base import Estimator, Model, Transformer
-from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.frame import Frame, object_column
 from sntc_tpu.core.params import Param, validators
 from sntc_tpu.parallel.collectives import make_tree_aggregate, shard_batch
 from sntc_tpu.parallel.context import get_default_mesh
@@ -77,13 +77,6 @@ def _tokens_column(frame: Frame, col: str) -> List[List[str]]:
     return [list(v) for v in raw]
 
 
-def _object_column(values: List[List[str]]) -> np.ndarray:
-    out = np.empty(len(values), dtype=object)
-    for i, v in enumerate(values):
-        out[i] = v
-    return out
-
-
 class Tokenizer(Transformer):
     """Lowercase + whitespace split [U]."""
 
@@ -92,7 +85,7 @@ class Tokenizer(Transformer):
 
     def transform(self, frame: Frame) -> Frame:
         toks = [str(s).lower().split() for s in frame[self.getInputCol()]]
-        return frame.with_column(self.getOutputCol(), _object_column(toks))
+        return frame.with_column(self.getOutputCol(), object_column(toks))
 
 
 class RegexTokenizer(Transformer):
@@ -120,7 +113,7 @@ class RegexTokenizer(Transformer):
             s = str(s).lower() if lo else str(s)
             toks = rx.split(s) if gaps else rx.findall(s)
             out.append([t for t in toks if len(t) >= mtl])
-        return frame.with_column(self.getOutputCol(), _object_column(out))
+        return frame.with_column(self.getOutputCol(), object_column(out))
 
 
 class StopWordsRemover(Transformer):
@@ -141,7 +134,7 @@ class StopWordsRemover(Transformer):
             [t for t in doc if keep(t)]
             for doc in _tokens_column(frame, self.getInputCol())
         ]
-        return frame.with_column(self.getOutputCol(), _object_column(out))
+        return frame.with_column(self.getOutputCol(), object_column(out))
 
 
 class NGram(Transformer):
@@ -155,7 +148,7 @@ class NGram(Transformer):
             [" ".join(doc[i:i + n]) for i in range(len(doc) - n + 1)]
             for doc in _tokens_column(frame, self.getInputCol())
         ]
-        return frame.with_column(self.getOutputCol(), _object_column(out))
+        return frame.with_column(self.getOutputCol(), object_column(out))
 
 
 # ---------------------------------------------------------------------------

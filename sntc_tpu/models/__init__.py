@@ -42,6 +42,9 @@ from sntc_tpu.models.glm import (
 from sntc_tpu.models.linear_regression import LinearRegression, LinearRegressionModel
 from sntc_tpu.models.linear_svc import LinearSVC, LinearSVCModel
 from sntc_tpu.models.pic import PowerIterationClustering
+from sntc_tpu.models.lda import LDA, LDAModel
+from sntc_tpu.models.als import ALS, ALSModel
+from sntc_tpu.models.fpm import FPGrowth, FPGrowthModel
 from sntc_tpu.models.bisecting_kmeans import (
     BisectingKMeans,
     BisectingKMeansModel,
@@ -54,6 +57,17 @@ from sntc_tpu.models.naive_bayes import NaiveBayes, NaiveBayesModel
 from sntc_tpu.models.one_vs_rest import OneVsRest, OneVsRestModel
 
 __all__ = [
+    "AFTSurvivalRegression",
+    "AFTSurvivalRegressionModel",
+    "ALS",
+    "ALSModel",
+    "BisectingKMeans",
+    "BisectingKMeansModel",
+    "FPGrowth",
+    "FPGrowthModel",
+    "LDA",
+    "LDAModel",
+    "PowerIterationClustering",
     "RandomForestClassifier",
     "RandomForestClassificationModel",
     "RandomForestRegressor",

@@ -1,0 +1,138 @@
+"""ALS: normal-equation exactness (the fitted factors must satisfy the
+ALS-WR stationary conditions they were solved for), low-rank recovery
+with held-out RMSE, implicit preference ordering, cold-start handling,
+recommend-top-k consistency, save/load."""
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.models import ALS
+from sntc_tpu.mlio.save_load import load_model, save_model
+
+
+def _low_rank_ratings(n_u=60, n_i=40, rank=4, frac=0.5, seed=0, noise=0.05):
+    rng = np.random.default_rng(seed)
+    U = rng.normal(size=(n_u, rank)) / np.sqrt(rank)
+    V = rng.normal(size=(n_i, rank)) / np.sqrt(rank)
+    R = U @ V.T + 2.0  # keep ratings positive-ish
+    mask = rng.random((n_u, n_i)) < frac
+    uu, ii = np.nonzero(mask)
+    r = R[uu, ii] + noise * rng.normal(size=len(uu))
+    # non-contiguous original ids to prove the lut round-trip
+    return 10 * uu + 3, 7 * ii + 1, r.astype(np.float32), R, mask
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    users, items, r, R, mask = _low_rank_ratings()
+    f = Frame({"user": users, "item": items, "rating": r})
+    m = ALS(rank=6, maxIter=15, regParam=0.01, seed=2).fit(f)
+    return m, users, items, r, R, mask
+
+
+def test_heldout_rmse(fitted):
+    m, users, items, r, R, mask = fitted
+    # held-out cells: the unobserved entries of the true low-rank matrix
+    hu, hi = np.nonzero(~mask)
+    f_test = Frame({"user": 10 * hu + 3, "item": 7 * hi + 1})
+    pred = m.transform(f_test)["prediction"]
+    rmse = float(np.sqrt(np.mean((pred - R[hu, hi]) ** 2)))
+    assert rmse < 0.15  # true noise level is 0.05; spread of R is ~1
+    # and the training cells fit tightly
+    pred_tr = m.transform(
+        Frame({"user": users, "item": items})
+    )["prediction"]
+    assert float(np.sqrt(np.mean((pred_tr - r) ** 2))) < 0.1
+
+
+def test_normal_equation_stationarity(fitted):
+    """The ITEM half-step runs last, so each item factor must solve
+    (Σ u uᵀ + λ n_i I) x = Σ r u exactly — the ALS-WR system [U]."""
+    m, users, items, r, _, _ = fitted
+    uf = {int(i): f for i, f in zip(m.userIds, np.asarray(m.userFactors["features"], np.float64))}
+    vf = {int(i): f for i, f in zip(m.itemIds, np.asarray(m.itemFactors["features"], np.float64))}
+    lam = 0.01
+    for iid in list(vf)[:5]:
+        rows = np.nonzero(items == iid)[0]
+        U = np.stack([uf[int(users[j])] for j in rows])
+        rr = r[rows].astype(np.float64)
+        A = U.T @ U + lam * len(rows) * np.eye(m.rank)
+        b = U.T @ rr
+        np.testing.assert_allclose(A @ vf[iid], b, atol=5e-3)
+
+
+def test_implicit_preference_ordering(mesh8):
+    # two user groups each consuming a disjoint item set: implicit ALS
+    # must score in-group items above out-group items
+    rng = np.random.default_rng(4)
+    users, items, counts = [], [], []
+    for u in range(40):
+        group = u % 2
+        for _ in range(15):
+            it = rng.integers(0, 20) + 20 * group
+            users.append(u)
+            items.append(it)
+            counts.append(float(rng.integers(1, 5)))
+    f = Frame({
+        "user": np.array(users), "item": np.array(items),
+        "rating": np.array(counts, np.float32),
+    })
+    m = ALS(
+        rank=4, maxIter=10, regParam=0.05, implicitPrefs=True, alpha=10.0,
+        seed=0,
+    ).fit(f)
+    rec = m.recommendForAllUsers(5)
+    ids = np.asarray(rec["id"])
+    recs = np.asarray(rec["recommendations"])
+    for row, uid in enumerate(ids):
+        group = int(uid) % 2
+        in_group = ((recs[row] >= 20 * group) & (recs[row] < 20 * (group + 1)))
+        assert in_group.mean() >= 0.8, (uid, recs[row])
+
+
+def test_cold_start(fitted):
+    m = fitted[0]
+    f = Frame({"user": np.array([3, 99999]), "item": np.array([1, 1])})
+    out_nan = m.transform(f)
+    assert np.isnan(out_nan["prediction"][1])
+    m2 = m.copy({"coldStartStrategy": "drop"})
+    out_drop = m2.transform(f)
+    assert out_drop.num_rows == 1
+
+
+def test_recommend_consistency(fitted):
+    m = fitted[0]
+    rec = m.recommendForAllUsers(3)
+    uid = int(np.asarray(rec["id"])[0])
+    top_items = np.asarray(rec["recommendations"])[0]
+    top_scores = np.asarray(rec["ratings"])[0]
+    # scores descending and equal to transform() on the same pairs
+    assert (np.diff(top_scores) <= 1e-6).all()
+    f = Frame({
+        "user": np.full(3, uid), "item": top_items.astype(np.int64),
+    })
+    pred = m.transform(f)["prediction"]
+    np.testing.assert_allclose(pred, top_scores, atol=1e-5)
+    # item-side API shape
+    rec_i = m.recommendForAllItems(2)
+    assert rec_i["recommendations"].shape == (len(m.itemIds), 2)
+
+
+def test_validation_and_save_load(fitted, tmp_path):
+    m = fitted[0]
+    with pytest.raises(ValueError, match="non-negative"):
+        ALS(implicitPrefs=True).fit(Frame({
+            "user": np.array([0]), "item": np.array([0]),
+            "rating": np.array([-1.0], np.float32),
+        }))
+    save_model(m, str(tmp_path / "als"))
+    m2 = load_model(str(tmp_path / "als"))
+    np.testing.assert_allclose(
+        np.asarray(m2.userFactors["features"]),
+        np.asarray(m.userFactors["features"]),
+    )
+    f = Frame({"user": np.array([3, 13]), "item": np.array([1, 8])})
+    np.testing.assert_allclose(
+        m2.transform(f)["prediction"], m.transform(f)["prediction"]
+    )

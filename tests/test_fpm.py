@@ -1,0 +1,142 @@
+"""FPGrowth: brute-force itemset enumeration oracle (itertools over the
+small universe — exact), rule metrics recomputed by hand, transform
+semantics, save/load."""
+
+from itertools import combinations
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.models import FPGrowth
+from sntc_tpu.mlio.save_load import load_model, save_model
+
+BASKETS = [
+    ["a", "b", "c"],
+    ["a", "b"],
+    ["a", "c"],
+    ["a", "b", "c", "d"],
+    ["b", "c"],
+    ["a", "d"],
+    ["c", "d"],
+    ["a", "b", "c"],
+]
+
+
+def _ragged_frame():
+    col = np.empty(len(BASKETS), dtype=object)
+    for i, b in enumerate(BASKETS):
+        col[i] = b
+    return Frame({"items": col})
+
+
+def _brute_force(min_support):
+    universe = sorted({x for b in BASKETS for x in b})
+    n = len(BASKETS)
+    out = {}
+    for k in range(1, len(universe) + 1):
+        for combo in combinations(universe, k):
+            freq = sum(1 for b in BASKETS if set(combo) <= set(b))
+            if freq >= min_support * n:
+                out[combo] = freq
+    return out
+
+
+@pytest.mark.parametrize("min_support", [0.25, 0.4, 0.6])
+def test_itemsets_match_bruteforce(min_support):
+    m = FPGrowth(minSupport=min_support).fit(_ragged_frame())
+    fi = m.freqItemsets
+    ours = {
+        tuple(sorted(items)): int(freq)
+        for items, freq in zip(fi["items"], fi["freq"])
+    }
+    assert ours == _brute_force(min_support)
+
+
+def test_association_rules_metrics():
+    m = FPGrowth(minSupport=0.25, minConfidence=0.6).fit(_ragged_frame())
+    rules = m.associationRules
+    n = len(BASKETS)
+    freq = _brute_force(0.0)
+    seen = 0
+    for a, c, conf, lift, sup in zip(
+        rules["antecedent"], rules["consequent"], rules["confidence"],
+        rules["lift"], rules["support"],
+    ):
+        whole = tuple(sorted(list(a) + list(c)))
+        fa = freq[tuple(sorted(a))]
+        fc = freq[tuple(c)]
+        assert conf == pytest.approx(freq[whole] / fa)
+        assert lift == pytest.approx(conf / (fc / n))
+        assert sup == pytest.approx(freq[whole] / n)
+        assert conf >= 0.6
+        seen += 1
+    assert seen > 0
+    # every qualifying rule is present: check one known rule by hand
+    # {b} -> c: freq(bc)=4, freq(b)=5, conf 0.8
+    pairs = {
+        (tuple(a), c[0])
+        for a, c in zip(rules["antecedent"], rules["consequent"])
+    }
+    assert (("b",), "c") in pairs
+
+
+def test_transform_predicts_consequents():
+    m = FPGrowth(minSupport=0.25, minConfidence=0.6).fit(_ragged_frame())
+    out = m.transform(
+        Frame({"items": np.array([["b"], ["a", "b", "c", "d"]], dtype=object)})
+    )
+    pred = out["prediction"]
+    assert "c" in pred[0]  # {b} -> c holds at conf 0.8
+    assert "b" not in pred[0]  # never predict an item already present
+    assert pred[1] == []  # basket already holds everything
+
+
+def test_duplicate_items_rejected():
+    col = np.empty(1, dtype=object)
+    col[0] = ["a", "a", "b"]
+    with pytest.raises(ValueError, match="duplicate"):
+        FPGrowth().fit(Frame({"items": col}))
+
+
+def test_integer_items_roundtrip(tmp_path):
+    """Itemset members must keep their types through save/load — int 1
+    and str '1' are different items."""
+    col = np.empty(4, dtype=object)
+    for i, b in enumerate([[1, 2], [1, 2], [2, 3], [1]]):
+        col[i] = b
+    f = Frame({"items": col})
+    m = FPGrowth(minSupport=0.4, minConfidence=0.5).fit(f)
+    save_model(m, str(tmp_path / "fpint"))
+    m2 = load_model(str(tmp_path / "fpint"))
+    fi = m2.freqItemsets
+    assert all(
+        isinstance(x, int) for items in fi["items"] for x in items
+    )
+    out = m2.transform(f)
+    assert 2 in out["prediction"][3]  # {1} -> 2 at conf 2/3
+
+
+def test_rules_cache_tracks_min_confidence():
+    m = FPGrowth(minSupport=0.25, minConfidence=0.9).fit(_ragged_frame())
+    strict = m.associationRules.num_rows
+    m2 = m.copy({"minConfidence": 0.3})
+    assert m2.associationRules.num_rows > strict
+    # the original is untouched but must also refresh if its own param
+    # changes (the cache keys on the confidence it was built at)
+    m.setParams(minConfidence=0.3)
+    assert m.associationRules.num_rows == m2.associationRules.num_rows
+
+
+def test_save_load(tmp_path):
+    m = FPGrowth(minSupport=0.25, minConfidence=0.6).fit(_ragged_frame())
+    save_model(m, str(tmp_path / "fp"))
+    m2 = load_model(str(tmp_path / "fp"))
+    fi1 = m.freqItemsets
+    fi2 = m2.freqItemsets
+    assert [list(v) for v in fi1["items"]] == [list(v) for v in fi2["items"]]
+    np.testing.assert_array_equal(fi1["freq"], fi2["freq"])
+    f = Frame({"items": np.array([["b"]], dtype=object)})
+    assert list(m2.transform(f)["prediction"][0]) == list(
+        m.transform(f)["prediction"][0]
+    )

@@ -1,0 +1,111 @@
+"""LDA: planted-topic recovery (the generative model's own oracle),
+topic-distribution inference, perplexity ordering vs a mismatched model,
+describeTopics shapes, save/load."""
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.models import LDA
+from sntc_tpu.mlio.save_load import load_model, save_model
+
+V = 30  # vocabulary
+K = 3
+
+
+def _planted_corpus(n_docs=300, doc_len=80, seed=0):
+    """Three disjoint-support topics: recovery is unambiguous."""
+    rng = np.random.default_rng(seed)
+    beta = np.zeros((K, V))
+    for t in range(K):
+        beta[t, t * 10:(t + 1) * 10] = 1.0 / 10
+    X = np.zeros((n_docs, V), np.float32)
+    dominant = np.zeros(n_docs, np.int64)
+    for d in range(n_docs):
+        theta = rng.dirichlet([0.2] * K)
+        dominant[d] = theta.argmax()
+        words = rng.choice(V, size=doc_len, p=theta @ beta)
+        X[d] = np.bincount(words, minlength=V)
+    return X, beta, dominant
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    return _planted_corpus()
+
+
+@pytest.fixture(scope="module")
+def fitted(corpus):
+    X, _, _ = corpus
+    return LDA(
+        k=K, maxIter=60, subsamplingRate=0.2, seed=1,
+    ).fit(Frame({"features": X}))
+
+
+def test_recovers_planted_topics(corpus, fitted):
+    _, beta, _ = corpus
+    topics = fitted.topicsMatrix().T  # [k, V]
+    # match each true topic to its best learned topic: the 10-word
+    # support must carry most of the mass
+    used = set()
+    for t in range(K):
+        support = beta[t] > 0
+        mass = topics[:, support].sum(axis=1)
+        best = int(np.argmax(mass))
+        assert mass[best] > 0.85
+        used.add(best)
+    assert len(used) == K  # distinct learned topic per true topic
+
+
+def test_topic_distribution_follows_dominant_topic(corpus, fitted):
+    X, beta, dominant = corpus
+    out = fitted.transform(Frame({"features": X}))
+    theta = out["topicDistribution"]
+    assert theta.shape == (len(X), K)
+    np.testing.assert_allclose(theta.sum(axis=1), 1.0, atol=1e-6)
+    # learned topic index for each true topic
+    topics = fitted.topicsMatrix().T
+    t_map = [
+        int(np.argmax(topics[:, beta[t] > 0].sum(axis=1))) for t in range(K)
+    ]
+    pred_dom = theta.argmax(axis=1)
+    agree = (pred_dom == np.array(t_map)[dominant]).mean()
+    assert agree > 0.8
+
+
+def test_perplexity_beats_mismatched_model(corpus, fitted):
+    X, _, _ = corpus
+    f = Frame({"features": X})
+    good = fitted.logPerplexity(f)
+    bad = LDA(k=K, maxIter=1, subsamplingRate=0.05, seed=9).fit(f)
+    assert good < bad.logPerplexity(f)
+    assert fitted.logLikelihood(f) < 0
+
+
+def test_describe_topics(fitted):
+    d = fitted.describeTopics(5)
+    assert d["termIndices"].shape == (K, 5)
+    assert d["termWeights"].shape == (K, 5)
+    # weights sorted descending within each topic
+    w = d["termWeights"]
+    assert (np.diff(w, axis=1) <= 1e-12).all()
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="non-negative"):
+        LDA(k=2).fit(
+            Frame({"features": -np.ones((4, 5), np.float32)})
+        )
+
+
+def test_save_load(corpus, fitted, tmp_path):
+    X, _, _ = corpus
+    save_model(fitted, str(tmp_path / "lda"))
+    m2 = load_model(str(tmp_path / "lda"))
+    np.testing.assert_allclose(m2.lam, fitted.lam)
+    f = Frame({"features": X[:20]})
+    np.testing.assert_allclose(
+        m2.transform(f)["topicDistribution"],
+        fitted.transform(f)["topicDistribution"],
+        atol=1e-6,
+    )

@@ -1,0 +1,93 @@
+"""Word2Vec: co-occurrence-structure recovery (words that share contexts
+embed closer than words that never do), exact transform averaging,
+vocabulary/minCount semantics, save/load."""
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.feature import Word2Vec
+
+
+ANIMALS = ["cat", "dog", "horse", "sheep"]
+TECH = ["cpu", "gpu", "ram", "disk"]
+
+
+def _corpus(n=300, seed=0):
+    """Sentences draw exclusively from one topic: animal words only ever
+    co-occur with animal words."""
+    rng = np.random.default_rng(seed)
+    docs = []
+    for _ in range(n):
+        pool = ANIMALS if rng.random() < 0.5 else TECH
+        docs.append(list(rng.choice(pool, size=6)))
+    col = np.empty(len(docs), dtype=object)
+    for i, d in enumerate(docs):
+        col[i] = d
+    return Frame({"tokens": col})
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    return Word2Vec(
+        vectorSize=16, windowSize=3, minCount=1, maxIter=50, seed=3,
+        stepSize=0.2,
+    ).fit(_corpus())
+
+
+def test_synonyms_respect_cooccurrence(fitted):
+    syn = fitted.findSynonyms("cat", 3)
+    top = list(syn["word"])
+    assert set(top) <= set(ANIMALS) - {"cat"}, top
+    # and across-topic similarity is lower than within-topic
+    syn_all = fitted.findSynonyms("cat", 7)
+    sims = dict(zip(syn_all["word"], syn_all["similarity"]))
+    worst_animal = min(sims[w] for w in ANIMALS if w != "cat")
+    best_tech = max(sims[w] for w in TECH)
+    assert worst_animal > best_tech
+
+
+def test_get_vectors_and_vocab(fitted):
+    v = fitted.getVectors()
+    assert set(v["word"]) == set(ANIMALS + TECH)
+    assert v["vector"].shape == (8, 16)
+
+
+def test_transform_is_mean_of_vectors(fitted):
+    col = np.empty(2, dtype=object)
+    col[0] = ["cat", "dog"]
+    col[1] = ["unknownword"]
+    out = fitted.transform(Frame({"tokens": col}))["wordVectors"]
+    vecs = {w: x for w, x in zip(
+        fitted.getVectors()["word"], fitted.getVectors()["vector"]
+    )}
+    np.testing.assert_allclose(
+        out[0], (vecs["cat"] + vecs["dog"]) / 2.0, atol=1e-6
+    )
+    np.testing.assert_array_equal(out[1], np.zeros(16, np.float32))
+
+
+def test_min_count_and_errors():
+    col = np.empty(2, dtype=object)
+    col[0] = ["rare", "word", "other"]
+    col[1] = ["word", "other", "another"]
+    f = Frame({"tokens": col})
+    m = Word2Vec(vectorSize=4, minCount=2, maxIter=1, seed=0).fit(f)
+    assert set(m.vocabulary) == {"word", "other"}
+    assert "rare" not in m.vocabulary
+    with pytest.raises(ValueError, match="empty vocabulary"):
+        Word2Vec(minCount=10).fit(f)
+    with pytest.raises(KeyError):
+        m.findSynonyms("rare", 1)
+
+
+def test_save_load(fitted, tmp_path):
+    from sntc_tpu.mlio.save_load import load_model, save_model
+
+    save_model(fitted, str(tmp_path / "w2v"))
+    m2 = load_model(str(tmp_path / "w2v"))
+    assert m2.vocabulary == fitted.vocabulary
+    np.testing.assert_allclose(m2.vectors, fitted.vectors)
+    syn1 = fitted.findSynonyms("dog", 2)
+    syn2 = m2.findSynonyms("dog", 2)
+    assert list(syn1["word"]) == list(syn2["word"])
