@@ -25,6 +25,14 @@ from sntc_tpu.feature.discretizers import (
 )
 from sntc_tpu.feature.expansion import Interaction, PolynomialExpansion
 from sntc_tpu.feature.word2vec import Word2Vec, Word2VecModel
+from sntc_tpu.feature.hashing import FeatureHasher
+from sntc_tpu.feature.vector_indexer import (
+    VectorIndexer,
+    VectorIndexerModel,
+    VectorSizeHint,
+)
+from sntc_tpu.feature.dct import DCT
+from sntc_tpu.feature.rformula import RFormula, RFormulaModel
 from sntc_tpu.feature.text import (
     CountVectorizer,
     CountVectorizerModel,
@@ -50,6 +58,13 @@ from sntc_tpu.feature.encoders import (
 )
 
 __all__ = [
+    "FeatureHasher",
+    "VectorIndexer",
+    "VectorIndexerModel",
+    "VectorSizeHint",
+    "DCT",
+    "RFormula",
+    "RFormulaModel",
     "BucketedRandomProjectionLSH",
     "BucketedRandomProjectionLSHModel",
     "CountVectorizer",

@@ -1,0 +1,70 @@
+"""FeatureHasher — the hashing trick over mixed-type columns.
+
+Behavioral spec: upstream ``ml/feature/FeatureHasher.scala`` [U]:
+project any set of numeric / string / boolean columns into a
+``numFeatures`` vector with murmur3(seed 42):
+
+  * numeric column: bucket = hash(colName), value added as-is;
+  * categorical (string, boolean, or listed in ``categoricalCols``):
+    bucket = hash("colName=value"), adds 1.0;
+
+colliding buckets accumulate.  Shares the exact Spark hash/bucket path
+with :class:`~sntc_tpu.feature.text.HashingTF`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sntc_tpu.core.base import Transformer
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+from sntc_tpu.feature.text import _spark_bucket
+
+
+class FeatureHasher(Transformer):
+    inputCols = Param("columns to hash", default=())
+    outputCol = Param("output vector column", default="features")
+    numFeatures = Param("vector width", default=1 << 18,
+                        validator=validators.gt(0))
+    categoricalCols = Param(
+        "numeric columns to force categorical treatment", default=(),
+    )
+
+    def transform(self, frame: Frame) -> Frame:
+        cols = list(self.getInputCols())
+        if not cols:
+            raise ValueError("inputCols must be set")
+        nf = int(self.getNumFeatures())
+        forced = set(self.getCategoricalCols())
+        n = frame.num_rows
+        if nf * max(n, 1) > 1 << 30:
+            raise ValueError(
+                f"dense output would hold {nf}×{n} floats; lower "
+                "numFeatures (this frame has no sparse vectors)"
+            )
+        out = np.zeros((n, nf), np.float32)
+        for c in cols:
+            col = frame[c]
+            numeric = (
+                np.issubdtype(col.dtype, np.number)
+                and not np.issubdtype(col.dtype, np.bool_)
+                and c not in forced
+            )
+            if numeric:
+                j = _spark_bucket(c, nf)
+                out[:, j] += np.asarray(col, np.float32)
+            else:
+                cache: dict = {}
+                for r, v in enumerate(col):
+                    if isinstance(v, (bool, np.bool_)):
+                        # Scala Boolean.toString is lowercase — Python's
+                        # str(True) would hash a different bucket
+                        key = f"{c}={'true' if v else 'false'}"
+                    else:
+                        key = f"{c}={v}"
+                    j = cache.get(key)
+                    if j is None:
+                        j = cache[key] = _spark_bucket(key, nf)
+                    out[r, j] += 1.0
+        return frame.with_column(self.getOutputCol(), out)

@@ -1,0 +1,156 @@
+"""VectorIndexer + VectorSizeHint.
+
+Behavioral spec: upstream ``ml/feature/{VectorIndexer,VectorSizeHint}
+.scala`` [U]:
+
+  * VectorIndexer: fit scans a vector column and declares every feature
+    with ≤ ``maxCategories`` distinct values CATEGORICAL, re-indexing its
+    values to ``0..k−1`` in ascending value order; other features pass
+    through.  ``handleInvalid`` error | skip | keep (keep maps unseen
+    values to index k).  The fitted ``categoryMaps`` feed tree
+    estimators' categorical metadata.
+  * VectorSizeHint: stateless width check/annotation — error | skip |
+    optimistic on rows whose vector width disagrees.
+
+Host-side fit (distinct-value scan = Spark's aggregate over executors);
+the transform's per-feature LUT is a vectorized searchsorted.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from sntc_tpu.core.base import Estimator, Model, Transformer
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+
+
+class _ViParams:
+    inputCol = Param("input vector column", default="features")
+    outputCol = Param("output vector column", default="indexed")
+    maxCategories = Param(
+        "features with <= this many distinct values become categorical",
+        default=20, validator=validators.gt(1),
+    )
+    handleInvalid = Param(
+        "error | skip | keep for unseen categorical values", default="error",
+        validator=validators.one_of("error", "skip", "keep"),
+    )
+
+
+class VectorIndexer(_ViParams, Estimator):
+    def _fit(self, frame: Frame) -> "VectorIndexerModel":
+        X = frame[self.getInputCol()]
+        if X.ndim != 2:
+            raise ValueError("inputCol must be a vector column")
+        X = np.asarray(X)
+        max_cat = int(self.getMaxCategories())
+        maps: Dict[int, np.ndarray] = {}
+        for j in range(X.shape[1]):
+            vals = np.unique(X[:, j]).astype(np.float64)
+            if len(vals) <= max_cat:
+                # Spark maps value 0.0 to index 0 when present (sparsity
+                # preservation — its scaladoc example {-1.0, 0.0} →
+                # {0.0: 0, -1.0: 1}); remaining values keep ascending
+                # order
+                if 0.0 in vals:
+                    vals = np.concatenate(([0.0], vals[vals != 0.0]))
+                maps[j] = vals
+        model = VectorIndexerModel(
+            numFeatures=X.shape[1], categoryMaps=maps
+        )
+        model.setParams(**self.paramValues())
+        return model
+
+
+class VectorIndexerModel(_ViParams, Model):
+    def __init__(self, numFeatures: int, categoryMaps: Dict[int, np.ndarray],
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.numFeatures = int(numFeatures)
+        self.categoryMaps = {
+            int(j): np.asarray(v, np.float64) for j, v in categoryMaps.items()
+        }
+
+    def _save_extra(self):
+        return (
+            {"numFeatures": self.numFeatures,
+             "catKeys": sorted(self.categoryMaps)},
+            {f"cat_{j}": v for j, v in self.categoryMaps.items()},
+        )
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        maps = {int(j): arrays[f"cat_{j}"] for j in extra["catKeys"]}
+        m = cls(numFeatures=int(extra["numFeatures"]), categoryMaps=maps)
+        m.setParams(**params)
+        return m
+
+    def transform(self, frame: Frame) -> Frame:
+        X = np.asarray(frame[self.getInputCol()], np.float64)
+        if X.shape[1] != self.numFeatures:
+            raise ValueError(
+                f"expected {self.numFeatures} features, got {X.shape[1]}"
+            )
+        mode = self.getHandleInvalid()
+        out = X.copy()
+        bad_rows = np.zeros(len(X), bool)
+        for j, vals in self.categoryMaps.items():
+            # vals need not be ascending (0.0 is pinned to index 0):
+            # search a sorted view, then permute back to category ids
+            order = np.argsort(vals, kind="stable")
+            sorted_vals = vals[order]
+            pos = np.searchsorted(sorted_vals, X[:, j])
+            pos_c = np.clip(pos, 0, len(vals) - 1)
+            known = sorted_vals[pos_c] == X[:, j]
+            out[:, j] = order[pos_c]
+            if not known.all():
+                if mode == "error":
+                    raise ValueError(
+                        f"unseen categorical value in feature {j} "
+                        "(handleInvalid='error')"
+                    )
+                if mode == "keep":
+                    # Spark: unseen -> extra bucket k
+                    out[~known, j] = len(vals)
+                else:
+                    bad_rows |= ~known
+        g = frame.with_column(
+            self.getOutputCol(), out.astype(np.float32)
+        )
+        if mode == "skip" and bad_rows.any():
+            g = g.filter(~bad_rows)
+        return g
+
+
+class VectorSizeHint(Transformer):
+    """Stateless vector-width contract [U]: error (raise) | skip (drop
+    bad rows) | optimistic (trust and pass through)."""
+
+    inputCol = Param("vector column to check", default="features")
+    size = Param("required width", default=None)
+    handleInvalid = Param(
+        "error | skip | optimistic", default="error",
+        validator=validators.one_of("error", "skip", "optimistic"),
+    )
+
+    def transform(self, frame: Frame) -> Frame:
+        size = self.getSize()
+        if size is None:
+            raise ValueError("size must be set")
+        mode = self.getHandleInvalid()
+        if mode == "optimistic":
+            return frame
+        X = frame[self.getInputCol()]
+        width = X.shape[1] if X.ndim == 2 else 1
+        if width == int(size):
+            return frame
+        if mode == "error":
+            raise ValueError(
+                f"column {self.getInputCol()!r} has width {width}, "
+                f"required {int(size)}"
+            )
+        # fixed-width columns disagree as a whole — skip drops everything
+        return frame.slice(0, 0)

@@ -1,0 +1,172 @@
+"""FeatureHasher / VectorIndexer / VectorSizeHint / DCT / RFormula:
+scipy + hand-computed oracles."""
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame, object_column
+from sntc_tpu.feature import (
+    DCT,
+    FeatureHasher,
+    RFormula,
+    VectorIndexer,
+    VectorSizeHint,
+)
+from sntc_tpu.feature.text import _spark_bucket
+from sntc_tpu.mlio.save_load import load_model, save_model
+
+
+def test_feature_hasher_buckets():
+    f = Frame({
+        "pkts": np.array([3.0, 5.0]),
+        "proto": object_column(["tcp", "udp"]),
+        "port": np.array([80, 80]),
+    })
+    h = FeatureHasher(
+        inputCols=("pkts", "proto", "port"), numFeatures=64,
+        categoricalCols=("port",),
+    )
+    out = h.transform(f)["features"]
+    assert out.shape == (2, 64)
+    # numeric: value lands at hash(colName)
+    assert out[0, _spark_bucket("pkts", 64)] == 3.0
+    assert out[1, _spark_bucket("pkts", 64)] == 5.0
+    # categorical: 1.0 at hash("col=value")
+    assert out[0, _spark_bucket("proto=tcp", 64)] == 1.0
+    assert out[1, _spark_bucket("proto=udp", 64)] == 1.0
+    # forced-categorical numeric column
+    assert out[0, _spark_bucket("port=80", 64)] == 1.0
+    with pytest.raises(ValueError, match="inputCols"):
+        FeatureHasher(numFeatures=8).transform(f)
+    # boolean columns hash the Scala lowercase rendering
+    fb = Frame({"flag": np.array([True, False])})
+    ob = FeatureHasher(inputCols=("flag",), numFeatures=64).transform(fb)
+    assert ob["features"][0, _spark_bucket("flag=true", 64)] == 1.0
+    assert ob["features"][1, _spark_bucket("flag=false", 64)] == 1.0
+
+
+def test_rformula_removal_validation():
+    f = Frame({"y": np.array([1.0]), "a": np.array([2.0])})
+    with pytest.raises(ValueError, match="fitIntercept"):
+        RFormula(formula="y ~ . - 1").fit(f)
+    with pytest.raises(ValueError, match="not among"):
+        RFormula(formula="y ~ a - nope").fit(f)
+
+
+def test_vector_indexer_semantics():
+    X = np.array([
+        [0.0, -1.0, 2.5],
+        [1.0, 0.0, 3.5],
+        [0.0, 1.0, 4.5],
+        [1.0, 0.0, 5.5],
+    ], np.float32)
+    f = Frame({"features": X})
+    m = VectorIndexer(maxCategories=3).fit(f)
+    # features 0 (2 values) and 1 (3 values) are categorical; 2 is not
+    assert set(m.categoryMaps) == {0, 1}
+    out = m.transform(f)["indexed"]
+    np.testing.assert_array_equal(out[:, 0], [0, 1, 0, 1])
+    # Spark pins 0.0 to index 0 (scaladoc: {-1.0, 0.0} -> {0.0: 0,
+    # -1.0: 1}); remaining values ascend: -1.0 -> 1, 1.0 -> 2
+    np.testing.assert_array_equal(out[:, 1], [1, 0, 2, 0])
+    np.testing.assert_allclose(out[:, 2], X[:, 2])  # passthrough
+    # unseen value handling
+    f_bad = Frame({"features": np.array([[2.0, 0.0, 9.9]], np.float32)})
+    with pytest.raises(ValueError, match="unseen"):
+        m.transform(f_bad)
+    m_keep = m.copy({"handleInvalid": "keep"})
+    assert m_keep.transform(f_bad)["indexed"][0, 0] == 2.0  # extra bucket
+    m_skip = m.copy({"handleInvalid": "skip"})
+    assert m_skip.transform(f_bad).num_rows == 0
+
+
+def test_vector_indexer_save_load(tmp_path):
+    X = np.array([[0.0, 7.5], [1.0, 8.5], [0.0, 9.5]], np.float32)
+    f = Frame({"features": X})
+    m = VectorIndexer(maxCategories=2).fit(f)
+    save_model(m, str(tmp_path / "vi"))
+    m2 = load_model(str(tmp_path / "vi"))
+    np.testing.assert_array_equal(
+        m2.transform(f)["indexed"], m.transform(f)["indexed"]
+    )
+
+
+def test_vector_size_hint():
+    f = Frame({"features": np.ones((3, 4), np.float32)})
+    assert VectorSizeHint(size=4).transform(f) is f
+    with pytest.raises(ValueError, match="width"):
+        VectorSizeHint(size=5).transform(f)
+    assert VectorSizeHint(
+        size=5, handleInvalid="skip"
+    ).transform(f).num_rows == 0
+    assert VectorSizeHint(
+        size=5, handleInvalid="optimistic"
+    ).transform(f) is f
+
+
+def test_dct_matches_scipy():
+    from scipy.fft import dct as scipy_dct
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(50, 17)).astype(np.float32)
+    f = Frame({"features": X})
+    out = DCT().transform(f)["dct"]
+    ref = scipy_dct(X.astype(np.float64), type=2, norm="ortho", axis=1)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+    # inverse round-trips
+    back = DCT(inputCol="dct", outputCol="back", inverse=True).transform(
+        Frame({"dct": out})
+    )["back"]
+    np.testing.assert_allclose(back, X, atol=1e-5)
+
+
+def test_rformula_numeric_and_dot():
+    f = Frame({
+        "y": np.array([1.0, 2.0, 3.0]),
+        "a": np.array([0.5, 1.5, 2.5]),
+        "b": np.array([1.0, 0.0, 1.0]),
+    })
+    m = RFormula(formula="y ~ .").fit(f)
+    out = m.transform(f)
+    np.testing.assert_allclose(
+        out["features"], np.stack([f["a"], f["b"]], axis=1)
+    )
+    np.testing.assert_allclose(out["label"], f["y"])
+    # term removal
+    m2 = RFormula(formula="y ~ . - b").fit(f)
+    assert m2.transform(f)["features"].shape == (3, 1)
+
+
+def test_rformula_string_dummies_and_interaction():
+    f = Frame({
+        "y": object_column(["pos", "neg", "pos", "pos"]),
+        "proto": object_column(["tcp", "udp", "tcp", "icmp"]),
+        "x": np.array([1.0, 2.0, 3.0, 4.0]),
+    })
+    m = RFormula(formula="y ~ proto + x + proto:x").fit(f)
+    out = m.transform(f)
+    X = out["features"]
+    # proto levels by frequency desc: tcp(2), icmp(1), udp(1) — ties by
+    # value; last level dropped -> 2 dummy cols; + x + 2 interaction cols
+    assert X.shape == (4, 5)
+    # string label indexed: pos (freq 3) -> 0, neg -> 1
+    np.testing.assert_array_equal(out["label"], [0, 1, 0, 0])
+    # interaction = dummies * x, row-wise
+    np.testing.assert_allclose(X[:, 3:], X[:, :2] * f["x"][:, None])
+    with pytest.raises(ValueError, match="unknown column"):
+        RFormula(formula="y ~ nope").fit(f)
+    with pytest.raises(ValueError, match="~"):
+        RFormula(formula="y + x").fit(f)
+
+
+def test_rformula_save_load(tmp_path):
+    f = Frame({
+        "y": np.array([1.0, 0.0, 1.0]),
+        "proto": object_column(["tcp", "udp", "tcp"]),
+    })
+    m = RFormula(formula="y ~ proto").fit(f)
+    save_model(m, str(tmp_path / "rf"))
+    m2 = load_model(str(tmp_path / "rf"))
+    np.testing.assert_allclose(
+        m2.transform(f)["features"], m.transform(f)["features"]
+    )
