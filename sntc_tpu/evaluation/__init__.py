@@ -5,8 +5,14 @@ from sntc_tpu.evaluation.multiclass import (
 from sntc_tpu.evaluation.binary import BinaryClassificationEvaluator
 from sntc_tpu.evaluation.regression import RegressionEvaluator
 from sntc_tpu.evaluation.clustering import ClusteringEvaluator
+from sntc_tpu.evaluation.ranking import (
+    MultilabelClassificationEvaluator,
+    RankingEvaluator,
+)
 
 __all__ = [
+    "RankingEvaluator",
+    "MultilabelClassificationEvaluator",
     "MulticlassClassificationEvaluator",
     "MulticlassMetrics",
     "BinaryClassificationEvaluator",

@@ -25,7 +25,11 @@ from sntc_tpu.feature.text import _spark_bucket
 class FeatureHasher(Transformer):
     inputCols = Param("columns to hash", default=())
     outputCol = Param("output vector column", default="features")
-    numFeatures = Param("vector width", default=1 << 18,
+    #: documented delta: Spark defaults to 2^18 assuming SPARSE vectors;
+    #: this frame is dense-columnar, where 2^18 × rows is unusable past a
+    #: few thousand rows — the default is 4096 (hash buckets still match
+    #: Spark exactly at any matching width)
+    numFeatures = Param("vector width", default=4096,
                         validator=validators.gt(0))
     categoricalCols = Param(
         "numeric columns to force categorical treatment", default=(),

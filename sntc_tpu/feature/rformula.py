@@ -27,7 +27,7 @@ from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param
 
 
-def _parse(formula: str, columns: List[str], label_hint: str):
+def _parse(formula: str, columns: List[str]):
     if "~" not in formula:
         raise ValueError("formula must contain '~' (label ~ terms)")
     lhs, rhs = (s.strip() for s in formula.split("~", 1))
@@ -41,7 +41,7 @@ def _parse(formula: str, columns: List[str], label_hint: str):
             removed.append(t[1:].strip())
         elif t == ".":
             terms.extend(c for c in columns if c != lhs and c not in terms)
-        else:
+        elif t not in terms:  # Spark's RFormulaParser dedups (.distinct)
             terms.append(t)
     for r in removed:
         if r == "1":
@@ -80,9 +80,7 @@ class RFormula(_RfParams, Estimator):
     def _fit(self, frame: Frame) -> "RFormulaModel":
         if not self.getFormula():
             raise ValueError("formula must be set")
-        label, terms = _parse(
-            self.getFormula(), frame.columns, self.getLabelCol()
-        )
+        label, terms = _parse(self.getFormula(), frame.columns)
         # per-column encodings: numeric passthrough, string -> ordered
         # category list — REUSING StringIndexer's frequencyDesc ordering
         # (one label-ordering contract in the codebase, not two)

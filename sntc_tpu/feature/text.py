@@ -11,8 +11,10 @@ StopWordsRemover,NGram,HashingTF,CountVectorizer,IDF}.scala`` [U]:
     (default English list).
   * NGram: sliding windows of ``n`` tokens joined by single spaces.
   * HashingTF: term-frequency vectors by murmur3_32(seed=42) of the
-    term's UTF-8 bytes, ``nonNegativeMod`` into ``numFeatures``
-    (2^18 default) — EXACT Spark bucket parity; optional ``binary``.
+    term's UTF-8 bytes, ``nonNegativeMod`` into ``numFeatures`` — EXACT
+    Spark bucket parity at any width (default 4096 here vs Spark's
+    sparse-vector 2^18; documented delta on the Param); optional
+    ``binary``.
   * CountVectorizer: vocabulary by corpus term frequency (``vocabSize``,
     ``minDF``/``maxDF`` document-frequency bounds, ``minTF`` per-doc
     filter, ``binary``); ties broken by term (deterministic).
@@ -203,7 +205,10 @@ class HashingTF(Transformer):
 
     inputCol = Param("input token column", default="tokens")
     outputCol = Param("output vector column", default="rawFeatures")
-    numFeatures = Param("vector width", default=1 << 18,
+    #: documented delta: Spark defaults to 2^18 assuming SPARSE vectors;
+    #: dense-columnar frames want a smaller width (buckets still match
+    #: Spark exactly at any matching numFeatures)
+    numFeatures = Param("vector width", default=4096,
                         validator=validators.gt(0))
     binary = Param("presence (1.0) instead of counts", default=False,
                    validator=validators.is_bool())

@@ -1,0 +1,174 @@
+"""RankingEvaluator / MultilabelClassificationEvaluator: hand-computed
+oracles on the classic mllib doc examples, plus an ALS integration."""
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame, object_column
+from sntc_tpu.evaluation import (
+    MultilabelClassificationEvaluator,
+    RankingEvaluator,
+)
+
+
+def _rank_frame():
+    preds = object_column([
+        [1, 6, 2, 7, 8, 3, 9, 10, 4, 5],
+        [4, 1, 5, 6, 2, 7, 3, 8, 9, 10],
+        [1, 2, 3, 4, 5],
+    ])
+    labels = object_column([
+        [1, 2, 3, 4, 5],
+        [1, 2, 3],
+        [1, 2],
+    ])
+    return Frame({"prediction": preds, "label": labels})
+
+
+def test_mean_average_precision():
+    f = _rank_frame()
+    ev = RankingEvaluator(metricName="meanAveragePrecision")
+    # query 1: hits at ranks 1,3,6,9,10 -> (1/1+2/3+3/6+4/9+5/10)/5
+    q1 = (1 + 2 / 3 + 3 / 6 + 4 / 9 + 5 / 10) / 5
+    # query 2: hits at 2,5,7 -> (1/2+2/5+3/7)/3
+    q2 = (1 / 2 + 2 / 5 + 3 / 7) / 3
+    # query 3: hits at 1,2 -> (1+1)/2
+    q3 = 1.0
+    assert ev.evaluate(f) == pytest.approx((q1 + q2 + q3) / 3)
+    assert ev.isLargerBetter()
+
+
+def test_precision_recall_at_k():
+    f = _rank_frame()
+    p3 = RankingEvaluator(metricName="precisionAtK", k=3)
+    # q1: {1,2} of first 3 -> 2/3; q2: {1} -> 1/3; q3: {1,2} -> 2/3
+    assert p3.evaluate(f) == pytest.approx((2 / 3 + 1 / 3 + 2 / 3) / 3)
+    r3 = RankingEvaluator(metricName="recallAtK", k=3)
+    assert r3.evaluate(f) == pytest.approx((2 / 5 + 1 / 3 + 2 / 2) / 3)
+
+
+def test_ndcg_and_map_at_k():
+    f = _rank_frame()
+    nd = RankingEvaluator(metricName="ndcgAtK", k=3)
+    inv = lambda i: 1.0 / np.log2(i + 2)  # noqa: E731
+    q1 = (inv(0) + inv(2)) / (inv(0) + inv(1) + inv(2))
+    q2 = inv(1) / (inv(0) + inv(1) + inv(2))
+    q3 = (inv(0) + inv(1)) / (inv(0) + inv(1))
+    assert nd.evaluate(f) == pytest.approx((q1 + q2 + q3) / 3)
+    mapk = RankingEvaluator(metricName="meanAveragePrecisionAtK", k=2)
+    # truncated at 2: q1 hit@1 -> (1/1)/2; q2 hit@2 -> (1/2)/2; q3 -> 1
+    assert mapk.evaluate(f) == pytest.approx((0.5 + 0.25 + 1.0) / 3)
+
+
+def test_ranking_evaluator_with_als():
+    from sntc_tpu.models import ALS
+
+    rng = np.random.default_rng(0)
+    users, items = [], []
+    for u in range(30):
+        group = u % 2
+        for _ in range(10):
+            users.append(u)
+            items.append(int(rng.integers(0, 10) + 10 * group))
+    f = Frame({
+        "user": np.array(users), "item": np.array(items),
+        "rating": np.ones(len(users), np.float32),
+    })
+    m = ALS(rank=4, maxIter=8, implicitPrefs=True, alpha=5.0, seed=0).fit(f)
+    rec = m.recommendForAllUsers(5)
+    truth = {u: sorted({i for uu, i in zip(users, items) if uu == u})
+             for u in range(30)}
+    eval_f = Frame({
+        "prediction": object_column(
+            [list(r) for r in rec["recommendations"]]
+        ),
+        "label": object_column(
+            [truth[int(u)] for u in rec["id"]]
+        ),
+    })
+    ndcg = RankingEvaluator(metricName="ndcgAtK", k=5).evaluate(eval_f)
+    assert ndcg > 0.8  # in-group items dominate the top of the ranking
+
+
+def test_multilabel_metrics():
+    # the classic mllib MultilabelMetrics doc example
+    preds = object_column([
+        [0.0, 1.0], [0.0, 2.0], [], [2.0], [2.0, 0.0], [0.0, 1.0, 2.0],
+        [1.0],
+    ])
+    labels = object_column([
+        [0.0, 1.0], [0.0, 2.0], [0.0], [2.0], [2.0, 0.0], [0.0, 1.0],
+        [1.0, 2.0],
+    ])
+    f = Frame({"prediction": preds, "label": labels})
+    ev = lambda name: MultilabelClassificationEvaluator(  # noqa: E731
+        metricName=name
+    ).evaluate(f)
+    assert ev("subsetAccuracy") == pytest.approx(4 / 7)
+    assert ev("accuracy") == pytest.approx(
+        (1 + 1 + 0 + 1 + 1 + 2 / 3 + 1 / 2) / 7
+    )
+    assert ev("hammingLoss") == pytest.approx((0 + 0 + 1 + 0 + 0 + 1 + 1) / 21)
+    assert ev("precision") == pytest.approx(
+        (1 + 1 + 0 + 1 + 1 + 2 / 3 + 1) / 7
+    )
+    assert ev("recall") == pytest.approx((1 + 1 + 0 + 1 + 1 + 1 + 0.5) / 7)
+    tp = 2 + 2 + 0 + 1 + 2 + 2 + 1  # per-doc intersections
+    fp = 0 + 0 + 0 + 0 + 0 + 1 + 0
+    fn = 0 + 0 + 1 + 0 + 0 + 0 + 1
+    assert ev("microPrecision") == pytest.approx(tp / (tp + fp))
+    assert ev("microRecall") == pytest.approx(tp / (tp + fn))
+    assert ev("microF1Measure") == pytest.approx(
+        2 * tp / (2 * tp + fp + fn)
+    )
+    assert not MultilabelClassificationEvaluator(
+        metricName="hammingLoss"
+    ).isLargerBetter()
+
+
+def test_text_pipeline_end_to_end_persisted(tmp_path):
+    """The full text stack inside a Pipeline object, fitted, persisted,
+    reloaded, and re-scored — the composition story for every new
+    stage."""
+    from sntc_tpu.core.base import Pipeline
+    from sntc_tpu.feature import (
+        CountVectorizer, IDF, StopWordsRemover, Tokenizer,
+    )
+    from sntc_tpu.models import NaiveBayes
+    from sntc_tpu.mlio.save_load import load_model, save_model
+
+    rng = np.random.default_rng(1)
+    attack = ["syn flood attack burst", "scan probe attack vector",
+              "flood probe syn storm"]
+    benign = ["normal web get request", "benign web browse page",
+              "normal page get fetch"]
+    texts, ys = [], []
+    for _ in range(120):
+        a = rng.random() < 0.5
+        texts.append((attack if a else benign)[rng.integers(3)])
+        ys.append(1.0 if a else 0.0)
+    f = Frame({"text": object_column(texts), "label": np.array(ys)})
+    pipe = Pipeline(stages=[
+        Tokenizer(inputCol="text", outputCol="tok"),
+        StopWordsRemover(inputCol="tok", outputCol="filt"),
+        CountVectorizer(inputCol="filt", outputCol="counts"),
+        IDF(inputCol="counts", outputCol="features"),
+        NaiveBayes(),
+    ])
+    model = pipe.fit(f)
+    acc = float((model.transform(f)["prediction"] == f["label"]).mean())
+    assert acc > 0.95
+    save_model(model, str(tmp_path / "textpipe"))
+    m2 = load_model(str(tmp_path / "textpipe"))
+    np.testing.assert_array_equal(
+        m2.transform(f)["prediction"], model.transform(f)["prediction"]
+    )
+
+
+def test_evaluators_are_params_stages(tmp_path):
+    from sntc_tpu.mlio.save_load import load_model, save_model
+
+    ev = RankingEvaluator(metricName="ndcgAtK", k=7)
+    save_model(ev, str(tmp_path / "rank_ev"))
+    ev2 = load_model(str(tmp_path / "rank_ev"))
+    assert ev2.getK() == 7 and ev2.getMetricName() == "ndcgAtK"

@@ -89,11 +89,17 @@ def test_hashingtf_bucket_parity_and_counts():
 
 
 def test_hashingtf_dense_guard():
-    f = _tok_frame()
     with pytest.raises(ValueError, match="dense"):
-        HashingTF(inputCol="tokens", outputCol="tf").transform(
+        HashingTF(
+            inputCol="tokens", outputCol="tf", numFeatures=1 << 18
+        ).transform(
             Frame({"tokens": np.array([["x"]] * 10_000, dtype=object)})
         )
+    # the default width stays usable at realistic row counts
+    out = HashingTF(inputCol="tokens", outputCol="tf").transform(
+        Frame({"tokens": np.array([["x"]] * 10_000, dtype=object)})
+    )
+    assert out["tf"].shape == (10_000, 4096)
 
 
 def test_count_vectorizer_matches_sklearn(mesh8):
