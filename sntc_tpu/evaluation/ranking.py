@@ -18,11 +18,12 @@ set):
     the ideal prefix, mllib's form.
 
 MultilabelClassificationEvaluator (prediction and label both label-set
-arrays): subsetAccuracy, accuracy (Jaccard mean), hammingLoss (needs a
-label universe: the union observed across both columns),
-precision/recall/f1 (micro by document sums, the mllib defaults), plus
-``microPrecision``/``microRecall``/``microF1Measure`` over global
-true/false positive counts.
+arrays): subsetAccuracy, accuracy (Jaccard mean; documented delta: a
+perfectly-predicted empty set scores 1.0 where Spark's 0/0 is NaN),
+hammingLoss (universe = distinct values of the LABEL column, mllib's
+``numLabels``), document-averaged precision/recall/f1 (the mllib
+defaults), plus ``microPrecision``/``microRecall``/``microF1Measure``
+over global true/false positive counts.
 
 Host-side: set arithmetic over ragged id arrays — no dense kernel
 (SURVEY.md §2.4's "on host" rule).

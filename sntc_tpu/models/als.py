@@ -10,20 +10,20 @@ surface: ``userFactors``/``itemFactors`` frames, ``transform`` over
 (user, item) pairs, ``recommendForAllUsers`` / ``recommendForAllItems``.
 ``nonnegative`` (Spark's NNLS mode) is not supported — documented delta.
 
-TPU design: one half-step (all users, or all items) is fully batched —
-the per-row normal matrices ``Σ v vᵀ`` land in a ``[n, r, r]`` tensor by
-ONE ``segment_sum`` of per-rating outer products (chunked over ratings to
-bound memory) and every row solves at once under ``vmap``'d Cholesky;
-there is no per-user Python or driver loop anywhere (Spark blocks and
-shuffles; here the whole side is one XLA program).  Implicit mode adds
-the shared ``YᵀY`` Gram once per half-step (one MXU matmul) exactly as
-Hu-Koren factorizes it.  ``recommendForAll*`` is one ``U @ Vᵀ`` matmul +
-``top_k``.
+TPU design: one half-step (all users, or all items) is fully batched
+AND mesh-sharded — ratings shard over the data axis, each shard
+``segment_sum``s its per-rating outer products into ``[n, r, r]``
+partials, and ONE ``psum`` merges them (Spark's in/out-block shuffle as
+a single collective); every row then solves at once under ``vmap``'d
+Cholesky.  There is no per-user Python or driver loop anywhere.
+Implicit mode adds the shared ``YᵀY`` Gram once per half-step (one MXU
+matmul) exactly as Hu-Koren factorizes it.  ``recommendForAll*`` is one
+``U @ Vᵀ`` matmul + ``top_k``.
 """
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -32,42 +32,47 @@ import numpy as np
 from sntc_tpu.core.base import Estimator, Model
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
+from sntc_tpu.parallel.collectives import make_tree_aggregate, shard_batch
+from sntc_tpu.parallel.context import get_default_mesh
 
 _CHUNK = 250_000  # ratings per outer-product chunk (memory bound: _CHUNK·r²)
 
 
-@partial(jax.jit, static_argnames=("n_rows", "rank"))
-def _accumulate_normal(rows, factors_other, ratings, *, n_rows, rank):
-    """``A [n_rows, r, r] += Σ v vᵀ`` and ``b [n_rows, r] += Σ r·v`` for
-    one chunk of explicit ratings (segment_sum over the row index)."""
-    outer = factors_other[:, :, None] * factors_other[:, None, :]
-    A = jax.ops.segment_sum(outer, rows, num_segments=n_rows)
-    b = jax.ops.segment_sum(
-        ratings[:, None] * factors_other, rows, num_segments=n_rows
-    )
-    cnt = jax.ops.segment_sum(
-        jnp.ones_like(ratings), rows, num_segments=n_rows
-    )
-    return A, b, cnt
+@lru_cache(maxsize=None)
+def _normal_agg(mesh, n_rows, implicit):
+    """Mesh-sharded sufficient statistics for one side's solve: ratings
+    are row-sharded over the mesh, each shard ``segment_sum``s its
+    per-rating outer products into ``[n_rows, r, r]`` partials, and the
+    ``psum`` merges them — Spark's in/out-block shuffle collapsed to one
+    collective.  The replicated ``[n_rows, r, r]`` result is the
+    algorithm's inherent statistic (Spark materializes the same blocks
+    per executor).  ``wm`` is the padding mask (shard_batch replicates a
+    real rating row into the padding, so unmasked padding would
+    double-count it).
 
+    Explicit:  ``A += Σ v vᵀ``,        ``b += Σ r·v``.
+    Implicit:  ``A += Σ (c−1) v vᵀ``,  ``b += Σ c·v`` (c = 1 + α·r)."""
 
-@partial(jax.jit, static_argnames=("n_rows", "rank"))
-def _accumulate_implicit(rows, factors_other, ratings, alpha, *, n_rows, rank):
-    """Hu-Koren sufficient statistics for one chunk:
-    ``A += Σ (c−1) v vᵀ``, ``b += Σ c·v`` with c = 1 + α·r, p = 1."""
-    c1 = alpha * ratings  # c − 1
-    outer = (
-        c1[:, None, None]
-        * factors_other[:, :, None] * factors_other[:, None, :]
-    )
-    A = jax.ops.segment_sum(outer, rows, num_segments=n_rows)
-    b = jax.ops.segment_sum(
-        (1.0 + c1)[:, None] * factors_other, rows, num_segments=n_rows
-    )
-    cnt = jax.ops.segment_sum(
-        jnp.ones_like(ratings), rows, num_segments=n_rows
-    )
-    return A, b, cnt
+    def stats(rows, factors_other, ratings, alpha, wm):
+        if implicit:
+            scale = wm * (alpha * ratings)  # (c − 1), masked
+            rhs_w = wm * (1.0 + alpha * ratings)
+        else:
+            scale = wm
+            rhs_w = wm * ratings
+        outer = (
+            scale[:, None, None]
+            * factors_other[:, :, None] * factors_other[:, None, :]
+        )
+        A = jax.ops.segment_sum(outer, rows, num_segments=n_rows)
+        b = jax.ops.segment_sum(
+            rhs_w[:, None] * factors_other, rows, num_segments=n_rows
+        )
+        cnt = jax.ops.segment_sum(wm, rows, num_segments=n_rows)
+        return A, b, cnt
+
+    # alpha is a replicated scalar arg; wm is built by shard_batch
+    return make_tree_aggregate(stats, mesh, replicated_args=(3,))
 
 
 @jax.jit
@@ -105,6 +110,10 @@ class _AlsParams:
 
 
 class ALS(_AlsParams, Estimator):
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+
     def _fit(self, frame: Frame) -> "ALSModel":
         users = np.asarray(frame[self.getUserCol()]).astype(np.int64)
         items = np.asarray(frame[self.getItemCol()]).astype(np.int64)
@@ -135,25 +144,19 @@ class ALS(_AlsParams, Estimator):
             np.float32
         )
 
+        mesh = self._mesh or get_default_mesh()
+
         def half_step(rows, other_idx, other, n_rows):
             A = np.zeros((n_rows, rank, rank), np.float32)
             b = np.zeros((n_rows, rank), np.float32)
             cnt = np.zeros(n_rows, np.float32)
+            agg = _normal_agg(mesh, n_rows, implicit)
             for s in range(0, len(rows), _CHUNK):
                 sl = slice(s, s + _CHUNK)
-                fo = other[other_idx[sl]]
-                if implicit:
-                    dA, db, dc = _accumulate_implicit(
-                        jnp.asarray(rows[sl]), jnp.asarray(fo),
-                        jnp.asarray(ratings[sl]), jnp.float32(alpha),
-                        n_rows=n_rows, rank=rank,
-                    )
-                else:
-                    dA, db, dc = _accumulate_normal(
-                        jnp.asarray(rows[sl]), jnp.asarray(fo),
-                        jnp.asarray(ratings[sl]),
-                        n_rows=n_rows, rank=rank,
-                    )
+                rs, fo, rr, wm = shard_batch(
+                    mesh, rows[sl], other[other_idx[sl]], ratings[sl]
+                )
+                dA, db, dc = agg(rs, fo, rr, jnp.float32(alpha), wm)
                 A += np.asarray(dA)
                 b += np.asarray(db)
                 cnt += np.asarray(dc)

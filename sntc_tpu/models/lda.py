@@ -15,21 +15,23 @@ the recommended path and the only one whose statistics are minibatch
 matmuls (documented delta).
 
 TPU design: one E-step is a jitted ``lax.while_loop`` over the WHOLE
-minibatch at once — ``γ [B,k]``/``φ`` updates are two dense
-``[B,V]×[V,k]`` contractions per inner iteration (MXU work; Spark loops
-documents on the driver-side executor in Breeze), converging on mean
-``γ`` change < 1e-3 like mllib.  The M-step blends sufficient
+minibatch at once, MESH-SHARDED over documents — ``γ [b,k]``/``φ``
+updates are two dense shard-local ``[b,V]×[V,k]`` contractions per
+inner iteration (MXU work; Spark loops documents on executors in
+Breeze), with the global mean-``γ``-change convergence test and the
+``[k,V]`` sufficient statistic as ``psum``s.  The M-step blends
 statistics into λ with the ``(τ₀ + t)^−κ`` schedule on host (a [k,V]
 update — tiny next to the E-step).
 """
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 from scipy.special import gammaln, psi
 
 from sntc_tpu.core.base import Estimator, Model
@@ -48,37 +50,84 @@ def _dirichlet_expectation(x):
     )
 
 
-@partial(jax.jit, static_argnames=("max_iters",))
-def _e_step(counts, exp_elog_beta, alpha, key, *, max_iters):
-    """Minibatch E-step: returns ``gamma [B,k]`` and the sufficient
-    statistic ``stat [k,V]`` (to be scaled by the corpus factor)."""
-    b, v = counts.shape
-    k = exp_elog_beta.shape[0]
-    gamma0 = jax.random.gamma(key, 100.0, (b, k)) / 100.0
+@lru_cache(maxsize=None)
+def _e_step_sharded(mesh, max_iters):
+    """Minibatch E-step over MESH-SHARDED documents: γ updates are
+    shard-local `[b,V]×[V,k]` contractions; the convergence test (mean
+    |Δγ| over ALL real docs) and the `[k,V]` sufficient statistic are
+    ``psum``s — Spark's per-iteration executor loop + driver reduce as
+    one XLA program.  ``wm`` masks padding docs out of the statistic and
+    the convergence mean; γ inits are keyed by GLOBAL doc index, so the
+    same seed reproduces the same draws at any device count."""
+    axis = mesh.axis_names[0]
 
-    def body(state):
-        gamma, _, it = state
-        exp_elog_theta = jnp.exp(_dirichlet_expectation(gamma))
-        # phinorm[d, w] = Σ_k expElogθ[d,k] expElogβ[k,w]
-        phinorm = exp_elog_theta @ exp_elog_beta + 1e-100
-        new_gamma = alpha + exp_elog_theta * (
-            (counts / phinorm) @ exp_elog_beta.T
+    def local(counts, wm, exp_elog_beta, alpha, key):
+        counts = counts * wm[:, None]  # padding docs contribute nothing
+        b, v = counts.shape
+        k = exp_elog_beta.shape[0]
+        # γ init keyed by GLOBAL document index, not shard index: the
+        # same seed draws the same init at ANY device count, so
+        # inference is deterministic across environments
+        offset = jax.lax.axis_index(axis) * b
+        keys = jax.vmap(
+            lambda i: jax.random.fold_in(key, offset + i)
+        )(jnp.arange(b))
+        gamma0 = jax.vmap(
+            lambda kk: jax.random.gamma(kk, 100.0, (k,))
+        )(keys) / 100.0
+        n_docs = jax.lax.psum(wm.sum(), axis)
+
+        def body(state):
+            gamma, _, it = state
+            exp_elog_theta = jnp.exp(_dirichlet_expectation(gamma))
+            # phinorm[d, w] = Σ_k expElogθ[d,k] expElogβ[k,w]
+            phinorm = exp_elog_theta @ exp_elog_beta + 1e-100
+            new_gamma = alpha + exp_elog_theta * (
+                (counts / phinorm) @ exp_elog_beta.T
+            )
+            change = jax.lax.psum(
+                (jnp.abs(new_gamma - gamma).mean(axis=1) * wm).sum(), axis
+            ) / jnp.maximum(n_docs, 1.0)
+            return new_gamma, change, it + 1
+
+        def cond(state):
+            _, change, it = state
+            return jnp.logical_and(
+                it < max_iters, change > _MEAN_CHANGE_TOL
+            )
+
+        gamma, _, _ = jax.lax.while_loop(
+            cond, body, (gamma0, jnp.asarray(jnp.inf, jnp.float32),
+                         jnp.asarray(0, jnp.int32))
         )
-        change = jnp.abs(new_gamma - gamma).mean()
-        return new_gamma, change, it + 1
+        exp_elog_theta = jnp.exp(_dirichlet_expectation(gamma))
+        phinorm = exp_elog_theta @ exp_elog_beta + 1e-100
+        stat = jax.lax.psum(
+            exp_elog_theta.T @ (counts / phinorm), axis
+        )  # [k, V]
+        return gamma, stat * exp_elog_beta
 
-    def cond(state):
-        _, change, it = state
-        return jnp.logical_and(it < max_iters, change > _MEAN_CHANGE_TOL)
-
-    gamma, _, _ = jax.lax.while_loop(
-        cond, body, (gamma0, jnp.asarray(jnp.inf, jnp.float32),
-                     jnp.asarray(0, jnp.int32))
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(), P(), P()),
+            out_specs=(P(axis), P()),
+        )
     )
-    exp_elog_theta = jnp.exp(_dirichlet_expectation(gamma))
-    phinorm = exp_elog_theta @ exp_elog_beta + 1e-100
-    stat = exp_elog_theta.T @ (counts / phinorm)  # [k, V]
-    return gamma, stat * exp_elog_beta
+
+
+def _run_e_step(mesh, counts_np, exp_elog_beta, alpha, key, max_iters):
+    """Shard a doc batch, run the SPMD E-step, return host (γ, stat)
+    with the padding rows stripped."""
+    from sntc_tpu.parallel.collectives import shard_batch
+
+    n = counts_np.shape[0]
+    xs, wm = shard_batch(mesh, counts_np)
+    gamma, stat = _e_step_sharded(mesh, max_iters)(
+        xs, wm, jnp.asarray(exp_elog_beta, jnp.float32),
+        jnp.float32(alpha), key,
+    )
+    return np.asarray(gamma)[:n], stat
 
 
 class _LdaParams:
@@ -109,7 +158,14 @@ class _LdaParams:
 
 
 class LDA(_LdaParams, Estimator):
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+
     def _fit(self, frame: Frame) -> "LDAModel":
+        from sntc_tpu.parallel.context import get_default_mesh
+
+        mesh = self._mesh or get_default_mesh()
         X = frame[self.getFeaturesCol()]
         if X.ndim != 2:
             raise ValueError(
@@ -137,10 +193,8 @@ class LDA(_LdaParams, Estimator):
             idx = rng.choice(n_docs, size=batch, replace=False)
             elog_beta = psi(lam) - psi(lam.sum(axis=1, keepdims=True))
             key, sub = jax.random.split(key)
-            _, stat = _e_step(
-                jnp.asarray(X[idx]),
-                jnp.asarray(np.exp(elog_beta), jnp.float32),
-                jnp.float32(alpha), sub, max_iters=_MAX_E_ITERS,
+            _, stat = _run_e_step(
+                mesh, X[idx], np.exp(elog_beta), alpha, sub, _MAX_E_ITERS
             )
             rho = (tau0 + t) ** (-kappa)
             lam_hat = eta + (n_docs / batch) * np.asarray(stat, np.float64)
@@ -179,13 +233,13 @@ class LDAModel(_LdaParams, Model):
         })
 
     def _infer_gamma(self, X: np.ndarray) -> np.ndarray:
+        from sntc_tpu.parallel.context import get_default_mesh
+
         elog_beta = psi(self.lam) - psi(self.lam.sum(axis=1, keepdims=True))
-        gamma, _ = _e_step(
-            jnp.asarray(X, jnp.float32),
-            jnp.asarray(np.exp(elog_beta), jnp.float32),
-            jnp.float32(self.alpha),
-            jax.random.PRNGKey(int(self.getSeed())),
-            max_iters=_MAX_E_ITERS,
+        gamma, _ = _run_e_step(
+            get_default_mesh(), X.astype(np.float32), np.exp(elog_beta),
+            self.alpha, jax.random.PRNGKey(int(self.getSeed())),
+            _MAX_E_ITERS,
         )
         return np.asarray(gamma, np.float64)
 

@@ -8,21 +8,24 @@ degree; ``assignClusters`` returns an (id, cluster) frame.  Algorithm:
 power-iterate ``v ← D⁻¹ A v`` (L1-normalized each step, stopping on the
 acceleration criterion), then k-means the resulting 1-D embedding.
 
-TPU design: one power-iteration step is ONE jitted ``segment_sum``
-mat-vec over the device-resident COO edge list inside a
-``lax.while_loop`` (the whole iteration loop is a single XLA program —
-no per-step host hops); the final 1-D embedding is clustered by the
-sharded KMeans Lloyd program.  Mirrored edges are materialized once
-(Spark normalizes the same way in its graph construction).
+TPU design: the edge list shards over the mesh, and one power-iteration
+step is a per-shard ``segment_sum`` mat-vec completed by a ``psum`` —
+the whole iteration loop runs as a single XLA program inside
+``lax.while_loop`` with ``v`` replicated (no per-step host hops; Spark's
+per-iteration VertexRDD shuffle is one collective).  The final 1-D
+embedding is clustered by the sharded KMeans Lloyd program.  Mirrored
+edges are materialized once (Spark normalizes the same way in its graph
+construction).
 """
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from sntc_tpu.core.base import Params
 from sntc_tpu.core.frame import Frame
@@ -30,39 +33,59 @@ from sntc_tpu.core.params import Param, validators
 from sntc_tpu.models.kmeans import KMeans
 
 
-@partial(jax.jit, static_argnames=("n", "max_iter"))
-def _power_iterate(src, dst, w, v0, *, n, max_iter):
-    """The full PIC loop as one XLA program.
+@lru_cache(maxsize=None)
+def _power_iterate_sharded(mesh, n, max_iter):
+    """The full PIC loop as one XLA program over MESH-SHARDED edges.
 
     ``v ← normalize₁(D⁻¹ A v)`` with the mllib stopping rule: stop when
     the ACCELERATION ‖(v_t − v_{t-1}) − (v_{t-1} − v_{t-2})‖∞ drops
-    below 1e-5 / n [U]."""
-    deg = jax.ops.segment_sum(w, src, num_segments=n)
-    inv_deg = jnp.where(deg > 0, 1.0 / jnp.maximum(deg, 1e-30), 0.0)
-    tol = jnp.float32(1e-5 / max(n, 1))
+    below 1e-5 / n [U].  Each shard ``segment_sum``s its edge slice of
+    the mat-vec; ``psum`` completes it — the whole iteration loop stays
+    on-device with ``v`` replicated (n floats).  ``wm`` masks the
+    padding edges (shard_batch replicates a real edge into them)."""
+    axis = mesh.axis_names[0]
+    tol = 1e-5 / max(n, 1)
 
-    def step(state):
-        v, prev_delta, _, it = state
-        av = jax.ops.segment_sum(w * v[dst], src, num_segments=n)
-        nv = inv_deg * av
-        nv = nv / jnp.maximum(jnp.abs(nv).sum(), 1e-30)
-        delta = jnp.abs(nv - v).max()
-        accel = jnp.abs(delta - prev_delta)
-        return nv, delta, accel, it + 1
+    def local(src, dst, w, wm, v0):
+        wmk = w * wm
+        deg = jax.lax.psum(
+            jax.ops.segment_sum(wmk, src, num_segments=n), axis
+        )
+        inv_deg = jnp.where(deg > 0, 1.0 / jnp.maximum(deg, 1e-30), 0.0)
 
-    def cond(state):
-        _, _, accel, it = state
-        return jnp.logical_and(it < max_iter, accel > tol)
+        def step(state):
+            v, prev_delta, _, it = state
+            av = jax.lax.psum(
+                jax.ops.segment_sum(wmk * v[dst], src, num_segments=n),
+                axis,
+            )
+            nv = inv_deg * av
+            nv = nv / jnp.maximum(jnp.abs(nv).sum(), 1e-30)
+            delta = jnp.abs(nv - v).max()
+            accel = jnp.abs(delta - prev_delta)
+            return nv, delta, accel, it + 1
 
-    v0 = v0 / jnp.maximum(jnp.abs(v0).sum(), 1e-30)
-    init = (
-        v0,
-        jnp.asarray(jnp.inf, jnp.float32),
-        jnp.asarray(jnp.inf, jnp.float32),
-        jnp.asarray(0, jnp.int32),
+        def cond(state):
+            _, _, accel, it = state
+            return jnp.logical_and(it < max_iter, accel > tol)
+
+        v0 = v0 / jnp.maximum(jnp.abs(v0).sum(), 1e-30)
+        init = (
+            v0,
+            jnp.asarray(jnp.inf, jnp.float32),
+            jnp.asarray(jnp.inf, jnp.float32),
+            jnp.asarray(0, jnp.int32),
+        )
+        v, _, _, it = jax.lax.while_loop(cond, step, init)
+        return v, it
+
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
+            out_specs=(P(), P()),
+        )
     )
-    v, _, _, it = jax.lax.while_loop(cond, step, init)
-    return v, it
 
 
 class PowerIterationClustering(Params):
@@ -119,10 +142,14 @@ class PowerIterationClustering(Params):
             # the L1 normalization inside the loop
             v0 = rng.random(n).astype(np.float32)
 
-        v, _ = _power_iterate(
-            jnp.asarray(s2), jnp.asarray(d2), jnp.asarray(w2),
-            jnp.asarray(v0), n=n, max_iter=int(self.getMaxIter()),
-        )
+        from sntc_tpu.parallel.collectives import shard_batch
+        from sntc_tpu.parallel.context import get_default_mesh
+
+        mesh = self._mesh or get_default_mesh()
+        ss, dd, ww, wm = shard_batch(mesh, s2, d2, w2)
+        v, _ = _power_iterate_sharded(
+            mesh, n, int(self.getMaxIter())
+        )(ss, dd, ww, wm, jnp.asarray(v0))
         v = np.asarray(v, np.float64)
 
         km = KMeans(
