@@ -33,6 +33,7 @@ from sntc_tpu.feature.vector_indexer import (
 )
 from sntc_tpu.feature.dct import DCT
 from sntc_tpu.feature.rformula import RFormula, RFormulaModel
+from sntc_tpu.feature.sql_transformer import SQLTransformer
 from sntc_tpu.feature.text import (
     CountVectorizer,
     CountVectorizerModel,
@@ -58,6 +59,7 @@ from sntc_tpu.feature.encoders import (
 )
 
 __all__ = [
+    "SQLTransformer",
     "FeatureHasher",
     "VectorIndexer",
     "VectorIndexerModel",

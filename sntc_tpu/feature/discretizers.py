@@ -142,8 +142,9 @@ class _ImputerParams:
     inputCols = Param("input scalar columns", default=None)
     outputCols = Param("output columns (same length)", default=None)
     strategy = Param(
-        "mean | median", default="mean",
-        validator=validators.one_of("mean", "median"),
+        "mean | median | mode (Spark 3.1; mode ties -> smallest value)",
+        default="mean",
+        validator=validators.one_of("mean", "median", "mode"),
     )
     missingValue = Param(
         "the value treated as missing (NaN compares by isnan)",
@@ -171,11 +172,14 @@ class Imputer(_ImputerParams, Estimator):
             good = v[~_missing_mask(v, mv)]
             if good.size == 0:
                 raise ValueError(f"Imputer: column {c!r} has no valid values")
-            surrogates.append(
-                float(np.mean(good))
-                if self.getStrategy() == "mean"
-                else float(np.median(good))
-            )
+            strat = self.getStrategy()
+            if strat == "mean":
+                surrogates.append(float(np.mean(good)))
+            elif strat == "median":
+                surrogates.append(float(np.median(good)))
+            else:  # mode: most frequent; ties -> smallest (Spark 3.1)
+                vals, counts = np.unique(good, return_counts=True)
+                surrogates.append(float(vals[np.argmax(counts)]))
         model = ImputerModel(surrogates=surrogates)
         model.setParams(**self.paramValues())
         return model

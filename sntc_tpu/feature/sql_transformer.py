@@ -1,0 +1,148 @@
+"""SQLTransformer — SQL-statement feature stage (restricted grammar).
+
+Behavioral spec: upstream ``ml/feature/SQLTransformer.scala`` [U]:
+``statement`` is a SQL string with the placeholder ``__THIS__`` for the
+input dataset, e.g. ``SELECT *, (v1 + v2) AS v3 FROM __THIS__ WHERE
+v1 > 2``.
+
+Documented delta: Spark hands the statement to a full Catalyst SQL
+engine; there is no SQL engine in this stack (Catalyst's role belongs
+to XLA — SURVEY.md §1 L4), so this stage supports the restricted
+grammar that covers the transformer's actual ML-pipeline uses:
+
+    SELECT <item> [, <item> ...] FROM __THIS__ [WHERE <condition>]
+
+where ``<item>`` is ``*``, a column name, or ``<expression> AS name``,
+and expressions/conditions are arithmetic/comparison/boolean
+combinations of scalar columns and literals, with the SQL spellings
+``=``, ``<>``, ``AND``/``OR``/``NOT`` rewritten to their pandas.eval
+forms before evaluation.  Column names with spaces — the CICIDS2017
+flow schema is full of them — are referenced with backticks, Spark's
+own quoting: ``SELECT (`Destination Port` * 2) AS dp2 FROM __THIS__``.  Anything the grammar or the evaluator cannot
+express (joins, aggregates, UDFs, nested selects) raises ``ValueError``
+— the statement regex only admits a single ``__THIS__`` table, and item
+expressions must evaluate.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+import numpy as np
+
+from sntc_tpu.core.base import Transformer
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param
+
+_STMT = re.compile(
+    r"^\s*SELECT\s+(?P<items>.+?)\s+FROM\s+__THIS__"
+    r"(?:\s+WHERE\s+(?P<where>.+?))?\s*;?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
+
+
+def _sqlize(expr: str) -> str:
+    """SQL operator spellings → pandas.eval spellings: ``<>`` → ``!=``,
+    bare ``=`` → ``==`` (leaves ``==``/``<=``/``>=``/``!=`` alone),
+    ``AND``/``OR``/``NOT`` (any case) → lowercase."""
+    expr = expr.replace("<>", "!=")
+    expr = re.sub(r"(?<![<>!=])=(?!=)", "==", expr)
+    for kw in ("and", "or", "not"):
+        expr = re.sub(rf"\b{kw}\b", kw, expr, flags=re.IGNORECASE)
+    return expr
+
+
+def _split_items(items: str) -> List[str]:
+    """Split the select list on top-level commas (parentheses nest)."""
+    out, depth, cur = [], 0, []
+    for ch in items:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur).strip())
+    return [s for s in out if s]
+
+
+def _eval(df, expr: str, n: int) -> np.ndarray:
+    """Evaluate one expression against the scalar columns, broadcasting
+    literal constants to the row count; evaluator failures surface as
+    grammar errors."""
+    try:
+        val = df.eval(_sqlize(expr))
+    except Exception as e:  # pandas raises a zoo of parser error types
+        raise ValueError(
+            f"cannot evaluate expression {expr!r} (restricted "
+            f"SQLTransformer grammar): {e}"
+        ) from e
+    arr = np.asarray(val)
+    if arr.ndim == 0:
+        arr = np.full(n, arr[()])
+    if arr.ndim != 1 or arr.shape[0] != n:
+        raise ValueError(
+            f"expression {expr!r} did not produce one value per row"
+        )
+    return arr
+
+
+class SQLTransformer(Transformer):
+    statement = Param(
+        "SELECT <items> FROM __THIS__ [WHERE <cond>] (restricted grammar "
+        "— see module docstring)",
+        default=None,
+    )
+
+    def transform(self, frame: Frame) -> Frame:
+        stmt = self.getStatement()
+        if not stmt:
+            raise ValueError("statement must be set")
+        m = _STMT.match(stmt)
+        if not m:
+            raise ValueError(
+                f"unsupported statement {stmt!r}: expected "
+                "'SELECT <items> FROM __THIS__ [WHERE <cond>]'"
+            )
+        import pandas as pd
+
+        scalar_cols = [c for c in frame.columns if frame[c].ndim == 1]
+        df = pd.DataFrame({c: np.asarray(frame[c]) for c in scalar_cols})
+
+        where = m.group("where")
+        src = frame
+        if where:
+            mask = np.asarray(
+                _eval(df, where, frame.num_rows), bool
+            )
+            src = frame.filter(mask)
+            df = df[mask]
+
+        out_cols = {}
+        for item in _split_items(m.group("items")):
+            if item == "*":
+                for c in src.columns:
+                    out_cols[c] = src[c]
+                continue
+            as_m = re.match(
+                r"^(?P<expr>.+?)\s+AS\s+(?P<name>\w+)$", item,
+                re.IGNORECASE | re.DOTALL,
+            )
+            if as_m:
+                expr, name = as_m.group("expr"), as_m.group("name")
+                out_cols[name] = _eval(df, expr, src.num_rows)
+            elif re.fullmatch(r"\w+", item):
+                if item not in src:
+                    raise ValueError(f"unknown column {item!r}")
+                out_cols[item] = src[item]
+            else:
+                raise ValueError(
+                    f"select item {item!r} needs 'AS <name>' (bare "
+                    "expressions have no output column name)"
+                )
+        return Frame(out_cols)

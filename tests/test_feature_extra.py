@@ -159,6 +159,74 @@ def test_rformula_string_dummies_and_interaction():
         RFormula(formula="y + x").fit(f)
 
 
+def test_sql_transformer():
+    from sntc_tpu.feature import SQLTransformer
+
+    f = Frame({
+        "v1": np.array([1.0, 3.0, 5.0]),
+        "v2": np.array([10.0, 20.0, 30.0]),
+        "vec": np.ones((3, 2), np.float32),
+    })
+    # the Spark doc example shape: SELECT *, expr AS name ... WHERE
+    out = SQLTransformer(
+        statement="SELECT *, (v1 + v2) AS v3, (v1 * v2) AS v4 "
+                  "FROM __THIS__ WHERE v1 > 2"
+    ).transform(f)
+    assert out.num_rows == 2
+    np.testing.assert_allclose(out["v3"], [23.0, 35.0])
+    np.testing.assert_allclose(out["v4"], [60.0, 150.0])
+    assert out["vec"].shape == (2, 2)  # '*' carries vector columns too
+    # projection without WHERE
+    out2 = SQLTransformer(
+        statement="SELECT v2, (v1 > 2) AS big FROM __THIS__"
+    ).transform(f)
+    assert out2.columns == ["v2", "big"]
+    np.testing.assert_array_equal(out2["big"], [False, True, True])
+    # SQL operator spellings: =, <>, AND/OR/NOT, plus literal columns
+    out3 = SQLTransformer(
+        statement="SELECT v1, 1 AS one FROM __THIS__ "
+                  "WHERE v1 = 3 OR (NOT v2 <> 30 AND v1 > 4)"
+    ).transform(f)
+    np.testing.assert_allclose(out3["v1"], [3.0, 5.0])
+    np.testing.assert_array_equal(out3["one"], [1, 1])
+    # backtick quoting for the space-laden flow schema (Spark's quoting)
+    fsp = Frame({"Destination Port": np.array([80.0, 0.0]),
+                 "x": np.array([1.0, 2.0])})
+    osp = SQLTransformer(
+        statement="SELECT x, (`Destination Port` + 1) AS dp "
+                  "FROM __THIS__ WHERE `Destination Port` > 0"
+    ).transform(fsp)
+    np.testing.assert_allclose(osp["dp"], [81.0])
+    # a column legitimately named like a SQL keyword is fine
+    f2 = Frame({"limit": np.array([1.0, 2.0])})
+    out4 = SQLTransformer(
+        statement="SELECT limit, (limit * 2) AS d FROM __THIS__"
+    ).transform(f2)
+    np.testing.assert_allclose(out4["d"], [2.0, 4.0])
+    for bad in (
+        "SELECT * FROM other",
+        "SELECT a FROM __THIS__ JOIN b",
+        "SELECT v1 + v2 FROM __THIS__",   # bare expression, no AS
+        "SELECT nope FROM __THIS__",
+        "SELECT COUNT(v1) AS c FROM __THIS__",  # aggregates don't eval
+    ):
+        with pytest.raises(ValueError):
+            SQLTransformer(statement=bad).transform(f)
+
+
+def test_imputer_mode_strategy():
+    from sntc_tpu.feature import Imputer
+
+    f = Frame({
+        "a": np.array([1.0, 2.0, 2.0, 7.0, 7.0, np.nan]),
+    })
+    m = Imputer(inputCols=("a",), strategy="mode").fit(f)
+    # ties between 2.0 and 7.0 (2 each) -> smallest wins (Spark 3.1)
+    assert m.surrogates[0] == 2.0
+    out = m.transform(f)["a"]
+    assert out[-1] == 2.0
+
+
 def test_rformula_save_load(tmp_path):
     f = Frame({
         "y": np.array([1.0, 0.0, 1.0]),
