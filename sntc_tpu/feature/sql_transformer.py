@@ -42,30 +42,65 @@ _STMT = re.compile(
 )
 
 
+def _outside_quotes(s: str, fn) -> str:
+    """Apply ``fn`` to every segment of ``s`` OUTSIDE single-quoted
+    string literals and backtick-quoted identifiers — operator
+    rewriting must never touch either."""
+    out: List[str] = []
+    seg: List[str] = []
+    state = None  # None | "'" | "`"
+    for ch in s:
+        if state is None:
+            if ch in ("'", "`"):
+                out.append(fn("".join(seg)))
+                seg = []
+                out.append(ch)
+                state = ch
+            else:
+                seg.append(ch)
+        else:
+            out.append(ch)
+            if ch == state:
+                state = None
+    out.append(fn("".join(seg)))
+    return "".join(out)
+
+
 def _sqlize(expr: str) -> str:
-    """SQL operator spellings → pandas.eval spellings: ``<>`` → ``!=``,
-    bare ``=`` → ``==`` (leaves ``==``/``<=``/``>=``/``!=`` alone),
-    ``AND``/``OR``/``NOT`` (any case) → lowercase."""
-    expr = expr.replace("<>", "!=")
-    expr = re.sub(r"(?<![<>!=])=(?!=)", "==", expr)
-    for kw in ("and", "or", "not"):
-        expr = re.sub(rf"\b{kw}\b", kw, expr, flags=re.IGNORECASE)
-    return expr
+    """SQL operator spellings → pandas.eval spellings (outside quotes):
+    ``<>`` → ``!=``, bare ``=`` → ``==`` (leaves ``==``/``<=``/``>=``/
+    ``!=`` alone), ``AND``/``OR``/``NOT`` (any case) → lowercase."""
+
+    def rewrite(seg: str) -> str:
+        seg = seg.replace("<>", "!=")
+        seg = re.sub(r"(?<![<>!=])=(?!=)", "==", seg)
+        for kw in ("and", "or", "not"):
+            seg = re.sub(rf"\b{kw}\b", kw, seg, flags=re.IGNORECASE)
+        return seg
+
+    return _outside_quotes(expr, rewrite)
 
 
 def _split_items(items: str) -> List[str]:
-    """Split the select list on top-level commas (parentheses nest)."""
+    """Split the select list on top-level commas — parentheses nest,
+    and commas inside string literals or backticked names don't split."""
     out, depth, cur = [], 0, []
+    state = None  # None | "'" | "`"
     for ch in items:
-        if ch == "(":
-            depth += 1
-        elif ch == ")":
-            depth -= 1
-        if ch == "," and depth == 0:
-            out.append("".join(cur).strip())
-            cur = []
-        else:
-            cur.append(ch)
+        if state is None:
+            if ch in ("'", "`"):
+                state = ch
+            elif ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            elif ch == "," and depth == 0:
+                out.append("".join(cur).strip())
+                cur = []
+                continue
+        elif ch == state:
+            state = None
+        cur.append(ch)
     if cur:
         out.append("".join(cur).strip())
     return [s for s in out if s]
@@ -130,16 +165,18 @@ class SQLTransformer(Transformer):
                     out_cols[c] = src[c]
                 continue
             as_m = re.match(
-                r"^(?P<expr>.+?)\s+AS\s+(?P<name>\w+)$", item,
+                r"^(?P<expr>.+?)\s+AS\s+(?P<name>\w+|`[^`]+`)$", item,
                 re.IGNORECASE | re.DOTALL,
             )
+            bare = re.fullmatch(r"\w+|`[^`]+`", item)
             if as_m:
                 expr, name = as_m.group("expr"), as_m.group("name")
-                out_cols[name] = _eval(df, expr, src.num_rows)
-            elif re.fullmatch(r"\w+", item):
-                if item not in src:
-                    raise ValueError(f"unknown column {item!r}")
-                out_cols[item] = src[item]
+                out_cols[name.strip("`")] = _eval(df, expr, src.num_rows)
+            elif bare:
+                col = item.strip("`")
+                if col not in src:
+                    raise ValueError(f"unknown column {col!r}")
+                out_cols[col] = src[col]
             else:
                 raise ValueError(
                     f"select item {item!r} needs 'AS <name>' (bare "

@@ -197,6 +197,27 @@ def test_sql_transformer():
                   "FROM __THIS__ WHERE `Destination Port` > 0"
     ).transform(fsp)
     np.testing.assert_allclose(osp["dp"], [81.0])
+    # bare backticked projection (no alias needed, Spark semantics)
+    osp2 = SQLTransformer(
+        statement="SELECT `Destination Port` FROM __THIS__"
+    ).transform(fsp)
+    assert osp2.columns == ["Destination Port"]
+    # rewriting never touches string literals or backticked names:
+    # '=' inside a literal survives; a column named with AND works
+    fstr = Frame({
+        "name": object_column(["a=b", "c"]),
+        "Fwd AND Bwd": np.array([1.0, 2.0]),
+    })
+    ostr = SQLTransformer(
+        statement="SELECT `Fwd AND Bwd` FROM __THIS__ WHERE name = 'a=b'"
+    ).transform(fstr)
+    assert ostr.num_rows == 1 and ostr["Fwd AND Bwd"][0] == 1.0
+    # commas inside literals don't split the select list
+    oc = SQLTransformer(
+        statement="SELECT (name == 'a,b') AS m, x FROM __THIS__"
+    ).transform(Frame({"name": object_column(["a,b", "z"]),
+                       "x": np.array([5.0, 6.0])}))
+    assert oc["m"].tolist() == [True, False]
     # a column legitimately named like a SQL keyword is fine
     f2 = Frame({"limit": np.array([1.0, 2.0])})
     out4 = SQLTransformer(

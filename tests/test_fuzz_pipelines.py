@@ -1,0 +1,132 @@
+"""Seeded pipeline-composition fuzz: random-but-reproducible stage
+chains over random frames must fit, transform, and round-trip through
+persistence without error, and produce finite predictions.  The
+cross-stage seams (column dtypes/shapes handed from stage to stage) are
+where composition bugs live — single-stage oracles can't see them.
+SURVEY.md §4's randomized-integration idiom."""
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.base import Pipeline
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.mlio.save_load import load_model, save_model
+
+N_TRIALS = 12
+
+
+def _random_frame(rng, n):
+    d = int(rng.integers(4, 9))
+    X = rng.lognormal(0.5, 1.0, size=(n, d)).astype(np.float32)
+    X[:, 0] = rng.integers(0, 3, size=n)  # a low-cardinality feature
+    y_bin = (X[:, 1] > np.median(X[:, 1])).astype(np.float64)
+    y_multi = rng.integers(0, 3, size=n).astype(np.float64)
+    # correlate the multiclass label with a feature so fits have signal
+    X[:, 2] += 2.0 * y_multi
+    return Frame({"features": X, "label": y_bin, "multi": y_multi}), d
+
+
+def _scaler_pool(rng):
+    from sntc_tpu.feature import (
+        MaxAbsScaler, MinMaxScaler, Normalizer, RobustScaler,
+        StandardScaler,
+    )
+
+    return rng.choice([
+        lambda: StandardScaler(inputCol="features", outputCol="f2",
+                               withMean=True),
+        lambda: MinMaxScaler(inputCol="features", outputCol="f2"),
+        lambda: MaxAbsScaler(inputCol="features", outputCol="f2"),
+        lambda: RobustScaler(inputCol="features", outputCol="f2"),
+        lambda: Normalizer(inputCol="features", outputCol="f2"),
+    ])()
+
+
+def _middle_pool(rng, d):
+    from sntc_tpu.feature import (
+        Binarizer, PCA, PolynomialExpansion, VectorIndexer, VectorSlicer,
+    )
+
+    return rng.choice([
+        lambda: PCA(inputCol="f2", outputCol="f3", k=min(3, d)),
+        lambda: VectorSlicer(inputCol="f2", outputCol="f3",
+                             indices=list(range(min(3, d)))),
+        lambda: PolynomialExpansion(inputCol="f2", outputCol="f3",
+                                    degree=2),
+        lambda: Binarizer(inputCol="f2", outputCol="f3", threshold=0.1),
+        lambda: VectorIndexer(inputCol="f2", outputCol="f3",
+                              maxCategories=4, handleInvalid="keep"),
+        lambda: None,
+    ])()
+
+
+def _estimator_pool(rng, label):
+    from sntc_tpu.models import (
+        DecisionTreeClassifier, LinearSVC, LogisticRegression,
+        MultilayerPerceptronClassifier, NaiveBayes,
+        RandomForestClassifier,
+    )
+
+    if label == "label":
+        pool = [
+            lambda: LogisticRegression(
+                featuresCol="f3", labelCol=label, maxIter=15),
+            lambda: LinearSVC(featuresCol="f3", labelCol=label, maxIter=15),
+            lambda: DecisionTreeClassifier(
+                featuresCol="f3", labelCol=label, maxDepth=3),
+        ]
+    else:
+        pool = [
+            lambda: LogisticRegression(
+                featuresCol="f3", labelCol=label, maxIter=15),
+            lambda: RandomForestClassifier(
+                featuresCol="f3", labelCol=label, numTrees=3, maxDepth=3),
+            lambda: NaiveBayes(featuresCol="f3", labelCol=label,
+                               modelType="gaussian"),
+            lambda: MultilayerPerceptronClassifier(
+                featuresCol="f3", labelCol=label, maxIter=10),
+        ]
+    return rng.choice(pool)()
+
+
+@pytest.mark.parametrize("trial", range(N_TRIALS))
+def test_random_pipeline_composition(mesh8, tmp_path, trial):
+    rng = np.random.default_rng(1000 + trial)
+    f, d = _random_frame(rng, int(rng.integers(150, 400)))
+    label = str(rng.choice(["label", "multi"]))
+
+    stages = [_scaler_pool(rng)]
+    mid = _middle_pool(rng, d)
+    if mid is None:
+        from sntc_tpu.feature import VectorSlicer
+
+        mid = VectorSlicer(inputCol="f2", outputCol="f3",
+                           indices=list(range(d)))
+    est = _estimator_pool(rng, label)
+    # MLP needs declared layer sizes: probe the mid-stage output width
+    if type(est).__name__ == "MultilayerPerceptronClassifier":
+        scaled = (
+            stages[0].fit(f).transform(f)
+            if hasattr(stages[0], "fit") else stages[0].transform(f)
+        )
+        probe = (
+            mid.fit(scaled).transform(scaled)
+            if hasattr(mid, "fit") else mid.transform(scaled)
+        )
+        width = probe["f3"].shape[1]
+        est.setParams(layers=[int(width), 8, 3])
+    stages.extend([mid, est])
+
+    model = Pipeline(stages=stages).fit(f)
+    out = model.transform(f)
+    pred = np.asarray(out["prediction"], np.float64)
+    assert pred.shape == (f.num_rows,)
+    assert np.isfinite(pred).all(), f"non-finite predictions (trial {trial})"
+
+    path = str(tmp_path / f"pipe_{trial}")
+    save_model(model, path)
+    reloaded = load_model(path)
+    np.testing.assert_array_equal(
+        np.asarray(reloaded.transform(f)["prediction"]), pred,
+        err_msg=f"persistence changed predictions (trial {trial})",
+    )
