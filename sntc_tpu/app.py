@@ -199,9 +199,46 @@ def cmd_evaluate(args) -> int:
     return 0
 
 
+def strip_label_indexer(model, label_index_col: str):
+    """Serving prep: remove the LABEL indexing (live flows carry no
+    label column) while KEEPING any feature-column indexing, and return
+    the label vocabulary for mapping predictions back to strings.
+
+    Handles both indexer modes: a single-column StringIndexerModel
+    writing ``label_index_col`` is dropped whole; a multi-column one is
+    reduced to its non-label columns.  Returns ``(stages, labels)``
+    where ``labels`` is None when no label indexer was found."""
+    from sntc_tpu.feature.string_indexer import (
+        StringIndexerModel,
+        _resolve_cols,
+    )
+
+    stages, labels = [], None
+    for s in model.getStages():
+        if isinstance(s, StringIndexerModel):
+            ins, outs = _resolve_cols(s)
+            if label_index_col in outs:
+                j = outs.index(label_index_col)
+                labels = s.labelsArray[j]
+                keep = [k for k in range(len(outs)) if k != j]
+                if keep:
+                    reduced = StringIndexerModel(
+                        labelsArray=[s.labelsArray[k] for k in keep],
+                    )
+                    reduced.setParams(
+                        inputCols=[ins[k] for k in keep],
+                        outputCols=[outs[k] for k in keep],
+                        handleInvalid=s.getHandleInvalid(),
+                        stringOrderType=s.getStringOrderType(),
+                    )
+                    stages.append(reduced)
+                continue
+        stages.append(s)
+    return stages, labels
+
+
 def cmd_serve(args) -> int:
     from sntc_tpu.core.base import PipelineModel
-    from sntc_tpu.feature.string_indexer import StringIndexerModel
     from sntc_tpu.mlio import load_model
     from sntc_tpu.serve import (
         CsvDirSink,
@@ -219,18 +256,14 @@ def cmd_serve(args) -> int:
         # reference app's output shape.  The scaler fuses into the model.
         from sntc_tpu.feature import IndexToString
 
-        stages, tail = [], []
-        for s in model.getStages():
-            if (
-                isinstance(s, StringIndexerModel)
-                and s.getOutputCol() == args.label_index_col
-            ):
-                tail = [IndexToString(
-                    inputCol="prediction", outputCol="predictedLabel",
-                    labels=s.labels,
-                )]
-            else:
-                stages.append(s)
+        stages, labels = strip_label_indexer(model, args.label_index_col)
+        tail = (
+            [IndexToString(
+                inputCol="prediction", outputCol="predictedLabel",
+                labels=labels,
+            )]
+            if labels is not None else []
+        )
         model = compile_serving(PipelineModel(stages=stages + tail))
         if tail:
             out_cols = ["prediction", "predictedLabel"]

@@ -34,6 +34,10 @@ from sntc_tpu.feature.vector_indexer import (
 from sntc_tpu.feature.dct import DCT
 from sntc_tpu.feature.rformula import RFormula, RFormulaModel
 from sntc_tpu.feature.sql_transformer import SQLTransformer
+from sntc_tpu.feature.variance_selector import (
+    VarianceThresholdSelector,
+    VarianceThresholdSelectorModel,
+)
 from sntc_tpu.feature.text import (
     CountVectorizer,
     CountVectorizerModel,
@@ -59,6 +63,8 @@ from sntc_tpu.feature.encoders import (
 )
 
 __all__ = [
+    "VarianceThresholdSelector",
+    "VarianceThresholdSelectorModel",
     "SQLTransformer",
     "FeatureHasher",
     "VectorIndexer",

@@ -45,11 +45,15 @@ _STMT = re.compile(
 def _outside_quotes(s: str, fn) -> str:
     """Apply ``fn`` to every segment of ``s`` OUTSIDE single-quoted
     string literals and backtick-quoted identifiers — operator
-    rewriting must never touch either."""
+    rewriting must never touch either.  The SQL escaped quote ``''``
+    inside a literal stays inside it and is rewritten to the Python
+    escape ``\\'`` pandas.eval understands."""
     out: List[str] = []
     seg: List[str] = []
     state = None  # None | "'" | "`"
-    for ch in s:
+    i, n = 0, len(s)
+    while i < n:
+        ch = s[i]
         if state is None:
             if ch in ("'", "`"):
                 out.append(fn("".join(seg)))
@@ -58,10 +62,15 @@ def _outside_quotes(s: str, fn) -> str:
                 state = ch
             else:
                 seg.append(ch)
+        elif state == "'" and ch == "'" and i + 1 < n and s[i + 1] == "'":
+            out.append("\\'")  # SQL '' -> Python \' (still in literal)
+            i += 2
+            continue
         else:
             out.append(ch)
             if ch == state:
                 state = None
+        i += 1
     out.append(fn("".join(seg)))
     return "".join(out)
 
@@ -83,10 +92,13 @@ def _sqlize(expr: str) -> str:
 
 def _split_items(items: str) -> List[str]:
     """Split the select list on top-level commas — parentheses nest,
-    and commas inside string literals or backticked names don't split."""
+    and commas inside string literals (incl. SQL ``''`` escapes) or
+    backticked names don't split."""
     out, depth, cur = [], 0, []
     state = None  # None | "'" | "`"
-    for ch in items:
+    i, n = 0, len(items)
+    while i < n:
+        ch = items[i]
         if state is None:
             if ch in ("'", "`"):
                 state = ch
@@ -97,10 +109,16 @@ def _split_items(items: str) -> List[str]:
             elif ch == "," and depth == 0:
                 out.append("".join(cur).strip())
                 cur = []
+                i += 1
                 continue
+        elif state == "'" and ch == "'" and i + 1 < n and items[i + 1] == "'":
+            cur.append("''")
+            i += 2
+            continue
         elif ch == state:
             state = None
         cur.append(ch)
+        i += 1
     if cur:
         out.append("".join(cur).strip())
     return [s for s in out if s]

@@ -36,6 +36,12 @@ def _order_labels(values: np.ndarray, order: str) -> List[str]:
 class _StringIndexerParams:
     inputCol = Param("input string column", default="label")
     outputCol = Param("output index column", default="labelIndex")
+    inputCols = Param(
+        "multi-column mode (Spark 3.0): input columns", default=None
+    )
+    outputCols = Param(
+        "multi-column mode: output columns (same length)", default=None
+    )
     stringOrderType = Param(
         "label ordering: frequencyDesc | frequencyAsc | alphabetDesc | alphabetAsc",
         default="frequencyDesc",
@@ -50,70 +56,113 @@ class _StringIndexerParams:
     )
 
 
+def _resolve_cols(stage) -> tuple:
+    """(ins, outs) for single- or multi-column mode (Spark 3.0: exactly
+    one of inputCol/inputCols drives)."""
+    multi_in = stage.getInputCols()
+    if multi_in:
+        outs = stage.getOutputCols()
+        if not outs or len(outs) != len(multi_in):
+            raise ValueError(
+                "outputCols must be set and match inputCols in length"
+            )
+        return list(multi_in), list(outs)
+    return [stage.getInputCol()], [stage.getOutputCol()]
+
+
+def _index_values(values: np.ndarray, labels: List[str]):
+    """Vectorized vocab lookup: hash-factorize the column once (C-level,
+    no per-row Python), then map the few unique values through the
+    fitted vocabulary (~7x faster than a per-row dict loop at 1M rows).
+    Returns ``(indices f64 with len(labels) marking unseen, bad mask)``."""
+    import pandas as pd
+
+    unseen_idx = float(len(labels))
+    # NA-ish values (None, nan, NaT) must round-trip through str()
+    # exactly like _fit indexed them — factorize would collapse None
+    # into the NaN unique, so stringify NA rows first (Python cost only
+    # on the NA rows themselves)
+    if values.dtype == object:
+        na = pd.isna(values)
+        if na.any():
+            values = values.copy()
+            values[na] = np.array(
+                [str(v) for v in values[na]], dtype=object
+            )
+    codes, uniques = pd.factorize(values, use_na_sentinel=False)
+    index = {l: float(i) for i, l in enumerate(labels)}
+    lut = np.array(
+        [index.get(str(u), unseen_idx) for u in uniques], dtype=np.float64
+    )
+    if len(lut) == 0:
+        out = np.full(len(codes), unseen_idx, dtype=np.float64)
+    else:
+        out = lut[codes]
+    return values, out, out == unseen_idx
+
+
 class StringIndexer(_StringIndexerParams, Estimator):
     def _fit(self, frame: Frame) -> "StringIndexerModel":
-        values = frame[self.getInputCol()]
-        labels = _order_labels(values, self.getStringOrderType())
-        model = StringIndexerModel(labels=labels)
+        ins, _ = _resolve_cols(self)
+        order = self.getStringOrderType()
+        labels_array = [_order_labels(frame[c], order) for c in ins]
+        model = StringIndexerModel(labelsArray=labels_array)
         model.setParams(**self.paramValues())
         return model
 
 
 class StringIndexerModel(_StringIndexerParams, Model):
-    def __init__(self, labels: List[str], **kwargs):
+    def __init__(self, labels: List[str] = None, labelsArray=None, **kwargs):
         super().__init__(**kwargs)
-        self.labels = list(labels)
+        if labelsArray is None:
+            labelsArray = [list(labels or [])]
+        self.labelsArray = [list(ls) for ls in labelsArray]
+
+    @property
+    def labels(self) -> List[str]:
+        """Single-column accessor (the Spark attribute); multi-column
+        models expose ``labelsArray``."""
+        return self.labelsArray[0]
 
     def _save_extra(self):
-        return {"labels": self.labels}, {}
+        return {"labelsArray": self.labelsArray}, {}
 
     @classmethod
     def _load_from(cls, params, extra, arrays):
-        m = cls(labels=extra["labels"])
+        if "labelsArray" in extra:
+            m = cls(labelsArray=extra["labelsArray"])
+        else:  # models persisted before multi-column support
+            m = cls(labels=extra["labels"])
         m.setParams(**params)
         return m
 
     def transform(self, frame: Frame) -> Frame:
-        values = frame[self.getInputCol()]
+        ins, outs = _resolve_cols(self)
+        if len(ins) != len(self.labelsArray):
+            raise ValueError(
+                f"model was fitted on {len(self.labelsArray)} columns, "
+                f"transform asked for {len(ins)}"
+            )
         mode = self.getHandleInvalid()
-        unseen_idx = float(len(self.labels))
-        # Vectorized vocab lookup: hash-factorize the column once (C-level, no
-        # per-row Python), then map the few unique values through the fitted
-        # vocabulary. ~7x faster than a per-row dict loop at 1M rows.
-        import pandas as pd
-
-        # NA-ish values (None, nan, NaT) must round-trip through str()
-        # exactly like _fit indexed them — factorize would collapse None
-        # into the NaN unique, so stringify NA rows first (Python cost only
-        # on the NA rows themselves)
-        if values.dtype == object:
-            na = pd.isna(values)
-            if na.any():
-                values = values.copy()
-                values[na] = np.array(
-                    [str(v) for v in values[na]], dtype=object
-                )
-        codes, uniques = pd.factorize(values, use_na_sentinel=False)
-        index = {l: float(i) for i, l in enumerate(self.labels)}
-        lut = np.array(
-            [index.get(str(u), unseen_idx) for u in uniques], dtype=np.float64
-        )
-        if len(lut) == 0:
-            out = np.full(len(codes), unseen_idx, dtype=np.float64)
-        else:
-            out = lut[codes]
-        bad = out == unseen_idx
-        if bad.any():
-            if mode == "error":
+        results, bad_any = [], np.zeros(frame.num_rows, bool)
+        for c, labels in zip(ins, self.labelsArray):
+            values, out, bad = _index_values(frame[c], labels)
+            if bad.any() and mode == "error":
                 unseen = sorted({str(v) for v in np.asarray(values)[bad]})
                 raise ValueError(
-                    f"StringIndexer: unseen labels {unseen} "
-                    "(handleInvalid='error')"
+                    f"StringIndexer: unseen labels {unseen} in column "
+                    f"{c!r} (handleInvalid='error')"
                 )
-            if mode == "skip":
-                frame = frame.filter(~bad)
-                out = out[~bad]
-        return frame.with_column(self.getOutputCol(), out)
+            results.append(out)
+            bad_any |= bad
+        if mode == "skip" and bad_any.any():
+            # Spark drops the ROW if any indexed column is unseen
+            keep = ~bad_any
+            frame = frame.filter(keep)
+            results = [r[keep] for r in results]
+        for name, out in zip(outs, results):
+            frame = frame.with_column(name, out)
+        return frame
 
 
 class IndexToString(Transformer):

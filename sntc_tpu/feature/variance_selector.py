@@ -1,0 +1,82 @@
+"""VarianceThresholdSelector — drop (near-)constant features.
+
+Behavioral spec: upstream ``ml/feature/VarianceThresholdSelector.scala``
+[U] (Spark 3.1): keep features whose SAMPLE variance is strictly
+greater than ``varianceThreshold`` (default 0.0 — drop constants).
+
+TPU design: the variances come from the StandardScaler's one-pass SPMD
+moments aggregate — no new reduction machinery; the transform is a
+column gather.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from sntc_tpu.core.base import Estimator, Model
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.core.params import Param, validators
+from sntc_tpu.parallel.collectives import shard_batch
+from sntc_tpu.parallel.context import get_default_mesh
+
+
+class _VtsParams:
+    featuresCol = Param("input vector column", default="features")
+    outputCol = Param("output vector column", default="selectedFeatures")
+    varianceThreshold = Param(
+        "keep features with sample variance > this", default=0.0,
+        validator=validators.gteq(0),
+    )
+
+
+class VarianceThresholdSelector(_VtsParams, Estimator):
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mesh = mesh
+
+    def _fit(self, frame: Frame) -> "VarianceThresholdSelectorModel":
+        from sntc_tpu.feature.standard_scaler import standardization_moments
+
+        mesh = self._mesh or get_default_mesh()
+        X = frame[self.getFeaturesCol()]
+        if X.ndim != 2:
+            raise ValueError("featuresCol must be a vector column")
+        X = X.astype(np.float32, copy=False)
+        n = X.shape[0]
+        xs, ws = shard_batch(mesh, X)
+        n_w, _, var = standardization_moments(
+            mesh, xs, ws, np.asarray(X[0]) if n else np.zeros(X.shape[1])
+        )
+        # standardization_moments returns the population form; Spark
+        # compares the UNBIASED sample variance
+        var = np.asarray(var, np.float64) * (n / max(n - 1, 1))
+        selected = [
+            int(j) for j in range(X.shape[1])
+            if var[j] > float(self.getVarianceThreshold())
+        ]
+        model = VarianceThresholdSelectorModel(selectedFeatures=selected)
+        model.setParams(**self.paramValues())
+        return model
+
+
+class VarianceThresholdSelectorModel(_VtsParams, Model):
+    def __init__(self, selectedFeatures: List[int] = (), **kwargs):
+        super().__init__(**kwargs)
+        self.selectedFeatures = [int(j) for j in selectedFeatures]
+
+    def _save_extra(self):
+        return {"selectedFeatures": self.selectedFeatures}, {}
+
+    @classmethod
+    def _load_from(cls, params, extra, arrays):
+        m = cls(selectedFeatures=extra["selectedFeatures"])
+        m.setParams(**params)
+        return m
+
+    def transform(self, frame: Frame) -> Frame:
+        X = frame[self.getFeaturesCol()]
+        return frame.with_column(
+            self.getOutputCol(), np.asarray(X)[:, self.selectedFeatures]
+        )

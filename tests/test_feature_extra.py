@@ -218,6 +218,12 @@ def test_sql_transformer():
     ).transform(Frame({"name": object_column(["a,b", "z"]),
                        "x": np.array([5.0, 6.0])}))
     assert oc["m"].tolist() == [True, False]
+    # SQL escaped quote '' stays inside the literal (matches "it's")
+    oq = SQLTransformer(
+        statement="SELECT x FROM __THIS__ WHERE name = 'it''s'"
+    ).transform(Frame({"name": object_column(["it's", "its"]),
+                       "x": np.array([1.0, 2.0])}))
+    assert oq["x"].tolist() == [1.0]
     # a column legitimately named like a SQL keyword is fine
     f2 = Frame({"limit": np.array([1.0, 2.0])})
     out4 = SQLTransformer(
@@ -233,6 +239,99 @@ def test_sql_transformer():
     ):
         with pytest.raises(ValueError):
             SQLTransformer(statement=bad).transform(f)
+
+
+def test_variance_threshold_selector(mesh8, tmp_path):
+    from sntc_tpu.feature import VarianceThresholdSelector
+    from sklearn.feature_selection import VarianceThreshold as SkVT
+
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(500, 5)).astype(np.float32)
+    X[:, 1] = 3.0           # constant
+    X[:, 3] *= 0.01         # tiny variance
+    f = Frame({"features": X})
+    m = VarianceThresholdSelector(varianceThreshold=0.001).fit(f)
+    sk = SkVT(threshold=0.001).fit(X.astype(np.float64))
+    assert m.selectedFeatures == list(np.nonzero(sk.get_support())[0])
+    out = m.transform(f)["selectedFeatures"]
+    np.testing.assert_allclose(out, X[:, m.selectedFeatures])
+    # default threshold 0: drops exactly the constant column
+    m0 = VarianceThresholdSelector().fit(f)
+    assert 1 not in m0.selectedFeatures and len(m0.selectedFeatures) == 4
+    save_model(m, str(tmp_path / "vts"))
+    m2 = load_model(str(tmp_path / "vts"))
+    assert m2.selectedFeatures == m.selectedFeatures
+
+
+def test_string_indexer_multi_column(mesh8, tmp_path):
+    from sntc_tpu.feature import StringIndexer
+
+    proto = np.array(["tcp", "udp", "tcp", "icmp"], dtype=object)
+    flag = np.array(["S", "S", "A", "R"], dtype=object)
+    f = Frame({"proto": proto, "flag": flag})
+    m = StringIndexer(
+        inputCols=("proto", "flag"), outputCols=("pi", "fi")
+    ).fit(f)
+    out = m.transform(f)
+    # frequencyDesc per column: tcp->0; S->0
+    np.testing.assert_array_equal(out["pi"], [0, 2, 0, 1])
+    assert out["fi"][0] == 0 and out["fi"][1] == 0
+    assert len(m.labelsArray) == 2
+    # skip drops the ROW when any column is unseen
+    f_bad = Frame({
+        "proto": np.array(["tcp", "gre"], dtype=object),
+        "flag": np.array(["S", "S"], dtype=object),
+    })
+    m_skip = m.copy({"handleInvalid": "skip"})
+    assert m_skip.transform(f_bad).num_rows == 1
+    with pytest.raises(ValueError, match="unseen"):
+        m.transform(f_bad)
+    # persistence round-trips the multi-column labels
+    save_model(m, str(tmp_path / "si_multi"))
+    m2 = load_model(str(tmp_path / "si_multi"))
+    assert m2.labelsArray == m.labelsArray
+    np.testing.assert_array_equal(
+        m2.transform(f)["pi"], out["pi"]
+    )
+    # outputCols validation
+    with pytest.raises(ValueError, match="outputCols"):
+        StringIndexer(inputCols=("proto",)).fit(f)
+
+
+def test_strip_label_indexer_multi_column(mesh8):
+    """Serving prep keeps FEATURE-column indexing when the label shares
+    a multi-column StringIndexerModel with features."""
+    from sntc_tpu.app import strip_label_indexer
+    from sntc_tpu.core.base import PipelineModel
+    from sntc_tpu.feature import StringIndexer
+
+    f = Frame({
+        "proto": np.array(["tcp", "udp", "tcp"], dtype=object),
+        "Label": np.array(["BENIGN", "DDoS", "BENIGN"], dtype=object),
+    })
+    m = StringIndexer(
+        inputCols=("proto", "Label"), outputCols=("pi", "label")
+    ).fit(f)
+    stages, labels = strip_label_indexer(
+        PipelineModel(stages=[m]), "label"
+    )
+    assert labels == m.labelsArray[1]  # the LABEL vocabulary, not proto's
+    assert len(stages) == 1  # proto indexing survives
+    out = stages[0].transform(Frame({
+        "proto": np.array(["udp"], dtype=object)
+    }))
+    assert out["pi"][0] == 1.0 and "label" not in out
+    # single-column label indexer drops whole, nothing else kept
+    m1 = StringIndexer(inputCol="Label", outputCol="label").fit(f)
+    stages1, labels1 = strip_label_indexer(
+        PipelineModel(stages=[m1]), "label"
+    )
+    assert stages1 == [] and labels1 == m1.labels
+    # no label indexer at all -> untouched, labels None
+    stages2, labels2 = strip_label_indexer(
+        PipelineModel(stages=[m1]), "other_col"
+    )
+    assert len(stages2) == 1 and labels2 is None
 
 
 def test_imputer_mode_strategy():
