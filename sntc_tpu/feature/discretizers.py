@@ -71,10 +71,16 @@ def _bucketize(
 
 class Bucketizer(Model):
     """Explicit-splits binning — stateless (a Model so QuantileDiscretizer
-    can return it from fit, exactly as Spark does)."""
+    can return it from fit, exactly as Spark does).  Multi-column mode
+    (Spark 3.0): ``inputCols``/``outputCols``/``splitsArray``."""
 
     inputCol = Param("input scalar column", default="input")
     outputCol = Param("output bucket-index column", default="bucketed")
+    inputCols = Param("multi-column mode: input columns", default=None)
+    outputCols = Param("multi-column mode: output columns", default=None)
+    splitsArray = Param(
+        "multi-column mode: one splits list per input column", default=None
+    )
     splits = Param(
         "strictly-increasing bucket boundaries (len >= 3; use -inf/+inf "
         "for open ends)",
@@ -87,28 +93,61 @@ class Bucketizer(Model):
         validator=validators.one_of("error", "keep", "skip"),
     )
 
-    def _splits(self) -> np.ndarray:
-        s = self.getSplits()
+    @staticmethod
+    def _check_splits(s, what: str) -> np.ndarray:
         if s is None or len(s) < 3:
-            raise ValueError("splits must have at least 3 boundaries")
+            raise ValueError(f"{what} must have at least 3 boundaries")
         arr = np.asarray(s, np.float64)
         if not np.all(np.diff(arr) > 0):
-            raise ValueError("splits must be strictly increasing")
+            raise ValueError(f"{what} must be strictly increasing")
         return arr
 
+    def _splits(self) -> np.ndarray:
+        return self._check_splits(self.getSplits(), "splits")
+
     def transform(self, frame: Frame) -> Frame:
-        splits = self._splits()
-        values = np.asarray(frame[self.getInputCol()], np.float64)
-        idx, keep = _bucketize(
-            values, splits, self.getHandleInvalid(), "Bucketizer"
-        )
-        out = frame.with_column(self.getOutputCol(), idx)
-        return out if keep is None else out.filter(keep)
+        multi = self.getInputCols()
+        if multi:
+            outs = self.getOutputCols()
+            sa = self.getSplitsArray()
+            if not outs or len(outs) != len(multi):
+                raise ValueError(
+                    "outputCols must be set and match inputCols in length"
+                )
+            if not sa or len(sa) != len(multi):
+                raise ValueError(
+                    "splitsArray must be set and match inputCols in length"
+                )
+            triples = [
+                (c, o, self._check_splits(s, f"splitsArray[{i}]"))
+                for i, (c, o, s) in enumerate(zip(multi, outs, sa))
+            ]
+        else:
+            triples = [(self.getInputCol(), self.getOutputCol(),
+                        self._splits())]
+        mode = self.getHandleInvalid()
+        keep_all = None
+        results = []
+        for c, o, splits in triples:
+            values = np.asarray(frame[c], np.float64)
+            idx, keep = _bucketize(values, splits, mode, "Bucketizer")
+            results.append((o, idx))
+            if keep is not None:
+                keep_all = keep if keep_all is None else (keep_all & keep)
+        if keep_all is not None:
+            # skip: a row drops when ANY bucketized column is NaN (Spark)
+            frame = frame.filter(keep_all)
+            results = [(o, idx[keep_all]) for o, idx in results]
+        for o, idx in results:
+            frame = frame.with_column(o, idx)
+        return frame
 
 
 class QuantileDiscretizer(Estimator):
     inputCol = Param("input scalar column", default="input")
     outputCol = Param("output bucket-index column", default="bucketed")
+    inputCols = Param("multi-column mode: input columns", default=None)
+    outputCols = Param("multi-column mode: output columns", default=None)
     numBuckets = Param(
         "number of quantile buckets", default=2, validator=validators.gt(1)
     )
@@ -118,24 +157,44 @@ class QuantileDiscretizer(Estimator):
         validator=validators.one_of("error", "keep", "skip"),
     )
 
-    def _fit(self, frame: Frame) -> "Bucketizer":
-        values = np.asarray(frame[self.getInputCol()], np.float64)
+    @staticmethod
+    def _column_splits(frame: Frame, col: str, n_buckets: int):
+        values = np.asarray(frame[col], np.float64)
         values = values[~np.isnan(values)]
         if values.size == 0:
             raise ValueError(
-                f"QuantileDiscretizer: column {self.getInputCol()!r} has "
-                "no non-NaN values to fit quantiles on"
+                f"QuantileDiscretizer: column {col!r} has no non-NaN "
+                "values to fit quantiles on"
             )
-        qs = np.linspace(0.0, 1.0, self.getNumBuckets() + 1)[1:-1]
+        qs = np.linspace(0.0, 1.0, n_buckets + 1)[1:-1]
         inner = np.unique(np.quantile(values, qs))
-        splits = np.concatenate([[-np.inf], inner, [np.inf]])
-        model = Bucketizer(
+        return [float(v) for v in
+                np.concatenate([[-np.inf], inner, [np.inf]])]
+
+    def _fit(self, frame: Frame) -> "Bucketizer":
+        n_buckets = self.getNumBuckets()
+        multi = self.getInputCols()
+        if multi:
+            outs = self.getOutputCols()
+            if not outs or len(outs) != len(multi):
+                raise ValueError(
+                    "outputCols must be set and match inputCols in length"
+                )
+            return Bucketizer(
+                inputCols=list(multi), outputCols=list(outs),
+                splitsArray=[
+                    self._column_splits(frame, c, n_buckets) for c in multi
+                ],
+                handleInvalid=self.getHandleInvalid(),
+            )
+        return Bucketizer(
             inputCol=self.getInputCol(),
             outputCol=self.getOutputCol(),
-            splits=[float(v) for v in splits],
+            splits=self._column_splits(
+                frame, self.getInputCol(), n_buckets
+            ),
             handleInvalid=self.getHandleInvalid(),
         )
-        return model
 
 
 class _ImputerParams:

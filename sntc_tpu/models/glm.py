@@ -41,7 +41,7 @@ _EPS = 1e-10
 # in f32 (log(1−μ) → −inf); 1e-6 is the tightest safely-representable gap
 _MU_EPS = 1e-6
 
-_FAMILIES = ("gaussian", "binomial", "poisson", "gamma")
+_FAMILIES = ("gaussian", "binomial", "poisson", "gamma", "tweedie")
 _LINKS = ("identity", "log", "logit", "inverse", "sqrt", "cloglog", "probit")
 _DEFAULT_LINK = {
     "gaussian": "identity",
@@ -59,8 +59,23 @@ _SUPPORTED = {
 
 
 def _link_fns(link: str):
-    """(g, g_inv, g_prime) for η = g(μ)."""
+    """(g, g_inv, g_prime) for η = g(μ).  ``power:<lp>`` is the tweedie
+    power link μ^lp (lp = 0 means log), Spark's ``linkPower``."""
     sn = jax.scipy.stats.norm
+    if link.startswith("power:"):
+        lp = float(link.split(":", 1)[1])
+        if lp == 0.0:
+            return (jnp.log, jnp.exp, lambda m: 1.0 / m)
+        if lp == 1.0:
+            return (lambda m: m, lambda e: e, lambda m: jnp.ones_like(m))
+        # μ > 0 for every non-identity power link, so η = μ^lp is
+        # positive too — clamp unconditionally (fractional 1/lp on a
+        # transiently negative η would silently NaN the IRLS loop)
+        return (
+            lambda m: m**lp,
+            lambda e: jnp.maximum(e, _EPS) ** (1.0 / lp),
+            lambda m: lp * m ** (lp - 1.0),
+        )
     if link == "identity":
         return (lambda m: m, lambda e: e, lambda m: jnp.ones_like(m))
     if link == "log":
@@ -90,26 +105,56 @@ def _link_fns(link: str):
     raise ValueError(f"unknown link {link!r}")
 
 
-def _variance(family: str, mu):
+def _variance(family: str, mu, var_power: float = 0.0):
     if family == "gaussian":
         return jnp.ones_like(mu)
     if family == "binomial":
         return mu * (1.0 - mu)
     if family == "poisson":
         return mu
+    if family == "tweedie":
+        if var_power == 0.0:
+            return jnp.ones_like(mu)
+        return jnp.maximum(mu, _EPS) ** var_power
     return mu**2  # gamma
 
 
-def _clip_mu(family: str, mu):
+def _clip_mu(family: str, mu, var_power: float = 0.0):
     if family == "binomial":
         return jnp.clip(mu, _MU_EPS, 1.0 - _MU_EPS)
     if family in ("poisson", "gamma"):
         return jnp.maximum(mu, _EPS)
+    if family == "tweedie" and var_power != 0.0:
+        return jnp.maximum(mu, _EPS)  # μ > 0 whenever Var(μ) = μ^p, p ≥ 1
     return mu
 
 
-def _deviance(family: str, y, mu, w):
+def _deviance(family: str, y, mu, w, var_power: float = 0.0):
     """Unit deviance summed with weights (Spark/R semantics)."""
+    if family == "tweedie":
+        p = var_power
+        if p == 0.0:
+            return jnp.sum(w * (y - mu) ** 2)
+        if p == 1.0:
+            ylog = jnp.where(
+                y > 0, y * jnp.log(jnp.maximum(y, _EPS) / mu), 0.0
+            )
+            return 2.0 * jnp.sum(w * (ylog - (y - mu)))
+        if p == 2.0:
+            return 2.0 * jnp.sum(
+                w * (-jnp.log(jnp.maximum(y, _EPS) / mu) + (y - mu) / mu)
+            )
+        # general Tweedie unit deviance (y = 0 contributes only the μ
+        # term for 1 < p < 2; labels are validated > 0 for p > 2)
+        yp = jnp.maximum(y, 0.0)
+        t1 = jnp.where(
+            yp > 0,
+            yp ** (2.0 - p) / ((1.0 - p) * (2.0 - p)),
+            0.0,
+        )
+        t2 = y * mu ** (1.0 - p) / (1.0 - p)
+        t3 = mu ** (2.0 - p) / (2.0 - p)
+        return 2.0 * jnp.sum(w * (t1 - t2 + t3))
     if family == "gaussian":
         return jnp.sum(w * (y - mu) ** 2)
     if family == "binomial":
@@ -131,10 +176,12 @@ def _deviance(family: str, y, mu, w):
 
 @partial(
     jax.jit,
-    static_argnames=("family", "link", "fit_intercept", "max_iter"),
+    static_argnames=(
+        "family", "link", "fit_intercept", "max_iter", "var_power",
+    ),
 )
 def _irls(xs, ys, ws, beta0, *, family, link, fit_intercept, max_iter,
-          tol, reg):
+          tol, reg, var_power=0.0):
     """Whole-fit IRLS: ``lax.while_loop`` whose body is two sharded MXU
     contractions + one tiny solve.  ``xs`` is AUGMENTED with a ones
     column when ``fit_intercept`` (the intercept is just another
@@ -150,7 +197,7 @@ def _irls(xs, ys, ws, beta0, *, family, link, fit_intercept, max_iter,
 
     def eta_mu(beta):
         eta = xs @ beta
-        return eta, _clip_mu(family, g_inv(eta))
+        return eta, _clip_mu(family, g_inv(eta), var_power)
 
     def cond(state):
         _, it, delta = state
@@ -161,7 +208,9 @@ def _irls(xs, ys, ws, beta0, *, family, link, fit_intercept, max_iter,
         eta, mu = eta_mu(beta)
         gp = g_prime(mu)
         z = eta + (ys - mu) * gp
-        wls = ws / jnp.maximum(_variance(family, mu) * gp**2, _EPS)
+        wls = ws / jnp.maximum(
+            _variance(family, mu, var_power) * gp**2, _EPS
+        )
         xw = xs * wls[:, None]
         A = xs.T @ xw + jnp.diag(pen)  # [D+1, D+1]; XLA psums row-shards
         b = xw.T @ z
@@ -175,14 +224,15 @@ def _irls(xs, ys, ws, beta0, *, family, link, fit_intercept, max_iter,
         cond, body, (beta0, jnp.int32(0), jnp.float32(jnp.inf))
     )
     _, mu = eta_mu(beta)
-    dev = _deviance(family, ys, mu, ws)
+    dev = _deviance(family, ys, mu, ws, var_power)
     # null deviance: intercept-only model -> mu = weighted mean response
     ybar = jnp.sum(ws * ys) / jnp.maximum(jnp.sum(ws), _EPS)
-    mu0 = _clip_mu(family, jnp.broadcast_to(ybar, ys.shape))
-    dev0 = _deviance(family, ys, mu0, ws)
+    mu0 = _clip_mu(family, jnp.broadcast_to(ybar, ys.shape), var_power)
+    dev0 = _deviance(family, ys, mu0, ws, var_power)
     # Pearson chi² (dispersion numerator)
     pearson = jnp.sum(
-        ws * (ys - mu) ** 2 / jnp.maximum(_variance(family, mu), _EPS)
+        ws * (ys - mu) ** 2
+        / jnp.maximum(_variance(family, mu, var_power), _EPS)
     )
     return beta, n_iter, dev, dev0, pearson
 
@@ -196,7 +246,7 @@ class _GlrParams:
         default=None,
     )
     family = Param(
-        "gaussian | binomial | poisson | gamma", default="gaussian",
+        "gaussian | binomial | poisson | gamma | tweedie", default="gaussian",
         validator=validators.one_of(*_FAMILIES),
     )
     link = Param(
@@ -210,6 +260,15 @@ class _GlrParams:
                 validator=validators.gt(0))
     regParam = Param("L2 regularization (Spark GLR is L2-only)",
                      default=0.0, validator=validators.gteq(0))
+    variancePower = Param(
+        "tweedie variance power p (Var = mu^p): 0 or >= 1 (Spark)",
+        default=0.0,
+        validator=lambda v: v == 0.0 or v >= 1.0,
+    )
+    linkPower = Param(
+        "tweedie link power (None -> 1 - variancePower; 0 means log)",
+        default=None,
+    )
     fitIntercept = Param("fit an intercept", default=True,
                          validator=validators.is_bool())
     weightCol = Param("optional row weight column", default=None)
@@ -243,6 +302,16 @@ class GeneralizedLinearRegression(_GlrParams, Estimator):
 
     def _resolved_link(self) -> str:
         family = self.getFamily()
+        if family == "tweedie":
+            # tweedie ignores `link` and uses linkPower (Spark [U])
+            if self.getLink() is not None:
+                raise ValueError(
+                    "family='tweedie' uses linkPower, not link (Spark)"
+                )
+            lp = self.getLinkPower()
+            if lp is None:
+                lp = 1.0 - float(self.getVariancePower())
+            return f"power:{float(lp)}"
         link = self.getLink() or _DEFAULT_LINK[family]
         if link not in _LINKS:
             raise ValueError(f"unknown link {link!r}; one of {_LINKS}")
@@ -271,6 +340,18 @@ class GeneralizedLinearRegression(_GlrParams, Estimator):
             raise ValueError(f"{family} family needs non-negative labels")
         if family == "gamma" and (y == 0).any():
             raise ValueError("gamma family needs strictly positive labels")
+        vp = float(self.getVariancePower()) if family == "tweedie" else 0.0
+        if family == "tweedie":
+            if vp >= 1.0 and (y < 0).any():
+                raise ValueError(
+                    "tweedie with variancePower >= 1 needs non-negative "
+                    "labels"
+                )
+            if vp >= 2.0 and (y == 0).any():
+                raise ValueError(
+                    "tweedie with variancePower >= 2 needs strictly "
+                    "positive labels"
+                )
         wcol = self.getWeightCol()
         w = (
             np.asarray(frame[wcol], np.float32)
@@ -294,6 +375,8 @@ class GeneralizedLinearRegression(_GlrParams, Estimator):
             ybar = min(max(ybar, 1e-6), 1.0 - 1e-6)
         elif link in ("log", "inverse", "sqrt"):
             ybar = max(ybar, 1e-6)
+        elif link.startswith("power:") and link != "power:1.0":
+            ybar = max(ybar, 1e-6)
         if fit_b:
             beta0[-1] = float(g(jnp.float32(ybar)))
 
@@ -303,6 +386,7 @@ class GeneralizedLinearRegression(_GlrParams, Estimator):
             max_iter=int(self.getMaxIter()),
             tol=jnp.float32(self.getTol()),
             reg=jnp.float32(self.getRegParam()),
+            var_power=vp,
         )
         beta = np.asarray(beta, np.float64)
         coef = beta[:d] if fit_b else beta
@@ -328,6 +412,22 @@ def _glm_predict(X, coef, intercept, *, link):
     _, g_inv, _ = _link_fns(link)
     eta = X @ coef + intercept
     return eta, g_inv(eta)
+
+
+def _model_link(stage) -> str:
+    """Resolve a fitted/hand-built model's link: the persisted value if
+    set, else the family default (tweedie: power link from linkPower or
+    1 − variancePower)."""
+    link = stage.getLink()
+    if link is not None:
+        return link
+    fam = stage.getFamily()
+    if fam == "tweedie":
+        lp = stage.getLinkPower()
+        if lp is None:
+            lp = 1.0 - float(stage.getVariancePower())
+        return f"power:{float(lp)}"
+    return _DEFAULT_LINK[fam]
 
 
 class GeneralizedLinearRegressionModel(_GlrParams, Model):
@@ -358,11 +458,12 @@ class GeneralizedLinearRegressionModel(_GlrParams, Model):
 
     def transform(self, frame: Frame) -> Frame:
         X = frame[self.getFeaturesCol()].astype(np.float32, copy=False)
+        link = _model_link(self)
         eta, mu = _glm_predict(
             jnp.asarray(X),
             jnp.asarray(self.coefficients, jnp.float32),
             jnp.float32(self.intercept),
-            link=self.getLink() or _DEFAULT_LINK[self.getFamily()],
+            link=link,
         )
         out = frame.with_column(
             self.getPredictionCol(), np.asarray(mu, np.float64)
@@ -377,6 +478,6 @@ class GeneralizedLinearRegressionModel(_GlrParams, Model):
             jnp.asarray(np.asarray(X, np.float32)),
             jnp.asarray(self.coefficients, jnp.float32),
             jnp.float32(self.intercept),
-            link=self.getLink() or _DEFAULT_LINK[self.getFamily()],
+            link=_model_link(self),
         )
         return np.asarray(mu, np.float64)

@@ -298,6 +298,35 @@ def test_string_indexer_multi_column(mesh8, tmp_path):
         StringIndexer(inputCols=("proto",)).fit(f)
 
 
+def test_bucketizer_multi_column(mesh8):
+    from sntc_tpu.feature import Bucketizer, QuantileDiscretizer
+
+    f = Frame({
+        "a": np.array([0.1, 0.5, 0.9, np.nan]),
+        "b": np.array([10.0, 20.0, 30.0, 40.0]),
+    })
+    bk = Bucketizer(
+        inputCols=("a", "b"), outputCols=("ab", "bb"),
+        splitsArray=[[-np.inf, 0.4, np.inf], [-np.inf, 15.0, 25.0, np.inf]],
+        handleInvalid="keep",
+    )
+    out = bk.transform(f)
+    np.testing.assert_array_equal(out["ab"], [0, 1, 1, 2])  # NaN -> extra
+    np.testing.assert_array_equal(out["bb"], [0, 1, 2, 2])
+    # skip drops the ROW when any column is NaN
+    out2 = bk.copy({"handleInvalid": "skip"}).transform(f)
+    assert out2.num_rows == 3
+    # multi-column QuantileDiscretizer returns a multi-column Bucketizer
+    qd = QuantileDiscretizer(
+        inputCols=("a", "b"), outputCols=("qa", "qb"), numBuckets=2,
+        handleInvalid="keep",
+    ).fit(f)
+    out3 = qd.transform(f)
+    assert set(np.unique(out3["qb"])) == {0.0, 1.0}
+    with pytest.raises(ValueError, match="splitsArray"):
+        Bucketizer(inputCols=("a",), outputCols=("x",)).transform(f)
+
+
 def test_strip_label_indexer_multi_column(mesh8):
     """Serving prep keeps FEATURE-column indexing when the label shares
     a multi-column StringIndexerModel with features."""

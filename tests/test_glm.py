@@ -168,3 +168,56 @@ def test_glm_save_load_roundtrip(mesh8, tmp_path):
         m2.transform(f)["prediction"], m.transform(f)["prediction"],
         rtol=1e-6,
     )
+
+
+def test_tweedie_matches_sklearn(mesh8):
+    """family='tweedie' vs sklearn TweedieRegressor (alpha=0 MLE, log
+    link) — the same GLM, independent optimizer."""
+    from sklearn.linear_model import TweedieRegressor
+
+    from sntc_tpu.models import GeneralizedLinearRegression
+
+    rng = np.random.default_rng(8)
+    n, d = 4000, 3
+    X = rng.normal(size=(n, d)).astype(np.float32) * 0.5
+    beta = np.array([0.6, -0.3, 0.2])
+    mu = np.exp(X @ beta + 0.8)
+    # compound-poisson-ish targets: gamma noise with exact zeros mixed in
+    y = (mu * rng.gamma(2.0, 0.5, size=n)).astype(np.float32)
+    y[rng.random(n) < 0.1] = 0.0
+
+    m = GeneralizedLinearRegression(
+        family="tweedie", variancePower=1.5, linkPower=0.0, maxIter=50,
+    ).fit(Frame({"features": X, "label": y}))
+    sk = TweedieRegressor(
+        power=1.5, alpha=0.0, link="log", max_iter=500, tol=1e-8
+    ).fit(X.astype(np.float64), y.astype(np.float64))
+    np.testing.assert_allclose(m.coefficients, sk.coef_, atol=5e-3)
+    assert m.intercept == pytest.approx(sk.intercept_, abs=5e-3)
+    # deviance improves on the null model and dispersion is finite
+    assert m.summary.deviance < m.summary.nullDeviance
+    assert np.isfinite(m.summary.dispersion)
+
+
+def test_tweedie_default_link_power_and_validation(mesh8):
+    from sntc_tpu.models import GeneralizedLinearRegression
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 2)).astype(np.float32)
+    y = np.exp(0.3 * X[:, 0] + 1.0).astype(np.float32)
+    # default linkPower = 1 - variancePower = -1 (inverse-ish power link)
+    m = GeneralizedLinearRegression(
+        family="tweedie", variancePower=2.0, maxIter=40,
+    ).fit(Frame({"features": X, "label": y}))
+    assert m.getLink() == "power:-1.0"
+    assert np.isfinite(m.transform(Frame({"features": X}))["prediction"]).all()
+    with pytest.raises(ValueError, match="linkPower"):
+        GeneralizedLinearRegression(
+            family="tweedie", link="log"
+        ).fit(Frame({"features": X, "label": y}))
+    with pytest.raises(ValueError, match="strictly"):
+        GeneralizedLinearRegression(
+            family="tweedie", variancePower=2.5
+        ).fit(Frame({"features": X, "label": np.zeros(300, np.float32)}))
+    with pytest.raises(ValueError):
+        GeneralizedLinearRegression(family="tweedie", variancePower=0.5)
