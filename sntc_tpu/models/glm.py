@@ -105,6 +105,23 @@ def _link_fns(link: str):
     raise ValueError(f"unknown link {link!r}")
 
 
+def _tweedie_link(stage) -> str:
+    """The ONE resolution of a tweedie stage's power link: an explicit
+    ``power:<lp>`` string (as persisted on fitted models) passes
+    through; otherwise linkPower, defaulting to 1 − variancePower."""
+    link = stage.getLink()
+    if link is not None:
+        if not link.startswith("power:"):
+            raise ValueError(
+                "family='tweedie' uses linkPower, not link (Spark)"
+            )
+        return link
+    lp = stage.getLinkPower()
+    if lp is None:
+        lp = 1.0 - float(stage.getVariancePower())
+    return f"power:{float(lp)}"
+
+
 def _variance(family: str, mu, var_power: float = 0.0):
     if family == "gaussian":
         return jnp.ones_like(mu)
@@ -268,6 +285,7 @@ class _GlrParams:
     linkPower = Param(
         "tweedie link power (None -> 1 - variancePower; 0 means log)",
         default=None,
+        validator=lambda v: v is None or isinstance(v, (int, float)),
     )
     fitIntercept = Param("fit an intercept", default=True,
                          validator=validators.is_bool())
@@ -303,15 +321,10 @@ class GeneralizedLinearRegression(_GlrParams, Estimator):
     def _resolved_link(self) -> str:
         family = self.getFamily()
         if family == "tweedie":
-            # tweedie ignores `link` and uses linkPower (Spark [U])
-            if self.getLink() is not None:
-                raise ValueError(
-                    "family='tweedie' uses linkPower, not link (Spark)"
-                )
-            lp = self.getLinkPower()
-            if lp is None:
-                lp = 1.0 - float(self.getVariancePower())
-            return f"power:{float(lp)}"
+            # tweedie ignores named links and uses linkPower (Spark [U]);
+            # a persisted "power:<lp>" (from a fitted model's params)
+            # passes through so clone-and-refit works
+            return _tweedie_link(self)
         link = self.getLink() or _DEFAULT_LINK[family]
         if link not in _LINKS:
             raise ValueError(f"unknown link {link!r}; one of {_LINKS}")
@@ -423,10 +436,7 @@ def _model_link(stage) -> str:
         return link
     fam = stage.getFamily()
     if fam == "tweedie":
-        lp = stage.getLinkPower()
-        if lp is None:
-            lp = 1.0 - float(stage.getVariancePower())
-        return f"power:{float(lp)}"
+        return _tweedie_link(stage)
     return _DEFAULT_LINK[fam]
 
 

@@ -89,6 +89,60 @@ def _estimator_pool(rng, label):
     return rng.choice(pool)()
 
 
+def _regressor_pool(rng):
+    from sntc_tpu.models import (
+        DecisionTreeRegressor, GBTRegressor, GeneralizedLinearRegression,
+        LinearRegression, RandomForestRegressor,
+    )
+
+    return rng.choice([
+        lambda: LinearRegression(featuresCol="f3", labelCol="target",
+                                 maxIter=15),
+        lambda: GeneralizedLinearRegression(
+            featuresCol="f3", labelCol="target", family="gaussian",
+            maxIter=10),
+        lambda: DecisionTreeRegressor(featuresCol="f3", labelCol="target",
+                                      maxDepth=3),
+        lambda: RandomForestRegressor(featuresCol="f3", labelCol="target",
+                                      numTrees=3, maxDepth=3),
+        lambda: GBTRegressor(featuresCol="f3", labelCol="target",
+                             maxIter=3, maxDepth=2),
+    ])()
+
+
+@pytest.mark.parametrize("trial", range(6))
+def test_random_regression_pipeline(mesh8, tmp_path, trial):
+    rng = np.random.default_rng(2000 + trial)
+    f, d = _random_frame(rng, int(rng.integers(150, 300)))
+    target = (
+        np.asarray(f["features"])[:, 1] * 0.7
+        + rng.normal(size=f.num_rows).astype(np.float32) * 0.1
+    ).astype(np.float64)
+    f = f.with_column("target", target)
+
+    stages = [_scaler_pool(rng)]
+    mid = _middle_pool(rng, d)
+    if mid is None:
+        from sntc_tpu.feature import VarianceThresholdSelector
+
+        mid = VarianceThresholdSelector(
+            featuresCol="f2", outputCol="f3", varianceThreshold=0.0
+        )
+    stages.extend([mid, _regressor_pool(rng)])
+
+    model = Pipeline(stages=stages).fit(f)
+    pred = np.asarray(model.transform(f)["prediction"], np.float64)
+    assert pred.shape == (f.num_rows,)
+    assert np.isfinite(pred).all(), f"non-finite predictions (trial {trial})"
+
+    path = str(tmp_path / f"rpipe_{trial}")
+    save_model(model, path)
+    np.testing.assert_array_equal(
+        np.asarray(load_model(path).transform(f)["prediction"]), pred,
+        err_msg=f"persistence changed predictions (trial {trial})",
+    )
+
+
 @pytest.mark.parametrize("trial", range(N_TRIALS))
 def test_random_pipeline_composition(mesh8, tmp_path, trial):
     rng = np.random.default_rng(1000 + trial)

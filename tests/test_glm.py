@@ -221,3 +221,26 @@ def test_tweedie_default_link_power_and_validation(mesh8):
         ).fit(Frame({"features": X, "label": np.zeros(300, np.float32)}))
     with pytest.raises(ValueError):
         GeneralizedLinearRegression(family="tweedie", variancePower=0.5)
+
+
+def test_tweedie_clone_params_refit(mesh8):
+    """GeneralizedLinearRegression(**fitted_model.paramValues()).fit —
+    the clone-and-refit idiom — must work for tweedie (the persisted
+    'power:<lp>' link passes through resolution)."""
+    from sntc_tpu.models import GeneralizedLinearRegression
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(400, 2)).astype(np.float32)
+    y = np.exp(0.4 * X[:, 0] + 0.5).astype(np.float32)
+    f = Frame({"features": X, "label": y})
+    m = GeneralizedLinearRegression(
+        family="tweedie", variancePower=1.5, linkPower=0.0, maxIter=30,
+    ).fit(f)
+    clone = GeneralizedLinearRegression(
+        **{k: v for k, v in m.paramValues().items()
+           if GeneralizedLinearRegression().hasParam(k)}
+    )
+    m2 = clone.fit(f)
+    np.testing.assert_allclose(m2.coefficients, m.coefficients, atol=1e-6)
+    with pytest.raises(ValueError, match="failed validation"):
+        GeneralizedLinearRegression(family="tweedie", linkPower="log")
