@@ -53,6 +53,24 @@ def _git_sha() -> str:
         return "unknown"
 
 
+def _git_dirty() -> bool:
+    """True when the working tree differs from HEAD — journal provenance
+    (a run at sha X with uncommitted changes is NOT the code at X; the
+    08:02Z 2026-07-31 gmm rows were exactly that case)."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "-C", REPO, "status", "--porcelain"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout
+        return any(
+            not line.startswith("??") for line in out.splitlines()
+        )
+    except Exception:
+        return True
+
+
 def _journal_run(cfg: str, line: dict) -> None:
     """Append the full machine-written record of this invocation to the
     COMMITTED ``bench_runs.jsonl`` — the auditable raw evidence behind
@@ -63,6 +81,7 @@ def _journal_run(cfg: str, line: dict) -> None:
     record = {
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "git_sha": _git_sha(),
+        "git_dirty": _git_dirty(),
         "config": cfg,
         "bench_rows_env": os.environ.get("BENCH_ROWS"),
         **line,
@@ -325,6 +344,183 @@ BENCHES = {
     "4": bench_config4,
     "5": bench_config5,
 }
+
+
+# ---------------------------------------------------------------------------
+# --families: comparative wall-clocks for the breadth families (KMeans /
+# GaussianMixture / LDA vs their sklearn equivalents on this host; ALS
+# has no sklearn analog and reports ours alone).  One JSON line per
+# family, journaled like the configs — the evidence that the beyond-
+# survey estimators are not just present but fast.
+# ---------------------------------------------------------------------------
+
+def bench_families(rows, mesh):
+    import jax
+
+    rng = np.random.default_rng(SEED)
+    platform = jax.devices()[0].platform
+    lines = []
+
+    def emit(name, ours_cold, ours_warm, sk_s, quality):
+        line = {
+            "metric": f"{name}_fit_wall_clock",
+            "value": round(ours_warm, 3),
+            "unit": "s",
+            "vs_baseline": (
+                round(sk_s / ours_warm, 2) if sk_s is not None else None
+            ),
+            "cold_value": round(ours_cold, 3),
+            "sklearn_s": round(sk_s, 3) if sk_s is not None else None,
+            "platform": platform,
+            "baseline": (
+                "sklearn (same host, 1 core)" if sk_s is not None else None
+            ),
+            **quality,
+        }
+        lines.append(line)
+        return line
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        out = fn()
+        return out, time.perf_counter() - t0
+
+    # ---- KMeans: 200k x 78 flow-shaped rows, k=8 ---------------------------
+    from sklearn.cluster import KMeans as SkKMeans
+
+    from sntc_tpu.core.frame import Frame
+    from sntc_tpu.models import KMeans
+
+    n_km = min(rows, 200_000)
+    Xk = rng.lognormal(0.5, 1.2, size=(n_km, 78)).astype(np.float32)
+    fk = Frame({"features": Xk})
+
+    def fit_km():
+        return KMeans(mesh=mesh, k=8, maxIter=20, seed=SEED).fit(fk)
+
+    m_cold, t_cold = timed(fit_km)
+    m_warm, t_warm = timed(fit_km)
+    Xk64 = Xk.astype(np.float64)  # outside the timer: dtype conversion
+    # is not model fitting (ours gets a pre-built Frame too)
+    sk, t_sk = timed(
+        lambda: SkKMeans(
+            n_clusters=8, n_init=1, max_iter=20, random_state=SEED,
+            algorithm="lloyd",
+        ).fit(Xk64)
+    )
+    emit(
+        "kmeans_200k", t_cold, t_warm, t_sk,
+        {
+            "n_rows": n_km,
+            "inertia_ratio": round(
+                m_warm.summary.trainingCost / max(sk.inertia_, 1e-9), 4
+            ),
+        },
+    )
+
+    # ---- GaussianMixture: 50k x 20, k=5 full covariance --------------------
+    from sklearn.mixture import GaussianMixture as SkGMM
+
+    from sntc_tpu.models import GaussianMixture
+
+    n_gm = min(rows, 50_000)
+    centers = rng.normal(size=(5, 20)) * 4
+    Xg = (
+        centers[rng.integers(0, 5, n_gm)]
+        + rng.normal(size=(n_gm, 20))
+    ).astype(np.float32)
+    fg = Frame({"features": Xg})
+
+    def fit_gm():
+        return GaussianMixture(k=5, maxIter=30, seed=SEED, tol=1e-3).fit(fg)
+
+    g_cold, t_cold = timed(fit_gm)
+    g_warm, t_warm = timed(fit_gm)
+    Xg64 = Xg.astype(np.float64)
+    sk_g, t_sk = timed(
+        lambda: SkGMM(
+            n_components=5, covariance_type="full", max_iter=30,
+            tol=1e-3, n_init=1, random_state=SEED,
+        ).fit(Xg64)
+    )
+    emit(
+        "gmm_50k", t_cold, t_warm, t_sk,
+        {
+            "n_rows": n_gm,
+            # summary.logLikelihood is already the weighted MEAN
+            # (gaussian_mixture.py e_step) — directly comparable to
+            # sklearn's .score()
+            "our_mean_ll": round(float(g_warm.summary.logLikelihood), 4),
+            "sk_mean_ll": round(float(sk_g.score(Xg64)), 4),
+        },
+    )
+
+    # ---- LDA: 5k docs x 1k vocab, k=10 online VB ---------------------------
+    from sklearn.decomposition import LatentDirichletAllocation as SkLDA
+
+    from sntc_tpu.models import LDA
+
+    n_docs, vocab, k_t = min(rows // 40, 5_000), 1_000, 10
+    beta = rng.dirichlet([0.05] * vocab, size=k_t)
+    theta = rng.dirichlet([0.3] * k_t, size=n_docs)
+    Xl = np.zeros((n_docs, vocab), np.float32)
+    for d0 in range(0, n_docs, 1_000):
+        d1 = min(d0 + 1_000, n_docs)
+        probs = theta[d0:d1] @ beta
+        Xl[d0:d1] = np.stack(
+            [rng.multinomial(120, probs[i]) for i in range(d1 - d0)]
+        )
+    fl = Frame({"features": Xl})
+
+    # ours: 20 minibatches of 10% ≈ sklearn's 2 online epochs (batch 500)
+    def fit_lda():
+        return LDA(
+            mesh=mesh, k=k_t, maxIter=20, subsamplingRate=0.1, seed=SEED,
+        ).fit(fl)
+
+    _, t_cold = timed(fit_lda)
+    l_warm, t_warm = timed(fit_lda)
+    Xl64 = Xl.astype(np.float64)
+    sk_l, t_sk = timed(
+        lambda: SkLDA(
+            n_components=k_t, learning_method="online", batch_size=500,
+            max_iter=2, random_state=SEED,
+        ).fit(Xl64)
+    )
+    emit(
+        "lda_5k_online", t_cold, t_warm, t_sk,
+        {
+            "n_rows": n_docs,
+            "our_log_perplexity": round(l_warm.logPerplexity(fl), 4),
+            "sk_log_perplexity": round(
+                float(np.log(sk_l.perplexity(Xl64))), 4
+            ),
+        },
+    )
+
+    # ---- ALS: 500k implicit ratings, rank 16 (no sklearn analog) -----------
+    from sntc_tpu.models import ALS
+
+    n_r = 500_000  # fixed workload — not scaled by --rows (the other
+    # families use rows; ALS cost scales with ratings, not matrix rows)
+    users = rng.integers(0, 20_000, n_r)
+    items = rng.integers(0, 2_000, n_r)
+    ratings = rng.integers(1, 6, n_r).astype(np.float32)
+    fa = Frame({"user": users, "item": items, "rating": ratings})
+
+    def fit_als():
+        return ALS(
+            mesh=mesh, rank=16, maxIter=5, regParam=0.05,
+            implicitPrefs=True, seed=SEED,
+        ).fit(fa)
+
+    a_cold, t_cold = timed(fit_als)
+    _, t_warm = timed(fit_als)
+    emit(
+        f"als_{n_r // 1000}k_implicit_r16", t_cold, t_warm, None,
+        {"n_rows": n_r, "n_users": 20_000, "n_items": 2_000},
+    )
+    return lines
 
 
 # ---------------------------------------------------------------------------
@@ -724,6 +920,12 @@ def main():
         "the MLP LBFGS fit (f32 AND bf16) + the Pallas histogram kernel",
     )
     ap.add_argument(
+        "--families", action="store_true",
+        help="comparative wall-clocks for the breadth families (KMeans/"
+        "GMM/LDA vs sklearn on this host; ALS ours-only), one JSON "
+        "line each",
+    )
+    ap.add_argument(
         "--platform", default=os.environ.get("BENCH_PLATFORM"),
         help="force a JAX platform (e.g. 'cpu' for local validation when "
         "the TPU tunnel is unavailable); the host sitecustomize pins "
@@ -767,6 +969,16 @@ def main():
         )
         _journal_run("mfu", line)
         print(json.dumps(line), flush=True)
+        return
+
+    if args.families:
+        from sntc_tpu.parallel.context import get_default_mesh
+
+        for line in bench_families(
+            args.rows or 200_000, get_default_mesh()
+        ):
+            _journal_run(f"family:{line['metric']}", line)
+            print(json.dumps(line), flush=True)
         return
 
     # flagship (config 2) last so the driver's final line is the headline

@@ -34,6 +34,7 @@ QUEUE=(
   "BENCH_ROWS=2800000 timeout 3600 python bench.py --config 2"
   "BENCH_ROWS=2800000 timeout 3600 python bench.py --config 4"
   "BENCH_ROWS=2800000 timeout 5400 python bench.py --config 3"
+  "timeout 1800 python bench.py --families"
 )
 
 pos=$(cat "$POS_FILE" 2>/dev/null || echo 0)

@@ -115,7 +115,13 @@ def _tweedie_link(stage) -> str:
             raise ValueError(
                 "family='tweedie' uses linkPower, not link (Spark)"
             )
-        return link
+        try:
+            return f"power:{float(link[6:])}"  # validate + normalize
+        except ValueError:
+            raise ValueError(
+                f"malformed tweedie power link {link!r} (expected "
+                "'power:<float>')"
+            ) from None
     lp = stage.getLinkPower()
     if lp is None:
         lp = 1.0 - float(stage.getVariancePower())
