@@ -64,8 +64,16 @@ def _git_dirty() -> bool:
             ["git", "-C", REPO, "status", "--porcelain"],
             capture_output=True, text=True, timeout=10,
         ).stdout
+        # append-only evidence files are not code: the journal's own
+        # append must not flag the rest of a multi-line run as dirty
+        evidence = (
+            "bench_runs.jsonl", "tpu_probe_log.jsonl",
+            "tpu_queue_log.jsonl", "PROGRESS.jsonl", "baseline_proxy.json",
+        )
         return any(
-            not line.startswith("??") for line in out.splitlines()
+            not line.startswith("??")
+            and not line.strip().endswith(evidence)
+            for line in out.splitlines()
         )
     except Exception:
         return True
