@@ -51,6 +51,39 @@ def _device_cache_max_bytes() -> int:
     return int(os.environ.get("SNTC_DEVICE_CACHE_MB", "2048")) * (1 << 20)
 
 
+def _spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh includes devices of OTHER processes — the
+    multi-host case where plain ``device_put`` cannot build the global
+    array."""
+    if jax.process_count() == 1:
+        return False
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
+def _global_shard_put(arr_p, sharding):
+    """Multi-host construction of a row-sharded global array: every
+    process holds the FULL host array (single-host data plane, same on
+    all processes) and serves its addressable shards by slicing — the
+    ``make_array_from_callback`` path ``device_put`` cannot take across
+    processes.  A ``jax.Array`` input (a device-resident column from an
+    upstream stage) is resharded globally instead: fetching it to host
+    would fail when it spans non-addressable devices."""
+    if isinstance(arr_p, jax.Array):
+        return jax.device_put(arr_p, sharding)
+    return jax.make_array_from_callback(
+        arr_p.shape, sharding, lambda idx: np.asarray(arr_p[idx])
+    )
+
+
+def _put_sharded(arr, sharding):
+    """The one routing point: global construction when the mesh spans
+    processes, plain ``device_put`` otherwise."""
+    if _spans_processes(sharding.mesh):
+        return _global_shard_put(arr, sharding)
+    return jax.device_put(arr, sharding)
+
+
 def _cached_shard_put(arr, n_pad: int, sharding):
     """Pad ``arr`` to ``n_pad`` rows (replicating row 0) and device_put it
     under ``sharding``, memoized on the identity of the UNPADDED array."""
@@ -87,7 +120,7 @@ def _cached_shard_put(arr, n_pad: int, sharding):
             arr_p = np.concatenate([arr, pad_block], axis=0)
     else:
         arr_p = arr
-    dev = jax.device_put(arr_p, sharding)
+    dev = _put_sharded(arr_p, sharding)
     if cacheable:
         try:
             ref = weakref.ref(arr)
@@ -143,7 +176,7 @@ def shard_batch(mesh: Mesh, *arrays: np.ndarray, axis_name: str = DATA_AXIS):
         out.append(_cached_shard_put(arr, n_pad, sharding))
     weights = np.zeros(n_pad, dtype=np.float32)
     weights[:n] = 1.0
-    out.append(jax.device_put(weights, NamedSharding(mesh, P(axis_name))))
+    out.append(_put_sharded(weights, NamedSharding(mesh, P(axis_name))))
     return tuple(out)
 
 
@@ -158,7 +191,7 @@ def shard_weights(
     weight column (user weights × padding mask in one array)."""
     w_pad = np.zeros(n_padded, dtype=np.float32)
     w_pad[: len(w)] = w
-    return jax.device_put(w_pad, NamedSharding(mesh, P(axis_name)))
+    return _put_sharded(w_pad, NamedSharding(mesh, P(axis_name)))
 
 
 def make_tree_aggregate(
