@@ -117,7 +117,19 @@ corr = float(
 assert corr > 0.95, corr
 acc = float((m.transform(f)["prediction"] == y).mean())
 assert acc > 0.9, acc
-print("FIT_OK", round(acc, 3), flush=True)
+
+# the TREE path too (a different collective shape: binned histogram
+# aggregation inside the grower, psum'd across processes)
+from sntc_tpu.models import DecisionTreeClassifier
+
+dt = DecisionTreeClassifier(mesh=mesh, maxDepth=3).fit(f)
+pred_col = dt.transform(f)["prediction"]
+dt_acc = float((pred_col == y).mean())
+assert dt_acc > 0.8, dt_acc
+dt_pred = np.asarray(pred_col, np.float32)[:64]
+both_dt = multihost_utils.process_allgather(dt_pred)
+assert np.array_equal(both_dt[0], both_dt[1])
+print("FIT_OK", round(acc, 3), round(dt_acc, 3), flush=True)
 """
 
 
