@@ -38,31 +38,55 @@ def _paths(ckpt_dir: str) -> Tuple[str, str]:
 
 
 def save_state(ckpt_dir: str, state: Dict, fingerprint: Dict) -> None:
+    from sntc_tpu.mlio.save_load import _orbax_save, payload_format
+
     os.makedirs(ckpt_dir, exist_ok=True)
     state_path, meta_path = _paths(ckpt_dir)
-    np.savez(
-        state_path, **{k2: np.asarray(v) for k2, v in state.items()}
-    )
+    host_state = {k2: np.asarray(v) for k2, v in state.items()}
+    if payload_format() == "orbax":
+        # SNTC_CHECKPOINT_FORMAT covers MID-FIT optimizer state too, not
+        # just model payloads (same env var, same meaning everywhere)
+        _orbax_save(state_path + ".orbax", host_state)
+        if os.path.exists(state_path):
+            os.remove(state_path)
+    else:
+        np.savez(state_path, **host_state)
+        # a stale orbax payload would shadow this save at load time
+        import shutil
+
+        if os.path.isdir(state_path + ".orbax"):
+            shutil.rmtree(state_path + ".orbax")
     with open(meta_path, "w") as f:
         json.dump(fingerprint, f)
 
 
 def load_state(ckpt_dir: str, fingerprint: Dict) -> Optional[Dict]:
+    from sntc_tpu.mlio.save_load import _orbax_load
+
     state_path, meta_path = _paths(ckpt_dir)
-    if not (os.path.exists(state_path) and os.path.exists(meta_path)):
+    orbax_path = state_path + ".orbax"
+    has_state = os.path.exists(state_path) or os.path.isdir(orbax_path)
+    if not (has_state and os.path.exists(meta_path)):
         return None
     with open(meta_path) as f:
         stored = json.load(f)
     if stored != fingerprint:
         return None  # different problem/hyperparams: ignore stale state
+    if os.path.isdir(orbax_path):
+        return _orbax_load(orbax_path)
     with np.load(state_path) as z:
         return {k2: z[k2] for k2 in z.files}
 
 
 def clear_state(ckpt_dir: str) -> None:
+    import shutil
+
     for p in _paths(ckpt_dir):
         if os.path.exists(p):
             os.remove(p)
+    orbax_path = _paths(ckpt_dir)[0] + ".orbax"
+    if os.path.isdir(orbax_path):
+        shutil.rmtree(orbax_path)
 
 
 def run_segmented(

@@ -89,11 +89,59 @@ def save_model(stage: PipelineStage, path: str) -> str:
 
     meta["params"] = params
     meta["extra"] = extra
+    payload = payload_format()
+    if arrays:
+        meta["payload"] = payload
     with open(os.path.join(path, "metadata.json"), "w") as f:
         json.dump(meta, f, cls=_NpEncoder, indent=1)
+    # re-saving over an old path must not leave the OTHER format's
+    # payload behind (load follows meta["payload"], but a stale file is
+    # still wrong on disk)
+    import shutil
+
+    npz_path = os.path.join(path, "data.npz")
+    orbax_path = os.path.join(path, "data.orbax")
+    if os.path.exists(npz_path) and not (arrays and payload == "npz"):
+        os.remove(npz_path)
+    if os.path.isdir(orbax_path) and not (arrays and payload == "orbax"):
+        shutil.rmtree(orbax_path)
     if arrays:
-        np.savez(os.path.join(path, "data.npz"), **arrays)
+        if payload == "orbax":
+            _orbax_save(orbax_path, arrays)
+        else:
+            np.savez(npz_path, **arrays)
     return path
+
+
+def payload_format() -> str:
+    """Array-payload backend: ``npz`` (default — one portable file) or
+    ``orbax`` (``SNTC_CHECKPOINT_FORMAT=orbax`` — the JAX-ecosystem
+    checkpointer SURVEY.md §5.4 names; async-capable, sharding-aware,
+    the right base for multi-host model dumps).  Loads auto-detect, so
+    repos can mix formats freely."""
+    fmt = os.environ.get("SNTC_CHECKPOINT_FORMAT", "npz")
+    if fmt not in ("npz", "orbax"):
+        raise ValueError(
+            f"SNTC_CHECKPOINT_FORMAT={fmt!r}: expected 'npz' or 'orbax'"
+        )
+    return fmt
+
+
+def _orbax_save(path: str, arrays: Dict[str, np.ndarray]) -> None:
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(
+            os.path.abspath(path), dict(arrays), force=True
+        )
+
+
+def _orbax_load(path: str) -> Dict[str, np.ndarray]:
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        out = ckptr.restore(os.path.abspath(path))
+    return {k: np.asarray(v) for k, v in out.items()}
 
 
 def load_model(path: str) -> PipelineStage:
@@ -105,8 +153,13 @@ def load_model(path: str) -> PipelineStage:
     params = meta.get("params", {})
     extra = meta.get("extra", {})
     npz_path = os.path.join(path, "data.npz")
+    orbax_path = os.path.join(path, "data.orbax")
     arrays: Dict[str, np.ndarray] = {}
-    if os.path.exists(npz_path):
+    payload = meta.get("payload")  # absent in pre-orbax saves: sniff
+    if payload == "orbax" or (payload is None and os.path.isdir(orbax_path)):
+        if os.path.isdir(orbax_path):
+            arrays = _orbax_load(orbax_path)
+    elif os.path.exists(npz_path):
         with np.load(npz_path) as z:
             arrays = {k: z[k] for k in z.files}
 

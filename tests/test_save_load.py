@@ -99,3 +99,72 @@ def test_load_rejects_foreign_class(tmp_path):
         json.dump({"format_version": 1, "class": "os.path.join", "params": {}}, fh)
     with pytest.raises(ValueError, match="outside sntc_tpu"):
         load_model(path)
+
+
+def test_orbax_payload_roundtrip(mesh8, tmp_path, monkeypatch):
+    """SNTC_CHECKPOINT_FORMAT=orbax writes array payloads through the
+    JAX-ecosystem checkpointer (SURVEY.md §5.4 names orbax/npz); loads
+    auto-detect the format, so mixed-format repos interoperate."""
+    import numpy as np
+
+    from sntc_tpu.core.base import Pipeline
+    from sntc_tpu.core.frame import Frame
+    from sntc_tpu.feature import StandardScaler
+    from sntc_tpu.models import LogisticRegression
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(400, 5)).astype(np.float32)
+    y = (X[:, 1] > 0).astype(np.float64)
+    f = Frame({"features": X, "label": y})
+    model = Pipeline(stages=[
+        StandardScaler(inputCol="features", outputCol="scaled",
+                       withMean=True),
+        LogisticRegression(featuresCol="scaled", maxIter=10),
+    ]).fit(f)
+
+    monkeypatch.setenv("SNTC_CHECKPOINT_FORMAT", "orbax")
+    p = str(tmp_path / "orbax_pipe")
+    save_model(model, p)
+    # every stage dir carries the orbax payload, no npz anywhere
+    import glob as _glob
+    import os as _os
+
+    assert not _glob.glob(p + "/**/data.npz", recursive=True)
+    assert _glob.glob(p + "/**/data.orbax", recursive=True)
+
+    monkeypatch.setenv("SNTC_CHECKPOINT_FORMAT", "npz")  # loads autodetect
+    m2 = load_model(p)
+    np.testing.assert_array_equal(
+        np.asarray(m2.transform(f)["prediction"]),
+        np.asarray(model.transform(f)["prediction"]),
+    )
+    with pytest.raises(ValueError, match="SNTC_CHECKPOINT_FORMAT"):
+        monkeypatch.setenv("SNTC_CHECKPOINT_FORMAT", "zarr")
+        save_model(model, str(tmp_path / "bad"))
+
+
+def test_optimizer_checkpoint_orbax(tmp_path, monkeypatch):
+    """SNTC_CHECKPOINT_FORMAT=orbax covers MID-FIT optimizer state too
+    (same env var, same meaning as model payloads)."""
+    import numpy as np
+
+    from sntc_tpu.mlio.optimizer_checkpoint import (
+        clear_state, load_state, save_state,
+    )
+
+    state = {"x": np.arange(6, dtype=np.float32), "k": np.int32(3)}
+    fp = {"problem": "t", "d": 6}
+    d = str(tmp_path / "ck")
+    monkeypatch.setenv("SNTC_CHECKPOINT_FORMAT", "orbax")
+    save_state(d, state, fp)
+    back = load_state(d, fp)
+    np.testing.assert_array_equal(back["x"], state["x"])
+    assert int(back["k"]) == 3
+    assert load_state(d, {"problem": "other"}) is None  # fingerprint gate
+    # switching format and re-saving replaces the payload cleanly
+    monkeypatch.setenv("SNTC_CHECKPOINT_FORMAT", "npz")
+    save_state(d, {"x": state["x"] * 2, "k": np.int32(4)}, fp)
+    back2 = load_state(d, fp)
+    assert int(back2["k"]) == 4
+    clear_state(d)
+    assert load_state(d, fp) is None
