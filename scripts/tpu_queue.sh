@@ -40,6 +40,16 @@ QUEUE=(
 pos=$(cat "$POS_FILE" 2>/dev/null || echo 0)
 attempts=0
 
+# stop firing new runs before the driver's own end-of-round bench: the
+# tunnel serializes clients, so a queue run still holding it at round
+# end would starve the driver's BENCH_r03 capture.  Override/disable
+# with SNTC_QUEUE_DEADLINE_UTC (empty = no deadline).
+DEADLINE="${SNTC_QUEUE_DEADLINE_UTC:-2026-07-31T15:05:00Z}"
+past_deadline() {
+  [ -n "$DEADLINE" ] || return 1
+  [ "$(date -u +%s)" -ge "$(date -u -d "$DEADLINE" +%s)" ]
+}
+
 probe() {
   TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   RAW=$(timeout 180 python -c "
@@ -61,6 +71,10 @@ print('PROBE_OK', jax.devices()[0].platform, float((x @ x).sum()))
 }
 
 while [ "$pos" -lt "${#QUEUE[@]}" ]; do
+  if past_deadline; then
+    echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"item\": \"(deadline reached — queue handed off to probe loop)\", \"rc\": 0, \"on_tpu\": false, \"attempt\": 0, \"advanced\": false, \"output\": null}" >> $QLOG
+    break
+  fi
   if ! probe; then
     sleep 300
     continue
