@@ -53,11 +53,13 @@ def _grow_single_tree(estimator, X, y_or_stats, w, mesh, impurity):
         xs, ys, _ = shard_batch(mesh, X, y_or_stats)  # ys: float targets
         ws = shard_weights(mesh, w, xs.shape[0])
         row_stats = jnp.stack([ws, ws * ys, ws * ys * ys], axis=1)
+        label_kwargs = {}
     else:
         xs, ys, _ = shard_batch(mesh, X, y_or_stats.astype(np.int32))
         ws = shard_weights(mesh, w, xs.shape[0])
         k = int(y_or_stats.max()) + 1 if n else 2
         row_stats = _one_hot_stats(ys, ws, max(k, 2))
+        label_kwargs = {"row_label": ys, "row_weight": ws}
     binned = bin_features(xs, jnp.asarray(edges))
     w_trees = jax.device_put(
         np.ones((1, xs.shape[0]), np.float32),
@@ -73,6 +75,7 @@ def _grow_single_tree(estimator, X, y_or_stats, w, mesh, impurity):
         impurity=impurity,
         seed=estimator.getSeed(),
         mesh=mesh,
+        **label_kwargs,
     )
 
 

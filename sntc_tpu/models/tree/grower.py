@@ -260,11 +260,15 @@ def _level_core(
     row_stats,  # [N, S] f32 shared, or [T, N, S] per-tree (the vectorized
     #            one-vs-rest path: every "tree" is a different binary
     #            problem over the same binned features) — row-sharded
+    row_label,  # [N] int32 class ids or None (label-fused scatter path)
+    row_weight,  # [N] f32 row weights or None (with row_label)
     w_trees,  # [T, N] f32 bagging weights, sharded on N
     node_idx,  # [T, N] int32 (-1 = inactive), sharded on N
     key,  # PRNG key for feature subsetting
     min_instances,  # f32 scalar
     min_info_gain,  # f32 scalar
+    parent_hist,  # [T, n_nodes/2, F, B, S] previous level's histograms
+    #             (sibling-subtraction path) or None (direct)
     *,
     n_nodes: int,
     n_bins: int,
@@ -275,6 +279,7 @@ def _level_core(
     mesh=None,
     interpret: bool = False,
     route: bool = True,
+    keep_hist: bool = False,
 ):
     """One level's histogram + split evaluation + (optional) row routing,
     with the node axis evaluated in memory-bounded groups of ``group``
@@ -295,11 +300,11 @@ def _level_core(
 
     if n_nodes <= group:
         out = _eval_node_group(
-            binned, binned_t, row_stats, w_trees, node_idx, fmask,
-            min_instances,
+            binned, binned_t, row_stats, row_label, row_weight,
+            w_trees, node_idx, fmask, min_instances, parent_hist,
             lo=jnp.int32(0), g=n_nodes, n_bins=n_bins,
             impurity=impurity, hist_impl=hist_impl, mesh=mesh,
-            interpret=interpret,
+            interpret=interpret, keep_hist=keep_hist,
         )
     else:
         # groups share shapes (pow2 group divides the pow2 level), so the
@@ -312,10 +317,11 @@ def _level_core(
 
             def one(lo_t):
                 return _eval_node_group(
-                    binned, binned_t, row_stats, w_trees, node_idx, None,
-                    min_instances,
+                    binned, binned_t, row_stats, row_label, row_weight,
+                    w_trees, node_idx, None, min_instances, parent_hist,
                     lo=lo_t, g=group, n_bins=n_bins, impurity=impurity,
                     hist_impl=hist_impl, mesh=mesh, interpret=interpret,
+                    keep_hist=keep_hist,
                 )
         else:
             fmask_g = fmask.reshape(T, n_groups, group, F).transpose(
@@ -325,10 +331,11 @@ def _level_core(
 
             def one(a):
                 return _eval_node_group(
-                    binned, binned_t, row_stats, w_trees, node_idx, a[1],
-                    min_instances,
+                    binned, binned_t, row_stats, row_label, row_weight,
+                    w_trees, node_idx, a[1], min_instances, parent_hist,
                     lo=a[0], g=group, n_bins=n_bins, impurity=impurity,
                     hist_impl=hist_impl, mesh=mesh, interpret=interpret,
+                    keep_hist=keep_hist,
                 )
 
         stacked = jax.lax.map(one, args)  # each: [n_groups, T, group, ...]
@@ -365,7 +372,7 @@ def _level_core(
     else:
         new_node_idx = node_idx
 
-    return {
+    res = {
         "best_feat": best_feat,
         "best_bin": best_bin,
         "best_gain": best_gain,
@@ -377,11 +384,14 @@ def _level_core(
         "right_stats": out["right_stats"],
         "new_node_idx": new_node_idx,
     }
+    if keep_hist:
+        res["hist"] = out["hist"]
+    return res
 
 
 def _eval_node_group(
-    binned, binned_t, row_stats, w_trees, node_idx, fmask,
-    min_instances,
+    binned, binned_t, row_stats, row_label, row_weight,
+    w_trees, node_idx, fmask, min_instances, parent_hist,
     *,
     lo,  # traced int32 scalar: first node id of the group
     g: int,
@@ -390,19 +400,92 @@ def _eval_node_group(
     hist_impl: str,
     mesh,
     interpret: bool,
+    keep_hist: bool,
 ):
     """Histogram + best-split evaluation for the ``g`` nodes starting at
     level-local offset ``lo`` (a traced scalar, so a whole level's groups
     run as one ``lax.map``); rows whose node lies outside the group are
-    masked inactive (id −1), exactly like dead rows."""
+    masked inactive (id −1), exactly like dead rows.
+
+    With ``parent_hist`` (sibling-histogram subtraction — the
+    LightGBM/XGBoost trick, absent from Spark's DTStatsAggregator): only
+    the EVEN (left) children are histogrammed from rows; each odd sibling
+    is ``parent − left``, since a split parent's rows partition exactly
+    into its two children.  Halves the histogram width every level below
+    the root — the dominant cost on the MXU one-hot path, and half the
+    group passes on the segment path.  Children of non-split parents
+    derive garbage (parent − 0) but are masked by ``exists_lvl`` in
+    :func:`_grow_fused` before any heap write, and no row routes there."""
+    n, F = binned.shape
+    S = row_stats.shape[-1]
+    T = w_trees.shape[0]
+
+    if parent_hist is not None and g >= 2:
+        ids_even = jnp.where(
+            (node_idx >= lo) & (node_idx < lo + g) & ((node_idx & 1) == 0),
+            (node_idx - lo) >> 1, -1,
+        )
+        h_even = _group_hist(
+            binned, binned_t, row_stats, row_label, row_weight, w_trees,
+            ids_even, g_eff=g // 2, n_bins=n_bins, hist_impl=hist_impl,
+            mesh=mesh, interpret=interpret,
+        )
+        par = jax.lax.dynamic_slice(
+            parent_hist, (0, lo // 2, 0, 0, 0),
+            (T, g // 2, F, n_bins, S),
+        )
+        # exact for integer-valued weights (Poisson bagging, unit rows:
+        # small-int f32 sums); with a fractional weightCol the
+        # subtraction carries ~1-ulp f32 rounding — same class of noise
+        # as any reduction reorder.  For non-negative class-count stats
+        # the clamp keeps a true-zero sibling cell from surfacing as a
+        # tiny negative count/probability; variance stats ([w, wy, wy²])
+        # are legitimately signed in wy, so they must NOT be clamped.
+        h_odd = par - h_even
+        if impurity in ("gini", "entropy"):
+            h_odd = jnp.maximum(h_odd, 0.0)
+        hist = jnp.stack([h_even, h_odd], axis=2).reshape(
+            T, g, F, n_bins, S
+        )
+    else:
+        ids = jnp.where(
+            (node_idx >= lo) & (node_idx < lo + g), node_idx - lo, -1
+        )
+        hist = _group_hist(
+            binned, binned_t, row_stats, row_label, row_weight, w_trees,
+            ids, g_eff=g, n_bins=n_bins, hist_impl=hist_impl, mesh=mesh,
+            interpret=interpret,
+        )
+
+    out = _eval_from_hist(hist, fmask, min_instances, impurity=impurity)
+    if keep_hist:
+        out["hist"] = hist
+    return out
+
+
+def _group_hist(
+    binned, binned_t, row_stats, row_label, row_weight, w_trees,
+    node_idx,  # [T, N] int32 GROUP-LOCAL ids in [0, g_eff) (-1 = dead)
+    *,
+    g_eff: int,
+    n_bins: int,
+    hist_impl: str,
+    mesh,
+    interpret: bool,
+):
+    """Histogram ``[T, g_eff, F, B, S]`` over pre-mapped local node ids.
+
+    Three impls: the pallas MXU one-hot matmul (TPU), the label-fused
+    scalar ``segment_sum`` (classification with shared one-hot stats —
+    scatters N scalars into ``(node·B + bin)·S + label`` instead of N×S
+    vector rows, ~6× less scatter traffic; requires
+    ``row_stats == one_hot(row_label) * row_weight[:, None]``), and the
+    generic vector ``segment_sum``."""
     n, F = binned.shape
     S = row_stats.shape[-1]
     T = w_trees.shape[0]
     per_tree_stats = row_stats.ndim == 3
-    n_nodes = g  # group-local histogram width
-    node_idx = jnp.where(
-        (node_idx >= lo) & (node_idx < lo + g), node_idx - lo, -1
-    )
+    n_nodes = g_eff  # group-local histogram width
 
     # ---- histogram: [T, nodes, F, B, S] ------------------------------------
     if hist_impl == "pallas":
@@ -443,20 +526,48 @@ def _eval_node_group(
             out_specs=P(),
             check_vma=False,  # pallas_call outputs carry no vma metadata
         )(binned_t, row_stats, w_trees, node_idx)
+    elif (
+        row_label is not None
+        and row_weight is not None
+        and not per_tree_stats
+    ):
+        # label-fused scalar scatter: one weight per row lands directly in
+        # its (node, bin, class) cell.  The scan runs over ``binned_t``
+        # rows so each feature's bins are a CONTIGUOUS [N] slab (a
+        # ``binned[:, f]`` column gather is stride-F and dominated the
+        # level cost on CPU: 2.0 s → 0.70 s at the depth-10 bench shapes)
+        def hist_one_scalar(w_t, node_t):
+            wv = jnp.where(node_t >= 0, w_t * row_weight, 0.0)
+            base = (
+                jnp.where(node_t >= 0, node_t, 0) * (n_bins * S) + row_label
+            )
+
+            def per_feature(carry, col):
+                h = jax.ops.segment_sum(
+                    wv, base + col * S, num_segments=n_nodes * n_bins * S
+                )
+                return carry, h.reshape(n_nodes * n_bins, S)
+
+            _, hists = jax.lax.scan(per_feature, 0, binned_t)
+            return hists  # [F, nodes*B, S]
+
+        hists = jax.lax.map(
+            lambda args: hist_one_scalar(*args), (w_trees, node_idx)
+        )  # [T, F, nodes*B, S]
     else:
         def hist_one(w_t, node_t, rs_t):
             active = (node_t >= 0).astype(rs_t.dtype)
             ids = jnp.where(node_t >= 0, node_t, 0)
             data = rs_t * (w_t * active)[:, None]
 
-            def per_feature(carry, f):
-                seg = ids * n_bins + binned[:, f]
+            def per_feature(carry, col):
+                seg = ids * n_bins + col
                 h = jax.ops.segment_sum(
                     data, seg, num_segments=n_nodes * n_bins
                 )
                 return carry, h
 
-            _, hists = jax.lax.scan(per_feature, 0, jnp.arange(F))
+            _, hists = jax.lax.scan(per_feature, 0, binned_t)
             return hists  # [F, nodes*B, S]
 
         if per_tree_stats:
@@ -468,7 +579,12 @@ def _eval_node_group(
                 lambda args: hist_one(args[0], args[1], row_stats),
                 (w_trees, node_idx),
             )  # [T, F, nodes*B, S]
-    hist = hists.reshape(T, F, n_nodes, n_bins, S).transpose(0, 2, 1, 3, 4)
+    return hists.reshape(T, F, n_nodes, n_bins, S).transpose(0, 2, 1, 3, 4)
+
+
+def _eval_from_hist(hist, fmask, min_instances, *, impurity):
+    """Best-split evaluation over a group histogram [T, g, F, B, S]."""
+    T, n_nodes, F, n_bins, S = hist.shape
 
     # ---- split evaluation --------------------------------------------------
     cum = jnp.cumsum(hist, axis=3)  # left stats for split at bin b
@@ -540,6 +656,8 @@ def grow_forest(
     seed: int,
     mesh=None,
     hist_impl: str = None,
+    row_label=None,  # [N] int32 (device, row-sharded): class ids
+    row_weight=None,  # [N] f32 (device, row-sharded): per-row weights
 ) -> Forest:
     """Grow T trees level-synchronously; returns host-side dense heaps.
 
@@ -551,6 +669,22 @@ def grow_forest(
     Resolved PER LEVEL: deep levels whose node×bin width would overflow
     the kernel's VMEM budget fall back to segment_sum while shallow levels
     keep the MXU path.  Overridable via the ``SNTC_TREE_HIST`` env var.
+
+    ``row_label``/``row_weight``: classification callers whose
+    ``row_stats`` satisfy ``one_hot(row_label) * row_weight[:, None]``
+    pass both to unlock the label-fused scalar scatter (~6× less scatter
+    traffic than the [N, S] vector scatter on CPU/segment levels).
+
+    Sibling-histogram subtraction (LightGBM-style, beyond Spark's
+    DTStatsAggregator) engages per level when the NEXT level runs the
+    pallas one-hot kernel (where histogram cost ∝ node-axis width — the
+    matmul halves; a segment_sum scatter costs O(N) regardless, so on
+    CPU the kept-histogram traffic would be pure overhead) AND the
+    previous level's full histogram fits ``SNTC_TREE_SIBLING_MB``
+    (default 1024 MB): only left children are histogrammed from rows,
+    right siblings are derived as parent − left.
+    ``SNTC_TREE_SIBLING=0`` disables everywhere; ``=1`` forces it on
+    segment levels too (tests).
     """
     from sntc_tpu.ops.pallas_histogram import (
         hist_fits_pallas,
@@ -587,10 +721,10 @@ def grow_forest(
     if mesh is None:
         hist_impls = tuple("segment" for _ in hist_impls)
     interpret = not on_tpu
-    binned_t = (
-        jnp.transpose(binned) if "pallas" in hist_impls else
-        jnp.zeros((binned.shape[1], 1), jnp.int32)  # unused placeholder
-    )
+    # every histogram impl scans the transposed layout: contiguous
+    # per-feature bins (pallas lane layout; stride-F column gathers
+    # dominated CPU level cost otherwise)
+    binned_t = jnp.transpose(binned)
     T = w_trees.shape[0]
     S = row_stats.shape[-1]
     H = (1 << (max_depth + 1)) - 1
@@ -605,12 +739,48 @@ def grow_forest(
         return Forest(feature, threshold, leaf_stats, max_depth,
                       np.zeros((T, H), np.float32), np.zeros((T, H), np.float32))
 
+    # sibling subtraction: level d+1 can subtract iff level d's FULL
+    # histogram is worth keeping device-resident (size gate) and the
+    # group width admits (even, ≥2) left/right pairs.  Profitable ONLY
+    # on the pallas path, where histogram cost ∝ node-axis width (the
+    # one-hot matmul halves); a segment_sum scatter costs O(N) regardless
+    # of width, so on CPU the kept-histogram traffic is pure overhead
+    # (measured 2.1× slower at the depth-10 bench shapes).
+    # SNTC_TREE_SIBLING=1 forces it everywhere (tests), =0 disables.
+    sib_env = os.environ.get("SNTC_TREE_SIBLING", "")
+    sib_on = group >= 2 and sib_env in ("", "1")
+    sib_mb = float(os.environ.get("SNTC_TREE_SIBLING_MB", 1024))
+    per_node_hist_mb = (
+        T * binned.shape[1] * n_bins * S * 4 / (1024 * 1024)
+    )
+    keep_hists = tuple(
+        sib_on
+        and d < max_depth - 1
+        # the level that WOULD subtract (d+1) must be on the matmul path
+        and (hist_impls[d + 1] == "pallas" or sib_env == "1")
+        and (1 << d) * per_node_hist_mb <= sib_mb
+        for d in range(max_depth)
+    )
+
     keys = jax.random.split(jax.random.PRNGKey(seed), max_depth)
+    if os.environ.get("SNTC_TREE_LABEL_FUSED", "1") == "0":
+        row_label = row_weight = None  # field kill-switch: generic path
+    if row_label is not None:
+        # out-of-range labels (e.g. a -1 sentinel) must contribute ZERO,
+        # exactly like one_hot's out-of-range zero vector — a raw scatter
+        # of `label - 1`-style indices would corrupt a neighboring cell
+        row_label = row_label.astype(jnp.int32)
+        in_range = (row_label >= 0) & (row_label < S)
+        row_label = jnp.clip(row_label, 0, S - 1)
+        if row_weight is not None:
+            row_weight = jnp.where(in_range, row_weight, 0.0)
     out = _grow_fused(
-        binned, binned_t, row_stats, w_trees, jnp.asarray(edges), keys,
+        binned, binned_t, row_stats, row_label, row_weight, w_trees,
+        jnp.asarray(edges), keys,
         jnp.float32(min_instances_per_node), jnp.float32(min_info_gain),
         max_depth=max_depth, n_bins=n_bins, impurity=impurity,
-        subset_k=subset_k, group=group, hist_impls=hist_impls, mesh=mesh,
+        subset_k=subset_k, group=group, hist_impls=hist_impls,
+        keep_hists=keep_hists, mesh=mesh,
         interpret=interpret,
     )
     feature, threshold, leaf_stats, gain_arr, count_arr = (
@@ -624,14 +794,15 @@ def grow_forest(
     jax.jit,
     static_argnames=(
         "max_depth", "n_bins", "impurity", "subset_k", "group",
-        "hist_impls", "mesh", "interpret",
+        "hist_impls", "keep_hists", "mesh", "interpret",
     ),
 )
 def _grow_fused(
-    binned, binned_t, row_stats, w_trees, edges_dev, keys,
+    binned, binned_t, row_stats, row_label, row_weight, w_trees,
+    edges_dev, keys,
     min_instances, min_info_gain,
-    *, max_depth, n_bins, impurity, subset_k, group, hist_impls, mesh,
-    interpret,
+    *, max_depth, n_bins, impurity, subset_k, group, hist_impls,
+    keep_hists, mesh, interpret,
 ):
     """The WHOLE level-wise growth as one XLA program: the depth loop is
     unrolled at trace time, so every level keeps its exact node count
@@ -651,18 +822,22 @@ def _grow_fused(
     node_idx = jnp.zeros((T, n), jnp.int32)
     exists_lvl = jnp.ones((T, 1), bool)  # root exists
 
+    prev_hist = None
     for depth in range(max_depth):
         n_nodes = 1 << depth
         off = n_nodes - 1
         out = _level_core(
-            binned, binned_t, row_stats, w_trees, node_idx, keys[depth],
-            min_instances, min_info_gain,
+            binned, binned_t, row_stats, row_label, row_weight,
+            w_trees, node_idx, keys[depth],
+            min_instances, min_info_gain, prev_hist,
             n_nodes=n_nodes, n_bins=n_bins, impurity=impurity,
             subset_k=subset_k, group=group,
             hist_impl=hist_impls[depth], mesh=mesh,
             interpret=interpret,
             route=depth < max_depth - 1,
+            keep_hist=keep_hists[depth],
         )
+        prev_hist = out.get("hist")
         split_mask = out["do_split"] & exists_lvl
         leaf_mask = exists_lvl & ~split_mask
 

@@ -111,6 +111,7 @@ class RandomForestClassifier(_RfParams, ClassifierEstimator):
             impurity=self.getImpurity(),
             seed=self.getSeed(),
             mesh=mesh,
+            row_label=ys, row_weight=ws,  # label-fused scatter path
         )
         model = RandomForestClassificationModel(
             forest=forest, n_classes=k, n_features=F

@@ -591,6 +591,110 @@ def test_node_group_batching_identical_forest(mesh8, monkeypatch, subset):
         np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.parametrize("subset", ["all", "sqrt"])
+def test_sibling_subtraction_identical_forest(mesh8, monkeypatch, subset):
+    """Sibling-histogram subtraction (right child = parent − left) must
+    grow EXACTLY the forest the direct path grows: with integer-valued
+    Poisson bagging weights every histogram cell is an exact small-int
+    f32 sum, so the subtraction is exact and the forests are
+    bit-identical — including under memory-bounded node grouping (the
+    subtraction path slices the SAME parent histograms per group)."""
+    from sntc_tpu.models import RandomForestClassifier
+    from sntc_tpu.models.tree.grower import node_group_size
+
+    rng = np.random.default_rng(5)
+    n = 4000
+    X = rng.normal(size=(n, 12)).astype(np.float32)
+    y = ((X[:, 1] > 0) * 2 + (X[:, 4] > -0.3)).astype(np.float64)
+    f = Frame({"features": X, "label": y})
+
+    def grow():
+        m = RandomForestClassifier(
+            mesh=mesh8, numTrees=4, maxDepth=6, seed=0,
+            featureSubsetStrategy=subset,
+        ).fit(f)
+        fo = m.forest
+        return fo.feature.copy(), fo.threshold.copy(), fo.leaf_stats.copy()
+
+    monkeypatch.setenv("SNTC_TREE_SIBLING", "0")
+    direct = grow()
+    monkeypatch.setenv("SNTC_TREE_SIBLING", "1")  # force (CPU default: off)
+    sibling = grow()
+    for a, b in zip(direct, sibling):
+        np.testing.assert_array_equal(a, b)
+
+    # grouping invariance on the subtraction path itself: the budget must
+    # land group in [2, 32) — group=1 would disable sibling subtraction
+    # entirely and make this leg vacuous (direct == direct)
+    monkeypatch.setenv("SNTC_TREE_NODE_GROUP_MB", "0.5")
+    assert 2 <= node_group_size(4, 12, 32, 4) < 32
+    sibling_grouped = grow()
+    for a, b in zip(sibling, sibling_grouped):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sibling_subtraction_regression_signed_stats(mesh8, monkeypatch):
+    """Variance stats ([w, wy, wy²]) are signed in wy — the sibling path
+    must NOT clamp derived siblings at zero (a clamp would zero negative
+    residual sums and corrupt every TPU GBT/regressor fit).  Integer-
+    valued targets keep all sums exact, so direct and sibling forests
+    are bit-identical."""
+    from sntc_tpu.models import RandomForestRegressor
+
+    rng = np.random.default_rng(13)
+    n = 3000
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    # integer-valued, centered targets: wy sums go genuinely negative
+    y = (np.round(2 * X[:, 0]) - np.round(X[:, 3])).astype(np.float64)
+    f = Frame({"features": X, "label": y})
+
+    def grow():
+        m = RandomForestRegressor(
+            mesh=mesh8, numTrees=3, maxDepth=5, seed=0,
+            featureSubsetStrategy="all",
+        ).fit(f)
+        fo = m.forest
+        return fo.feature.copy(), fo.threshold.copy(), fo.leaf_stats.copy()
+
+    monkeypatch.setenv("SNTC_TREE_SIBLING", "0")
+    direct = grow()
+    monkeypatch.setenv("SNTC_TREE_SIBLING", "1")
+    sibling = grow()
+    for a, b in zip(direct, sibling):
+        np.testing.assert_array_equal(a, b)
+    # the planted negative-mean leaves really exist (guards vacuity)
+    leaf_wy = direct[2][..., 1][direct[0] == -1]
+    assert (leaf_wy < 0).any(), "no negative wy leaf — test lost its teeth"
+
+
+def test_label_fused_scatter_identical_forest(mesh8, monkeypatch):
+    """The label-fused scalar scatter (default for classification) must
+    produce EXACTLY the forest of the generic vector segment_sum path —
+    both accumulate the same integer-valued weights in row order, so the
+    comparison is bit-exact.  SNTC_TREE_LABEL_FUSED=0 is the field
+    kill-switch that forces the generic path."""
+    from sntc_tpu.models import RandomForestClassifier
+
+    rng = np.random.default_rng(9)
+    n = 3000
+    X = rng.normal(size=(n, 9)).astype(np.float32)
+    y = ((X[:, 0] > -0.5) * 2 + (X[:, 2] > 0.4)).astype(np.float64)
+    f = Frame({"features": X, "label": y})
+
+    def grow():
+        m = RandomForestClassifier(
+            mesh=mesh8, numTrees=3, maxDepth=5, seed=0
+        ).fit(f)
+        fo = m.forest
+        return fo.feature.copy(), fo.threshold.copy(), fo.leaf_stats.copy()
+
+    fused = grow()
+    monkeypatch.setenv("SNTC_TREE_LABEL_FUSED", "0")
+    generic = grow()
+    for a, b in zip(fused, generic):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_gbt_regressor_absolute_loss_wide_range_targets(mesh8):
     """Advisor r2 (medium): with lossType='absolute', the FIRST tree must
     fit the raw residuals with weight 1.0 (Spark boost()); the old
