@@ -10,9 +10,15 @@ Behavioral spec: upstream ``ml/clustering/LDA.scala`` →
 (V×k expected word-topic distribution), ``describeTopics``,
 ``transform`` → ``topicDistribution``, ``logLikelihood`` /
 ``logPerplexity`` (the variational ELBO bound, token-normalized for
-perplexity).  Spark's legacy "em" optimizer is not built — online is
-the recommended path and the only one whose statistics are minibatch
-matmuls (documented delta).
+perplexity).  ``optimizer`` selects "online" (default, as ml.LDA) or
+"em": full-corpus batch variational EM with Spark's EM hyperparameter
+defaults (docConcentration auto → (50/k)+1, topicConcentration auto →
+1.1 [U: ``EMLDAOptimizer``]) — every iteration E-steps ALL documents
+and sets λ = η + stat directly (no decay schedule).  Documented delta:
+Spark's EM is the GraphX collapsed-count implementation returning a
+``DistributedLDAModel``; ours is batch VB-EM over the same parameter
+surface returning the same ``LDAModel`` (deterministic, minibatch-free
+— the fixed point of the same variational objective).
 
 TPU design: one E-step is a jitted ``lax.while_loop`` over the WHOLE
 minibatch at once, MESH-SHARDED over documents — ``γ [b,k]``/``φ``
@@ -136,14 +142,18 @@ class _LdaParams:
         "output topic-mixture column", default="topicDistribution"
     )
     k = Param("number of topics", default=10, validator=validators.gt(1))
-    maxIter = Param("minibatch iterations", default=20,
-                    validator=validators.gt(0))
+    maxIter = Param(
+        "iterations (online: one minibatch each; em: one full-corpus "
+        "E+M step each)", default=20, validator=validators.gt(0),
+    )
     docConcentration = Param(
-        "α (None = auto 1/k)", default=None,
+        "α (None = auto: 1/k online, (50/k)+1 em — Spark per-optimizer "
+        "defaults)", default=None,
         validator=lambda v: v is None or v > 0,
     )
     topicConcentration = Param(
-        "η (None = auto 1/k)", default=None,
+        "η (None = auto: 1/k online, 1.1 em — Spark per-optimizer "
+        "defaults)", default=None,
         validator=lambda v: v is None or v > 0,
     )
     learningOffset = Param("τ₀ downweights early iterations", default=1024.0,
@@ -153,6 +163,10 @@ class _LdaParams:
     subsamplingRate = Param(
         "minibatch fraction per iteration, in (0, 1]", default=0.05,
         validator=lambda v: 0.0 < v <= 1.0,
+    )
+    optimizer = Param(
+        "online (minibatch VB) | em (full-corpus batch VB-EM)",
+        default="online", validator=validators.one_of("online", "em"),
     )
     seed = Param("random seed", default=0)
 
@@ -177,10 +191,14 @@ class LDA(_LdaParams, Estimator):
             raise ValueError("LDA requires non-negative counts")
         n_docs, v = X.shape
         k = int(self.getK())
+        em = self.getOptimizer() == "em"
         dc = self.getDocConcentration()
         tc = self.getTopicConcentration()
-        alpha = float(dc) if dc is not None else 1.0 / k
-        eta = float(tc) if tc is not None else 1.0 / k
+        # Spark's per-optimizer auto defaults [U: LDAOptimizer.initialize]
+        alpha = float(dc) if dc is not None else (
+            (50.0 / k) + 1.0 if em else 1.0 / k
+        )
+        eta = float(tc) if tc is not None else (1.1 if em else 1.0 / k)
         tau0 = float(self.getLearningOffset())
         kappa = float(self.getLearningDecay())
         frac = float(self.getSubsamplingRate())
@@ -190,15 +208,26 @@ class LDA(_LdaParams, Estimator):
 
         lam = rng.gamma(100.0, 1.0 / 100.0, size=(k, v)).astype(np.float64)
         for t in range(int(self.getMaxIter())):
-            idx = rng.choice(n_docs, size=batch, replace=False)
             elog_beta = psi(lam) - psi(lam.sum(axis=1, keepdims=True))
             key, sub = jax.random.split(key)
-            _, stat = _run_e_step(
-                mesh, X[idx], np.exp(elog_beta), alpha, sub, _MAX_E_ITERS
-            )
-            rho = (tau0 + t) ** (-kappa)
-            lam_hat = eta + (n_docs / batch) * np.asarray(stat, np.float64)
-            lam = (1.0 - rho) * lam + rho * lam_hat
+            if em:
+                # batch VB-EM: E-step the WHOLE corpus, set λ at the
+                # M-step fixed point — no minibatch scaling, no decay
+                _, stat = _run_e_step(
+                    mesh, X, np.exp(elog_beta), alpha, sub, _MAX_E_ITERS
+                )
+                lam = eta + np.asarray(stat, np.float64)
+            else:
+                idx = rng.choice(n_docs, size=batch, replace=False)
+                _, stat = _run_e_step(
+                    mesh, X[idx], np.exp(elog_beta), alpha, sub,
+                    _MAX_E_ITERS,
+                )
+                rho = (tau0 + t) ** (-kappa)
+                lam_hat = (
+                    eta + (n_docs / batch) * np.asarray(stat, np.float64)
+                )
+                lam = (1.0 - rho) * lam + rho * lam_hat
 
         model = LDAModel(lam=lam, alpha=alpha, eta=eta, numDocs=n_docs)
         model.setParams(**self.paramValues())

@@ -136,3 +136,42 @@ def test_validation_and_save_load(fitted, tmp_path):
     np.testing.assert_allclose(
         m2.transform(f)["prediction"], m.transform(f)["prediction"]
     )
+
+
+def test_nonnegative_factors_and_kkt():
+    """nonnegative=True must (a) produce factor matrices that are
+    elementwise >= 0 and (b) land each item factor at the KKT point of
+    its constrained ALS-WR system: free coordinates (x_j > 0) have zero
+    gradient, bound coordinates (x_j = 0) have non-negative gradient —
+    the defining optimality conditions of Spark's NNLS solves."""
+    rng = np.random.default_rng(4)
+    n_u, n_i, rank = 50, 35, 3
+    U = np.abs(rng.normal(size=(n_u, rank))) / np.sqrt(rank)
+    V = np.abs(rng.normal(size=(n_i, rank))) / np.sqrt(rank)
+    R = U @ V.T
+    mask = rng.random((n_u, n_i)) < 0.6
+    uu, ii = np.nonzero(mask)
+    r = (R[uu, ii] + 0.02 * rng.normal(size=len(uu))).astype(np.float32)
+    f = Frame({"user": uu, "item": ii, "rating": r})
+    lam = 0.02
+    m = ALS(rank=4, maxIter=10, regParam=lam, nonnegative=True, seed=3).fit(f)
+
+    uf = np.asarray(m.userFactors["features"], np.float64)
+    vf = np.asarray(m.itemFactors["features"], np.float64)
+    assert (uf >= 0).all() and (vf >= 0).all()
+
+    # KKT of the final (item) half-step
+    ulut = {int(i): j for j, i in enumerate(m.userIds)}
+    for col, iid in enumerate(np.asarray(m.itemIds)[:8]):
+        rows = np.nonzero(ii == iid)[0]
+        Um = np.stack([uf[ulut[int(uu[j])]] for j in rows])
+        A = Um.T @ Um + lam * len(rows) * np.eye(m.rank)
+        b = Um.T @ r[rows].astype(np.float64)
+        x = vf[col]
+        g = A @ x - b
+        assert (g[x > 1e-8] < 5e-3).all() and (g[x > 1e-8] > -5e-3).all()
+        assert (g[x <= 1e-8] > -5e-3).all()
+
+    # the constrained fit still reconstructs the planted nonneg matrix
+    pred = m.transform(Frame({"user": uu, "item": ii}))["prediction"]
+    assert float(np.sqrt(np.mean((pred - r) ** 2))) < 0.1

@@ -109,3 +109,28 @@ def test_save_load(corpus, fitted, tmp_path):
         fitted.transform(f)["topicDistribution"],
         atol=1e-6,
     )
+
+
+def test_em_optimizer_recovers_topics_deterministically(corpus):
+    """optimizer='em' (full-corpus batch VB-EM) must recover the planted
+    topics, apply Spark's EM auto-defaults (α=(50/k)+1, η=1.1), and be
+    deterministic (no minibatch sampling anywhere)."""
+    X, beta, _ = corpus
+    m = LDA(k=K, maxIter=15, optimizer="em", seed=1).fit(
+        Frame({"features": X})
+    )
+    assert m.alpha == pytest.approx(50.0 / K + 1.0)
+    assert m.eta == pytest.approx(1.1)
+    topics = m.topicsMatrix().T
+    used = set()
+    for t in range(K):
+        support = beta[t] > 0
+        mass = topics[:, support].sum(axis=1)
+        best = int(np.argmax(mass))
+        assert mass[best] > 0.85
+        used.add(best)
+    assert len(used) == K
+    m2 = LDA(k=K, maxIter=15, optimizer="em", seed=1).fit(
+        Frame({"features": X})
+    )
+    np.testing.assert_allclose(m2.lam, m.lam)
