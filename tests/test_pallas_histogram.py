@@ -69,6 +69,48 @@ def test_rf_identical_forest_under_pallas_hist(mesh8, monkeypatch):
     )
 
 
+def test_mixed_pallas_segment_levels_with_sibling(mesh8, monkeypatch):
+    """Depth deep enough that the widest levels overflow the pallas VMEM
+    gate and fall back to segment_sum while shallow levels keep the MXU
+    kernel — the exact mixed regime a real chip hits — with sibling
+    subtraction auto-gated per level (engages only where the NEXT level
+    is pallas).  The grown forest must equal the all-segment one.
+
+    Exact equality is safe, not flaky: Poisson bagging weights are
+    integer-valued, so every histogram cell is an exact small-int f32
+    sum on BOTH impls (the sibling subtraction parent − left is exact
+    on integers), identical cells feed the identical split-eval code,
+    and the gain argmaxes cannot diverge."""
+    from sntc_tpu.core.frame import Frame
+    from sntc_tpu.models import RandomForestClassifier
+    from sntc_tpu.ops.pallas_histogram import hist_fits_pallas
+
+    # depth 9 → level 8 has 256 nodes; 256·32 bins overflows the kernel
+    # budget, so levels 0–7 are pallas and level 8 is segment
+    assert hist_fits_pallas(128, 32) and not hist_fits_pallas(256, 32)
+
+    rng = np.random.default_rng(21)
+    n = 800
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    y = ((X[:, 0] > 0) * 2 + (X[:, 3] > 0.2)).astype(np.float64)
+    f = Frame({"features": X, "label": y})
+    kw = dict(mesh=mesh8, numTrees=2, maxDepth=9, seed=0,
+              featureSubsetStrategy="all")
+
+    monkeypatch.setenv("SNTC_TREE_HIST", "segment")
+    m_seg = RandomForestClassifier(**kw).fit(f)
+    monkeypatch.setenv("SNTC_TREE_HIST", "pallas")
+    m_mix = RandomForestClassifier(**kw).fit(f)
+
+    np.testing.assert_array_equal(
+        m_mix.forest.feature, m_seg.forest.feature
+    )
+    np.testing.assert_allclose(
+        m_mix.forest.leaf_stats, m_seg.forest.leaf_stats,
+        rtol=1e-5, atol=1e-5,
+    )
+
+
 def test_row_padding_contributes_zero():
     # n not a multiple of tile_n exercises the padding path
     n, f, s, n_nodes, n_bins = 130, 3, 2, 2, 4
