@@ -554,13 +554,16 @@ def bench_mfu(n_rows, mesh):
     """Measured FLOP/s vs chip peak for the two compute cores:
 
     (a) the flagship MLP LBFGS fit (configs 2): analytic gemm FLOPs —
-        fwd 2·N·Σ(fan_in·fan_out), bwd 2× that — times 2
-        objective+gradient evals per LBFGS iteration (1 Armijo accept +
-        1 gradient refresh; a LOWER bound when backtracking re-evals),
-        over the measured warm fit; run at BOTH computeDtype settings,
-        so the bf16-vs-f32 claim (mlp.py) is measured, not asserted;
+        fwd 2·N·Σ(fan_in·fan_out), bwd 2× that — ONE fused
+        value-and-grad eval per LBFGS iteration (the line search
+        carries the candidate gradient since `0218f3a`; a LOWER bound
+        when backtracking re-evals), over the measured warm fit; run
+        at BOTH computeDtype settings, so the bf16-vs-f32 claim
+        (mlp.py) is measured, not asserted;
     (b) the Pallas one-hot histogram kernel at config-3 level-pass
-        shapes: executed (padded) one-hot-matmul FLOPs over measured
+        shapes (classification stats S=15, the widest node width the
+        kernel's VMEM gate admits — the same shrink the grower
+        applies): executed (padded) one-hot-matmul FLOPs over measured
         kernel time — MXU-bound or not, in absolute terms.
     """
     import jax
@@ -595,7 +598,10 @@ def bench_mfu(n_rows, mesh):
 
         model, warm, cold = _timed_fit(build, feat)
         iters = model.summary.totalIterations
-        total_flops = flops_per_eval * 2.0 * iters
+        # one fused fwd+bwd per iteration at the typical immediate
+        # line-search accept (exact since the gradient-carry change;
+        # backtracking re-evals only add FLOPs, so MFU is a lower bound)
+        total_flops = flops_per_eval * float(iters)
         key = "f32" if dtype == "float32" else "bf16"
         out[f"mlp_{key}_fit_s"] = round(warm, 4)
         out[f"mlp_{key}_iters"] = iters
@@ -612,8 +618,18 @@ def bench_mfu(n_rows, mesh):
         level_histogram_pallas,
     )
 
-    F, B, S = CHISQ_TOP, 32, 3
-    n_nodes = 2 ** (RF_DEPTH - 1)  # deepest (widest) level
+    from sntc_tpu.models.tree.grower import node_group_size
+
+    F, B, S = CHISQ_TOP, 32, 15  # config-3 classification stats width
+    # the width a config-3 level pass really runs: the deepest level,
+    # capped by the grower's memory-bounded node group, shrunk until
+    # the kernel's VMEM gate admits it — the same resolution
+    # grow_forest applies on TPU
+    n_nodes = min(
+        2 ** (RF_DEPTH - 1), node_group_size(RF_TREES, F, B, S)
+    )
+    while n_nodes > 1 and not hist_fits_pallas(n_nodes, B):
+        n_nodes //= 2
     if hist_fits_pallas(n_nodes, B) and platform != "cpu":
         rng = np.random.default_rng(0)
         n_loc = min(N, 200_000)
