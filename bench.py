@@ -910,27 +910,6 @@ def run_config(cfg: str, rows):
     return line
 
 
-def _probe_default_backend() -> bool:
-    """True if the default JAX backend initializes within the timeout.
-
-    Probed in a SUBPROCESS: backend init on a hung TPU tunnel blocks
-    forever with no interruptible handle, so the only safe way to test it
-    is from a process we can kill (``BENCH_PROBE_TIMEOUT_S`` to tune, 0
-    disables the probe and trusts the backend)."""
-    import subprocess
-
-    timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 180))
-    if timeout_s <= 0:
-        return True
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
 
 
 def main():
@@ -965,17 +944,15 @@ def main():
         print(json.dumps({c: cache.get(c) for c in configs}))
         return
 
-    platform = args.platform
-    if not platform and not _probe_default_backend():
-        # the TPU tunnel can hang indefinitely inside jax.devices(); a
-        # hung bench records nothing — fall back to CPU, clearly labeled
-        # (the "platform" field in the output line shows what really ran)
-        print(
-            "bench: default JAX backend unreachable (probe timeout); "
-            "falling back to platform=cpu",
-            file=sys.stderr,
-        )
-        platform = "cpu"
+    # the TPU tunnel can hang indefinitely inside jax.devices(); a hung
+    # bench records nothing — shared probe+fallback policy
+    # (sntc_tpu.utils.backend_probe; the "platform" field in the output
+    # line shows what really ran; BENCH_PROBE_TIMEOUT_S overrides)
+    from sntc_tpu.utils.backend_probe import resolve_platform
+
+    platform = resolve_platform(
+        args.platform, specific_env="BENCH_PROBE_TIMEOUT_S"
+    )
     if platform:
         import jax
 

@@ -300,6 +300,8 @@ def cmd_synth(args) -> int:
 
 
 def main(argv=None) -> int:
+    from sntc_tpu.utils.backend_probe import add_platform_arg
+
     ap = argparse.ArgumentParser(
         prog="python -m sntc_tpu",
         description=__doc__.split("\n\n")[1],
@@ -314,6 +316,7 @@ def main(argv=None) -> int:
                        help="benign-vs-attack relabel (config 1 [B:7])")
         p.add_argument("--metric", default="macroF1")
         p.add_argument("--seed", type=int, default=0)
+        add_platform_arg(p)
 
     p = sub.add_parser("train", help="fit a pipeline, report held-out metric")
     common(p)
@@ -351,6 +354,7 @@ def main(argv=None) -> int:
     p.add_argument("--poll-interval", type=float, default=1.0)
     p.add_argument("--once", action="store_true",
                    help="drain available files and exit")
+    add_platform_arg(p)
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("synth", help="write schema-identical synthetic day CSVs")
@@ -361,6 +365,19 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_synth)
 
     args = ap.parse_args(argv)
+    # backend liveness: the default platform is a remote TPU tunnel
+    # that can hang forever inside jax.devices() when down — probe it
+    # from a killable subprocess and fall back to CPU rather than hang
+    # the user's terminal (--platform skips the probe; synth is
+    # numpy-only and needs neither)
+    if args.cmd != "synth":
+        from sntc_tpu.utils.backend_probe import resolve_platform
+
+        platform = resolve_platform(getattr(args, "platform", None))
+        if platform:
+            import jax
+
+            jax.config.update("jax_platforms", platform)
     # Spark pays no per-process compile; neither should a CLI user on
     # their second run (SURVEY.md §3.5 cold-start — docs/PARITY.md)
     from sntc_tpu.utils.compile_cache import enable_persistent_cache

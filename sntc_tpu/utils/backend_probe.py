@@ -1,0 +1,114 @@
+"""Default-backend liveness probe (SURVEY.md §0 environment reality).
+
+On this class of host the default JAX platform is a remote TPU tunnel
+that can HANG forever inside backend init (``jax.devices()``) when the
+tunnel is down — there is no interruptible handle, so the only safe
+test is a subprocess we can kill.  Both ``bench.py`` and the
+``python -m sntc_tpu`` CLI use this to fall back to CPU (clearly
+labeled) instead of hanging a user's terminal.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+_OK_MARKER = os.path.join(
+    os.path.expanduser("~"), ".cache", "sntc_tpu_probe_ok"
+)
+_OK_TTL_S = 300.0
+
+
+def probe_default_backend(
+    timeout_s: float | None = None, *, specific_env: str | None = None
+) -> bool:
+    """True if the default JAX backend initializes within the timeout.
+
+    Timeout resolution, specific-overrides-generic: ``specific_env``
+    (e.g. ``BENCH_PROBE_TIMEOUT_S``) when set, else
+    ``SNTC_PROBE_TIMEOUT_S``, else 180; ``0`` disables the probe and
+    trusts the backend.  A success is cached in a marker file for
+    5 minutes so repeated CLI calls on a healthy backend don't pay a
+    full subprocess backend init each time (failures are never cached —
+    a tunnel can come back any moment)."""
+    if timeout_s is None:
+        raw = None
+        if specific_env:
+            raw = os.environ.get(specific_env)
+        if raw is None:
+            raw = os.environ.get("SNTC_PROBE_TIMEOUT_S", 180)
+        timeout_s = float(raw)
+    if timeout_s <= 0:
+        return True
+    try:
+        if time.time() - os.path.getmtime(_OK_MARKER) < _OK_TTL_S:
+            return True
+    except OSError:
+        pass
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        ok = proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    if ok:
+        try:
+            os.makedirs(os.path.dirname(_OK_MARKER), exist_ok=True)
+            with open(_OK_MARKER, "w"):
+                pass
+        except OSError:
+            pass
+    return ok
+
+
+def add_platform_arg(parser) -> None:
+    """The shared ``--platform`` CLI argument."""
+    parser.add_argument(
+        "--platform", default=None,
+        help="force a JAX platform (e.g. 'cpu'); default probes the "
+        "backend and falls back to cpu if the TPU tunnel is unreachable",
+    )
+
+
+def resolve_platform(
+    requested: str | None, *, specific_env: str | None = None
+) -> str | None:
+    """The platform to force, or None to trust the default backend.
+
+    ``requested`` wins when given.  The probe is skipped only when this
+    process has ALREADY pinned a cpu-only platform (tests, embedding
+    callers) or already initialized a backend — NOT merely because
+    ``jax_platforms`` is set: the host sitecustomize pre-imports jax
+    with ``JAX_PLATFORMS=axon`` in every process, so a bare truthiness
+    test would disable the probe on exactly the hung-tunnel host class
+    it exists for."""
+    if requested:
+        return requested
+    if "jax" in sys.modules:
+        import jax
+
+        plats = jax.config.jax_platforms
+        if plats and all(
+            p.strip() == "cpu" for p in plats.split(",") if p.strip()
+        ):
+            return None  # cpu-only cannot hang; probing would be a stall
+        try:
+            from jax._src import xla_bridge
+
+            if getattr(xla_bridge, "_backends", None):
+                return None  # a live backend already initialized here
+        except Exception:
+            pass
+    if not probe_default_backend(specific_env=specific_env):
+        print(
+            "sntc_tpu: default JAX backend unreachable (probe timeout); "
+            "falling back to platform=cpu",
+            file=sys.stderr,
+        )
+        return "cpu"
+    return None
