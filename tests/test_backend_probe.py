@@ -1,0 +1,37 @@
+"""Backend-probe policy: requested platform wins, cpu-only in-process
+pins skip the probe (no 3-minute stall in tests/embedders), disabled
+probe trusts the backend."""
+
+import jax
+
+from sntc_tpu.utils.backend_probe import (
+    probe_default_backend,
+    resolve_platform,
+)
+
+
+def test_requested_platform_wins():
+    assert resolve_platform("cpu") == "cpu"
+    assert resolve_platform("tpu") == "tpu"
+
+
+def test_cpu_only_pin_skips_probe():
+    # conftest pins jax_platforms to cpu in-process: resolving must
+    # return instantly (no subprocess probe) and trust the pin
+    assert jax.config.jax_platforms and all(
+        p.strip() == "cpu" for p in jax.config.jax_platforms.split(",")
+    )
+    assert resolve_platform(None) is None
+
+
+def test_probe_disabled_trusts_backend():
+    assert probe_default_backend(timeout_s=0) is True
+
+
+def test_specific_env_overrides_generic(monkeypatch):
+    monkeypatch.setenv("SNTC_PROBE_TIMEOUT_S", "180")
+    monkeypatch.setenv("TOOL_PROBE_TIMEOUT_S", "0")
+    # the tool-specific 0 must win -> probe disabled -> instant True
+    assert (
+        probe_default_backend(specific_env="TOOL_PROBE_TIMEOUT_S") is True
+    )
