@@ -18,8 +18,8 @@ set):
     the ideal prefix, mllib's form.
 
 MultilabelClassificationEvaluator (prediction and label both label-set
-arrays): subsetAccuracy, accuracy (Jaccard mean; documented delta: a
-perfectly-predicted empty set scores 1.0 where Spark's 0/0 is NaN),
+arrays): subsetAccuracy, accuracy (Jaccard mean; a both-empty row is
+0/0 = NaN and poisons the mean, exactly as Spark's bare division does),
 hammingLoss (universe = distinct values of the LABEL column, mllib's
 ``numLabels``), document-averaged precision/recall/f1 (the mllib
 defaults), plus ``microPrecision``/``microRecall``/``microF1Measure``
@@ -136,11 +136,13 @@ class MultilabelClassificationEvaluator(Evaluator):
         if metric == "subsetAccuracy":
             return float(np.mean([p == l for p, l in zip(preds, labels)]))
         if metric == "accuracy":
-            # documented delta: an exactly-correct empty prediction
-            # scores 1.0 (consistent with subsetAccuracy) where Spark's
-            # 0/0 division yields NaN
+            # Spark MultilabelMetrics.accuracy is the mean Jaccard with a
+            # bare 0/0 division: a row where BOTH sets are empty yields
+            # NaN and poisons the mean — parity means reproducing that,
+            # not repairing it (the former 1.0 repair was the last
+            # documented evaluator delta, closed r5)
             return float(np.mean([
-                len(p & l) / len(p | l) if (p or l) else 1.0
+                len(p & l) / len(p | l) if (p or l) else float("nan")
                 for p, l in zip(preds, labels)
             ]))
         if metric == "hammingLoss":

@@ -16,9 +16,11 @@ per-iteration host involvement (the Spark driver runs its WLS solve per
 iteration on collected aggregates).
 
 Summary parity: ``model.summary`` carries deviance / nullDeviance /
-dispersion / residual degrees of freedom and ``totalIterations`` (the
-``GeneralizedLinearRegressionTrainingSummary`` core surface; AIC is not
-computed).
+dispersion / residual degrees of freedom / ``totalIterations`` and
+``aic`` — the R-family log-likelihood forms Spark mirrors (gaussian uses
+the closed form from the deviance; binomial treats weights as trial
+counts; gamma plugs the deviance-based dispersion), plus ``2·rank``.
+Tweedie has no AIC in Spark and raises, as upstream does.
 """
 
 from __future__ import annotations
@@ -260,6 +262,60 @@ def _irls(xs, ys, ws, beta0, *, family, link, fit_intercept, max_iter,
     return beta, n_iter, dev, dev0, pearson
 
 
+def _aic(family: str, y, mu, w, n: int, dev: float, rank: int) -> float:
+    """Spark's ``Family.aic`` + 2·rank (the R family $aic forms [U]).
+
+    Host-side float64 one-pass — a summary statistic, not a fit cost.
+    ``mu`` is the converged mean from the fitted linear predictor.
+    """
+    from scipy.special import gammaln
+
+    y = np.asarray(y, np.float64)
+    mu = np.asarray(mu, np.float64)
+    w = np.asarray(w, np.float64)
+    if family == "gaussian":
+        # closed form from the deviance; R gaussian()$aic incl. −Σ log w
+        ll2 = (
+            n * (np.log(dev / n * 2.0 * np.pi) + 1.0)
+            + 2.0
+            - float(np.sum(np.log(w)))
+        )
+        return float(ll2 + 2.0 * rank)
+    if family == "binomial":
+        # weights are trial counts: Binomial(round(w), μ) log-pmf of
+        # round(y·w) successes; weight-0 rows contribute 0 (Spark)
+        wt = np.round(w)
+        r = np.round(y * w)
+        mu_c = np.clip(mu, _MU_EPS, 1.0 - _MU_EPS)
+        logpmf = (
+            gammaln(wt + 1.0)
+            - gammaln(r + 1.0)
+            - gammaln(wt - r + 1.0)
+            + r * np.log(mu_c)
+            + (wt - r) * np.log1p(-mu_c)
+        )
+        ll = float(np.sum(np.where(wt == 0, 0.0, logpmf)))
+        return float(-2.0 * ll + 2.0 * rank)
+    if family == "poisson":
+        yi = np.floor(y)  # Poisson pmf is over integers (Spark y.toInt)
+        logpmf = yi * np.log(np.maximum(mu, _EPS)) - mu - gammaln(yi + 1.0)
+        return float(-2.0 * np.sum(w * logpmf) + 2.0 * rank)
+    if family == "gamma":
+        # dispersion from the deviance (Spark/R plug-in), shape 1/φ,
+        # scale μ·φ
+        disp = dev / float(np.sum(w))
+        shape = 1.0 / disp
+        scale = mu * disp
+        logpdf = (
+            (shape - 1.0) * np.log(y)
+            - y / scale
+            - gammaln(shape)
+            - shape * np.log(scale)
+        )
+        return float(-2.0 * np.sum(w * logpdf) + 2.0 + 2.0 * rank)
+    raise AssertionError(f"_aic called for unsupported family {family!r}")
+
+
 class _GlrParams:
     featuresCol = Param("feature vector column", default="features")
     labelCol = Param("target column", default="label")
@@ -300,7 +356,7 @@ class _GlrParams:
 
 class GeneralizedLinearRegressionTrainingSummary:
     def __init__(self, *, deviance, null_deviance, pearson, n, rank,
-                 family, total_iterations):
+                 family, total_iterations, aic=None):
         self.deviance = float(deviance)
         self.nullDeviance = float(null_deviance)
         self.residualDegreeOfFreedom = int(n - rank)
@@ -313,6 +369,21 @@ class GeneralizedLinearRegressionTrainingSummary:
             if family in ("binomial", "poisson")
             else float(pearson) / max(n - rank, 1)
         )
+        # a value, a zero-arg thunk (computed lazily like Spark's lazy
+        # val — most callers never read aic), or None (tweedie)
+        self._aic = aic
+
+    @property
+    def aic(self) -> float:
+        # Spark raises for tweedie (no AIC defined); mirror that instead
+        # of returning a junk number
+        if self._aic is None:
+            raise ValueError(
+                "No AIC available for the tweedie family (Spark parity)"
+            )
+        if callable(self._aic):
+            self._aic = float(self._aic())
+        return self._aic
 
     @property
     def objectiveHistory(self):  # API-compat shim (IRLS keeps no trace)
@@ -353,8 +424,10 @@ class GeneralizedLinearRegression(_GlrParams, Estimator):
             )
         X = X.astype(np.float32, copy=False)
         y = np.asarray(frame[self.getLabelCol()], np.float32)
-        if family == "binomial" and not np.all((y == 0) | (y == 1)):
-            raise ValueError("binomial family needs labels in {0, 1}")
+        if family == "binomial" and not np.all((y >= 0) & (y <= 1)):
+            # Spark Binomial accepts the full [0, 1] range: fractional
+            # labels are success PROPORTIONS with weightCol trial counts
+            raise ValueError("binomial family needs labels in [0, 1]")
         if family in ("poisson", "gamma") and (y < 0).any():
             raise ValueError(f"{family} family needs non-negative labels")
         if family == "gamma" and (y == 0).any():
@@ -419,9 +492,27 @@ class GeneralizedLinearRegression(_GlrParams, Estimator):
         )
         model.set("link", link)  # persist the RESOLVED link
         rank = d + (1 if fit_b else 0)
+        if family == "tweedie":
+            aic = None  # Spark: no AIC for tweedie; property raises
+        else:
+            # lazy (Spark lazy val): the O(n·d) host matmul + gammaln
+            # pass only runs if summary.aic is actually read.  The
+            # closure keeps Xa/y/w alive for the summary's lifetime —
+            # the same retention Spark's summary-holds-DataFrame has.
+            dev_f = float(dev)
+
+            def aic(_Xa=Xa, _y=y, _w=w, _fam=family, _link=link, _vp=vp,
+                    _beta=beta, _dev=dev_f, _n=n, _rank=rank):
+                _, g_inv, _ = _link_fns(_link)
+                eta = _Xa.astype(np.float64) @ _beta
+                mu_fit = np.asarray(
+                    _clip_mu(_fam, g_inv(eta), _vp), np.float64
+                )
+                return _aic(_fam, _y, mu_fit, _w, _n, _dev, _rank)
         model.summary = GeneralizedLinearRegressionTrainingSummary(
             deviance=dev, null_deviance=dev0, pearson=pearson, n=n,
             rank=rank, family=family, total_iterations=int(n_iter),
+            aic=aic,
         )
         return model
 

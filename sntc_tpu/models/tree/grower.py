@@ -748,6 +748,15 @@ def grow_forest(
     # (measured 2.1× slower at the depth-10 bench shapes).
     # SNTC_TREE_SIBLING=1 forces it everywhere (tests), =0 disables.
     sib_env = os.environ.get("SNTC_TREE_SIBLING", "")
+    if sib_env not in ("", "0", "1"):
+        import warnings
+
+        warnings.warn(
+            f"SNTC_TREE_SIBLING={sib_env!r} is not one of '', '0', '1'; "
+            "using the default (pallas-gated on)",
+            stacklevel=2,
+        )
+        sib_env = ""
     sib_on = group >= 2 and sib_env in ("", "1")
     sib_mb = float(os.environ.get("SNTC_TREE_SIBLING_MB", 1024))
     per_node_hist_mb = (

@@ -386,6 +386,7 @@ def _summary_agg(mesh):
         return {
             "count": (wr > 0).sum().astype(jnp.float32),
             "wsum": wr.sum(),
+            "w2sum": (wr * wr).sum(),
             "s1": wx.sum(axis=0),
             "s2": (xc * wx).sum(axis=0),
             "l1": (jnp.abs(xs) * wr[:, None]).sum(axis=0),
@@ -418,7 +419,13 @@ class SummaryBuilder:
         col: str = "features",
         weightCol: Optional[str] = None,
         mesh=None,
+        weightNorm: str = "reliability",
     ) -> Frame:
+        """``weightNorm`` (extension; Spark has no knob): "reliability"
+        (default) matches ``ml.stat`` SummarizerBuffer's unbiased
+        denominator Σw − Σw²/Σw; "frequency" uses Σw − 1, under which
+        ``weightCol`` ≡ integer row replication (the contract the
+        framework's weighted FITS pin).  Unweighted they coincide."""
         mesh = mesh or get_default_mesh()
         X = _features_matrix(frame, col).astype(np.float32)
         if X.shape[0] == 0:
@@ -440,17 +447,29 @@ class SummaryBuilder:
                 "Summarizer: total weight is zero (all rows weight-0)"
             )
         mean = pilot + m["s1"] / wsum
-        # unbiased variance with the FREQUENCY-weight denominator Σw − 1:
-        # weightCol ≡ integer row replication, the contract every weighted
-        # fit in this framework pins (GLM/LR/evaluators).  Documented
-        # delta (PARITY.md): Spark's ml.stat SummarizerBuffer uses the
-        # reliability-weight denominator Σw − Σw²/Σw, which differs for
-        # non-integer weights (mllib's MultivariateOnlineSummarizer uses
-        # Σw − 1 like us).
-        var = np.maximum(
-            (m["s2"] - m["s1"] ** 2 / wsum) / np.maximum(wsum - 1.0, 1.0),
-            0.0,
+        # unbiased variance.  Default denominator is the RELIABILITY-
+        # weight form Σw − Σw²/Σw — exactly Spark's ml.stat
+        # SummarizerBuffer/MultivariateOnlineSummarizer (parity; r5 closed
+        # the former frequency-denominator delta).  "frequency" keeps the
+        # Σw − 1 replication contract as an opt-in extension.
+        if weightNorm not in ("reliability", "frequency"):
+            raise ValueError(
+                f"weightNorm must be 'reliability' or 'frequency', got "
+                f"{weightNorm!r}"
+            )
+        denom = float(
+            wsum - m["w2sum"] / wsum
+            if weightNorm == "reliability"
+            else wsum - 1.0
         )
+        # Spark: a non-positive denominator (single row / one dominant
+        # weight) yields zero variance, not a division blow-up
+        if denom > 0:
+            var = np.maximum(
+                (m["s2"] - m["s1"] ** 2 / wsum) / denom, 0.0
+            )
+        else:
+            var = np.zeros_like(mean)
         values = {
             "mean": mean,
             "sum": mean * wsum,

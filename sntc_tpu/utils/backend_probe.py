@@ -10,15 +10,29 @@ labeled) instead of hanging a user's terminal.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import subprocess
 import sys
 import time
 
-_OK_MARKER = os.path.join(
-    os.path.expanduser("~"), ".cache", "sntc_tpu_probe_ok"
-)
 _OK_TTL_S = 300.0
+
+
+def _ok_marker() -> str:
+    """Marker path, keyed on the backend-relevant environment.
+
+    The probe subprocess inherits this process's env, so a success under
+    ``JAX_PLATFORMS=cpu`` proves nothing about the tunnel-default
+    backend; caching it un-keyed would suppress the probe for
+    tunnel-default processes for 5 minutes (ADVICE r4).  Hashing
+    ``JAX_PLATFORMS`` into the filename keeps the two verdicts apart.
+    """
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    suffix = hashlib.sha1(plats.encode()).hexdigest()[:12]
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", f"sntc_tpu_probe_ok_{suffix}"
+    )
 
 
 def probe_default_backend(
@@ -39,11 +53,19 @@ def probe_default_backend(
             raw = os.environ.get(specific_env)
         if raw is None:
             raw = os.environ.get("SNTC_PROBE_TIMEOUT_S", 180)
-        timeout_s = float(raw)
+        try:
+            timeout_s = float(raw)
+        except (TypeError, ValueError):
+            print(
+                f"sntc_tpu: malformed probe timeout {raw!r}; using 180 s",
+                file=sys.stderr,
+            )
+            timeout_s = 180.0
     if timeout_s <= 0:
         return True
+    marker = _ok_marker()
     try:
-        if time.time() - os.path.getmtime(_OK_MARKER) < _OK_TTL_S:
+        if time.time() - os.path.getmtime(marker) < _OK_TTL_S:
             return True
     except OSError:
         pass
@@ -58,8 +80,8 @@ def probe_default_backend(
         ok = False
     if ok:
         try:
-            os.makedirs(os.path.dirname(_OK_MARKER), exist_ok=True)
-            with open(_OK_MARKER, "w"):
+            os.makedirs(os.path.dirname(marker), exist_ok=True)
+            with open(marker, "w"):
                 pass
         except OSError:
             pass

@@ -5,6 +5,7 @@ probe trusts the backend."""
 import jax
 
 from sntc_tpu.utils.backend_probe import (
+    _ok_marker,
     probe_default_backend,
     resolve_platform,
 )
@@ -35,3 +36,38 @@ def test_specific_env_overrides_generic(monkeypatch):
     assert (
         probe_default_backend(specific_env="TOOL_PROBE_TIMEOUT_S") is True
     )
+
+
+def test_malformed_timeout_env_falls_back(monkeypatch, capsys):
+    # ADVICE r4: an empty/garbage timeout env must not crash startup.
+    # The real probe subprocess would hang 180 s on this host class when
+    # the tunnel is down (sitecustomize re-pins the platform regardless
+    # of env) — stub it; the parse path is what's under test.
+    import subprocess as sp
+
+    calls = {}
+
+    def fake_run(cmd, timeout=None, **kw):
+        calls["timeout"] = timeout
+        return sp.CompletedProcess(cmd, 0)
+
+    import sntc_tpu.utils.backend_probe as bp
+
+    monkeypatch.setattr(bp.subprocess, "run", fake_run)
+    monkeypatch.setattr(
+        bp, "_ok_marker", lambda: "/nonexistent/sntc-probe-marker"
+    )
+    monkeypatch.setenv("SNTC_PROBE_TIMEOUT_S", "not-a-number")
+    assert probe_default_backend() is True
+    assert calls["timeout"] == 180.0  # fell back to the default
+    assert "malformed probe timeout" in capsys.readouterr().err
+
+
+def test_ok_marker_keyed_on_platform_env(monkeypatch):
+    # ADVICE r4: a success cached under JAX_PLATFORMS=cpu must not
+    # suppress the probe for tunnel-default (unset) processes
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    cpu_marker = _ok_marker()
+    monkeypatch.delenv("JAX_PLATFORMS")
+    default_marker = _ok_marker()
+    assert cpu_marker != default_marker

@@ -1,0 +1,42 @@
+"""Compile-cache host partition (VERDICT r4 weak #4): an XLA:CPU
+executable AOT-compiled on a differently-featured host must be a cache
+MISS, not a served artifact that can SIGILL."""
+
+import sntc_tpu.utils.compile_cache as cc
+
+
+def test_host_signature_is_stable_and_flag_sensitive(monkeypatch):
+    sig1 = cc.host_feature_signature()
+    sig2 = cc.host_feature_signature()
+    assert sig1 == sig2 and len(sig1) >= 4
+
+
+def test_cache_dir_partitioned_by_host_signature(tmp_path, monkeypatch):
+    monkeypatch.delenv("SNTC_NO_COMPILE_CACHE", raising=False)
+    monkeypatch.delenv("SNTC_CACHE_NO_HOST_KEY", raising=False)
+    base = str(tmp_path / "xla")
+
+    monkeypatch.setattr(cc, "host_feature_signature", lambda: "aaaa1111bbbb")
+    dir_a = cc.resolve_cache_dir(base)
+    # a foreign host wrote an artifact into ITS partition
+    monkeypatch.setattr(cc, "host_feature_signature", lambda: "cccc2222dddd")
+    dir_b = cc.resolve_cache_dir(base)
+
+    assert dir_a != dir_b
+    assert dir_a.startswith(base) and dir_b.startswith(base)
+    # structural guarantee: nothing under dir_a is visible from dir_b,
+    # so an entry written under another feature signature cannot be
+    # served here — it is a clean miss
+    import os
+
+    os.makedirs(dir_a, exist_ok=True)
+    open(os.path.join(dir_a, "foreign-entry"), "w").close()
+    assert not os.path.exists(os.path.join(dir_b, "foreign-entry"))
+
+
+def test_host_key_opt_out_and_disable(tmp_path, monkeypatch):
+    base = str(tmp_path / "xla")
+    monkeypatch.setenv("SNTC_CACHE_NO_HOST_KEY", "1")
+    assert cc.resolve_cache_dir(base) == base
+    monkeypatch.setenv("SNTC_NO_COMPILE_CACHE", "1")
+    assert cc.resolve_cache_dir(base) is None

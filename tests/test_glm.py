@@ -244,3 +244,88 @@ def test_tweedie_clone_params_refit(mesh8):
     np.testing.assert_allclose(m2.coefficients, m.coefficients, atol=1e-6)
     with pytest.raises(ValueError, match="failed validation"):
         GeneralizedLinearRegression(family="tweedie", linkPower="log")
+
+
+# ---------------------------------------------------------------------------
+# AIC (r5): Spark GeneralizedLinearRegressionSummary.aic = family log-
+# likelihood form + 2*rank, oracle-checked against scipy.stats
+# ---------------------------------------------------------------------------
+
+
+def test_aic_gaussian_closed_form(mesh8):
+    X, beta, eta, rng = _design(n=800, seed=7)
+    y = eta + 0.1 * rng.normal(size=len(eta))
+    glr = GeneralizedLinearRegression(mesh=mesh8).fit(
+        Frame({"features": X, "label": y})
+    )
+    n, rank = len(y), X.shape[1] + 1
+    dev = glr.summary.deviance
+    oracle = n * (np.log(2 * np.pi * dev / n) + 1) + 2 + 2 * rank
+    assert glr.summary.aic == pytest.approx(oracle, rel=1e-6)
+
+
+def test_aic_poisson_matches_scipy(mesh8):
+    from scipy.stats import poisson as sp_poisson
+
+    X, beta, eta, rng = _design(n=800, seed=8)
+    y = rng.poisson(np.exp(eta)).astype(np.float64)
+    glr = GeneralizedLinearRegression(
+        mesh=mesh8, family="poisson", maxIter=50
+    ).fit(Frame({"features": X, "label": y}))
+    mu = glr.predict(X)
+    oracle = -2.0 * sp_poisson.logpmf(y.astype(int), mu).sum() + 2 * (
+        X.shape[1] + 1
+    )
+    assert glr.summary.aic == pytest.approx(oracle, rel=1e-4)
+
+
+def test_aic_binomial_weighted_trials_matches_scipy(mesh8):
+    """Spark treats weightCol as Binomial trial counts: y is the success
+    FRACTION, round(y*w) the successes."""
+    from scipy.stats import binom as sp_binom
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(600, 3)).astype(np.float32) * 0.5
+    eta = X @ np.array([0.8, -0.5, 0.3]) + 0.1
+    p = 1 / (1 + np.exp(-eta))
+    w = rng.integers(1, 6, size=600).astype(np.float64)
+    succ = rng.binomial(w.astype(int), p).astype(np.float64)
+    y = succ / w
+    glr = GeneralizedLinearRegression(
+        mesh=mesh8, family="binomial", weightCol="w", maxIter=50
+    ).fit(Frame({"features": X, "label": y, "w": w}))
+    mu = glr.predict(X)
+    oracle = -2.0 * sp_binom.logpmf(
+        np.round(y * w).astype(int), w.astype(int), mu
+    ).sum() + 2 * (X.shape[1] + 1)
+    assert glr.summary.aic == pytest.approx(oracle, rel=1e-4)
+
+
+def test_aic_gamma_matches_scipy(mesh8):
+    from scipy.stats import gamma as sp_gamma
+
+    X, beta, eta, rng = _design(n=800, seed=10)
+    mu_true = np.exp(eta)
+    y = rng.gamma(shape=5.0, scale=mu_true / 5.0).astype(np.float64)
+    glr = GeneralizedLinearRegression(
+        mesh=mesh8, family="gamma", link="log", maxIter=50
+    ).fit(Frame({"features": X, "label": y}))
+    mu = glr.predict(X)
+    disp = glr.summary.deviance / len(y)
+    oracle = (
+        -2.0 * sp_gamma.logpdf(y, a=1.0 / disp, scale=mu * disp).sum()
+        + 2.0
+        + 2 * (X.shape[1] + 1)
+    )
+    assert glr.summary.aic == pytest.approx(oracle, rel=1e-4)
+
+
+def test_aic_tweedie_raises(mesh8):
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(300, 2)).astype(np.float32)
+    y = np.exp(0.3 * X[:, 0] + 1.0).astype(np.float32)
+    m = GeneralizedLinearRegression(
+        family="tweedie", variancePower=1.5, maxIter=20
+    ).fit(Frame({"features": X, "label": y}))
+    with pytest.raises(ValueError, match="tweedie"):
+        m.summary.aic

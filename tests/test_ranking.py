@@ -126,6 +126,24 @@ def test_multilabel_metrics():
     ).isLargerBetter()
 
 
+def test_multilabel_accuracy_nan_on_both_empty_row():
+    """Spark parity (r5): a row whose prediction AND label sets are both
+    empty is a bare 0/0 in MultilabelMetrics.accuracy — NaN, which
+    poisons the mean.  The other metrics stay finite on the same data."""
+    import math
+
+    f = Frame({
+        "prediction": object_column([[1.0], []]),
+        "label": object_column([[1.0], []]),
+    })
+    acc = MultilabelClassificationEvaluator(metricName="accuracy").evaluate(f)
+    assert math.isnan(acc)
+    sub = MultilabelClassificationEvaluator(
+        metricName="subsetAccuracy"
+    ).evaluate(f)
+    assert sub == pytest.approx(1.0)
+
+
 def test_text_pipeline_end_to_end_persisted(tmp_path):
     """The full text stack inside a Pipeline object, fitted, persisted,
     reloaded, and re-scored — the composition story for every new

@@ -156,14 +156,17 @@ def test_summarizer_unweighted(mesh8, xy):
 
 
 def test_summarizer_weighted_matches_replication(mesh8):
-    """weightCol ≡ integer row replication — the Spark weighted-stats
-    contract the rest of the framework pins (e.g. GLM weightCol)."""
+    """weightNorm="frequency": weightCol ≡ integer row replication — the
+    weighted-stats contract the framework's FITS pin (e.g. GLM
+    weightCol).  Kept as an opt-in extension; the default is Spark's
+    reliability form (next test)."""
     rng = np.random.default_rng(5)
     X = rng.normal(size=(501, 3)).astype(np.float32)
     w = rng.integers(1, 4, size=501).astype(np.float32)
     rep = np.repeat(X, w.astype(int), axis=0)
     out_w = Summarizer.metrics("mean", "variance", "weightSum").summary(
-        Frame({"features": X, "w": w}), "features", weightCol="w"
+        Frame({"features": X, "w": w}), "features", weightCol="w",
+        weightNorm="frequency",
     )
     out_r = Summarizer.metrics("mean", "variance", "weightSum").summary(
         Frame({"features": rep}), "features"
@@ -173,6 +176,33 @@ def test_summarizer_weighted_matches_replication(mesh8):
         out_w["variance"][0], out_r["variance"][0], rtol=1e-4
     )
     assert out_w["weightSum"][0] == pytest.approx(out_r["weightSum"][0])
+
+
+def test_summarizer_reliability_variance_matches_spark(mesh8):
+    """Default weighted variance = Spark ml.stat SummarizerBuffer's
+    reliability-weight denominator Σw − Σw²/Σw (r5 closed the former
+    frequency-denominator delta).  Hand-computed float64 oracle on
+    NON-integer weights, where the two forms differ."""
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(257, 3)).astype(np.float32)
+    w = rng.uniform(0.25, 2.75, size=257).astype(np.float32)
+    out = Summarizer.metrics("variance").summary(
+        Frame({"features": X, "w": w}), "features", weightCol="w"
+    )
+    X64, w64 = X.astype(np.float64), w.astype(np.float64)
+    wsum = w64.sum()
+    mean = (w64[:, None] * X64).sum(axis=0) / wsum
+    num = (w64[:, None] * (X64 - mean) ** 2).sum(axis=0)
+    oracle = num / (wsum - (w64**2).sum() / wsum)
+    np.testing.assert_allclose(out["variance"][0], oracle, rtol=1e-3)
+    # the frequency form must differ on this data (the delta was real)
+    freq = num / (wsum - 1.0)
+    assert not np.allclose(oracle, freq, rtol=1e-3)
+    with pytest.raises(ValueError, match="weightNorm"):
+        Summarizer.metrics("variance").summary(
+            Frame({"features": X, "w": w}), "features", weightCol="w",
+            weightNorm="bogus",
+        )
 
 
 def test_summarizer_zero_weight_rows_excluded(mesh8):
