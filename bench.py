@@ -12,8 +12,10 @@ wall-clock at macro-F1 parity, over the five reference configs [B:6-12]:
 No Spark and no real CICIDS2017 exist in-image (SURVEY.md §6), so the
 workload is the schema-locked synthetic generator (real day CSVs drop in
 unchanged) and the baseline is a CPU proxy (sklearn, same algorithm family
-and budget, measured on this host with ``--measure-baseline`` and cached
-in ``baseline_proxy.json`` — labeled as a proxy).
+and budget — labeled as a proxy).  Since r5 the proxy is measured IN THE
+SAME INVOCATION on the same split (``paired: true`` in the output/journal)
+so host drift cancels inside each ratio; ``--no-pair`` falls back to the
+cached ``baseline_proxy.json`` (measured with ``--measure-baseline``).
 
 stdout is ONE JSON line for the selected config (default: 2):
   {"metric": ..., "value": <train_wall_clock_s>, "unit": "s",
@@ -198,6 +200,7 @@ def bench_config1(n_rows, mesh):
     auc = BinaryClassificationEvaluator().evaluate(model.transform(test))
     return {
         "metric": "cicids2017_binary_lr_train_wall_clock",
+        "_datasets": (train, test),
         "value": warm, "cold_value": cold,
         "quality": {"areaUnderROC": auc},
         "n_rows": train.num_rows,
@@ -221,6 +224,7 @@ def bench_config2(n_rows, mesh):
     f1 = _evaluate(model, test, mesh)
     return {
         "metric": "cicids2017_15class_mlp_pipeline_train_wall_clock",
+        "_datasets": (train, test),
         "value": warm, "cold_value": cold,
         "quality": {"macro_f1": f1},
         "n_rows": train.num_rows,
@@ -247,6 +251,7 @@ def bench_config3(n_rows, mesh):
     f1 = _evaluate(model, test, mesh)
     return {
         "metric": "cicids2017_rf_chisq_train_wall_clock",
+        "_datasets": (train, test),
         "value": warm, "cold_value": cold,
         "quality": {"macro_f1": f1},
         "n_rows": train.num_rows,
@@ -274,6 +279,7 @@ def bench_config4(n_rows, mesh):
     f1 = _evaluate(model, test, mesh)
     return {
         "metric": "cicids2017_gbt_ovr_train_wall_clock",
+        "_datasets": (train, test),
         "value": warm, "cold_value": cold,
         "quality": {"macro_f1": f1},
         "n_rows": train.num_rows,
@@ -335,6 +341,7 @@ def bench_config5(n_rows, mesh):
     rows = sum(f.num_rows for f in sink.frames)
     return {
         "metric": "cicids2017_streaming_inference_rows_per_s",
+        "_datasets": (train, test),
         "value": rows / dt, "unit": "rows/s",
         "quality": {
             "micro_batches": n_done,
@@ -674,7 +681,12 @@ def bench_mfu(n_rows, mesh):
 
 
 # ---------------------------------------------------------------------------
-# CPU proxy baselines (sklearn) — measured once, cached
+# CPU proxy baselines (sklearn).  Since r5 every config run measures its
+# proxy IN THE SAME INVOCATION on the SAME train/test split (the
+# --families discipline, VERDICT r4 item 2): host speed drifts by large
+# factors across hours on this box, and a ratio of two same-session
+# numbers cancels that drift where a cached proxy cannot.  The cache +
+# --measure-baseline path remains for --no-pair and for pre-measuring.
 # ---------------------------------------------------------------------------
 
 
@@ -696,165 +708,169 @@ def _proxy_xy(frame, vocab=None):
     return X[valid], idx_c[valid].astype(np.int64), vocab
 
 
-def measure_baseline(configs, rows):
-    from sklearn.ensemble import (
-        GradientBoostingClassifier,
-        RandomForestClassifier as SkRF,
-    )
-    from sklearn.feature_selection import SelectKBest, chi2
+def proxy_config1(train, test):
     from sklearn.linear_model import LogisticRegression as SkLR
-    from sklearn.multiclass import OneVsRestClassifier
-    from sklearn.neural_network import MLPClassifier
-    from sklearn.preprocessing import MinMaxScaler, StandardScaler as SkScaler
+    from sklearn.metrics import roc_auc_score
+    from sklearn.preprocessing import StandardScaler as SkScaler
 
+    X, y, vocab = _proxy_xy(train)
+    Xt, yt, _ = _proxy_xy(test, vocab)
+    t0 = time.perf_counter()
+    scaler = SkScaler().fit(X)
+    clf = SkLR(max_iter=LR_MAX_ITER, tol=1e-6).fit(scaler.transform(X), y)
+    dt = time.perf_counter() - t0
+    auc = roc_auc_score(yt, clf.predict_proba(scaler.transform(Xt))[:, 1])
+    return {
+        "desc": "LogisticRegression lbfgs, standardized",
+        "train_s": dt,
+        "quality": {"areaUnderROC": float(auc)},
+    }
+
+
+def proxy_config2(train, test):
+    from sklearn.metrics import f1_score
+    from sklearn.neural_network import MLPClassifier
+    from sklearn.preprocessing import StandardScaler as SkScaler
+
+    X, y, vocab = _proxy_xy(train)
+    Xt, yt, _ = _proxy_xy(test, vocab)
+    t0 = time.perf_counter()
+    scaler = SkScaler().fit(X)
+    clf = MLPClassifier(
+        hidden_layer_sizes=(MLP_LAYERS[1],), activation="logistic",
+        solver="lbfgs", max_iter=MLP_MAX_ITER, tol=1e-6, random_state=0,
+    ).fit(scaler.transform(X), y)
+    dt = time.perf_counter() - t0
+    f1 = f1_score(yt, clf.predict(scaler.transform(Xt)), average="macro")
+    return {
+        "desc": "MLPClassifier 78-64-15 logistic lbfgs 100 iters",
+        "train_s": dt,
+        "quality": {"macro_f1": float(f1)},
+    }
+
+
+def proxy_config3(train, test):
+    from sklearn.ensemble import RandomForestClassifier as SkRF
+    from sklearn.feature_selection import SelectKBest, chi2
+    from sklearn.metrics import f1_score
+    from sklearn.preprocessing import MinMaxScaler
+
+    X, y, vocab = _proxy_xy(train)
+    Xt, yt, _ = _proxy_xy(test, vocab)
+    t0 = time.perf_counter()
+    mm = MinMaxScaler().fit(X)
+    sel = SelectKBest(chi2, k=CHISQ_TOP).fit(mm.transform(X), y)
+    rf = SkRF(
+        n_estimators=RF_TREES, max_depth=RF_DEPTH, n_jobs=-1,
+        random_state=0,
+    ).fit(sel.transform(mm.transform(X)), y)
+    dt = time.perf_counter() - t0
+    f1 = f1_score(
+        yt, rf.predict(sel.transform(mm.transform(Xt))), average="macro"
+    )
+    return {
+        "desc": f"SelectKBest(chi2,k={CHISQ_TOP}) + RF",
+        "train_s": dt,
+        "quality": {"macro_f1": float(f1)},
+    }
+
+
+def proxy_config4(train, test):
+    from sklearn.ensemble import GradientBoostingClassifier
+    from sklearn.metrics import f1_score
+    from sklearn.multiclass import OneVsRestClassifier
+
+    X, y, vocab = _proxy_xy(train)
+    Xt, yt, _ = _proxy_xy(test, vocab)
+    t0 = time.perf_counter()
+    clf = OneVsRestClassifier(
+        GradientBoostingClassifier(
+            n_estimators=GBT_ROUNDS, max_depth=GBT_DEPTH,
+            learning_rate=0.1, random_state=0,
+        )
+    ).fit(X, y)
+    dt = time.perf_counter() - t0
+    f1 = f1_score(yt, clf.predict(Xt), average="macro")
+    return {
+        "desc": f"OneVsRest(GradientBoosting x{GBT_ROUNDS})",
+        "train_s": dt,
+        "quality": {"macro_f1": float(f1)},
+    }
+
+
+def proxy_config5(train, test):
+    """Serving throughput proxy: fit excluded (like ours); micro-batches
+    arrive as COLUMNS (the NetFlow/Arrow record shape [B:11]) and each
+    chunk pays feature assembly, scaling, and predict."""
+    from sklearn.linear_model import LogisticRegression as SkLR
+    from sklearn.preprocessing import StandardScaler as SkScaler
+
+    from sntc_tpu.data import CICIDS2017_FEATURES
+
+    X, y, _ = _proxy_xy(train)
+    scaler = SkScaler().fit(X)
+    clf = SkLR(max_iter=20).fit(scaler.transform(X), y)
+    cols = [
+        np.ascontiguousarray(test[c], dtype=np.float64)
+        for c in CICIDS2017_FEATURES
+    ]
+    n_test = test.num_rows
+    per = max(n_test // 20, 1)
+    t0 = time.perf_counter()
+    for i in range(20):
+        s, e = i * per, min((i + 1) * per, n_test)
+        if e > s:
+            chunk = np.stack([c[s:e] for c in cols], axis=1)
+            clf.predict_proba(scaler.transform(chunk))
+    dt = time.perf_counter() - t0
+    return {
+        "desc": "columnar chunked assemble+scale+predict_proba",
+        "rows_per_s": n_test / dt,
+        "n_rows_served": int(n_test),
+    }
+
+
+PROXIES = {
+    "1": proxy_config1,
+    "2": proxy_config2,
+    "3": proxy_config3,
+    "4": proxy_config4,
+    "5": proxy_config5,
+}
+
+
+def measure_baseline(configs, rows):
+    """Measure the sklearn proxies standalone and cache them — the
+    --no-pair fallback and a pre-measured sanity anchor.  Same proxy
+    functions the paired path runs in-invocation."""
     cache = {}
     if os.path.exists(BASELINE_CACHE):
         with open(BASELINE_CACHE) as f:
             cache = json.load(f)
 
-    from sklearn.metrics import f1_score, roc_auc_score
-
-    def record(cfg, desc, fn, train, quality_fn=None):
-        """Time ``fn`` (fit; returns quality inputs) and record proxy
-        train-wall-clock + held-out quality — the 'equal macro-F1' side of
-        the [B:2] metric of record, measured for the proxy too."""
-        t0 = time.perf_counter()
-        fitted = fn()
-        dt = time.perf_counter() - t0
-        cache[cfg] = {
-            "baseline": f"sklearn CPU proxy: {desc}",
-            "train_s": dt,
-            "n_rows": int(train.num_rows),
-            "host_cpus": os.cpu_count(),
-        }
-        if quality_fn is not None:
-            cache[cfg]["quality"] = quality_fn(fitted)
-        print(
-            f"baseline config {cfg}: {dt:.1f}s {cache[cfg].get('quality', '')}",
-            file=sys.stderr,
-        )
-
     for cfg in configs:
         n = rows or DEFAULT_ROWS[cfg]
-        if cfg == "1":
-            train, test = _dataset(n, binary=True)
-            X, y, vocab = _proxy_xy(train)
-            Xt, yt, _ = _proxy_xy(test, vocab)
-
-            def fit_lr():
-                scaler = SkScaler().fit(X)
-                return scaler, SkLR(max_iter=LR_MAX_ITER, tol=1e-6).fit(
-                    scaler.transform(X), y
-                )
-
-            record(
-                "1", "LogisticRegression lbfgs, standardized", fit_lr, train,
-                lambda f: {
-                    "areaUnderROC": float(roc_auc_score(
-                        yt, f[1].predict_proba(f[0].transform(Xt))[:, 1]
-                    ))
-                },
-            )
-        elif cfg == "2":
-            train, test = _dataset(n)
-            X, y, vocab = _proxy_xy(train)
-            Xt, yt, _ = _proxy_xy(test, vocab)
-
-            def fit_mlp():
-                scaler = SkScaler().fit(X)
-                return scaler, MLPClassifier(
-                    hidden_layer_sizes=(MLP_LAYERS[1],), activation="logistic",
-                    solver="lbfgs", max_iter=MLP_MAX_ITER, tol=1e-6,
-                    random_state=0,
-                ).fit(scaler.transform(X), y)
-
-            record(
-                "2", "MLPClassifier 78-64-15 logistic lbfgs 100 iters",
-                fit_mlp, train,
-                lambda f: {
-                    "macro_f1": float(f1_score(
-                        yt, f[1].predict(f[0].transform(Xt)), average="macro"
-                    ))
-                },
-            )
-        elif cfg == "3":
-            train, test = _dataset(n)
-            X, y, vocab = _proxy_xy(train)
-            Xt, yt, _ = _proxy_xy(test, vocab)
-
-            def fit_rf():
-                mm = MinMaxScaler().fit(X)
-                sel = SelectKBest(chi2, k=CHISQ_TOP).fit(mm.transform(X), y)
-                rf = SkRF(
-                    n_estimators=RF_TREES, max_depth=RF_DEPTH, n_jobs=-1,
-                    random_state=0,
-                ).fit(sel.transform(mm.transform(X)), y)
-                return mm, sel, rf
-
-            record(
-                "3", f"SelectKBest(chi2,k={CHISQ_TOP}) + RF", fit_rf, train,
-                lambda f: {
-                    "macro_f1": float(f1_score(
-                        yt,
-                        f[2].predict(f[1].transform(f[0].transform(Xt))),
-                        average="macro",
-                    ))
-                },
-            )
-        elif cfg == "4":
-            train, test = _dataset(n)
-            X, y, vocab = _proxy_xy(train)
-            Xt, yt, _ = _proxy_xy(test, vocab)
-            record(
-                "4", f"OneVsRest(GradientBoosting x{GBT_ROUNDS})",
-                lambda: OneVsRestClassifier(
-                    GradientBoostingClassifier(
-                        n_estimators=GBT_ROUNDS, max_depth=GBT_DEPTH,
-                        learning_rate=0.1, random_state=0,
-                    )
-                ).fit(X, y),
-                train,
-                lambda f: {
-                    "macro_f1": float(f1_score(
-                        yt, f.predict(Xt), average="macro"
-                    ))
-                },
-            )
-        elif cfg == "5":
-            train, test = _dataset(n, binary=True)
-            X, y, _ = _proxy_xy(train)
-            scaler = SkScaler().fit(X)
-            clf = SkLR(max_iter=20).fit(scaler.transform(X), y)
-            # symmetric with the engine under test: micro-batches arrive as
-            # COLUMNS (the NetFlow/Arrow record shape [B:11]) and each chunk
-            # pays feature assembly, scaling, and predict
-            from sntc_tpu.data import CICIDS2017_FEATURES
-
-            cols = [
-                np.ascontiguousarray(test[c], dtype=np.float64)
-                for c in CICIDS2017_FEATURES
-            ]
-            n_test = test.num_rows
-
-            def serve():
-                per = max(n_test // 20, 1)
-                for i in range(20):
-                    s, e = i * per, min((i + 1) * per, n_test)
-                    if e > s:
-                        chunk = np.stack([c[s:e] for c in cols], axis=1)
-                        clf.predict_proba(scaler.transform(chunk))
-
-            t0 = time.perf_counter()
-            serve()
-            dt = time.perf_counter() - t0
-            cache["5"] = {
-                "baseline": "sklearn CPU proxy: columnar chunked "
-                "assemble+scale+predict_proba",
-                "rows_per_s": n_test / dt,
-                "n_rows": int(n_test),
-                "host_cpus": os.cpu_count(),
-            }
-            print(f"baseline config 5: {n_test/dt:.0f} rows/s", file=sys.stderr)
+        train, test = _dataset(n, binary=cfg in ("1", "5"))
+        p = PROXIES[cfg](train, test)
+        entry = {
+            "baseline": f"sklearn CPU proxy: {p['desc']}",
+            "n_rows": (
+                int(test.num_rows) if cfg == "5" else int(train.num_rows)
+            ),
+            "host_cpus": os.cpu_count(),
+        }
+        for k in ("train_s", "rows_per_s"):
+            if k in p:
+                entry[k] = p[k]
+        if "quality" in p:
+            entry["quality"] = p["quality"]
+        cache[cfg] = entry
+        shown = entry.get("train_s") or entry.get("rows_per_s")
+        print(
+            f"baseline config {cfg}: {shown:.1f} "
+            f"{entry.get('quality', '')}",
+            file=sys.stderr,
+        )
 
     with open(BASELINE_CACHE, "w") as f:
         json.dump(cache, f, indent=1)
@@ -881,32 +897,61 @@ def _vs_baseline(cfg: str, result: dict, base: dict):
     return (base["train_s"] * scale) / result["value"]
 
 
-def run_config(cfg: str, rows):
+def _round_ratio(r):
+    """3 significant digits: tiny ratios (smoke-scale runs where fixed
+    overhead dominates) must not collapse to 0.0."""
+    return float(f"{r:.3g}")
+
+
+def run_config(cfg: str, rows, pair: bool = True):
     import jax
 
     from sntc_tpu.parallel.context import get_default_mesh
 
     mesh = get_default_mesh()
     result = BENCHES[cfg](rows or DEFAULT_ROWS[cfg], mesh)
-    base = _load_baseline(cfg)
+    train, test = result.pop("_datasets", (None, None))
     line = {
         "metric": result["metric"],
         "value": round(result["value"], 3),
         "unit": result.get("unit", "s"),
-        "vs_baseline": (
-            round(v, 2) if (v := _vs_baseline(cfg, result, base)) else None
-        ),
     }
+    if pair:
+        # drift-proof ratio: the sklearn proxy runs NOW, in this same
+        # invocation, on the same train/test split — both sides of the
+        # ratio see the same host state (VERDICT r4 item 2)
+        proxy = PROXIES[cfg](train, test)
+        if cfg == "5":
+            line["vs_baseline"] = _round_ratio(
+                result["value"] / proxy["rows_per_s"]
+            )
+            line["proxy_rows_per_s"] = round(proxy["rows_per_s"], 1)
+        else:
+            line["vs_baseline"] = _round_ratio(
+                proxy["train_s"] / result["value"]
+            )
+            line["proxy_s"] = round(proxy["train_s"], 3)
+        line["paired"] = True
+        base_quality = proxy.get("quality")
+        line["baseline"] = (
+            f"sklearn-cpu-proxy same-invocation: {proxy['desc']}"
+        )
+    else:
+        base = _load_baseline(cfg)
+        v = _vs_baseline(cfg, result, base)
+        line["vs_baseline"] = _round_ratio(v) if v else None
+        line["paired"] = False
+        base_quality = base.get("quality")
+        line["baseline"] = "sklearn-cpu-proxy (baseline_proxy.json)"
     for k in ("cold_value", "n_rows"):
         if k in result:
             line[k] = (
                 round(result[k], 3) if isinstance(result[k], float) else result[k]
             )
     line.update(result.get("quality", {}))
-    if "quality" in base:
-        line["baseline_quality"] = base["quality"]
+    if base_quality:
+        line["baseline_quality"] = base_quality
     line["platform"] = jax.devices()[0].platform
-    line["baseline"] = "sklearn-cpu-proxy (baseline_proxy.json)"
     return line
 
 
@@ -927,6 +972,13 @@ def main():
         help="comparative wall-clocks for the breadth families (KMeans/"
         "GMM/LDA vs sklearn on this host; ALS ours-only), one JSON "
         "line each",
+    )
+    ap.add_argument(
+        "--no-pair", action="store_true",
+        default=bool(os.environ.get("BENCH_NO_PAIR")),
+        help="skip the same-invocation sklearn proxy (fall back to the "
+        "cached baseline_proxy.json with row scaling; rows journal "
+        "paired:false)",
     )
     ap.add_argument(
         "--platform", default=os.environ.get("BENCH_PLATFORM"),
@@ -985,7 +1037,7 @@ def main():
     # flagship (config 2) last so the driver's final line is the headline
     ordered = sorted(configs, key=lambda c: (c == "2", c))
     for cfg in ordered:
-        line = run_config(cfg, args.rows)
+        line = run_config(cfg, args.rows, pair=not args.no_pair)
         _journal_run(cfg, line)
         print(json.dumps(line), flush=True)
 

@@ -38,11 +38,13 @@ def test_specific_env_overrides_generic(monkeypatch):
     )
 
 
-def test_malformed_timeout_env_falls_back(monkeypatch, capsys):
+def test_malformed_timeout_env_falls_back(monkeypatch, capsys, tmp_path):
     # ADVICE r4: an empty/garbage timeout env must not crash startup.
     # The real probe subprocess would hang 180 s on this host class when
     # the tunnel is down (sitecustomize re-pins the platform regardless
-    # of env) — stub it; the parse path is what's under test.
+    # of env) — stub it; the parse path is what's under test.  The marker
+    # is redirected into tmp_path (a fake success WRITES the marker, so a
+    # shared fixed path would leak a fresh marker into later runs).
     import subprocess as sp
 
     calls = {}
@@ -54,12 +56,12 @@ def test_malformed_timeout_env_falls_back(monkeypatch, capsys):
     import sntc_tpu.utils.backend_probe as bp
 
     monkeypatch.setattr(bp.subprocess, "run", fake_run)
-    monkeypatch.setattr(
-        bp, "_ok_marker", lambda: "/nonexistent/sntc-probe-marker"
-    )
+    marker = tmp_path / "probe-marker"
+    monkeypatch.setattr(bp, "_ok_marker", lambda: str(marker))
     monkeypatch.setenv("SNTC_PROBE_TIMEOUT_S", "not-a-number")
     assert probe_default_backend() is True
     assert calls["timeout"] == 180.0  # fell back to the default
+    assert marker.exists()  # success cached — in tmp_path, not ~
     assert "malformed probe timeout" in capsys.readouterr().err
 
 

@@ -20,6 +20,7 @@ def test_bench_config_emits_contract_line(cfg):
         BENCH_ROWS="2000",
         BENCH_PLATFORM="cpu",
         BENCH_PROBE_TIMEOUT_S="0",
+        BENCH_NO_JOURNAL="1",  # committed journal holds real runs only
         XLA_FLAGS="--xla_force_host_platform_device_count=8",
     )
     proc = subprocess.run(
@@ -33,6 +34,11 @@ def test_bench_config_emits_contract_line(cfg):
     for key in ("metric", "value", "unit", "vs_baseline", "platform"):
         assert key in rec, rec
     assert rec["value"] > 0
+    # r5 pairing contract: the ratio comes from a proxy measured in THIS
+    # invocation, not the cache
+    assert rec["paired"] is True, rec
+    assert ("proxy_s" in rec) or ("proxy_rows_per_s" in rec), rec
+    assert rec["vs_baseline"] is not None and rec["vs_baseline"] > 0
 
 
 def test_bench_mfu_emits_contract_line():
