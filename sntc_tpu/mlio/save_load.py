@@ -15,20 +15,40 @@ params and identical transform behavior.
 Stages opt in by implementing ``_save_extra() -> (json_dict, arrays_dict)``
 and ``_load_from(params, extra, arrays) -> instance``; pure-params stages
 need neither.
+
+Durability (resilience layer): :func:`save_model` writes the WHOLE stage
+tree into a staging directory, seals it with a sha256 manifest
+(``_manifest.json``), and publishes with directory renames — the live
+checkpoint is never a partially-written tree, and the previous good
+snapshot is retained at ``<path>.prev``.  :func:`load_model` verifies
+the manifest (``SNTC_VERIFY_CHECKPOINT=0`` skips the hash pass) and, on
+a torn/corrupted primary, falls back to ``<path>.prev`` with a
+structured event instead of dying.  Both ends expose fault-injection
+sites (``ckpt.save`` / ``ckpt.load``).
 """
 
 from __future__ import annotations
 
+import hashlib
 import importlib
 import json
 import os
-from typing import Any, Dict, Tuple
+import shutil
+import sys
+from typing import Any, Dict, Optional
 
 import numpy as np
 
 from sntc_tpu.core.base import Pipeline, PipelineModel, PipelineStage
+from sntc_tpu.resilience import emit_event, fault_point
 
 _FORMAT_VERSION = 1
+_MANIFEST = "_manifest.json"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The checkpoint tree fails manifest verification (torn write,
+    bit-rot, or partial copy) — names the first offending file."""
 
 
 class _NpEncoder(json.JSONEncoder):
@@ -59,8 +79,9 @@ def _resolve(qualname: str) -> type:
     return cls
 
 
-def save_model(stage: PipelineStage, path: str) -> str:
-    """Persist a stage (or whole Pipeline/PipelineModel) to ``path``."""
+def _save_stage(stage: PipelineStage, path: str) -> str:
+    """One stage directory (recursing over sub-stages) — the pre-r6
+    ``save_model`` body, now always writing into a staging tree."""
     os.makedirs(path, exist_ok=True)
     params = dict(stage.paramValues())
     meta: Dict[str, Any] = {
@@ -78,7 +99,7 @@ def save_model(stage: PipelineStage, path: str) -> str:
         meta["stage_dirs"] = []
         for i, sub in enumerate(sub_stages):
             sub_dir = f"stage_{i:03d}"
-            save_model(sub, os.path.join(path, sub_dir))
+            _save_stage(sub, os.path.join(path, sub_dir))
             meta["stage_dirs"].append(sub_dir)
     extra, arrays = (
         stage._save_extra() if hasattr(stage, "_save_extra") else ({}, {})
@@ -94,22 +115,133 @@ def save_model(stage: PipelineStage, path: str) -> str:
         meta["payload"] = payload
     with open(os.path.join(path, "metadata.json"), "w") as f:
         json.dump(meta, f, cls=_NpEncoder, indent=1)
-    # re-saving over an old path must not leave the OTHER format's
-    # payload behind (load follows meta["payload"], but a stale file is
-    # still wrong on disk)
-    import shutil
-
-    npz_path = os.path.join(path, "data.npz")
-    orbax_path = os.path.join(path, "data.orbax")
-    if os.path.exists(npz_path) and not (arrays and payload == "npz"):
-        os.remove(npz_path)
-    if os.path.isdir(orbax_path) and not (arrays and payload == "orbax"):
-        shutil.rmtree(orbax_path)
+    # no stale-payload sweep needed: save_model always stages into a
+    # fresh directory and publishes by rename, so the other format's
+    # leftover file cannot exist here
     if arrays:
         if payload == "orbax":
-            _orbax_save(orbax_path, arrays)
+            _orbax_save(os.path.join(path, "data.orbax"), arrays)
         else:
-            np.savez(npz_path, **arrays)
+            np.savez(os.path.join(path, "data.npz"), **arrays)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# manifest: sha256 over every file of the staged tree
+# ---------------------------------------------------------------------------
+
+
+def _tree_files(root: str):
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, root)
+            if rel != _MANIFEST:
+                yield rel, full
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_manifest(root: str) -> None:
+    files = {
+        rel: {"sha256": _sha256(full), "bytes": os.path.getsize(full)}
+        for rel, full in _tree_files(root)
+    }
+    tmp = os.path.join(root, _MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump({"manifest_version": 1, "files": files}, f, indent=1)
+    os.replace(tmp, os.path.join(root, _MANIFEST))
+
+
+def verify_checkpoint(path: str) -> bool:
+    """Verify ``path`` against its manifest; True when verified, False
+    when no manifest exists (pre-resilience checkpoints load unchecked).
+    Raises :class:`CheckpointCorruptError` on the first mismatch."""
+    mpath = os.path.join(path, _MANIFEST)
+    if not os.path.exists(mpath):
+        return False
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (json.JSONDecodeError, KeyError, OSError) as e:
+        raise CheckpointCorruptError(
+            f"unreadable checkpoint manifest {mpath}: {e!r}"
+        ) from e
+    for rel, want in files.items():
+        full = os.path.join(path, rel)
+        if not os.path.exists(full):
+            raise CheckpointCorruptError(
+                f"checkpoint {path}: manifest file {rel!r} is missing"
+            )
+        if os.path.getsize(full) != want["bytes"]:
+            raise CheckpointCorruptError(
+                f"checkpoint {path}: {rel!r} is "
+                f"{os.path.getsize(full)} bytes, manifest says "
+                f"{want['bytes']} (torn write)"
+            )
+        if os.environ.get("SNTC_VERIFY_CHECKPOINT", "1") != "0":
+            got = _sha256(full)
+            if got != want["sha256"]:
+                raise CheckpointCorruptError(
+                    f"checkpoint {path}: {rel!r} sha256 mismatch "
+                    f"(expected {want['sha256'][:12]}…, got {got[:12]}…)"
+                )
+    # files present on disk but absent from the manifest are tolerated
+    # (a stranger file beside the tree is not corruption of the tree)
+    return True
+
+
+def _prev_path(path: str) -> str:
+    return os.path.normpath(path) + ".prev"
+
+
+def save_model(stage: PipelineStage, path: str) -> str:
+    """Persist a stage (or whole Pipeline/PipelineModel) to ``path``.
+
+    Atomic publish: the tree is staged at ``<path>.tmp-<pid>``, sealed
+    with a manifest, then swapped in by rename; an existing checkpoint
+    at ``path`` is retained as ``<path>.prev`` (the fallback snapshot
+    :func:`load_model` degrades to).  A crash — or an armed
+    ``ckpt.save`` fault — before the swap leaves the previous
+    checkpoint fully intact."""
+    path = os.path.normpath(path)
+    staging = f"{path}.tmp-{os.getpid()}"
+    if os.path.isdir(staging):
+        shutil.rmtree(staging)
+    moved_aside = False
+    prev = _prev_path(path)
+    try:
+        _save_stage(stage, staging)
+        # injected faults land here: after the expensive tree write,
+        # BEFORE anything touches the live checkpoint
+        fault_point("ckpt.save")
+        _write_manifest(staging)
+        if os.path.isdir(path):
+            if os.path.isdir(prev):
+                shutil.rmtree(prev)
+            os.replace(path, prev)
+            moved_aside = True
+        os.replace(staging, path)
+    except BaseException:
+        # if the old checkpoint was already moved aside and the final
+        # publish failed, put it back — a failed save must never leave
+        # ``path`` empty while the only good tree sits at .prev
+        if (
+            moved_aside
+            and not os.path.isdir(path)
+            and os.path.isdir(prev)
+        ):
+            os.replace(prev, path)
+        if os.path.isdir(staging):
+            shutil.rmtree(staging, ignore_errors=True)
+        raise
     return path
 
 
@@ -144,7 +276,7 @@ def _orbax_load(path: str) -> Dict[str, np.ndarray]:
     return {k: np.asarray(v) for k, v in out.items()}
 
 
-def load_model(path: str) -> PipelineStage:
+def _load_stage(path: str) -> PipelineStage:
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
     if meta.get("format_version") != _FORMAT_VERSION:
@@ -165,13 +297,15 @@ def load_model(path: str) -> PipelineStage:
 
     if issubclass(cls, (Pipeline, PipelineModel)):
         stages = [
-            load_model(os.path.join(path, d)) for d in meta.get("stage_dirs", [])
+            load_model(os.path.join(path, d), fallback=False)
+            for d in meta.get("stage_dirs", [])
         ]
         obj = cls(stages=stages)
         obj.setParams(**params)
     elif hasattr(cls, "_from_sub_stages"):
         stages = [
-            load_model(os.path.join(path, d)) for d in meta.get("stage_dirs", [])
+            load_model(os.path.join(path, d), fallback=False)
+            for d in meta.get("stage_dirs", [])
         ]
         obj = cls._from_sub_stages(stages, params, extra)
     elif hasattr(cls, "_load_from"):
@@ -181,3 +315,41 @@ def load_model(path: str) -> PipelineStage:
         obj.setParams(**params)
     obj.uid = meta.get("uid", obj.uid)
     return obj
+
+
+def load_model(path: str, fallback: bool = True) -> PipelineStage:
+    """Load a stage tree, verifying its manifest when present.
+
+    On a corrupted/torn primary (manifest mismatch, unreadable
+    metadata, bad payload), a verified ``<path>.prev`` snapshot — kept
+    by :func:`save_model`'s atomic publish — is loaded instead, with a
+    structured ``ckpt_fallback`` event and a stderr warning; without
+    one the original error propagates.  ``fallback=False`` (and every
+    recursive sub-stage load) disables degradation."""
+    path = os.path.normpath(path)
+    try:
+        # inside the try: an injected ckpt.load fault must take the same
+        # degradation path a real load failure does
+        fault_point("ckpt.load")
+        verify_checkpoint(path)
+        return _load_stage(path)
+    except Exception as primary_err:
+        prev = _prev_path(path)
+        if not fallback or not os.path.isdir(prev):
+            raise
+        try:
+            verify_checkpoint(prev)
+            obj = _load_stage(prev)
+        except Exception:
+            raise primary_err  # both bad: report the primary failure
+        emit_event(
+            event="ckpt_fallback", site="ckpt.load", path=path,
+            fallback_path=prev, error=repr(primary_err),
+        )
+        print(
+            f"sntc_tpu: checkpoint {path!r} failed to load "
+            f"({primary_err!r}); degraded to previous good snapshot "
+            f"{prev!r}",
+            file=sys.stderr,
+        )
+        return obj

@@ -107,6 +107,45 @@ def _link_fns(link: str):
     raise ValueError(f"unknown link {link!r}")
 
 
+def _link_inv_np(link: str):
+    """numpy-float64 inverse link — the host-side twin of
+    ``_link_fns(link)[1]`` for summary statistics (the lazy AIC pass),
+    where routing eta through the jnp implementations would silently
+    downcast the float64 linear predictor to float32."""
+    from scipy.special import expit, ndtr
+
+    if link.startswith("power:"):
+        lp = float(link.split(":", 1)[1])
+        if lp == 0.0:
+            return np.exp
+        if lp == 1.0:
+            return lambda e: e
+        return lambda e: np.maximum(e, _EPS) ** (1.0 / lp)
+    try:
+        return {
+            "identity": lambda e: e,
+            "log": np.exp,
+            "logit": expit,
+            "inverse": lambda e: 1.0 / e,
+            "sqrt": lambda e: e**2,
+            "cloglog": lambda e: -np.expm1(-np.exp(e)),
+            "probit": ndtr,
+        }[link]
+    except KeyError:
+        raise ValueError(f"unknown link {link!r}") from None
+
+
+def _clip_mu_np(family: str, mu, var_power: float = 0.0):
+    """Float64 host-side twin of :func:`_clip_mu` (same bounds)."""
+    if family == "binomial":
+        return np.clip(mu, _MU_EPS, 1.0 - _MU_EPS)
+    if family in ("poisson", "gamma"):
+        return np.maximum(mu, _EPS)
+    if family == "tweedie" and var_power != 0.0:
+        return np.maximum(mu, _EPS)
+    return mu
+
+
 def _tweedie_link(stage) -> str:
     """The ONE resolution of a tweedie stage's power link: an explicit
     ``power:<lp>`` string (as persisted on fitted models) passes
@@ -283,9 +322,12 @@ def _aic(family: str, y, mu, w, n: int, dev: float, rank: int) -> float:
         return float(ll2 + 2.0 * rank)
     if family == "binomial":
         # weights are trial counts: Binomial(round(w), μ) log-pmf of
-        # round(y·w) successes; weight-0 rows contribute 0 (Spark)
-        wt = np.round(w)
-        r = np.round(y * w)
+        # round(y·w) successes; weight-0 rows contribute 0 (Spark).
+        # Scala math.round is half-UP — floor(x + 0.5) — not numpy's
+        # banker's rounding (np.round(2.5) == 2, math.round(2.5) == 3),
+        # and half-integer weights hit exactly that difference
+        wt = np.floor(w + 0.5)
+        r = np.floor(y * w + 0.5)
         mu_c = np.clip(mu, _MU_EPS, 1.0 - _MU_EPS)
         logpmf = (
             gammaln(wt + 1.0)
@@ -503,10 +545,13 @@ class GeneralizedLinearRegression(_GlrParams, Estimator):
 
             def aic(_Xa=Xa, _y=y, _w=w, _fam=family, _link=link, _vp=vp,
                     _beta=beta, _dev=dev_f, _n=n, _rank=rank):
-                _, g_inv, _ = _link_fns(_link)
+                # float64 end to end: the jnp link fns would downcast
+                # eta to f32 (jax x64 is off), costing digits the
+                # "host-side float64 one-pass" contract promises
+                g_inv = _link_inv_np(_link)
                 eta = _Xa.astype(np.float64) @ _beta
-                mu_fit = np.asarray(
-                    _clip_mu(_fam, g_inv(eta), _vp), np.float64
+                mu_fit = _clip_mu_np(
+                    _fam, np.asarray(g_inv(eta), np.float64), _vp
                 )
                 return _aic(_fam, _y, mu_fit, _w, _n, _dev, _rank)
         model.summary = GeneralizedLinearRegressionTrainingSummary(

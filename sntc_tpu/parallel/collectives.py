@@ -27,6 +27,26 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sntc_tpu.parallel.mesh import DATA_AXIS
+from sntc_tpu.resilience import RetryPolicy, fault_point, with_retries
+from sntc_tpu.resilience.policy import int_from_env
+
+
+def _dispatch_policy() -> "RetryPolicy | None":
+    """Optional retry for aggregate dispatch (site
+    ``collective.dispatch``): ``SNTC_COLLECTIVE_RETRIES=N`` arms N
+    in-place retries with deterministic backoff for dispatch failures
+    that RAISE (transient backend RPC/transfer errors, injected
+    faults).  It cannot help the XLA:CPU rendezvous-timeout class that
+    SIGABRTs the whole process (VERDICT r5) — process-level isolation
+    (``bench.py --isolate``) is the mitigation there.  Default 0
+    (single-shot: dispatch failures propagate unchanged)."""
+    retries = int_from_env("SNTC_COLLECTIVE_RETRIES", 0, minimum=0)
+    if retries <= 0:
+        return None
+    return RetryPolicy(
+        max_attempts=retries + 1, base_delay_s=0.1, multiplier=2.0,
+        max_delay_s=10.0, jitter=0.1, seed=0,
+    )
 
 # ---------------------------------------------------------------------------
 # device-residency cache — the BlockManager / ``df.cache()`` analog.
@@ -236,7 +256,24 @@ def make_tree_aggregate(
             check_vma=check_vma,  # False for fns with pallas_call inside
         )(*arrays)
 
-    return jax.jit(agg)
+    jitted = jax.jit(agg)
+    # resolved ONCE at build time: dispatch runs per optimizer iteration
+    # and per streaming batch — thousands of calls per fit must not each
+    # re-parse the env and rebuild a policy
+    policy = _dispatch_policy()
+
+    def dispatch(*arrays):
+        # the fault/retry hook lives OUTSIDE the jit so it runs per
+        # call (inside the trace it would fire once, at compile time)
+        def attempt():
+            fault_point("collective.dispatch")
+            return jitted(*arrays)
+
+        if policy is None:
+            return attempt()
+        return with_retries(attempt, policy, site="collective.dispatch")
+
+    return dispatch
 
 
 def tree_aggregate(fn: Callable, mesh: Mesh, *arrays, axis_name: str = DATA_AXIS):

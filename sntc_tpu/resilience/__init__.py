@@ -1,0 +1,43 @@
+"""Resilience layer: retry/backoff policies, deterministic fault
+injection, and the structured-event stream behind both.  See
+``docs/RESILIENCE.md`` for the site map and env knobs."""
+
+from sntc_tpu.resilience.faults import (
+    SITES,
+    InjectedFault,
+    InjectedIOFault,
+    InjectedTimeoutFault,
+    arm,
+    call_count,
+    clear,
+    disarm,
+    fault_point,
+    parse_faults_env,
+)
+from sntc_tpu.resilience.policy import (
+    RetryExhausted,
+    RetryPolicy,
+    clear_events,
+    emit_event,
+    recent_events,
+    with_retries,
+)
+
+__all__ = [
+    "RetryPolicy",
+    "RetryExhausted",
+    "with_retries",
+    "emit_event",
+    "recent_events",
+    "clear_events",
+    "fault_point",
+    "arm",
+    "disarm",
+    "clear",
+    "call_count",
+    "parse_faults_env",
+    "InjectedFault",
+    "InjectedIOFault",
+    "InjectedTimeoutFault",
+    "SITES",
+]

@@ -1,0 +1,246 @@
+"""Deterministic fault injection — named sites, armable by tests or env.
+
+Every external-world boundary in the framework calls
+``fault_point("<site>")`` before doing its real work.  Unarmed, that is
+a dictionary miss — effectively free.  Armed (programmatically via
+:func:`arm` or through the ``SNTC_FAULTS`` env knob), the point raises a
+typed :class:`InjectedFault` on a deterministic schedule, so every retry
+/ quarantine / fallback path in the codebase is exercisable in tier-1
+CPU tests without real hardware failures.
+
+Wired sites:
+
+======================  =====================================================
+``stream.read``         ``StreamingQuery`` micro-batch source read
+``sink.write``          ``StreamingQuery`` sink delivery (per batch)
+``ckpt.save``           ``mlio.save_model`` (before the atomic publish)
+``ckpt.load``           ``mlio.load_model`` (before manifest verification)
+``probe.init``          ``utils.backend_probe`` backend-liveness attempt
+``collective.dispatch`` ``parallel.collectives`` aggregate dispatch
+``cv.fit``              ``CrossValidator`` per-(fold, grid-point) fit
+======================  =====================================================
+
+Env grammar (comma-separated specs)::
+
+    SNTC_FAULTS=site[:kind[:prob[:seed]]][,site2:...]
+
+``kind`` is ``exc`` (RuntimeError), ``io`` (OSError) or ``timeout``
+(TimeoutError); ``prob`` in [0, 1] is evaluated per call with a
+generator seeded by ``seed`` — the same env string yields the same
+fault sequence in every run.  Example: arm the sink to fail ~30% of
+writes deterministically::
+
+    SNTC_FAULTS=sink.write:io:0.3:7
+
+Programmatic arming adds Nth-call precision: ``arm("sink.write",
+after=2, times=1)`` raises on exactly the 3rd call.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+from sntc_tpu.resilience.policy import emit_event
+
+
+class InjectedFault(RuntimeError):
+    """Base class of every injected fault (never raised by real code)."""
+
+
+class InjectedIOFault(InjectedFault, OSError):
+    pass
+
+
+class InjectedTimeoutFault(InjectedFault, TimeoutError):
+    pass
+
+
+_KINDS = {
+    "exc": InjectedFault,
+    "io": InjectedIOFault,
+    "timeout": InjectedTimeoutFault,
+}
+
+# the documented wired sites (arming others is allowed — custom call
+# sites can declare their own — but a typo'd WIRED site should be loud)
+SITES = (
+    "stream.read",
+    "sink.write",
+    "ckpt.save",
+    "ckpt.load",
+    "probe.init",
+    "collective.dispatch",
+    "cv.fit",
+)
+
+
+@dataclass
+class _Armed:
+    site: str
+    kind: str = "exc"
+    prob: float = 1.0
+    seed: int = 0
+    after: int = 0  # calls to let through before fault logic starts
+    times: Optional[int] = None  # max faults to raise; None = unlimited
+    from_env: bool = False
+    calls: int = 0
+    raised: int = 0
+    rng: np.random.Generator = field(default=None)  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; expected one of "
+                f"{sorted(_KINDS)}"
+            )
+        if not 0.0 <= self.prob <= 1.0:
+            raise ValueError(f"fault prob must lie in [0, 1], got {self.prob}")
+        self.rng = np.random.default_rng(self.seed)
+
+    def decide(self) -> bool:
+        """Called under the registry lock, once per fault_point hit."""
+        self.calls += 1
+        if self.calls <= self.after:
+            return False
+        if self.times is not None and self.raised >= self.times:
+            return False
+        # consume one deterministic draw per eligible call, so the
+        # fault sequence depends only on (seed, call index)
+        fire = (
+            self.prob >= 1.0 or float(self.rng.uniform()) < self.prob
+        )
+        if fire:
+            self.raised += 1
+        return fire
+
+
+_registry: Dict[str, _Armed] = {}
+_lock = threading.Lock()
+_env_installed: Optional[str] = None
+
+
+def arm(
+    site: str,
+    kind: str = "exc",
+    prob: float = 1.0,
+    seed: int = 0,
+    *,
+    after: int = 0,
+    times: Optional[int] = 1,
+    _from_env: bool = False,
+) -> None:
+    """Arm ``site``; default raises on the next call, exactly once."""
+    spec = _Armed(
+        site=site, kind=kind, prob=prob, seed=seed, after=after,
+        times=times, from_env=_from_env,
+    )
+    with _lock:
+        _registry[site] = spec
+
+
+def disarm(site: str) -> None:
+    with _lock:
+        _registry.pop(site, None)
+
+
+def clear() -> None:
+    """Drop every armed fault (programmatic AND env-installed; the env
+    string is re-installed on the next fault_point if still set)."""
+    global _env_installed
+    with _lock:
+        _registry.clear()
+        _env_installed = None
+
+
+def call_count(site: str) -> int:
+    with _lock:
+        spec = _registry.get(site)
+        return spec.calls if spec else 0
+
+
+def parse_faults_env(raw: str) -> list:
+    """Parse the ``SNTC_FAULTS`` grammar into arm() argument dicts."""
+    out = []
+    for chunk in raw.split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        parts = chunk.split(":")
+        if len(parts) > 4:
+            raise ValueError(
+                f"malformed SNTC_FAULTS spec {chunk!r}: expected "
+                "site[:kind[:prob[:seed]]]"
+            )
+        spec = {"site": parts[0]}
+        if len(parts) > 1:
+            spec["kind"] = parts[1]
+        try:
+            if len(parts) > 2:
+                spec["prob"] = float(parts[2])
+            if len(parts) > 3:
+                spec["seed"] = int(parts[3])
+        except ValueError:
+            raise ValueError(
+                f"malformed SNTC_FAULTS spec {chunk!r}: prob must be a "
+                "float, seed an int"
+            ) from None
+        out.append(spec)
+    return out
+
+
+def _sync_env() -> None:
+    """(Re)install env-armed faults when SNTC_FAULTS changed; never
+    touches programmatically armed sites.  A malformed string warns
+    ONCE on stderr and arms nothing — raising from here would surface
+    inside arbitrary fault_point call sites, where the retry/quarantine
+    machinery would misclassify the config typo as a real site fault."""
+    global _env_installed
+    raw = os.environ.get("SNTC_FAULTS") or None
+    if raw == _env_installed:
+        return
+    with _lock:
+        for site in [s for s, a in _registry.items() if a.from_env]:
+            del _registry[site]
+    if raw:
+        import sys
+
+        try:
+            specs = parse_faults_env(raw)
+            for spec in specs:
+                # env faults are probabilistic and unlimited — the knob
+                # models an unreliable environment, not a one-shot test
+                arm(times=None, _from_env=True, **spec)
+        except ValueError as e:
+            with _lock:
+                for site in [
+                    s for s, a in _registry.items() if a.from_env
+                ]:
+                    del _registry[site]
+            print(
+                f"sntc_tpu: ignoring malformed SNTC_FAULTS: {e}",
+                file=sys.stderr,
+            )
+    _env_installed = raw
+
+
+def fault_point(site: str) -> None:
+    """The per-site hook real code calls; raises when armed + scheduled."""
+    _sync_env()
+    spec = _registry.get(site)
+    if spec is None:
+        return
+    with _lock:
+        fire = spec.decide()
+        call = spec.calls
+    if fire:
+        emit_event(
+            event="fault_injected", site=site, kind=spec.kind, call=call
+        )
+        raise _KINDS[spec.kind](
+            f"injected {spec.kind} fault at site {site!r} (call {call})"
+        )

@@ -1,0 +1,214 @@
+"""Retry policies — the Spark task-retry (``spark.task.maxFailures``)
+analog for a single-process JAX pipeline.
+
+Behavioral spec: Spark's execution layer retries failed tasks with
+backoff and keeps the job alive (MLlib rode on it for free); tf.data
+treats input-pipeline fault handling as a first-class concern.  Here the
+substrate is one process talking to flaky externals — a TPU tunnel that
+times out, a sink volume that hiccups, a checkpoint torn mid-write — so
+the unit of retry is a *site*: a named callable boundary
+(``stream.read``, ``sink.write``, ``ckpt.load``, ``probe.init``, ...).
+
+:class:`RetryPolicy` is a frozen value object: max attempts, exponential
+backoff with DETERMINISTIC seeded jitter (the schedule is a pure
+function of the policy — tests assert it exactly), an optional overall
+deadline, and a retryable-exception classifier.
+:func:`with_retries` executes a thunk under a policy and emits
+structured JSONL events (``retry`` / ``retry_success`` /
+``retry_exhausted``) through :mod:`sntc_tpu.utils.logging` — set
+``SNTC_RESILIENCE_LOG=<path>`` to persist them; the last 512 events are
+always inspectable in-process via :func:`recent_events`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type
+
+import numpy as np
+
+from sntc_tpu.utils.logging import MetricsLogger
+
+
+class RetryExhausted(RuntimeError):
+    """Every attempt a policy allowed has failed; wraps the last error."""
+
+    def __init__(self, site: str, attempts: int, last: BaseException):
+        super().__init__(
+            f"{site}: {attempts} attempt(s) failed; last error: {last!r}"
+        )
+        self.site = site
+        self.attempts = attempts
+        self.last_exception = last
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Immutable retry spec; the backoff schedule is deterministic.
+
+    ``jitter`` is a ± fraction applied to each exponential delay with a
+    ``numpy`` generator seeded by ``seed`` — the same policy always
+    yields the same schedule, so sleep sequences are assertable in
+    tests and reproducible in incident logs.  ``deadline_s`` bounds the
+    TOTAL elapsed time (including the would-be next sleep): a retry
+    that cannot finish before the deadline is not attempted.
+    """
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    multiplier: float = 2.0
+    max_delay_s: float = 5.0
+    jitter: float = 0.1
+    seed: int = 0
+    deadline_s: Optional[float] = None
+    retryable: Tuple[Type[BaseException], ...] = (Exception,)
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if self.base_delay_s < 0 or self.max_delay_s < 0:
+            raise ValueError("delays must be >= 0")
+        if not 0.0 <= self.jitter <= 1.0:
+            raise ValueError("jitter must lie in [0, 1]")
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        return isinstance(exc, self.retryable)
+
+    def backoff_schedule(self) -> List[float]:
+        """Delay before retry i (i = 1 .. max_attempts-1), exactly."""
+        rng = np.random.default_rng(self.seed)
+        out = []
+        for i in range(max(0, self.max_attempts - 1)):
+            base = min(
+                self.base_delay_s * self.multiplier**i, self.max_delay_s
+            )
+            u = float(rng.uniform(-1.0, 1.0))
+            out.append(max(0.0, base * (1.0 + self.jitter * u)))
+        return out
+
+
+def int_from_env(var: str, default: int, minimum: int = 0) -> int:
+    """Shared env-int parser for retry knobs (``SNTC_PROBE_ATTEMPTS``,
+    ``SNTC_COLLECTIVE_RETRIES``, ...): malformed values warn once on
+    stderr and fall back — a config typo must never crash startup."""
+    raw = os.environ.get(var)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+    except (TypeError, ValueError):
+        print(
+            f"sntc_tpu: malformed {var}={raw!r}; using {default}",
+            file=sys.stderr,
+        )
+        return default
+    return max(minimum, val)
+
+
+# ---------------------------------------------------------------------------
+# structured events: JSONL through MetricsLogger + an in-process ring
+# ---------------------------------------------------------------------------
+
+_RECENT_MAX = 512
+_recent: "deque[Dict[str, Any]]" = deque(maxlen=_RECENT_MAX)
+_logger: Optional[MetricsLogger] = None
+
+
+def _events_logger() -> MetricsLogger:
+    # pathless: the MetricsLogger only shapes records (step/elapsed);
+    # file persistence is handled below in APPEND mode — the run-logger's
+    # truncate-on-construction would clobber a log shared with parent or
+    # sibling processes (bench --isolate children, probe subprocesses)
+    global _logger
+    if _logger is None:
+        _logger = MetricsLogger(None)
+    return _logger
+
+
+def emit_event(**fields: Any) -> Dict[str, Any]:
+    """Append one structured resilience event (JSONL when
+    ``SNTC_RESILIENCE_LOG`` is set; always kept in the in-process ring)."""
+    record = _events_logger().log(**fields)
+    path = os.environ.get("SNTC_RESILIENCE_LOG")
+    if path:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    _recent.append(record)
+    return record
+
+
+def recent_events(
+    site: Optional[str] = None, event: Optional[str] = None
+) -> List[Dict[str, Any]]:
+    """The in-process event ring, optionally filtered by site/event."""
+    return [
+        r
+        for r in _recent
+        if (site is None or r.get("site") == site)
+        and (event is None or r.get("event") == event)
+    ]
+
+
+def clear_events() -> None:
+    _recent.clear()
+
+
+# ---------------------------------------------------------------------------
+# the executor
+# ---------------------------------------------------------------------------
+
+
+def with_retries(
+    fn: Callable[[], Any],
+    policy: Optional[RetryPolicy] = None,
+    *,
+    site: str = "unspecified",
+    sleep: Callable[[float], None] = time.sleep,
+) -> Any:
+    """Run ``fn()`` under ``policy``; emit structured events per retry.
+
+    Non-retryable exceptions propagate unchanged.  Retryable failures
+    sleep the policy's deterministic backoff and re-invoke; when
+    attempts (or the deadline) run out, :class:`RetryExhausted` wraps
+    the last error.  ``sleep`` is injectable so tests assert schedules
+    without wall-clock cost.
+    """
+    policy = policy or RetryPolicy()
+    schedule = policy.backoff_schedule()
+    t0 = time.monotonic()
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            out = fn()
+        except BaseException as e:
+            if not policy.is_retryable(e):
+                raise
+            delay = schedule[attempt - 1] if attempt <= len(schedule) else 0.0
+            elapsed = time.monotonic() - t0
+            out_of_time = (
+                policy.deadline_s is not None
+                and elapsed + delay >= policy.deadline_s
+            )
+            if attempt >= policy.max_attempts or out_of_time:
+                emit_event(
+                    event="retry_exhausted", site=site, attempts=attempt,
+                    error=repr(e), deadline_hit=bool(out_of_time),
+                )
+                raise RetryExhausted(site, attempt, e) from e
+            emit_event(
+                event="retry", site=site, attempt=attempt,
+                delay_s=round(delay, 6), error=repr(e),
+            )
+            sleep(delay)
+        else:
+            if attempt > 1:
+                emit_event(
+                    event="retry_success", site=site, attempts=attempt
+                )
+            return out
+    raise AssertionError("unreachable")

@@ -28,6 +28,12 @@ import numpy as np
 from sntc_tpu.core.base import Transformer
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.data.ingest import load_csv
+from sntc_tpu.resilience import (
+    RetryPolicy,
+    emit_event,
+    fault_point,
+    with_retries,
+)
 from sntc_tpu.serve.transform import BatchPredictor
 
 
@@ -135,10 +141,12 @@ class CsvDirSink(StreamSink):
         cols = self.columns or [
             c for c in frame.columns if frame[c].ndim == 1
         ]
-        pacsv.write_csv(
-            frame.select(cols).to_arrow(),
-            os.path.join(self.path, f"batch_{batch_id:06d}.csv"),
-        )
+        # atomic tmp-then-rename: a crash (or injected fault) mid-write
+        # leaves no torn batch_*.csv for downstream readers to ingest
+        final = os.path.join(self.path, f"batch_{batch_id:06d}.csv")
+        tmp = final + ".tmp"
+        pacsv.write_csv(frame.select(cols).to_arrow(), tmp)
+        os.replace(tmp, final)
 
 
 class ConsoleSink(StreamSink):
@@ -161,6 +169,18 @@ class StreamingQuery:
     Starting a second query on the same dir, or committing externally while
     one runs, yields stale bookkeeping — recover by constructing a fresh
     query, which re-scans the log.
+
+    **Resilience (opt-in, defaults preserve single-shot semantics):**
+    ``retry_policy`` arms per-site retry with deterministic backoff for
+    source reads (``stream.read``) and sink delivery (``sink.write``).
+    ``max_batch_failures=N`` arms poison-batch quarantine: after a batch
+    has failed N retirement rounds (each round is a full retry cycle
+    under the policy), it is journaled to the dead-letter sink
+    (``<checkpoint_dir>/dead_letter/``) and COMMITTED so the query keeps
+    going instead of dying — Spark's "skip bad records" degradation,
+    with the evidence preserved.  Both sites call
+    ``sntc_tpu.resilience.fault_point`` so tier-1 tests (or
+    ``SNTC_FAULTS``) can inject failures deterministically.
     """
 
     _PROGRESS_KEEP = 100  # Spark keeps the last 100 progress records
@@ -174,6 +194,9 @@ class StreamingQuery:
         max_batch_offsets: Optional[int] = None,
         pipeline_depth: int = 2,
         wal_mode: str = "files",
+        retry_policy: Optional[RetryPolicy] = None,
+        max_batch_failures: Optional[int] = None,
+        dead_letter_dir: Optional[str] = None,
     ):
         self.predictor = BatchPredictor(model)
         self.source = source
@@ -189,6 +212,14 @@ class StreamingQuery:
         # restarted query replays exactly as Spark does.  Depth 1 disables
         # overlap.
         self.pipeline_depth = max(1, int(pipeline_depth))
+        self.retry_policy = retry_policy
+        if max_batch_failures is not None and max_batch_failures < 1:
+            raise ValueError("max_batch_failures must be >= 1 (or None)")
+        self.max_batch_failures = max_batch_failures
+        self.dead_letter_dir = dead_letter_dir or os.path.join(
+            checkpoint_dir, "dead_letter"
+        )
+        self._batch_failures: dict = {}
         self._in_flight: List[tuple] = []
         self._stopped = False
         # last _PROGRESS_KEEP committed batches' timing/rows (the
@@ -328,12 +359,54 @@ class StreamingQuery:
             self._wal_intent(batch_id, intent)
 
         t0 = time.perf_counter()
-        frame = self.source.get_batch(intent["start"], intent["end"])
-        finalize = self.predictor.predict_frame_async(frame)
+
+        def _read() -> Frame:
+            fault_point("stream.read")
+            return self.source.get_batch(intent["start"], intent["end"])
+
+        frame = None
+        stage = "stream.read"
+        try:
+            frame = (
+                with_retries(_read, self.retry_policy, site="stream.read")
+                if self.retry_policy is not None
+                else _read()
+            )
+            # eager models (the host micro-batch path) compute the whole
+            # prediction HERE — a malformed batch is as much a poison
+            # batch as a sink failure and must quarantine, not kill
+            stage = "predict.dispatch"
+            finalize = self.predictor.predict_frame_async(frame)
+        except Exception as e:
+            fails = self._bump_failures(batch_id, stage)
+            if self.max_batch_failures is None:
+                raise  # quarantine unarmed: r5 single-shot semantics
+            if fails < self.max_batch_failures or self._in_flight:
+                # below the threshold (or older in-flight batches must
+                # commit first — commit order is the restart-recovery
+                # contract): stop dispatching this round and retry next
+                # round WITHOUT killing the engine loop
+                return False
+            self._quarantine(batch_id, intent, frame, e, site=stage)
+            self._commit_batch(batch_id, intent, n_rows=0, t0=t0,
+                               quarantined=True)
+            self._next_start = intent["end"]
+            return True
         self._in_flight.append((batch_id, intent, finalize, t0,
-                                frame.num_rows))
+                                frame.num_rows, frame))
         self._next_start = intent["end"]
         return True
+
+    def _bump_failures(self, batch_id: int, stage: str) -> int:
+        """Per-(batch, stage) failure rounds: a read flake and a sink
+        flake on the same batch must not pool toward one threshold."""
+        key = (batch_id, stage)
+        self._batch_failures[key] = self._batch_failures.get(key, 0) + 1
+        return self._batch_failures[key]
+
+    def _clear_failures(self, batch_id: int) -> None:
+        for key in [k for k in self._batch_failures if k[0] == batch_id]:
+            del self._batch_failures[key]
 
     def _retire_oldest(self) -> None:
         """Materialize the oldest in-flight batch, sink it, commit.
@@ -342,41 +415,122 @@ class StreamingQuery:
         written: if the sink raises, the batch stays queued and the next
         ``process_available`` retries it from its WAL'd intent — popping
         first would silently skip the batch and shift every later
-        ``batch_id`` (exactly-once violation)."""
-        batch_id, intent, finalize, t0, n_rows = self._in_flight[0]
-        self.sink.add_batch(batch_id, finalize())
-        self._wal_commit(batch_id, intent)
+        ``batch_id`` (exactly-once violation).
+
+        With ``max_batch_failures=N`` armed, failed rounds below the
+        threshold DEFER (the batch stays queued, the engine loop stays
+        alive — under ``run()``/``start()`` each poll tick is one retry
+        round) and the N-th failed round quarantines the batch
+        (dead-letter journal + commit) so the query continues.  Returns
+        True when a batch was committed."""
+        batch_id, intent, finalize, t0, n_rows, frame = self._in_flight[0]
+
+        def _deliver() -> None:
+            fault_point("sink.write")
+            self.sink.add_batch(batch_id, finalize())
+
+        quarantined = False
+        try:
+            if self.retry_policy is not None:
+                with_retries(_deliver, self.retry_policy, site="sink.write")
+            else:
+                _deliver()
+        except Exception as e:
+            fails = self._bump_failures(batch_id, "sink.write")
+            if self.max_batch_failures is None:
+                raise  # quarantine unarmed: r5 single-shot semantics
+            if fails < self.max_batch_failures:
+                return False  # stays queued; retried next round
+            self._quarantine(batch_id, intent, frame, e,
+                             site="sink.write")
+            quarantined = True
         self._in_flight.pop(0)
+        self._commit_batch(batch_id, intent, n_rows=n_rows, t0=t0,
+                           quarantined=quarantined)
+        return True
+
+    def _commit_batch(self, batch_id: int, intent: dict, *, n_rows: int,
+                      t0: float, quarantined: bool) -> None:
+        """The ONE commit protocol (WAL commit + bookkeeping + progress
+        record), shared by normal retirement and both quarantine paths
+        so restart-recovery state can never diverge between them."""
+        self._wal_commit(batch_id, intent)
+        self._clear_failures(batch_id)
         self._last_committed = batch_id
         self._end_offset = intent["end"]
         dur = time.perf_counter() - t0
-        self.recentProgress.append({
+        progress = {
             "batchId": batch_id,
             "numInputRows": int(n_rows),
             "durationMs": dur * 1e3,
             "processedRowsPerSecond": (n_rows / dur) if dur > 0 else 0.0,
-        })
+        }
+        if quarantined:
+            progress["quarantined"] = True
+        self.recentProgress.append(progress)
         if len(self.recentProgress) > self._PROGRESS_KEEP:
             del self.recentProgress[0]
 
+    def _quarantine(
+        self, batch_id: int, intent: dict, frame: Optional[Frame],
+        exc: BaseException, site: str = "sink.write",
+    ) -> None:
+        """Journal the poison batch to the dead-letter sink: one JSONL
+        record (intent + error) always; the raw 1-D input columns as a
+        CSV alongside when dumpable.  The batch is then committed by the
+        caller — the query degrades instead of dying, and the evidence
+        survives for replay/repair tooling."""
+        os.makedirs(self.dead_letter_dir, exist_ok=True)
+        record = {
+            "batch_id": batch_id,
+            "intent": intent,
+            "error": repr(exc),
+            "failures": sum(
+                v for k, v in self._batch_failures.items()
+                if k[0] == batch_id
+            ),
+            "num_rows": int(frame.num_rows) if frame is not None else None,
+            "ts": time.time(),
+            "rows_file": None,
+        }
+        if frame is not None:
+            try:
+                # reuse the atomic CSV sink for the raw-rows dump
+                CsvDirSink(self.dead_letter_dir).add_batch(batch_id, frame)
+                record["rows_file"] = f"batch_{batch_id:06d}.csv"
+            except Exception as dump_err:
+                record["dump_error"] = repr(dump_err)
+        with open(
+            os.path.join(self.dead_letter_dir, "dead_letter.jsonl"), "a"
+        ) as f:
+            f.write(json.dumps(record) + "\n")
+        emit_event(
+            event="quarantine", site=site, batch_id=batch_id,
+            error=repr(exc),
+        )
+
     def _run_one_batch(self) -> bool:
         """Advance the pipeline by one committed batch; returns False when
-        no batch was committed (and nothing could be dispatched)."""
+        no batch was committed (and nothing could be dispatched).  A
+        read-poison batch quarantined inside the dispatch loop counts as
+        progress too (it commits without ever entering the pipeline)."""
+        before = self._last_committed
         while len(self._in_flight) < self.pipeline_depth:
             if not self._dispatch_next():
                 break
         if self._in_flight:
             self._retire_oldest()
-            return True
-        return False
+        return self._last_committed != before
 
     def process_available(self) -> int:
         """Deterministically drain all currently-available data; returns the
-        number of batches run (test/step API)."""
-        n = 0
+        number of batches COMMITTED (test/step API) — counted by commit
+        delta, so a read-quarantined batch that commits inside the
+        dispatch loop is included."""
+        start = self._last_committed
         while not self._stopped and self._run_one_batch():
-            n += 1
-        return n
+            pass
+        return self._last_committed - start
 
     def run(
         self,
@@ -384,12 +538,16 @@ class StreamingQuery:
         max_batches: Optional[int] = None,
     ) -> int:
         """Continuous micro-batch loop (the ``writeStream.start()`` analog,
-        in the foreground)."""
+        in the foreground).  Counts batches by commit delta — one round
+        can commit several read-quarantined batches; a deferred round
+        (quarantine armed, threshold not reached) sleeps and retries."""
         done = 0
         while not self._stopped:
-            ran = self._run_one_batch()
-            if ran:
-                done += 1
+            before = self._last_committed
+            self._run_one_batch()
+            delta = self._last_committed - before
+            if delta:
+                done += delta
                 if max_batches is not None and done >= max_batches:
                     break
             else:

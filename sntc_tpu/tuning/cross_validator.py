@@ -28,8 +28,22 @@ import numpy as np
 from sntc_tpu.core.base import Estimator, Model
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
+from sntc_tpu.resilience import (
+    RetryPolicy,
+    emit_event,
+    fault_point,
+    with_retries,
+)
 
 logger = logging.getLogger(__name__)
+
+# the default per-cell policy when faultTolerant=True and the caller
+# didn't pass one: one in-place retry, near-immediate (a CV cell failure
+# is usually deterministic — the retry catches transient device/host
+# flakes, then the cell degrades to NaN)
+_DEFAULT_CV_POLICY = RetryPolicy(
+    max_attempts=2, base_delay_s=0.01, max_delay_s=0.5, jitter=0.0
+)
 
 
 def _is_batched(estimator, grid) -> bool:
@@ -103,17 +117,28 @@ class _TuningParams:
         "optional column of user-assigned fold indices in [0, numFolds)",
         default=None,
     )
+    faultTolerant = Param(
+        "retry a failed (fold, grid) cell fit under the resilience "
+        "policy, then record NaN for that cell and keep the grid "
+        "search alive instead of aborting (forces per-cell sequential "
+        "fits — fault isolation needs cell-granular execution)",
+        default=False,
+        validator=validators.is_bool(),
+    )
 
 
 class CrossValidator(_TuningParams, Estimator):
     def __init__(self, estimator=None, estimatorParamMaps=None, evaluator=None,
-                 **kwargs):
+                 retryPolicy=None, **kwargs):
         super().__init__(**kwargs)
         if estimator is None or evaluator is None:
             raise ValueError("CrossValidator requires estimator and evaluator")
         self.estimator = estimator
         self.estimatorParamMaps = estimatorParamMaps or [{}]
         self.evaluator = evaluator
+        # in-memory only (not persisted): the per-cell policy used when
+        # faultTolerant=True; defaults to one quick in-place retry
+        self.retryPolicy = retryPolicy
 
     def _fit(self, frame: Frame) -> "CrossValidatorModel":
         k = self.getNumFolds()
@@ -144,39 +169,66 @@ class CrossValidator(_TuningParams, Estimator):
         )
 
         _warn_parallelism_noop(self.estimator, grid, self.getParallelism())
-        # strongest path: the whole k-fold × grid sweep as one vmapped
-        # device program (folds are per-lane weight masks; data uploads
-        # once) — available when the estimator supports batched grids
-        fold_models = None
-        if _is_batched(self.estimator, grid) and hasattr(
-            self.estimator, "_fit_grid_folds"
-        ):
-            fold_models = self.estimator._fit_grid_folds(
-                frame, grid, fold_of, k
-            )
-        for fold in range(k):
-            valid = frame.filter(fold_of == fold)
-            models = (
-                fold_models[fold]
-                if fold_models is not None
-                else _grid_fit(
-                    self.estimator, frame.filter(fold_of != fold), grid
+        if self.getFaultTolerant():
+            self._fit_folds_tolerant(frame, fold_of, k, grid, metrics,
+                                     sub_models)
+        else:
+            # strongest path: the whole k-fold × grid sweep as one vmapped
+            # device program (folds are per-lane weight masks; data uploads
+            # once) — available when the estimator supports batched grids
+            fold_models = None
+            if _is_batched(self.estimator, grid) and hasattr(
+                self.estimator, "_fit_grid_folds"
+            ):
+                fold_models = self.estimator._fit_grid_folds(
+                    frame, grid, fold_of, k
                 )
-            )
-            for gi, model in enumerate(models):
-                metrics[gi, fold] = self.evaluator.evaluate(
-                    model.transform(valid)
+            for fold in range(k):
+                valid = frame.filter(fold_of == fold)
+                models = (
+                    fold_models[fold]
+                    if fold_models is not None
+                    else _grid_fit(
+                        self.estimator, frame.filter(fold_of != fold), grid
+                    )
                 )
-                if sub_models is not None:
-                    sub_models[gi].append(model)
+                for gi, model in enumerate(models):
+                    metrics[gi, fold] = self.evaluator.evaluate(
+                        model.transform(valid)
+                    )
+                    if sub_models is not None:
+                        sub_models[gi].append(model)
 
-        avg = metrics.mean(axis=1)
-        best_idx = (
-            int(np.argmax(avg))
-            if self.evaluator.isLargerBetter()
-            else int(np.argmin(avg))
-        )
-        best_model = self.estimator.copy(grid[best_idx]).fit(frame)
+        larger = self.evaluator.isLargerBetter()
+        if self.getFaultTolerant():
+            # degraded cells are NaN: average each grid point over its
+            # SURVIVING folds; a grid point with no surviving fold can
+            # never win
+            counts = (~np.isnan(metrics)).sum(axis=1)
+            if not counts.any():
+                raise RuntimeError(
+                    "CrossValidator: every (fold, grid) cell failed "
+                    "even under the fault-tolerance policy"
+                )
+            sums = np.nansum(metrics, axis=1)
+            avg = np.where(
+                counts > 0, sums / np.maximum(counts, 1),
+                -np.inf if larger else np.inf,
+            )
+        else:
+            avg = metrics.mean(axis=1)
+        best_idx = int(np.argmax(avg)) if larger else int(np.argmin(avg))
+        refit = lambda: self.estimator.copy(grid[best_idx]).fit(frame)
+        if self.getFaultTolerant():
+            # the final refit deserves the same transient-flake cover as
+            # the cells — losing the whole surviving sweep to one blip
+            # at the finish line would defeat the tolerance
+            best_model = with_retries(
+                refit, self.retryPolicy or _DEFAULT_CV_POLICY,
+                site="cv.fit",
+            )
+        else:
+            best_model = refit()
         return CrossValidatorModel(
             bestModel=best_model,
             avgMetrics=avg.tolist(),
@@ -186,6 +238,47 @@ class CrossValidator(_TuningParams, Estimator):
             evaluator=self.evaluator,
             estimatorParamMaps=grid,
         )
+
+    def _fit_folds_tolerant(self, frame, fold_of, k, grid, metrics,
+                            sub_models) -> None:
+        """Per-(fold, grid-point) execution under the resilience policy:
+        each cell fit+evaluate retries per ``retryPolicy`` (site
+        ``cv.fit``), and on exhaustion the cell records NaN with a
+        structured ``cv_cell_degraded`` event — the grid search
+        continues.  Cell-granular by construction: the batched vmapped
+        sweep cannot isolate one lane's failure."""
+        policy = self.retryPolicy or _DEFAULT_CV_POLICY
+        for fold in range(k):
+            valid = frame.filter(fold_of == fold)
+            train = frame.filter(fold_of != fold)
+            for gi, params in enumerate(grid):
+                def _cell(params=params):
+                    fault_point("cv.fit")
+                    model = self.estimator.copy(params).fit(train)
+                    return model, self.evaluator.evaluate(
+                        model.transform(valid)
+                    )
+
+                try:
+                    model, metric = with_retries(
+                        _cell, policy, site="cv.fit"
+                    )
+                except Exception as e:
+                    metrics[gi, fold] = np.nan
+                    emit_event(
+                        event="cv_cell_degraded", site="cv.fit",
+                        fold=fold, grid_index=gi, error=repr(e),
+                    )
+                    logger.warning(
+                        "CrossValidator: fold %d grid point %d failed "
+                        "(%r); cell recorded as NaN", fold, gi, e,
+                    )
+                    if sub_models is not None:
+                        sub_models[gi].append(None)
+                    continue
+                metrics[gi, fold] = metric
+                if sub_models is not None:
+                    sub_models[gi].append(model)
 
     # -- persistence: a saved CrossValidator round-trips its full spec
     # (estimator + evaluator stages, grid in JSON), Spark ReadWrite parity

@@ -70,7 +70,13 @@ def resolve_cache_dir(cache_dir: str | None = None) -> str | None:
     )
     if os.environ.get("SNTC_CACHE_NO_HOST_KEY"):
         return base
-    return os.path.join(base, f"host-{host_feature_signature()}")
+    part = f"host-{host_feature_signature()}"
+    if os.path.basename(os.path.normpath(base)) == part:
+        # base is ALREADY the per-host partition — e.g. the env var was
+        # rewritten by a prior enable_persistent_cache(); nesting a
+        # second host-<sig> level would orphan every cached entry
+        return base
+    return os.path.join(base, part)
 
 
 def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
@@ -83,6 +89,13 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     import jax
 
     os.makedirs(resolved, exist_ok=True)
+    # ADVICE r5: when JAX_COMPILATION_CACHE_DIR is set, jax enables the
+    # cache at the UNpartitioned base at import time — rewrite the env
+    # var to the per-host path so compiles that consult the env (pre- or
+    # post-enable, this process or subprocesses inheriting the env)
+    # can never read/write foreign-host entries from the shared base,
+    # the exact SIGILL hazard the partition exists to prevent
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = resolved
     jax.config.update("jax_compilation_cache_dir", resolved)
     # default min compile time is 1s, which skips most of the small
     # per-stage programs (binning, scaler aggregates) whose compiles

@@ -59,6 +59,9 @@ def test_malformed_timeout_env_falls_back(monkeypatch, capsys, tmp_path):
     marker = tmp_path / "probe-marker"
     monkeypatch.setattr(bp, "_ok_marker", lambda: str(marker))
     monkeypatch.setenv("SNTC_PROBE_TIMEOUT_S", "not-a-number")
+    # single attempt so the total budget == per-attempt timeout (r6
+    # splits the budget across SNTC_PROBE_ATTEMPTS)
+    monkeypatch.setenv("SNTC_PROBE_ATTEMPTS", "1")
     assert probe_default_backend() is True
     assert calls["timeout"] == 180.0  # fell back to the default
     assert marker.exists()  # success cached — in tmp_path, not ~

@@ -40,3 +40,23 @@ def test_host_key_opt_out_and_disable(tmp_path, monkeypatch):
     assert cc.resolve_cache_dir(base) == base
     monkeypatch.setenv("SNTC_NO_COMPILE_CACHE", "1")
     assert cc.resolve_cache_dir(base) is None
+
+
+def test_enable_rewrites_env_to_partitioned_path(tmp_path, monkeypatch):
+    """ADVICE r5: with JAX_COMPILATION_CACHE_DIR set, jax can enable the
+    cache at the UNpartitIONED base before enable_persistent_cache()
+    runs; the helper must rewrite the env var to the per-host path so no
+    compile (here or in subprocesses) can touch the shared base."""
+    import os
+
+    base = str(tmp_path / "xla")
+    monkeypatch.delenv("SNTC_NO_COMPILE_CACHE", raising=False)
+    monkeypatch.delenv("SNTC_CACHE_NO_HOST_KEY", raising=False)
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", base)
+    resolved = cc.enable_persistent_cache()
+    assert resolved != base and resolved.startswith(base)
+    assert os.environ["JAX_COMPILATION_CACHE_DIR"] == resolved
+    # idempotent: re-enabling with the rewritten env must NOT nest a
+    # second host-<sig> partition level
+    assert cc.enable_persistent_cache() == resolved
+    assert cc.resolve_cache_dir() == resolved

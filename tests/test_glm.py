@@ -276,7 +276,7 @@ def test_aic_poisson_matches_scipy(mesh8):
     oracle = -2.0 * sp_poisson.logpmf(y.astype(int), mu).sum() + 2 * (
         X.shape[1] + 1
     )
-    assert glr.summary.aic == pytest.approx(oracle, rel=1e-4)
+    assert glr.summary.aic == pytest.approx(oracle, rel=1e-5)
 
 
 def test_aic_binomial_weighted_trials_matches_scipy(mesh8):
@@ -298,7 +298,40 @@ def test_aic_binomial_weighted_trials_matches_scipy(mesh8):
     oracle = -2.0 * sp_binom.logpmf(
         np.round(y * w).astype(int), w.astype(int), mu
     ).sum() + 2 * (X.shape[1] + 1)
-    assert glr.summary.aic == pytest.approx(oracle, rel=1e-4)
+    assert glr.summary.aic == pytest.approx(oracle, rel=1e-5)
+
+
+def test_aic_binomial_half_integer_weights_scala_rounding(mesh8):
+    """ADVICE r5: Scala math.round is half-UP; np.round is banker's.
+    Half-integer trial weights (w=2.5 -> 3 trials, not 2) must follow
+    Spark's floor(x + 0.5)."""
+    from scipy.stats import binom as sp_binom
+
+    rng = np.random.default_rng(21)
+    X = rng.normal(size=(400, 2)).astype(np.float32) * 0.5
+    eta = X @ np.array([0.7, -0.4]) + 0.2
+    p = 1 / (1 + np.exp(-eta))
+    # all weights half-integers: every row hits the rounding difference
+    w = rng.integers(1, 5, size=400).astype(np.float64) + 0.5
+    trials_scala = np.floor(w + 0.5)  # half-up, Scala math.round
+    succ = rng.binomial(trials_scala.astype(int), p).astype(np.float64)
+    y = np.clip(succ / w, 0.0, 1.0)
+    glr = GeneralizedLinearRegression(
+        mesh=mesh8, family="binomial", weightCol="w", maxIter=50
+    ).fit(Frame({"features": X, "label": y, "w": w}))
+    mu = glr.predict(X)
+    r_scala = np.floor(y * w + 0.5)
+    oracle = -2.0 * sp_binom.logpmf(
+        r_scala.astype(int), trials_scala.astype(int), mu
+    ).sum() + 2 * (X.shape[1] + 1)
+    assert glr.summary.aic == pytest.approx(oracle, rel=1e-5)
+    # and it must NOT match the banker's-rounding oracle (np.round(2.5)
+    # == 2): the two differ on every row here
+    oracle_bankers = -2.0 * sp_binom.logpmf(
+        np.round(y * w).astype(int), np.round(w).astype(int), mu
+    ).sum() + 2 * (X.shape[1] + 1)
+    assert abs(oracle - oracle_bankers) > 1.0
+    assert glr.summary.aic != pytest.approx(oracle_bankers, rel=1e-5)
 
 
 def test_aic_gamma_matches_scipy(mesh8):
@@ -317,7 +350,7 @@ def test_aic_gamma_matches_scipy(mesh8):
         + 2.0
         + 2 * (X.shape[1] + 1)
     )
-    assert glr.summary.aic == pytest.approx(oracle, rel=1e-4)
+    assert glr.summary.aic == pytest.approx(oracle, rel=1e-5)
 
 
 def test_aic_tweedie_raises(mesh8):
