@@ -81,6 +81,54 @@ def _git_dirty() -> bool:
         return True
 
 
+# watermark so each journal record reports only ITS OWN resilience
+# activity: a --config all sweep runs several configs in one process,
+# and config 1's retries must not show up as evidence against config 5
+_resilience_mark = {"step": -1, "dropped": 0}
+
+
+def _resilience_summary():
+    """Health/breaker evidence for the journal: per-type counts of the
+    structured resilience events SINCE the previous journal record
+    (event ``step`` watermark), ring evictions in the same window, and
+    any breaker that is not a pristine closed one.  None when the
+    window was clean — a result with retries or open breakers behind it
+    is not the same evidence as one without."""
+    try:
+        from sntc_tpu.resilience import (
+            breakers_snapshot,
+            events_dropped,
+            recent_events,
+        )
+    except Exception:
+        return None
+    counts: dict = {}
+    max_step = _resilience_mark["step"]
+    for e in recent_events():
+        step = e.get("step", 0)
+        if step <= _resilience_mark["step"]:
+            continue
+        max_step = max(max_step, step)
+        name = e.get("event", "unknown")
+        counts[name] = counts.get(name, 0) + 1
+    dropped_now = events_dropped()
+    # clear_events() resets the counter; never report a negative delta
+    dropped = max(0, dropped_now - _resilience_mark["dropped"])
+    _resilience_mark["step"] = max_step
+    _resilience_mark["dropped"] = dropped_now
+    breakers = {
+        site: snap
+        for site, snap in breakers_snapshot().items()
+        if snap["state"] != "closed" or snap["open_count"]
+    }
+    if not counts and not breakers and not dropped:
+        return None
+    out = {"event_counts": counts, "events_dropped": dropped}
+    if breakers:
+        out["breakers"] = breakers
+    return out
+
+
 def _journal_run(cfg: str, line: dict) -> None:
     """Append the full machine-written record of this invocation to the
     COMMITTED ``bench_runs.jsonl`` — the auditable raw evidence behind
@@ -96,6 +144,13 @@ def _journal_run(cfg: str, line: dict) -> None:
         "bench_rows_env": os.environ.get("BENCH_ROWS"),
         **line,
     }
+    # a line that already carries its own evidence (an --isolate child
+    # shipped its ring through stdout) must not be overwritten with the
+    # parent's — the parent ring never saw the child's events
+    if "resilience" not in record:
+        resilience = _resilience_summary()
+        if resilience is not None:
+            record["resilience"] = resilience
     with open(RUNS_JOURNAL, "a") as f:
         f.write(json.dumps(record) + "\n")
 
@@ -1130,6 +1185,15 @@ def main():
     ordered = sorted(configs, key=lambda c: (c == "2", c))
     for cfg in ordered:
         line = run_config(cfg, args.rows, pair=not args.no_pair)
+        # evidence in the PRINTED line, not only the journal record: an
+        # --isolate child runs with BENCH_NO_JOURNAL=1 and its stdout
+        # line is all the parent's journal will ever see of its ring.
+        # Guard BEFORE summarizing — the summary advances the event
+        # watermark, and discarding it would silently drop events.
+        if "resilience" not in line:
+            resilience = _resilience_summary()
+            if resilience is not None:
+                line["resilience"] = resilience
         _journal_run(cfg, line)
         print(json.dumps(line), flush=True)
 

@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""Crash-consistency chaos matrix for the streaming engine.
+
+Forks a real engine process over a directory of CSV micro-batches and
+KILLS it (``SNTC_FAULTS=<site>:kill`` → ``os._exit``, no cleanup) at
+each armed protocol boundary:
+
+=================  ====================================================
+``stream.wal``     pre-WAL: the batch was planned but no intent exists
+``sink.write``     post-WAL / pre-sink: intent logged, no output
+``stream.commit``  post-sink / pre-commit: output written, no commit
+=================  ====================================================
+
+After each kill the engine is restarted on the same checkpoint dir and
+must converge to EXACTLY the committed offsets and sink row counts of
+an uninterrupted reference run — no duplicate rows, no lost rows
+(exactly-once w.r.t. the offset log; the CSV sink dedupes a replayed
+batch by rewriting ``batch_<id>.csv`` in place).
+
+The drain scenario starts a supervised serving loop (slow sink so a
+batch is reliably in flight), sends SIGTERM, and requires: exit code
+0, a committed in-flight batch, and ``drain_marker.json`` in the
+checkpoint dir.
+
+Run it directly (``python scripts/chaos_crash_matrix.py``) for a JSON
+verdict per scenario; ``tests/test_supervision.py`` drives the same
+functions in tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.abspath(__file__)
+
+KILL_SITES = ("stream.wal", "sink.write", "stream.commit")
+KILL_EXIT_CODE = 137  # mirrors sntc_tpu.resilience.KILL_EXIT_CODE
+
+
+# ---------------------------------------------------------------------------
+# scenario inputs / state readers (parent side; no sntc_tpu import)
+# ---------------------------------------------------------------------------
+
+
+def write_inputs(watch_dir: str, n_files: int = 4, rows: int = 6) -> None:
+    """``n_files`` tiny CSVs; with ``max_batch_offsets=1`` each file is
+    one micro-batch."""
+    os.makedirs(watch_dir, exist_ok=True)
+    for i in range(n_files):
+        with open(
+            os.path.join(watch_dir, f"in_{i:03d}.csv"), "w", newline=""
+        ) as f:
+            w = csv.writer(f)
+            w.writerow(["x"])
+            for r in range(rows):
+                w.writerow([i * 1000 + r])
+
+
+def committed_state(ckpt_dir: str) -> dict:
+    """Committed batch ids and their offset ranges from the WAL."""
+    commits = {}
+    for p in sorted(glob.glob(os.path.join(ckpt_dir, "commits", "*.json"))):
+        with open(p) as f:
+            rec = json.load(f)
+        commits[int(os.path.splitext(os.path.basename(p))[0])] = (
+            rec["start"], rec["end"],
+        )
+    return commits
+
+
+def sink_rows(out_dir: str) -> dict:
+    """Data-row count per batch CSV the sink published."""
+    out = {}
+    for p in sorted(glob.glob(os.path.join(out_dir, "batch_*.csv"))):
+        with open(p) as f:
+            out[os.path.basename(p)] = max(0, sum(1 for _ in f) - 1)
+    return out
+
+
+def run_worker(
+    watch: str, out: str, ckpt: str, *, faults: str = "",
+    slow_sink_s: float = 0.0, timeout: float = 120.0,
+) -> subprocess.CompletedProcess:
+    """One drain-and-exit engine pass in a child process."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS=faults)
+    env.pop("SNTC_RESILIENCE_LOG", None)
+    cmd = [
+        sys.executable, SCRIPT, "--worker", "--watch", watch, "--out",
+        out, "--ckpt", ckpt, "--slow-sink-s", str(slow_sink_s),
+    ]
+    return subprocess.run(
+        cmd, env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+def run_reference(workdir: str) -> dict:
+    """One uninterrupted run over the standard inputs; every kill
+    scenario is compared against its committed offsets and sink rows
+    (the inputs are identical, so one reference serves all)."""
+    d = os.path.join(workdir, "reference")
+    watch = os.path.join(d, "in")
+    write_inputs(watch)
+    ref_out, ref_ckpt = os.path.join(d, "out"), os.path.join(d, "ckpt")
+    ref = run_worker(watch, ref_out, ref_ckpt)
+    if ref.returncode != 0:
+        raise RuntimeError(
+            f"reference run rc={ref.returncode}: {ref.stderr}"
+        )
+    return {"commits": committed_state(ref_ckpt), "rows": sink_rows(ref_out)}
+
+
+def run_kill_scenario(workdir: str, site: str, reference: dict) -> dict:
+    """Kill the engine at ``site``, restart, compare against the clean
+    reference run.  Returns a verdict dict with ``ok``."""
+    d = os.path.join(workdir, site.replace(".", "_"))
+    watch = os.path.join(d, "in")
+    write_inputs(watch)
+
+    out, ckpt = os.path.join(d, "out"), os.path.join(d, "ckpt")
+    killed = run_worker(watch, out, ckpt, faults=f"{site}:kill")
+    if killed.returncode != KILL_EXIT_CODE:
+        return {"site": site, "ok": False,
+                "error": f"kill run rc={killed.returncode} (expected "
+                f"{KILL_EXIT_CODE}): {killed.stderr}"}
+
+    restarted = run_worker(watch, out, ckpt)  # no faults: converge
+    if restarted.returncode != 0:
+        return {"site": site, "ok": False,
+                "error": f"restart rc={restarted.returncode}: "
+                f"{restarted.stderr}"}
+
+    got_commits = committed_state(ckpt)
+    want_commits = reference["commits"]
+    got_rows = sink_rows(out)
+    want_rows = reference["rows"]
+    ok = got_commits == want_commits and got_rows == want_rows
+    return {
+        "site": site, "ok": ok,
+        "commits": {str(k): v for k, v in got_commits.items()},
+        "expected_commits": {str(k): v for k, v in want_commits.items()},
+        "sink_rows": got_rows, "expected_sink_rows": want_rows,
+    }
+
+
+def run_drain_scenario(workdir: str, timeout: float = 120.0) -> dict:
+    """SIGTERM a supervised serving loop mid-batch; require exit 0, a
+    commit for the in-flight batch, and the drain marker."""
+    d = os.path.join(workdir, "drain")
+    watch = os.path.join(d, "in")
+    out, ckpt = os.path.join(d, "out"), os.path.join(d, "ckpt")
+    write_inputs(watch, n_files=6)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS="")
+    proc = subprocess.Popen(
+        [
+            sys.executable, SCRIPT, "--worker", "--serve", "--watch",
+            watch, "--out", out, "--ckpt", ckpt, "--slow-sink-s", "0.4",
+            "--poll-interval", "0.05",
+        ],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        deadline = time.time() + timeout
+        # wait until the engine is demonstrably mid-stream (first batch
+        # out, more input pending) so SIGTERM lands with work in flight
+        while time.time() < deadline and not sink_rows(out):
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except Exception:
+        proc.kill()
+        raise
+    marker_path = os.path.join(ckpt, "drain_marker.json")
+    marker = None
+    if os.path.exists(marker_path):
+        with open(marker_path) as f:
+            marker = json.load(f)
+    commits = committed_state(ckpt)
+    rows = sink_rows(out)
+    ok = (
+        proc.returncode == 0
+        and marker is not None
+        and marker["in_flight_left"] == 0
+        and len(commits) >= 1
+        and len(rows) == len(commits)  # every commit has its sink batch
+        and marker["last_committed"] == max(commits)
+    )
+    return {
+        "site": "drain", "ok": ok, "rc": proc.returncode,
+        "marker": marker, "commits": {str(k): v for k, v in commits.items()},
+        "sink_batches": len(rows), "stderr": stderr[-2000:],
+        "stdout": stdout[-500:],
+    }
+
+
+def run_matrix(workdir: str) -> dict:
+    reference = run_reference(workdir)
+    results = [
+        run_kill_scenario(workdir, s, reference) for s in KILL_SITES
+    ]
+    results.append(run_drain_scenario(workdir))
+    return {"ok": all(r["ok"] for r in results), "scenarios": results}
+
+
+# ---------------------------------------------------------------------------
+# worker (child side)
+# ---------------------------------------------------------------------------
+
+
+def worker_main(args) -> int:
+    sys.path.insert(0, REPO)
+    from sntc_tpu.core.base import Transformer
+    from sntc_tpu.resilience import QuerySupervisor, default_breakers
+    from sntc_tpu.serve import CsvDirSink, FileStreamSource, StreamingQuery
+
+    class Identity(Transformer):
+        def transform(self, frame):
+            return frame
+
+    sink = CsvDirSink(args.out, columns=["x"])
+    if args.slow_sink_s > 0:
+        real_add = sink.add_batch
+
+        def slow_add(batch_id, frame):
+            time.sleep(args.slow_sink_s)
+            real_add(batch_id, frame)
+
+        sink.add_batch = slow_add
+    q = StreamingQuery(
+        Identity(), FileStreamSource(args.watch), sink, args.ckpt,
+        max_batch_offsets=1, breakers=default_breakers(),
+    )
+    if not args.serve:
+        n = q.process_available()
+        print(json.dumps({"batches": n}))
+        return 0
+    sup = QuerySupervisor(q, health_json=os.path.join(args.ckpt, "health.json"))
+    sup.install_signal_handlers()
+    status = sup.run(poll_interval=args.poll_interval)
+    print(json.dumps({"batches": status["engine"]["batches_done"],
+                      "drained": status["drained"]}))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--serve", action="store_true",
+                    help="worker: supervised loop instead of one pass")
+    ap.add_argument("--watch")
+    ap.add_argument("--out")
+    ap.add_argument("--ckpt")
+    ap.add_argument("--slow-sink-s", type=float, default=0.0)
+    ap.add_argument("--poll-interval", type=float, default=0.05)
+    ap.add_argument("--workdir", default=None,
+                    help="matrix scratch dir (default: a fresh tempdir)")
+    args = ap.parse_args(argv)
+    if args.worker:
+        return worker_main(args)
+    workdir = args.workdir
+    if workdir is None:
+        import tempfile
+
+        workdir = tempfile.mkdtemp(prefix="chaos_matrix_")
+    verdict = run_matrix(workdir)
+    print(json.dumps(verdict, indent=1))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

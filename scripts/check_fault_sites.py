@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Static drift check: fault sites in code ⇔ docs/RESILIENCE.md.
+
+Every ``fault_point("<site>")`` call site wired in ``sntc_tpu/`` must
+be (a) declared in ``sntc_tpu.resilience.SITES`` and (b) documented in
+the site table of ``docs/RESILIENCE.md`` — and vice versa: a
+documented or declared site with no live call site is drift too.
+Wired as a tier-1 test (``tests/test_supervision.py``) so the three
+sources cannot diverge silently.
+
+Exit 0 when consistent; exit 1 with a per-direction report otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CALL_RE = re.compile(r"""fault_point\(\s*["']([A-Za-z0-9_.]+)["']\s*\)""")
+# docs table rows: | `site.name` | description |
+_DOC_RE = re.compile(r"^\|\s*`([A-Za-z0-9_.]+)`\s*\|", re.MULTILINE)
+
+
+def code_sites(root: str = None) -> set:
+    """Sites passed as literals to fault_point() anywhere in sntc_tpu/
+    (the definition module itself is excluded — it is the hook, not a
+    call site)."""
+    root = root or os.path.join(REPO, "sntc_tpu")
+    sites = set()
+    for dirpath, _, files in os.walk(root):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            if path.endswith(os.path.join("resilience", "faults.py")):
+                continue
+            with open(path) as f:
+                sites.update(_CALL_RE.findall(f.read()))
+    return sites
+
+
+def declared_sites() -> set:
+    sys.path.insert(0, REPO)
+    from sntc_tpu.resilience import SITES
+
+    return set(SITES)
+
+
+def documented_sites(doc_path: str = None) -> set:
+    doc_path = doc_path or os.path.join(REPO, "docs", "RESILIENCE.md")
+    with open(doc_path) as f:
+        text = f.read()
+    return {s for s in _DOC_RE.findall(text) if "." in s and s != "site"}
+
+
+def check() -> list:
+    """Returns a list of human-readable drift complaints (empty = ok)."""
+    in_code = code_sites()
+    declared = declared_sites()
+    documented = documented_sites()
+    problems = []
+    for site in sorted(in_code - declared):
+        problems.append(
+            f"fault_point({site!r}) is wired in code but missing from "
+            "sntc_tpu.resilience.SITES"
+        )
+    for site in sorted(in_code - documented):
+        problems.append(
+            f"fault_point({site!r}) is wired in code but undocumented "
+            "in docs/RESILIENCE.md"
+        )
+    for site in sorted(declared - in_code):
+        problems.append(
+            f"SITES declares {site!r} but no fault_point({site!r}) call "
+            "site exists in sntc_tpu/"
+        )
+    for site in sorted(documented - in_code):
+        problems.append(
+            f"docs/RESILIENCE.md documents {site!r} but no "
+            f"fault_point({site!r}) call site exists in sntc_tpu/"
+        )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("fault-site drift detected:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    n = len(code_sites())
+    print(f"ok: {n} fault sites consistent across code, SITES, and docs")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -240,6 +240,11 @@ def strip_label_indexer(model, label_index_col: str):
 def cmd_serve(args) -> int:
     from sntc_tpu.core.base import PipelineModel
     from sntc_tpu.mlio import load_model
+    from sntc_tpu.resilience import (
+        QuerySupervisor,
+        RetryPolicy,
+        default_breakers,
+    )
     from sntc_tpu.serve import (
         CsvDirSink,
         FileStreamSource,
@@ -267,6 +272,13 @@ def cmd_serve(args) -> int:
         model = compile_serving(PipelineModel(stages=stages + tail))
         if tail:
             out_cols = ["prediction", "predictedLabel"]
+    # a SERVED query degrades instead of dying: transient read/sink
+    # errors retry in place, a batch that keeps failing quarantines to
+    # the dead-letter journal after --max-batch-failures rounds, and
+    # the breakers get enough outcomes to actually open — without these
+    # the first IOError would kill the process and the supervision
+    # layer below would never see a second chance
+    retries = max(1, args.batch_retry_attempts)
     q = StreamingQuery(
         model,
         FileStreamSource(args.watch),
@@ -274,17 +286,44 @@ def cmd_serve(args) -> int:
         args.checkpoint,
         max_batch_offsets=args.max_files_per_batch,
         pipeline_depth=args.pipeline_depth,
+        breakers=default_breakers(),
+        retry_policy=(
+            RetryPolicy(max_attempts=retries, base_delay_s=0.2, jitter=0.1)
+            if retries > 1 else None
+        ),
+        max_batch_failures=(
+            args.max_batch_failures if args.max_batch_failures > 0 else None
+        ),
     )
     if args.once:
         n = q.process_available()
         print(json.dumps({"batches": n}))
         return 0
+    # supervised loop: SIGTERM (and Ctrl-C) drains — finish in-flight
+    # batches, commit, write drain_marker.json — and exits 0; a restart
+    # on the same checkpoint resumes exactly-once from the offset log
+    sup = QuerySupervisor(
+        q,
+        max_pending_batches=args.max_pending_batches,
+        shed_policy=args.shed_policy,
+        max_batch_wall_time=args.max_batch_wall_time,
+        health_json=args.health_json,
+    )
+    sup.install_signal_handlers()
     print(f"serving: watching {args.watch} -> {args.out} "
-          f"(checkpoint {args.checkpoint}); Ctrl-C to stop", file=sys.stderr)
+          f"(checkpoint {args.checkpoint}); SIGTERM/Ctrl-C drains",
+          file=sys.stderr)
     try:
-        q.run(poll_interval=args.poll_interval)
+        status = sup.run(poll_interval=args.poll_interval)
     except KeyboardInterrupt:
-        q.stop()
+        status = sup.drain_now("KeyboardInterrupt")
+    finally:
+        sup.close()  # unsubscribe the health monitor from the event bus
+    print(json.dumps({
+        "batches": status["engine"]["batches_done"],
+        "drained": status["drained"],
+        "health": status["health"]["overall"],
+    }))
     return 0
 
 
@@ -354,6 +393,26 @@ def main(argv=None) -> int:
     p.add_argument("--poll-interval", type=float, default=1.0)
     p.add_argument("--once", action="store_true",
                    help="drain available files and exit")
+    p.add_argument("--health-json", default=None, metavar="PATH",
+                   help="atomically rewrite a health/breaker/engine "
+                   "status dump here every engine tick")
+    p.add_argument("--max-pending-batches", type=int, default=None,
+                   help="load-shed when the source backlog exceeds this "
+                   "many micro-batches (default: never shed)")
+    p.add_argument("--shed-policy", default="oldest",
+                   choices=["oldest", "sample"],
+                   help="shed the oldest surplus offsets, or process the "
+                   "whole backlog row-subsampled (journaled either way)")
+    p.add_argument("--max-batch-wall-time", type=float, default=None,
+                   metavar="S", help="watchdog: flag a batch running "
+                   "longer than this as UNHEALTHY (watchdog_stall event)")
+    p.add_argument("--batch-retry-attempts", type=int, default=2,
+                   help="in-place attempts per read/sink stage before a "
+                   "round counts as failed (1 = no retry)")
+    p.add_argument("--max-batch-failures", type=int, default=3,
+                   help="failed rounds before a poison batch is "
+                   "dead-lettered and committed; 0 = first failure "
+                   "kills the query (pre-r6 semantics)")
     add_platform_arg(p)
     p.set_defaults(fn=cmd_serve)
 

@@ -27,8 +27,28 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sntc_tpu.parallel.mesh import DATA_AXIS
-from sntc_tpu.resilience import RetryPolicy, fault_point, with_retries
+from sntc_tpu.resilience import (
+    CircuitOpenError,
+    RetryPolicy,
+    breaker_for,
+    fault_point,
+    with_retries,
+)
 from sntc_tpu.resilience.policy import int_from_env
+
+
+def _dispatch_breaker():
+    """Optional circuit breaker for aggregate dispatch:
+    ``SNTC_COLLECTIVE_BREAKER=1`` shares one process-wide breaker for
+    site ``collective.dispatch`` across every aggregate — when a
+    backend is down hard, dispatch fails FAST with
+    :class:`CircuitOpenError` instead of burning a retry budget per
+    call.  Cooldown via ``SNTC_COLLECTIVE_BREAKER_COOLDOWN_S``
+    (default 30).  Default off: dispatch behavior is unchanged."""
+    if int_from_env("SNTC_COLLECTIVE_BREAKER", 0) <= 0:
+        return None
+    cooldown = int_from_env("SNTC_COLLECTIVE_BREAKER_COOLDOWN_S", 30)
+    return breaker_for("collective.dispatch", cooldown_s=float(cooldown))
 
 
 def _dispatch_policy() -> "RetryPolicy | None":
@@ -261,17 +281,35 @@ def make_tree_aggregate(
     # and per streaming batch — thousands of calls per fit must not each
     # re-parse the env and rebuild a policy
     policy = _dispatch_policy()
+    breaker = _dispatch_breaker()
 
     def dispatch(*arrays):
-        # the fault/retry hook lives OUTSIDE the jit so it runs per
-        # call (inside the trace it would fire once, at compile time)
+        # the fault/retry/breaker hooks live OUTSIDE the jit so they run
+        # per call (inside the trace they would fire once, at compile time)
         def attempt():
             fault_point("collective.dispatch")
             return jitted(*arrays)
 
-        if policy is None:
-            return attempt()
-        return with_retries(attempt, policy, site="collective.dispatch")
+        if breaker is not None and not breaker.allow():
+            raise CircuitOpenError(
+                "collective.dispatch", breaker.retry_after_s()
+            )
+        try:
+            if policy is None:
+                out = attempt()
+            else:
+                out = with_retries(
+                    attempt, policy, site="collective.dispatch"
+                )
+        except Exception:
+            # KeyboardInterrupt/SystemExit pass through uncounted — a
+            # user interrupt is not evidence the backend is down
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        if breaker is not None:
+            breaker.record_success()
+        return out
 
     return dispatch
 

@@ -1,8 +1,17 @@
 """Resilience layer: retry/backoff policies, deterministic fault
-injection, and the structured-event stream behind both.  See
+injection, circuit breakers, health monitoring, query supervision, and
+the structured-event stream behind all of them.  See
 ``docs/RESILIENCE.md`` for the site map and env knobs."""
 
+from sntc_tpu.resilience.circuit import (
+    CircuitBreaker,
+    CircuitOpenError,
+    breaker_for,
+    breakers_snapshot,
+    reset_breakers,
+)
 from sntc_tpu.resilience.faults import (
+    KILL_EXIT_CODE,
     SITES,
     InjectedFault,
     InjectedIOFault,
@@ -14,14 +23,19 @@ from sntc_tpu.resilience.faults import (
     fault_point,
     parse_faults_env,
 )
+from sntc_tpu.resilience.health import HealthMonitor, HealthState
 from sntc_tpu.resilience.policy import (
     RetryExhausted,
     RetryPolicy,
+    add_event_observer,
     clear_events,
     emit_event,
+    events_dropped,
     recent_events,
+    remove_event_observer,
     with_retries,
 )
+from sntc_tpu.resilience.supervisor import QuerySupervisor, default_breakers
 
 __all__ = [
     "RetryPolicy",
@@ -29,6 +43,9 @@ __all__ = [
     "with_retries",
     "emit_event",
     "recent_events",
+    "events_dropped",
+    "add_event_observer",
+    "remove_event_observer",
     "clear_events",
     "fault_point",
     "arm",
@@ -40,4 +57,14 @@ __all__ = [
     "InjectedIOFault",
     "InjectedTimeoutFault",
     "SITES",
+    "KILL_EXIT_CODE",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "breaker_for",
+    "breakers_snapshot",
+    "reset_breakers",
+    "HealthMonitor",
+    "HealthState",
+    "QuerySupervisor",
+    "default_breakers",
 ]

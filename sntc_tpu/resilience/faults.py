@@ -11,7 +11,9 @@ CPU tests without real hardware failures.
 Wired sites:
 
 ======================  =====================================================
+``stream.wal``          ``StreamingQuery`` before the intent WAL write
 ``stream.read``         ``StreamingQuery`` micro-batch source read
+``stream.commit``       ``StreamingQuery`` after sink delivery, before commit
 ``sink.write``          ``StreamingQuery`` sink delivery (per batch)
 ``ckpt.save``           ``mlio.save_model`` (before the atomic publish)
 ``ckpt.load``           ``mlio.load_model`` (before manifest verification)
@@ -24,8 +26,9 @@ Env grammar (comma-separated specs)::
 
     SNTC_FAULTS=site[:kind[:prob[:seed]]][,site2:...]
 
-``kind`` is ``exc`` (RuntimeError), ``io`` (OSError) or ``timeout``
-(TimeoutError); ``prob`` in [0, 1] is evaluated per call with a
+``kind`` is ``exc`` (RuntimeError), ``io`` (OSError), ``timeout``
+(TimeoutError) or ``kill`` (``os._exit`` — the chaos-harness process
+crash); ``prob`` in [0, 1] is evaluated per call with a
 generator seeded by ``seed`` — the same env string yields the same
 fault sequence in every run.  Example: arm the sink to fail ~30% of
 writes deterministically::
@@ -66,10 +69,19 @@ _KINDS = {
     "timeout": InjectedTimeoutFault,
 }
 
+# ``kill`` is the chaos-harness kind: instead of raising, the armed
+# site hard-exits the process (``os._exit``, skipping every handler and
+# atexit hook — a real crash, not an exception) so crash-consistency
+# tests can kill a forked engine at an exact protocol boundary.
+KILL_KIND = "kill"
+KILL_EXIT_CODE = 137
+
 # the documented wired sites (arming others is allowed — custom call
 # sites can declare their own — but a typo'd WIRED site should be loud)
 SITES = (
+    "stream.wal",
     "stream.read",
+    "stream.commit",
     "sink.write",
     "ckpt.save",
     "ckpt.load",
@@ -93,10 +105,10 @@ class _Armed:
     rng: np.random.Generator = field(default=None)  # type: ignore[assignment]
 
     def __post_init__(self):
-        if self.kind not in _KINDS:
+        if self.kind not in _KINDS and self.kind != KILL_KIND:
             raise ValueError(
                 f"unknown fault kind {self.kind!r}; expected one of "
-                f"{sorted(_KINDS)}"
+                f"{sorted(_KINDS) + [KILL_KIND]}"
             )
         if not 0.0 <= self.prob <= 1.0:
             raise ValueError(f"fault prob must lie in [0, 1], got {self.prob}")
@@ -164,7 +176,12 @@ def call_count(site: str) -> int:
 
 
 def parse_faults_env(raw: str) -> list:
-    """Parse the ``SNTC_FAULTS`` grammar into arm() argument dicts."""
+    """Parse the ``SNTC_FAULTS`` grammar into arm() argument dicts.
+
+    Every grammar failure raises a ``ValueError`` that NAMES the
+    offending comma-separated segment and says which field broke —
+    wrong arity, empty site, unknown kind, non-numeric or out-of-range
+    prob, non-integer seed — never a bare unpack/conversion error."""
     out = []
     for chunk in raw.split(","):
         chunk = chunk.strip()
@@ -173,22 +190,45 @@ def parse_faults_env(raw: str) -> list:
         parts = chunk.split(":")
         if len(parts) > 4:
             raise ValueError(
-                f"malformed SNTC_FAULTS spec {chunk!r}: expected "
-                "site[:kind[:prob[:seed]]]"
+                f"malformed SNTC_FAULTS spec {chunk!r}: expected at most "
+                f"4 ':'-separated fields (site[:kind[:prob[:seed]]]), "
+                f"got {len(parts)}"
+            )
+        if not parts[0]:
+            raise ValueError(
+                f"malformed SNTC_FAULTS spec {chunk!r}: empty site name"
             )
         spec = {"site": parts[0]}
         if len(parts) > 1:
+            if parts[1] not in _KINDS and parts[1] != KILL_KIND:
+                raise ValueError(
+                    f"malformed SNTC_FAULTS spec {chunk!r}: unknown kind "
+                    f"{parts[1]!r}; expected one of "
+                    f"{sorted(_KINDS) + [KILL_KIND]}"
+                )
             spec["kind"] = parts[1]
-        try:
-            if len(parts) > 2:
-                spec["prob"] = float(parts[2])
-            if len(parts) > 3:
+        if len(parts) > 2:
+            try:
+                prob = float(parts[2])
+            except ValueError:
+                raise ValueError(
+                    f"malformed SNTC_FAULTS spec {chunk!r}: prob "
+                    f"{parts[2]!r} is not a float"
+                ) from None
+            if not 0.0 <= prob <= 1.0:
+                raise ValueError(
+                    f"malformed SNTC_FAULTS spec {chunk!r}: prob {prob} "
+                    "must lie in [0, 1]"
+                )
+            spec["prob"] = prob
+        if len(parts) > 3:
+            try:
                 spec["seed"] = int(parts[3])
-        except ValueError:
-            raise ValueError(
-                f"malformed SNTC_FAULTS spec {chunk!r}: prob must be a "
-                "float, seed an int"
-            ) from None
+            except ValueError:
+                raise ValueError(
+                    f"malformed SNTC_FAULTS spec {chunk!r}: seed "
+                    f"{parts[3]!r} is not an int"
+                ) from None
         out.append(spec)
     return out
 
@@ -241,6 +281,10 @@ def fault_point(site: str) -> None:
         emit_event(
             event="fault_injected", site=site, kind=spec.kind, call=call
         )
+        if spec.kind == KILL_KIND:
+            # hard crash, not an exception: no finally blocks, no WAL
+            # flushes, no atexit — what a SIGKILL/OOM/preemption does
+            os._exit(KILL_EXIT_CODE)
         raise _KINDS[spec.kind](
             f"injected {spec.kind} fault at site {site!r} (call {call})"
         )

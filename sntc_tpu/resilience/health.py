@@ -1,0 +1,216 @@
+"""Health aggregation and the batch watchdog.
+
+:class:`HealthMonitor` keeps one :class:`HealthState` per named
+component (``engine``, ``sink.write``, ``collective.dispatch``, ...),
+fed two ways:
+
+* **explicitly** — ``health.report("engine", HealthState.DEGRADED,
+  "backlog over limit")``;
+* **from the structured event stream** — ``health.attach()``
+  subscribes to :func:`sntc_tpu.resilience.emit_event`, mapping the
+  resilience vocabulary to states (``retry`` → DEGRADED,
+  ``retry_exhausted``/``quarantine``/``breaker_open`` → UNHEALTHY,
+  ``retry_success``/``breaker_closed`` → OK, ...), so every wired
+  site's health tracks automatically.
+
+State changes themselves emit ``health_changed`` events, making
+transitions observable in the same JSONL stream.  :meth:`overall`
+returns the worst component state — the single value ``--health-json``
+and the supervisor act on.
+
+Recovery is evidence-driven, which means it needs a recovery SIGNAL: a
+mapped OK event (``retry_success``, ``breaker_closed``), an explicit
+:meth:`report`, or — for the serving-path sites — the supervisor's
+clean-commit reset.  Components outside the serving loop
+(``collective.dispatch``, ``ckpt.save``, ``cv.fit``) only recover when
+their own site next emits, because a plain first-attempt success emits
+nothing; treat a long-stale UNHEALTHY there as "last observed
+evidence", not a live probe.
+
+The **watchdog** flags a wedged batch: the engine (or supervisor)
+calls :meth:`batch_started` / :meth:`batch_finished` around each
+micro-batch; :meth:`check_watchdog` compares the in-flight batch's age
+on the monitor's injectable clock against ``max_batch_wall_time`` and,
+on breach, marks the engine UNHEALTHY and emits a ``watchdog_stall``
+event (once per stalled batch).  Poll it from any thread — the
+supervisor runs a daemon heartbeat thread so a batch that wedges the
+engine loop still trips the alarm.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from sntc_tpu.resilience.policy import (
+    add_event_observer,
+    emit_event,
+    remove_event_observer,
+)
+
+
+class HealthState(enum.IntEnum):
+    """Ordered severity: max() over components is the overall state."""
+
+    OK = 0
+    DEGRADED = 1
+    UNHEALTHY = 2
+
+
+# event name -> state it implies for the component that emitted it
+_EVENT_STATES: Dict[str, HealthState] = {
+    "retry": HealthState.DEGRADED,
+    "retry_success": HealthState.OK,
+    "retry_exhausted": HealthState.UNHEALTHY,
+    "quarantine": HealthState.UNHEALTHY,
+    "ckpt_fallback": HealthState.DEGRADED,
+    "cv_cell_degraded": HealthState.DEGRADED,
+    "breaker_open": HealthState.UNHEALTHY,
+    "breaker_half_open": HealthState.DEGRADED,
+    "breaker_closed": HealthState.OK,
+    "load_shed": HealthState.DEGRADED,
+    "watchdog_stall": HealthState.UNHEALTHY,
+}
+
+
+class HealthMonitor:
+    """Per-component health registry + heartbeat watchdog (thread-safe,
+    injectable clock)."""
+
+    def __init__(
+        self,
+        *,
+        max_batch_wall_time: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._clock = clock
+        self.max_batch_wall_time = max_batch_wall_time
+        self._lock = threading.RLock()
+        self._components: Dict[str, Dict[str, Any]] = {}
+        self._inflight: Dict[int, float] = {}  # batch_id -> started_at
+        self._stalled_flagged: set = set()
+        self._observer = None
+
+    # -- component states ---------------------------------------------------
+
+    def report(
+        self, component: str, state: HealthState, reason: str = ""
+    ) -> None:
+        """Set ``component``'s state; emits ``health_changed`` on change."""
+        state = HealthState(state)
+        with self._lock:
+            prev = self._components.get(component)
+            changed = prev is None or prev["state"] != state
+            self._components[component] = {
+                "state": state,
+                "reason": reason,
+                "since": self._clock() if changed else prev["since"],
+            }
+        if changed:
+            emit_event(
+                event="health_changed", component=component,
+                state=state.name,
+                previous=prev["state"].name if prev else None,
+                reason=reason,
+            )
+
+    def state_of(self, component: str) -> HealthState:
+        with self._lock:
+            entry = self._components.get(component)
+            return entry["state"] if entry else HealthState.OK
+
+    def overall(self) -> HealthState:
+        with self._lock:
+            if not self._components:
+                return HealthState.OK
+            return max(e["state"] for e in self._components.values())
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "overall": self.overall().name,
+                "components": {
+                    name: {
+                        "state": e["state"].name,
+                        "reason": e["reason"],
+                    }
+                    for name, e in sorted(self._components.items())
+                },
+            }
+
+    # -- event-stream aggregation ------------------------------------------
+
+    def observe_event(self, record: Dict[str, Any]) -> None:
+        """Fold one structured event into component health (component =
+        the event's ``site``, falling back to ``component``)."""
+        state = _EVENT_STATES.get(record.get("event"))
+        if state is None:
+            return
+        component = record.get("site") or record.get("component")
+        if not component:
+            return
+        self.report(
+            component, state,
+            reason=f"event {record['event']}",
+        )
+
+    def attach(self) -> "HealthMonitor":
+        """Subscribe to the process event stream (idempotent)."""
+        if self._observer is None:
+            self._observer = self.observe_event
+            add_event_observer(self._observer)
+        return self
+
+    def detach(self) -> None:
+        if self._observer is not None:
+            remove_event_observer(self._observer)
+            self._observer = None
+
+    # -- heartbeat watchdog -------------------------------------------------
+
+    def batch_started(self, batch_id: int) -> None:
+        """Idempotent: re-announcing a batch that is already in flight
+        (a retirement round that deferred and retries next tick) keeps
+        the ORIGINAL start time, so a batch stuck across many short
+        ticks still ages toward ``max_batch_wall_time``."""
+        with self._lock:
+            self._inflight.setdefault(batch_id, self._clock())
+
+    def batch_finished(self, batch_id: int) -> None:
+        with self._lock:
+            self._inflight.pop(batch_id, None)
+            self._stalled_flagged.discard(batch_id)
+
+    def check_watchdog(self) -> List[int]:
+        """Flag in-flight batches older than ``max_batch_wall_time``;
+        returns the batch ids NEWLY flagged this call (each stalled
+        batch alarms once, not once per poll)."""
+        if self.max_batch_wall_time is None:
+            return []
+        now = self._clock()
+        newly = []
+        with self._lock:
+            for batch_id, started in self._inflight.items():
+                age = now - started
+                if (
+                    age > self.max_batch_wall_time
+                    and batch_id not in self._stalled_flagged
+                ):
+                    self._stalled_flagged.add(batch_id)
+                    newly.append((batch_id, age))
+        for batch_id, age in newly:
+            emit_event(
+                event="watchdog_stall", component="engine",
+                batch_id=batch_id, age_s=round(age, 3),
+                max_batch_wall_time=self.max_batch_wall_time,
+            )
+            self.report(
+                "engine", HealthState.UNHEALTHY,
+                reason=(
+                    f"batch {batch_id} running {age:.1f}s > "
+                    f"max_batch_wall_time={self.max_batch_wall_time}s"
+                ),
+            )
+        return [b for b, _ in newly]

@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -55,8 +56,10 @@ class RetryPolicy:
     ``numpy`` generator seeded by ``seed`` — the same policy always
     yields the same schedule, so sleep sequences are assertable in
     tests and reproducible in incident logs.  ``deadline_s`` bounds the
-    TOTAL elapsed time (including the would-be next sleep): a retry
-    that cannot finish before the deadline is not attempted.
+    TOTAL elapsed time: a backoff sleep that would overshoot it is
+    CLAMPED to the remaining budget (the final attempt still runs at
+    the deadline), and once the deadline has elapsed no further attempt
+    is made.
     """
 
     max_attempts: int = 3
@@ -116,7 +119,10 @@ def int_from_env(var: str, default: int, minimum: int = 0) -> int:
 
 _RECENT_MAX = 512
 _recent: "deque[Dict[str, Any]]" = deque(maxlen=_RECENT_MAX)
+_events_lock = threading.Lock()
+_events_dropped = 0
 _logger: Optional[MetricsLogger] = None
+_observers: List[Callable[[Dict[str, Any]], None]] = []
 
 
 def _events_logger() -> MetricsLogger:
@@ -132,14 +138,46 @@ def _events_logger() -> MetricsLogger:
 
 def emit_event(**fields: Any) -> Dict[str, Any]:
     """Append one structured resilience event (JSONL when
-    ``SNTC_RESILIENCE_LOG`` is set; always kept in the in-process ring)."""
-    record = _events_logger().log(**fields)
+    ``SNTC_RESILIENCE_LOG`` is set; always kept in the in-process ring).
+
+    The ring is hard-capped at ``_RECENT_MAX`` records — a long-running
+    query emits events for the life of the process, and the cap turns
+    that into bounded memory.  Evictions are counted
+    (:func:`events_dropped`), never silent.  Thread-safe: the engine
+    loop, the watchdog thread, and ``--health-json`` snapshots all
+    touch the ring concurrently.
+    """
+    global _events_dropped
     path = os.environ.get("SNTC_RESILIENCE_LOG")
-    if path:
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path, "a") as f:
-            f.write(json.dumps(record) + "\n")
-    _recent.append(record)
+    with _events_lock:
+        # logger init, the step counter, file append, and the ring all
+        # mutate under the ONE lock — the engine loop and the watchdog
+        # thread emit concurrently, and a torn step sequence would break
+        # the step-watermark windows bench journaling relies on
+        record = _events_logger().log(**fields)
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        if len(_recent) == _recent.maxlen:
+            _events_dropped += 1
+        _recent.append(record)
+        observers = list(_observers)
+    # observers run OUTSIDE the ring lock: an observer that emits (a
+    # health change triggered by this event) must not deadlock.  A
+    # RAISING observer is evicted, not propagated — emit_event runs
+    # inside retry loops and breaker transitions, and an exception here
+    # would replace the real error the resilience machinery is handling
+    for fn in observers:
+        try:
+            fn(record)
+        except Exception as e:
+            remove_event_observer(fn)
+            print(
+                f"sntc_tpu: event observer {fn!r} raised {e!r}; "
+                "observer removed",
+                file=sys.stderr,
+            )
     return record
 
 
@@ -147,16 +185,42 @@ def recent_events(
     site: Optional[str] = None, event: Optional[str] = None
 ) -> List[Dict[str, Any]]:
     """The in-process event ring, optionally filtered by site/event."""
+    with _events_lock:
+        snapshot = list(_recent)
     return [
         r
-        for r in _recent
+        for r in snapshot
         if (site is None or r.get("site") == site)
         and (event is None or r.get("event") == event)
     ]
 
 
+def events_dropped() -> int:
+    """Events evicted from the ring since the last :func:`clear_events`
+    — nonzero means ``recent_events`` is a suffix, not the full story."""
+    with _events_lock:
+        return _events_dropped
+
+
+def add_event_observer(fn: Callable[[Dict[str, Any]], None]) -> None:
+    """Register ``fn(record)`` to run on every future event (the
+    :class:`~sntc_tpu.resilience.health.HealthMonitor` feed)."""
+    with _events_lock:
+        if fn not in _observers:
+            _observers.append(fn)
+
+
+def remove_event_observer(fn: Callable[[Dict[str, Any]], None]) -> None:
+    with _events_lock:
+        if fn in _observers:
+            _observers.remove(fn)
+
+
 def clear_events() -> None:
-    _recent.clear()
+    global _events_dropped
+    with _events_lock:
+        _recent.clear()
+        _events_dropped = 0
 
 
 # ---------------------------------------------------------------------------
@@ -170,18 +234,24 @@ def with_retries(
     *,
     site: str = "unspecified",
     sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
 ) -> Any:
     """Run ``fn()`` under ``policy``; emit structured events per retry.
 
     Non-retryable exceptions propagate unchanged.  Retryable failures
     sleep the policy's deterministic backoff and re-invoke; when
     attempts (or the deadline) run out, :class:`RetryExhausted` wraps
-    the last error.  ``sleep`` is injectable so tests assert schedules
-    without wall-clock cost.
+    the last error.  The deadline clamps, not truncates: a backoff that
+    would overshoot ``deadline_s`` is shortened to exactly the
+    remaining budget and the final attempt still runs — the executor
+    never sleeps past the deadline just to raise
+    :class:`RetryExhausted` late, and never gives up with budget left.
+    ``sleep`` and ``clock`` are injectable so tests assert schedules
+    and deadline behavior without wall-clock cost.
     """
     policy = policy or RetryPolicy()
     schedule = policy.backoff_schedule()
-    t0 = time.monotonic()
+    t0 = clock()
     for attempt in range(1, policy.max_attempts + 1):
         try:
             out = fn()
@@ -189,17 +259,20 @@ def with_retries(
             if not policy.is_retryable(e):
                 raise
             delay = schedule[attempt - 1] if attempt <= len(schedule) else 0.0
-            elapsed = time.monotonic() - t0
-            out_of_time = (
-                policy.deadline_s is not None
-                and elapsed + delay >= policy.deadline_s
+            elapsed = clock() - t0
+            remaining = (
+                None if policy.deadline_s is None
+                else policy.deadline_s - elapsed
             )
+            out_of_time = remaining is not None and remaining <= 0
             if attempt >= policy.max_attempts or out_of_time:
                 emit_event(
                     event="retry_exhausted", site=site, attempts=attempt,
                     error=repr(e), deadline_hit=bool(out_of_time),
                 )
                 raise RetryExhausted(site, attempt, e) from e
+            if remaining is not None:
+                delay = min(delay, remaining)
             emit_event(
                 event="retry", site=site, attempt=attempt,
                 delay_s=round(delay, 6), error=repr(e),

@@ -1,0 +1,335 @@
+"""Query supervision: admission control, watchdog, preemption-safe
+drain.
+
+:class:`QuerySupervisor` owns a ``StreamingQuery``'s engine loop the
+way an operator would — it is the layer between "the engine can
+retry" (PR 1 primitives) and "the query survives production":
+
+* **Admission control / load shedding** — when the source backlog
+  exceeds ``max_pending_batches`` micro-batches, the supervisor sheds
+  before dispatching: policy ``"oldest"`` drops the oldest pending
+  offsets outright (freshness wins — the Spark
+  ``maxOffsetsPerTrigger``-backlog failure mode, resolved instead of
+  ignored), policy ``"sample"`` processes the whole backlog as one
+  row-subsampled batch (coverage wins, at reduced resolution).  Every
+  shed is journaled to ``<checkpoint>/shed.jsonl`` and emitted as a
+  ``load_shed`` event — shedding is a recorded decision, never silent
+  data loss.
+* **Health & watchdog** — a :class:`~sntc_tpu.resilience.health
+  .HealthMonitor` (attached to the structured-event stream) aggregates
+  per-site health; a daemon heartbeat thread trips
+  ``watchdog_stall``/UNHEALTHY when a batch exceeds
+  ``max_batch_wall_time`` even while the engine loop is wedged.
+* **Preemption-safe drain** — SIGTERM (or :meth:`request_drain`)
+  finishes the in-flight batches, commits them, writes an atomic
+  ``drain_marker.json`` into the checkpoint dir, and returns cleanly
+  (exit 0 from the CLI).  A restart on the same checkpoint resumes
+  exactly-once from the offset log — drain is just the graceful
+  version of the crash contract the WAL already guarantees.
+* **Status** — :meth:`status` (and the ``--health-json`` CLI flag)
+  dumps overall/component health, breaker states, engine offsets,
+  backlog, and shed totals as one JSON object, rewritten atomically
+  each tick.
+
+The clock is injectable and the loop is steppable (:meth:`tick`), so
+every behavior above is unit-testable without threads or sleeps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from sntc_tpu.resilience.circuit import CircuitBreaker, breakers_snapshot
+from sntc_tpu.resilience.health import HealthMonitor, HealthState
+from sntc_tpu.resilience.policy import emit_event, events_dropped
+
+DRAIN_MARKER = "drain_marker.json"
+
+
+def _atomic_json(path: str, obj: Dict[str, Any], **dump_kwargs: Any) -> str:
+    """Write ``obj`` as JSON via tmp-then-rename: readers never see a
+    torn file (the drain marker and health dump both promise this)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, **dump_kwargs)
+    os.replace(tmp, path)
+    return path
+
+
+def default_breakers(
+    clock=time.monotonic, **kwargs: Any
+) -> Dict[str, CircuitBreaker]:
+    """The serving-path breaker set: sink delivery and model dispatch."""
+    return {
+        site: CircuitBreaker(site, clock=clock, **kwargs)
+        for site in ("sink.write", "predict.dispatch")
+    }
+
+
+class QuerySupervisor:
+    """Supervises one ``StreamingQuery`` (single-threaded loop owner)."""
+
+    def __init__(
+        self,
+        query,
+        *,
+        max_pending_batches: Optional[int] = None,
+        shed_policy: str = "oldest",
+        max_batch_wall_time: Optional[float] = None,
+        health: Optional[HealthMonitor] = None,
+        health_json: Optional[str] = None,
+        clock=time.monotonic,
+    ):
+        if max_pending_batches is not None and max_pending_batches < 1:
+            raise ValueError("max_pending_batches must be >= 1 (or None)")
+        if shed_policy not in ("oldest", "sample"):
+            raise ValueError("shed_policy must be 'oldest' or 'sample'")
+        self.query = query
+        self.max_pending_batches = max_pending_batches
+        self.shed_policy = shed_policy
+        self.health_json = health_json
+        self._clock = clock
+        # a monitor WE create is ours: attached to the event stream here
+        # and detached in close().  A caller-supplied monitor keeps its
+        # own subscription lifecycle — the caller decides whether it is
+        # attach()ed, and close() must not pull it out from under them.
+        self._owns_health = health is None
+        self.health = health or HealthMonitor(
+            max_batch_wall_time=max_batch_wall_time, clock=clock
+        ).attach()
+        if max_batch_wall_time is not None and health is not None:
+            self.health.max_batch_wall_time = max_batch_wall_time
+        self._drain = threading.Event()
+        self._drain_reason: Optional[str] = None
+        self.shed_total_offsets = 0
+        self.batches_done = 0
+        self.drained = False
+
+    def close(self) -> None:
+        """Supervisor teardown: detach the health monitor from the
+        event stream IF this supervisor created it (a caller-supplied
+        monitor's subscription belongs to the caller)."""
+        if self._owns_health:
+            self.health.detach()
+
+    # -- preemption ---------------------------------------------------------
+
+    def request_drain(self, reason: str = "request_drain") -> None:
+        """Ask the loop to finish in-flight work, commit, and stop."""
+        if not self._drain.is_set():
+            self._drain_reason = reason
+            self._drain.set()
+
+    @property
+    def drain_requested(self) -> bool:
+        return self._drain.is_set()
+
+    def install_signal_handlers(self) -> bool:
+        """Route SIGTERM to :meth:`request_drain` (preemption notice →
+        graceful drain).  Returns False off the main thread, where
+        CPython forbids installing handlers."""
+        try:
+            signal.signal(
+                signal.SIGTERM,
+                lambda signum, frame: self.request_drain("SIGTERM"),
+            )
+            return True
+        except ValueError:
+            return False
+
+    # -- supervision steps --------------------------------------------------
+
+    def maybe_shed(self, latest: Optional[int] = None) -> Optional[dict]:
+        """One admission-control decision; returns the shed record when
+        load was shed.  ``latest`` lets the loop reuse one per-tick
+        source offset read."""
+        if self.max_pending_batches is None:
+            return None
+        record = self.query.shed_backlog(
+            self.max_pending_batches, policy=self.shed_policy,
+            latest=latest,
+        )
+        if record is not None:
+            self.shed_total_offsets += record.get("offsets_shed", 0)
+            self.health.report(
+                "engine", HealthState.DEGRADED,
+                reason=f"load shed ({self.shed_policy}): "
+                f"backlog > {self.max_pending_batches} batches",
+            )
+        return record
+
+    def tick(self) -> int:
+        """One supervised engine step: shed if needed, advance the
+        engine by (at most) one committed batch, update health
+        bookkeeping.  Returns batches committed this tick."""
+        latest = self.query.source.latest_offset()  # ONE read per tick
+        shed = self.maybe_shed(latest)
+        tick_id = self.query.last_committed() + 1
+        # watchdog-track the tick's batch only when there is actual work
+        # (in-flight or unplanned backlog): an idle stream must not age
+        # a phantom batch into a watchdog_stall.  started is idempotent:
+        # a batch deferring across ticks (sink down, breaker open) keeps
+        # its first start time and AGES toward max_batch_wall_time; it
+        # leaves the watchdog only on commit.
+        have_work = (
+            self.query.in_flight_count() > 0
+            or latest > self.query.planned_offset()
+        )
+        if have_work:
+            self.health.batch_started(tick_id)
+        before = self.query.last_committed()
+        try:
+            self.query._run_one_batch()
+        finally:
+            if self.query.last_committed() >= tick_id:
+                self.health.batch_finished(tick_id)
+        delta = self.query.last_committed() - before
+        self.batches_done += delta
+        # a committing engine is healthy — this also RECOVERS from a
+        # past watchdog stall (the stalled batch evidently finished);
+        # but a tick that also shed load stays DEGRADED, so sustained
+        # overload is visible in health dumps, not only in the event
+        # stream
+        if delta and shed is None:
+            self.health.report("engine", HealthState.OK, reason="committing")
+            progress = self.query.lastProgress
+            if progress and not progress.get("quarantined"):
+                # a CLEAN commit traversed read → predict → sink: any
+                # stage component a past failure left DEGRADED/UNHEALTHY
+                # has demonstrably recovered (retry_success never fires
+                # for first-attempt successes, so without this a single
+                # quarantined batch would pin health UNHEALTHY forever)
+                for site in (
+                    "stream.read", "predict.dispatch", "sink.write"
+                ):
+                    if self.health.state_of(site) != HealthState.OK:
+                        self.health.report(
+                            site, HealthState.OK, reason="batch committed"
+                        )
+        if self.health_json:
+            self.write_health_json(latest=latest)
+        return delta
+
+    def run(
+        self,
+        poll_interval: float = 1.0,
+        max_batches: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """The supervised foreground loop (the ``query.run()`` analog).
+
+        Runs until ``max_batches`` commits or a drain request; an idle
+        tick waits ``poll_interval`` (interruptibly — a drain request
+        cuts the wait short).  Returns the final :meth:`status` dict.
+        """
+        watchdog = self._start_watchdog()
+        try:
+            while not self._drain.is_set():
+                delta = self.tick()
+                if (
+                    max_batches is not None
+                    and self.batches_done >= max_batches
+                ):
+                    break
+                if delta == 0:
+                    self._drain.wait(poll_interval)
+        finally:
+            if watchdog is not None:
+                watchdog["stop"].set()
+                watchdog["thread"].join()
+        if self._drain.is_set():
+            self._do_drain()
+        if self.health_json:
+            self.write_health_json()
+        return self.status()
+
+    def _start_watchdog(self) -> Optional[dict]:
+        """Daemon heartbeat poller: flags a wedged batch even while the
+        engine loop thread is stuck inside it."""
+        if self.health.max_batch_wall_time is None:
+            return None
+        stop = threading.Event()
+        interval = max(0.05, self.health.max_batch_wall_time / 4.0)
+
+        def _poll():
+            while not stop.wait(interval):
+                self.health.check_watchdog()
+
+        t = threading.Thread(
+            target=_poll, name="sntc-watchdog", daemon=True
+        )
+        t.start()
+        return {"thread": t, "stop": stop}
+
+    def drain_now(self, reason: str = "drain_now") -> Dict[str, Any]:
+        """Drain synchronously (the non-loop entry: Ctrl-C handlers,
+        tests) and return the final status."""
+        self.request_drain(reason)
+        self._do_drain()
+        if self.health_json:
+            self.write_health_json()
+        return self.status()
+
+    def _do_drain(self) -> None:
+        """Finish in-flight batches, commit, write the drain marker."""
+        if self.drained:
+            return
+        committed = self.query.drain()
+        self.batches_done += committed
+        marker = {
+            "ts": time.time(),
+            "reason": self._drain_reason,
+            "last_committed": self.query.last_committed(),
+            "end_offset": self.query.committed_end(),
+            "batches_committed_at_drain": committed,
+            "in_flight_left": self.query.in_flight_count(),
+            "pid": os.getpid(),
+        }
+        _atomic_json(
+            os.path.join(self.query.checkpoint_dir, DRAIN_MARKER), marker
+        )
+        self.drained = True
+        emit_event(
+            event="drained", component="engine", reason=self._drain_reason,
+            last_committed=marker["last_committed"],
+            in_flight_left=marker["in_flight_left"],
+        )
+        self.query.stop()
+
+    # -- status -------------------------------------------------------------
+
+    def status(self, latest: Optional[int] = None) -> Dict[str, Any]:
+        """Status snapshot; ``latest`` reuses a caller's source offset
+        read instead of re-scanning the source per dump."""
+        q = self.query
+        breakers = {
+            site: br.snapshot()
+            for site, br in getattr(q, "breakers", {}).items()
+        }
+        # process-registry breakers (collective.dispatch &c.) ride along
+        for site, snap in breakers_snapshot().items():
+            breakers.setdefault(site, snap)
+        return {
+            "health": self.health.snapshot(),
+            "breakers": breakers,
+            "engine": {
+                "last_committed": q.last_committed(),
+                "end_offset": q.committed_end(),
+                "in_flight": q.in_flight_count(),
+                "backlog_offsets": q.backlog_offsets(latest),
+                "batches_done": self.batches_done,
+            },
+            "shed_total_offsets": self.shed_total_offsets,
+            "events_dropped": events_dropped(),
+            "drain_requested": self.drain_requested,
+            "drained": self.drained,
+        }
+
+    def write_health_json(self, latest: Optional[int] = None) -> str:
+        """Atomically (re)write the status dump; returns the path."""
+        return _atomic_json(self.health_json, self.status(latest), indent=1)

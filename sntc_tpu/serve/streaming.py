@@ -181,6 +181,13 @@ class StreamingQuery:
     with the evidence preserved.  Both sites call
     ``sntc_tpu.resilience.fault_point`` so tier-1 tests (or
     ``SNTC_FAULTS``) can inject failures deterministically.
+    ``breakers={"sink.write": CircuitBreaker(...), "predict.dispatch":
+    ...}`` arms per-site circuit breakers: an OPEN breaker defers the
+    stage (batch stays queued, loop stays alive) instead of hammering a
+    dead dependency; see ``sntc_tpu.resilience.circuit``.  The
+    :class:`~sntc_tpu.resilience.supervisor.QuerySupervisor` layers
+    admission control (load shedding), a batch watchdog, and
+    preemption-safe drain on top of this engine.
     """
 
     _PROGRESS_KEEP = 100  # Spark keeps the last 100 progress records
@@ -197,6 +204,7 @@ class StreamingQuery:
         retry_policy: Optional[RetryPolicy] = None,
         max_batch_failures: Optional[int] = None,
         dead_letter_dir: Optional[str] = None,
+        breakers: Optional[dict] = None,
     ):
         self.predictor = BatchPredictor(model)
         self.source = source
@@ -219,8 +227,13 @@ class StreamingQuery:
         self.dead_letter_dir = dead_letter_dir or os.path.join(
             checkpoint_dir, "dead_letter"
         )
+        # per-site circuit breakers (sink.write / predict.dispatch): an
+        # OPEN breaker defers the stage — the batch stays queued and the
+        # loop stays alive — instead of hammering a dead dependency
+        self.breakers: dict = dict(breakers or {})
         self._batch_failures: dict = {}
         self._in_flight: List[tuple] = []
+        self._sample_next: Optional[int] = None  # stride for next intent
         self._stopped = False
         # last _PROGRESS_KEEP committed batches' timing/rows (the
         # ``StreamingQueryProgress``/``recentProgress`` analog); durationMs
@@ -305,9 +318,6 @@ class StreamingQuery:
     def last_committed(self) -> int:
         return self._last_committed
 
-    def _committed_end(self) -> int:
-        return self._end_offset
-
     def _pending_intent(self, batch_id: int):
         if self._pending_intents is not None:  # append mode: in-memory
             return self._pending_intents.get(batch_id)
@@ -355,6 +365,16 @@ class StreamingQuery:
             if self.max_batch_offsets is not None:
                 end = min(end, start + self.max_batch_offsets)
             intent = {"batch_id": batch_id, "start": start, "end": end}
+            if self._sample_next is not None:
+                # sample-shed recovery batch: cover the WHOLE backlog in
+                # one intent at reduced row resolution; the stride lives
+                # in the intent so a crash replays the same sample
+                intent["end"] = latest
+                intent["sample_stride"] = self._sample_next
+                self._sample_next = None
+            # kill point pre-WAL: a crash here leaves NO intent — the
+            # restarted query plans the batch fresh (chaos matrix row 1)
+            fault_point("stream.wal")
             # intent WAL before any processing (OffsetSeqLog)
             self._wal_intent(batch_id, intent)
 
@@ -362,10 +382,22 @@ class StreamingQuery:
 
         def _read() -> Frame:
             fault_point("stream.read")
-            return self.source.get_batch(intent["start"], intent["end"])
+            frame = self.source.get_batch(intent["start"], intent["end"])
+            stride = intent.get("sample_stride", 1)
+            if stride > 1:
+                frame = frame.take(np.arange(0, frame.num_rows, stride))
+            return frame
 
         frame = None
         stage = "stream.read"
+        # fail-fast while the predict breaker is OPEN: deferring is the
+        # certain outcome, so don't re-read (and re-retry) the whole
+        # batch each poll tick just to discard it.  A state check, not
+        # allow(): reserving a half-open probe slot here would leak it
+        # if the read failed before dispatch.
+        br_predict = self.breakers.get("predict.dispatch")
+        if br_predict is not None and br_predict.state == "open":
+            return False
         try:
             frame = (
                 with_retries(_read, self.retry_policy, site="stream.read")
@@ -376,7 +408,16 @@ class StreamingQuery:
             # prediction HERE — a malformed batch is as much a poison
             # batch as a sink failure and must quarantine, not kill
             stage = "predict.dispatch"
-            finalize = self.predictor.predict_frame_async(frame)
+            if br_predict is not None and not br_predict.allow():
+                return False  # breaker open: defer, intent replays later
+            try:
+                finalize = self.predictor.predict_frame_async(frame)
+            except Exception:
+                if br_predict is not None:
+                    br_predict.record_failure()
+                raise
+            if br_predict is not None:
+                br_predict.record_success()
         except Exception as e:
             fails = self._bump_failures(batch_id, stage)
             if self.max_batch_failures is None:
@@ -390,11 +431,14 @@ class StreamingQuery:
             self._quarantine(batch_id, intent, frame, e, site=stage)
             self._commit_batch(batch_id, intent, n_rows=0, t0=t0,
                                quarantined=True)
-            self._next_start = intent["end"]
+            self._next_start = max(self._next_start, intent["end"])
             return True
         self._in_flight.append((batch_id, intent, finalize, t0,
                                 frame.num_rows, frame))
-        self._next_start = intent["end"]
+        # max(): a replayed WAL intent can end BELOW a cursor that an
+        # 'oldest' shed already advanced — moving it back would undo the
+        # journaled shed and double-count it on the next tick
+        self._next_start = max(self._next_start, intent["end"])
         return True
 
     def _bump_failures(self, batch_id: int, stage: str) -> int:
@@ -408,7 +452,7 @@ class StreamingQuery:
         for key in [k for k in self._batch_failures if k[0] == batch_id]:
             del self._batch_failures[key]
 
-    def _retire_oldest(self) -> None:
+    def _retire_oldest(self) -> bool:
         """Materialize the oldest in-flight batch, sink it, commit.
 
         The entry leaves ``_in_flight`` only AFTER its commit file is
@@ -429,6 +473,9 @@ class StreamingQuery:
             fault_point("sink.write")
             self.sink.add_batch(batch_id, finalize())
 
+        breaker = self.breakers.get("sink.write")
+        if breaker is not None and not breaker.allow():
+            return False  # breaker open: batch stays queued, loop alive
         quarantined = False
         try:
             if self.retry_policy is not None:
@@ -436,6 +483,10 @@ class StreamingQuery:
             else:
                 _deliver()
         except Exception as e:
+            # one breaker outcome per retirement ROUND (a failure that
+            # survived the whole retry cycle is real trouble)
+            if breaker is not None:
+                breaker.record_failure()
             fails = self._bump_failures(batch_id, "sink.write")
             if self.max_batch_failures is None:
                 raise  # quarantine unarmed: r5 single-shot semantics
@@ -444,6 +495,9 @@ class StreamingQuery:
             self._quarantine(batch_id, intent, frame, e,
                              site="sink.write")
             quarantined = True
+        else:
+            if breaker is not None:
+                breaker.record_success()
         self._in_flight.pop(0)
         self._commit_batch(batch_id, intent, n_rows=n_rows, t0=t0,
                            quarantined=quarantined)
@@ -454,6 +508,11 @@ class StreamingQuery:
         """The ONE commit protocol (WAL commit + bookkeeping + progress
         record), shared by normal retirement and both quarantine paths
         so restart-recovery state can never diverge between them."""
+        # kill point post-sink/pre-commit: results reached the sink but
+        # the commit never lands — the restarted query must REPLAY the
+        # batch from its WAL'd intent and the sink must dedupe (chaos
+        # matrix row 3)
+        fault_point("stream.commit")
         self._wal_commit(batch_id, intent)
         self._clear_failures(batch_id)
         self._last_committed = batch_id
@@ -531,6 +590,128 @@ class StreamingQuery:
         while not self._stopped and self._run_one_batch():
             pass
         return self._last_committed - start
+
+    # -- supervision hooks (QuerySupervisor surface) ------------------------
+
+    def backlog_offsets(self, latest: Optional[int] = None) -> int:
+        """Source offsets available but not yet covered by any intent.
+        ``latest`` lets a supervising loop reuse one per-tick source
+        offset read instead of re-scanning the source."""
+        if latest is None:
+            latest = self.source.latest_offset()
+        return max(0, latest - self._next_start)
+
+    def in_flight_count(self) -> int:
+        """Dispatched-but-uncommitted batches (the drain tail length)."""
+        return len(self._in_flight)
+
+    def committed_end(self) -> int:
+        """End offset of the last committed batch (the resume point)."""
+        return self._end_offset
+
+    def planned_offset(self) -> int:
+        """The planning cursor: offsets below it are committed, in
+        flight, or shed; offsets at/above it are unplanned backlog."""
+        return self._next_start
+
+    def shed_backlog(
+        self,
+        max_pending_batches: int,
+        policy: str = "oldest",
+        latest: Optional[int] = None,
+    ) -> Optional[dict]:
+        """Admission control: when the pending backlog exceeds
+        ``max_pending_batches`` micro-batches (batch =
+        ``max_batch_offsets`` source offsets; one offset when unset),
+        shed down to the cap and return the journaled record, else None.
+
+        ``"oldest"`` drops the oldest surplus offsets outright (the
+        freshest data keeps flowing); ``"sample"`` marks the next
+        intent to cover the WHOLE backlog with a deterministic row
+        stride (``sample_stride``), trading resolution for coverage.
+        Either way the decision is appended to
+        ``<checkpoint>/shed.jsonl`` and emitted as a ``load_shed``
+        event.  Shedding is an in-memory flow decision, not a commit: a
+        crash before the next commit restores the backlog and the
+        supervisor simply sheds again on restart.
+        """
+        if policy not in ("oldest", "sample"):
+            raise ValueError("shed policy must be 'oldest' or 'sample'")
+        if self._sample_next is not None:
+            # a sample decision is already pending consumption (dispatch
+            # deferred by an open breaker, say): re-shedding every poll
+            # tick would journal duplicate records and flood the event
+            # ring with load_shed noise for ONE backlog decision
+            return None
+        unit = self.max_batch_offsets or 1
+        if latest is None:  # caller may pass its own per-tick read
+            latest = self.source.latest_offset()
+        # offsets covered by uncommitted WAL intents WILL be replayed
+        # regardless (the exactly-once contract) — they are not
+        # sheddable, and journaling them as dropped would over-report
+        base = self._next_start
+        bid = self.last_committed() + 1 + len(self._in_flight)
+        while True:
+            replay = self._pending_intent(bid)
+            if replay is None:
+                break
+            base = max(base, replay["end"])
+            bid += 1
+        pending = latest - base
+        keep = max_pending_batches * unit
+        if pending <= keep:
+            return None
+        record = {
+            "ts": time.time(),
+            "policy": policy,
+            "backlog_offsets": pending,
+            "max_pending_batches": max_pending_batches,
+        }
+        if policy == "oldest":
+            shed_end = latest - keep
+            record.update(
+                start=base, end=shed_end,
+                offsets_shed=shed_end - base,
+            )
+            self._next_start = max(self._next_start, shed_end)
+        else:  # sample
+            stride = -(-pending // keep)  # ceil: keeps ~keep offsets' rows
+            record.update(
+                start=base, end=latest, sample_stride=stride,
+                offsets_shed=0,
+            )
+            self._sample_next = stride
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        with open(
+            os.path.join(self.checkpoint_dir, "shed.jsonl"), "a"
+        ) as f:
+            f.write(json.dumps(record) + "\n")
+        emit_event(
+            event="load_shed", site="stream.read", policy=policy,
+            start=record["start"], end=record["end"],
+            offsets_shed=record["offsets_shed"],
+            sample_stride=record.get("sample_stride"),
+        )
+        return record
+
+    def drain(self) -> int:
+        """Finish and commit every in-flight batch WITHOUT dispatching
+        new ones (the preemption-drain tail).  Returns batches
+        committed.  Retirement rounds that keep deferring (open
+        breaker, quarantine threshold not yet reached) are bounded —
+        anything left uncommitted stays in the WAL for the restarted
+        query to replay, which is the same contract a crash has."""
+        before = self._last_committed
+        stalled_rounds = 0
+        max_stalled = ((self.max_batch_failures or 1) + 1) * (
+            len(self._in_flight) + 1
+        )
+        while self._in_flight and stalled_rounds < max_stalled:
+            if self._retire_oldest():
+                stalled_rounds = 0
+            else:
+                stalled_rounds += 1
+        return self._last_committed - before
 
     def run(
         self,

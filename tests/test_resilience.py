@@ -94,19 +94,30 @@ def test_with_retries_exhaustion_and_classifier():
     assert R.recent_events(site="t.nr") == []
 
 
-def test_with_retries_deadline_stops_early():
+def test_with_retries_deadline_clamps_final_sleep():
+    """The 100s backoff cannot fit the 50s deadline: the final sleep is
+    CLAMPED to exactly the remaining budget (never slept past the
+    deadline, never given up with budget left) and the last attempt
+    runs at the deadline."""
     p = RetryPolicy(max_attempts=10, base_delay_s=100.0,
                     max_delay_s=100.0, jitter=0.0, deadline_s=50.0)
+    t = {"now": 0.0}
+    slept = []
+
+    def sleep(d):
+        slept.append(d)
+        t["now"] += d
 
     def fail():
         raise IOError("x")
 
     with pytest.raises(RetryExhausted):
-        with_retries(fail, p, site="t.dl", sleep=lambda d: None)
-    # would have retried 9 times; the 100s backoff cannot fit in the
-    # 50s deadline, so attempt 1 is also the last
+        with_retries(fail, p, site="t.dl", sleep=sleep,
+                     clock=lambda: t["now"])
+    assert slept == [50.0]  # clamped to remaining deadline, not 100
+    assert t["now"] == 50.0  # total elapsed never exceeds the deadline
     ex = R.recent_events(site="t.dl", event="retry_exhausted")
-    assert len(ex) == 1 and ex[0]["attempts"] == 1 and ex[0]["deadline_hit"]
+    assert len(ex) == 1 and ex[0]["attempts"] == 2 and ex[0]["deadline_hit"]
 
 
 def test_events_jsonl_sink(tmp_path, monkeypatch):

@@ -251,6 +251,47 @@ def test_pipelined_sink_failure_retries_not_skips(model, tmp_path):
     assert q.last_committed() == 3
 
 
+def test_crash_between_sink_and_commit_replays_and_sink_dedupes(
+    model, tmp_path
+):
+    """Crash injected at ``stream.commit`` (post-sink, pre-commit): the
+    batch's output reached the sink but no commit landed.  On restart
+    the batch is REPLAYED with its WAL-logged range and the CSV sink
+    dedupes by rewriting ``batch_<id>.csv`` in place — row counts stay
+    exactly-once, never doubled."""
+    import sntc_tpu.resilience as R
+
+    ckpt, out = str(tmp_path / "ckpt"), str(tmp_path / "out")
+    src = MemorySource([_batch(40, 1), _batch(25, 2)])
+    q = StreamingQuery(
+        model, src, CsvDirSink(out, columns=["prediction"]), ckpt,
+        max_batch_offsets=1,
+    )
+    R.arm("stream.commit", times=1)
+    try:
+        with pytest.raises(R.InjectedFault):
+            q.process_available()
+    finally:
+        R.clear()
+    # the sink saw batch 0; the offset log did not
+    assert os.path.exists(os.path.join(out, "batch_000000.csv"))
+    assert os.listdir(os.path.join(ckpt, "commits")) == []
+    del q  # crash
+
+    q2 = StreamingQuery(
+        model, src, CsvDirSink(out, columns=["prediction"]), ckpt,
+        max_batch_offsets=1,
+    )
+    assert q2.process_available() == 2  # batch 0 replayed + batch 1
+    assert sorted(os.listdir(out)) == [
+        "batch_000000.csv", "batch_000001.csv"
+    ]
+    with open(os.path.join(out, "batch_000000.csv")) as f:
+        assert sum(1 for _ in f) - 1 == 40  # replayed rows, not doubled
+    with open(os.path.join(ckpt, "commits", "0.json")) as f:
+        assert json.load(f) == {"batch_id": 0, "start": 0, "end": 1}
+
+
 def test_append_wal_resume_and_replay(model, tmp_path):
     """wal_mode='append': same exactly-once recovery contract as the
     per-file WAL — committed batches don't reprocess; a crash between

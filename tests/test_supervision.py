@@ -1,0 +1,754 @@
+"""Supervision layer (r7): circuit breakers, health & watchdog, load
+shedding, preemption-safe drain, the bounded event ring, the strict
+SNTC_FAULTS grammar, the fault-site drift check, and the kill-at-
+fault-point chaos crash matrix.  Breaker/health/watchdog tests run on
+injectable clocks — fully deterministic, no sleeps."""
+
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import sntc_tpu.resilience as R
+from sntc_tpu.core.base import Transformer
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    HealthMonitor,
+    HealthState,
+    QuerySupervisor,
+)
+from sntc_tpu.resilience.supervisor import DRAIN_MARKER
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    R.clear()
+    R.clear_events()
+    R.reset_breakers()
+    yield
+    R.clear()
+    R.clear_events()
+    R.reset_breakers()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class _Identity(Transformer):
+    def transform(self, frame):
+        return frame
+
+
+def _frames(n_batches, rows=8):
+    return [
+        Frame({"x": np.arange(rows, dtype=np.float64) + 100 * b})
+        for b in range(n_batches)
+    ]
+
+
+def _query(tmp_path, src_frames, sink=None, **kw):
+    from sntc_tpu.serve import MemorySink, MemorySource, StreamingQuery
+
+    src = MemorySource(src_frames)
+    sink = sink if sink is not None else MemorySink()
+    q = StreamingQuery(
+        _Identity(), src, sink, str(tmp_path / "ckpt"),
+        max_batch_offsets=1, **kw,
+    )
+    return q, sink
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker: the state machine, on an injectable clock
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_opens_on_failure_rate_window():
+    clk = FakeClock()
+    br = CircuitBreaker(
+        "t.site", window=4, failure_threshold=0.5, min_calls=4,
+        cooldown_s=10.0, clock=clk,
+    )
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"  # 2 outcomes < min_calls
+    br.record_success()
+    br.record_failure()  # 4 outcomes, rate 0.75 >= 0.5
+    assert br.state == "open"
+    assert not br.allow()
+    assert br.retry_after_s() == pytest.approx(10.0)
+    opened = R.recent_events(site="t.site", event="breaker_open")
+    assert len(opened) == 1 and opened[0]["failure_rate"] == 0.75
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    clk = FakeClock()
+    br = CircuitBreaker(
+        "t.site", window=2, failure_threshold=1.0, min_calls=2,
+        cooldown_s=5.0, clock=clk,
+    )
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "open"
+    clk.t = 5.0
+    assert br.state == "half_open"
+    assert R.recent_events(site="t.site", event="breaker_half_open")
+    assert br.allow()       # the single probe slot
+    assert not br.allow()   # no second probe
+    br.record_failure()     # probe failed: fresh cooldown
+    assert br.state == "open"
+    clk.t = 9.9
+    assert not br.allow()   # cooldown restarted at t=5
+    clk.t = 10.0
+    assert br.allow()
+    br.record_success()
+    assert br.state == "closed"
+    assert R.recent_events(site="t.site", event="breaker_closed")
+
+
+def test_breaker_call_wrapper_and_snapshot():
+    clk = FakeClock()
+    br = CircuitBreaker(
+        "t.call", window=2, failure_threshold=1.0, min_calls=2,
+        cooldown_s=30.0, clock=clk,
+    )
+    assert br.call(lambda: "ok") == "ok"
+    for _ in range(2):
+        with pytest.raises(ValueError):
+            br.call(lambda: (_ for _ in ()).throw(ValueError("down")))
+    with pytest.raises(CircuitOpenError) as ei:
+        br.call(lambda: "never runs")
+    assert ei.value.site == "t.call"
+    snap = br.snapshot()
+    assert snap["state"] == "open" and snap["open_count"] == 1
+    assert snap["retry_after_s"] == pytest.approx(30.0)
+
+
+def test_breaker_registry():
+    a = R.breaker_for("reg.site", cooldown_s=1.0)
+    assert R.breaker_for("reg.site") is a
+    a.record_failure()
+    assert "reg.site" in R.breakers_snapshot()
+    R.reset_breakers()
+    assert R.breakers_snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# breaker wired into the streaming engine: defer, cool down, recover
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_sink_breaker_defers_then_recovers(tmp_path):
+    from sntc_tpu.serve import MemorySink
+
+    class DownSink(MemorySink):
+        def __init__(self):
+            super().__init__()
+            self.down = True
+            self.calls = 0
+
+        def add_batch(self, batch_id, frame):
+            self.calls += 1
+            if self.down:
+                raise IOError("sink down")
+            super().add_batch(batch_id, frame)
+
+    clk = FakeClock()
+    br = CircuitBreaker(
+        "sink.write", window=4, failure_threshold=1.0, min_calls=2,
+        cooldown_s=60.0, clock=clk,
+    )
+    q, sink = _query(
+        tmp_path, _frames(3), sink=DownSink(),
+        max_batch_failures=100, breakers={"sink.write": br},
+    )
+    assert q.process_available() == 0  # round 1 fails, defers
+    assert q.process_available() == 0  # round 2 fails -> breaker opens
+    assert br.state == "open"
+    calls_when_open = sink.calls
+    # while open the engine defers WITHOUT touching the sink
+    assert q.process_available() == 0
+    assert sink.calls == calls_when_open
+    assert q.last_committed() == -1  # nothing skipped, batch still queued
+    # dependency heals + cooldown elapses -> half-open probe commits
+    sink.down = False
+    clk.t = 60.0
+    assert q.process_available() == 3
+    assert br.state == "closed"
+    assert [i for i, _ in sink.batches] == [0, 1, 2]
+
+
+def test_streaming_predict_breaker_defers(tmp_path):
+    class BoomModel(Transformer):
+        def __init__(self):
+            super().__init__()
+            self.down = True
+
+        def transform(self, frame):
+            if self.down:
+                raise RuntimeError("model down")
+            return frame
+
+    from sntc_tpu.serve import MemorySink, MemorySource, StreamingQuery
+
+    class CountingSource(MemorySource):
+        def __init__(self, frames):
+            super().__init__(frames)
+            self.reads = 0
+
+        def get_batch(self, start, end):
+            self.reads += 1
+            return super().get_batch(start, end)
+
+    clk = FakeClock()
+    br = CircuitBreaker(
+        "predict.dispatch", window=4, failure_threshold=1.0, min_calls=2,
+        cooldown_s=60.0, clock=clk,
+    )
+    model = BoomModel()
+    src = CountingSource(_frames(2))
+    sink = MemorySink()
+    q = StreamingQuery(
+        model, src, sink, str(tmp_path / "ckpt"), max_batch_offsets=1,
+        max_batch_failures=100, breakers={"predict.dispatch": br},
+    )
+    assert q.process_available() == 0
+    assert q.process_available() == 0
+    assert br.state == "open"
+    model.down = False
+    # while OPEN the engine defers BEFORE reading: no wasted batch read
+    # per poll tick during an outage
+    reads_when_open = src.reads
+    assert q.process_available() == 0
+    assert src.reads == reads_when_open
+    clk.t = 60.0
+    assert q.process_available() == 2
+    assert br.state == "closed"
+    assert len(sink.frames) == 2
+
+
+# ---------------------------------------------------------------------------
+# health monitor + watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_health_report_overall_and_changed_events():
+    h = HealthMonitor()
+    assert h.overall() == HealthState.OK
+    h.report("sink.write", HealthState.DEGRADED, "flaky")
+    h.report("engine", HealthState.OK)
+    assert h.overall() == HealthState.DEGRADED
+    h.report("sink.write", HealthState.UNHEALTHY, "dead")
+    assert h.overall() == HealthState.UNHEALTHY
+    snap = h.snapshot()
+    assert snap["overall"] == "UNHEALTHY"
+    assert snap["components"]["sink.write"]["reason"] == "dead"
+    changed = R.recent_events(event="health_changed")
+    assert [(e["component"], e["state"]) for e in changed] == [
+        ("sink.write", "DEGRADED"), ("engine", "OK"),
+        ("sink.write", "UNHEALTHY"),
+    ]
+    # unchanged state: no new event
+    h.report("engine", HealthState.OK)
+    assert len(R.recent_events(event="health_changed")) == 3
+
+
+def test_health_aggregates_from_event_stream():
+    h = HealthMonitor().attach()
+    try:
+        R.emit_event(event="retry", site="sink.write", attempt=1)
+        assert h.state_of("sink.write") == HealthState.DEGRADED
+        R.emit_event(event="retry_exhausted", site="sink.write", attempts=3)
+        assert h.state_of("sink.write") == HealthState.UNHEALTHY
+        R.emit_event(event="retry_success", site="sink.write", attempts=2)
+        assert h.state_of("sink.write") == HealthState.OK
+        assert h.overall() == HealthState.OK
+    finally:
+        h.detach()
+    # detached: events no longer move health
+    R.emit_event(event="quarantine", site="sink.write")
+    assert h.state_of("sink.write") == HealthState.OK
+
+
+def test_watchdog_flags_stalled_batch_once():
+    clk = FakeClock()
+    h = HealthMonitor(max_batch_wall_time=5.0, clock=clk)
+    h.batch_started(7)
+    clk.t = 4.0
+    assert h.check_watchdog() == []
+    clk.t = 6.0
+    assert h.check_watchdog() == [7]
+    assert h.state_of("engine") == HealthState.UNHEALTHY
+    stalls = R.recent_events(event="watchdog_stall")
+    assert len(stalls) == 1 and stalls[0]["batch_id"] == 7
+    assert h.check_watchdog() == []  # one alarm per stalled batch
+    h.batch_finished(7)
+    assert h.check_watchdog() == []
+
+
+# ---------------------------------------------------------------------------
+# supervisor: load shedding
+# ---------------------------------------------------------------------------
+
+
+def test_shed_oldest_caps_backlog_and_journals(tmp_path):
+    q, sink = _query(tmp_path, _frames(10))
+    sup = QuerySupervisor(q, max_pending_batches=2, shed_policy="oldest")
+    try:
+        rec = sup.maybe_shed()
+        assert rec["offsets_shed"] == 8
+        assert rec["start"] == 0 and rec["end"] == 8
+        assert sup.shed_total_offsets == 8
+        # the freshest two offsets survive and commit
+        assert q.process_available() == 2
+        assert [int(f["x"][0]) for f in sink.frames] == [800, 900]
+        # journaled evidence + structured event + degraded health
+        shed_log = os.path.join(str(tmp_path / "ckpt"), "shed.jsonl")
+        records = [json.loads(ln) for ln in open(shed_log)]
+        assert len(records) == 1 and records[0]["policy"] == "oldest"
+        assert R.recent_events(event="load_shed")
+        assert sup.health.state_of("engine") == HealthState.DEGRADED
+        # under the cap: no further shedding
+        assert sup.maybe_shed() is None
+    finally:
+        sup.close()
+
+
+def test_shed_sample_processes_backlog_at_stride(tmp_path):
+    q, sink = _query(tmp_path, _frames(10))
+    sup = QuerySupervisor(q, max_pending_batches=2, shed_policy="sample")
+    try:
+        rec = sup.maybe_shed()
+        assert rec["sample_stride"] == 5  # ceil(10 pending / 2 kept)
+        assert q.process_available() == 1  # ONE batch covers everything
+        # 10 frames x 8 rows = 80 rows, stride 5 -> 16 rows survive
+        assert sink.frames[0].num_rows == 16
+        np.testing.assert_array_equal(
+            sink.frames[0]["x"][:2], [0.0, 5.0]
+        )
+        # the stride is IN the committed intent -> a replay reproduces
+        # the identical sample
+        with open(
+            os.path.join(str(tmp_path / "ckpt"), "commits", "0.json")
+        ) as f:
+            intent = json.load(f)
+        assert intent["sample_stride"] == 5
+        assert intent["start"] == 0 and intent["end"] == 10
+    finally:
+        sup.close()
+
+
+def test_shed_sample_replays_identically_after_crash(tmp_path):
+    from sntc_tpu.serve import MemorySink, MemorySource, StreamingQuery
+
+    frames = _frames(10)
+    q, _ = _query(tmp_path, frames)
+    sup = QuerySupervisor(q, max_pending_batches=2, shed_policy="sample")
+    try:
+        sup.maybe_shed()
+        R.arm("stream.commit", times=1)  # crash post-sink, pre-commit
+        with pytest.raises(R.InjectedFault):
+            q.process_available()
+    finally:
+        R.clear()
+        sup.close()
+    # restart: the WAL'd sampled intent replays with the same stride
+    sink2 = MemorySink()
+    q2 = StreamingQuery(
+        _Identity(), MemorySource(frames), sink2,
+        str(tmp_path / "ckpt"), max_batch_offsets=1,
+    )
+    assert q2.process_available() == 1
+    assert sink2.frames[0].num_rows == 16
+    np.testing.assert_array_equal(
+        sink2.frames[0]["x"], np.concatenate([f["x"] for f in frames])[::5]
+    )
+
+
+# ---------------------------------------------------------------------------
+# supervisor: drain + run loop
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_drain_commits_in_flight_and_writes_marker(tmp_path):
+    q, sink = _query(tmp_path, _frames(4), pipeline_depth=3)
+    # dispatch two batches without retiring either (in flight at the
+    # moment the preemption notice lands)
+    assert q._dispatch_next() and q._dispatch_next()
+    assert len(q._in_flight) == 2
+    sup = QuerySupervisor(q)
+    try:
+        sup.request_drain("SIGTERM")
+        status = sup.run(poll_interval=0.01)
+    finally:
+        sup.close()
+    assert status["drained"] is True
+    assert status["engine"]["in_flight"] == 0
+    assert q.last_committed() == 1  # both in-flight batches committed
+    marker_path = os.path.join(str(tmp_path / "ckpt"), DRAIN_MARKER)
+    marker = json.load(open(marker_path))
+    assert marker["reason"] == "SIGTERM"
+    assert marker["last_committed"] == 1
+    assert marker["in_flight_left"] == 0
+    assert R.recent_events(event="drained")
+    # restart resumes exactly-once: only the two undispatched batches
+    from sntc_tpu.serve import MemorySink, MemorySource, StreamingQuery
+
+    sink2 = MemorySink()
+    q2 = StreamingQuery(
+        _Identity(), MemorySource(_frames(4)), sink2,
+        str(tmp_path / "ckpt"), max_batch_offsets=1,
+    )
+    assert q2.process_available() == 2
+    assert [i for i, _ in sink2.batches] == [2, 3]
+
+
+def test_health_site_recovers_after_quarantine(tmp_path):
+    """One poison batch must not pin sink.write UNHEALTHY for the life
+    of the process: the next CLEAN commit proves the stage recovered
+    (first-attempt successes never emit retry_success, so this is the
+    only recovery signal)."""
+    from sntc_tpu.serve import MemorySink
+
+    class Poison0(MemorySink):
+        def add_batch(self, batch_id, frame):
+            if batch_id == 0:
+                raise IOError("poison")
+            super().add_batch(batch_id, frame)
+
+    q, sink = _query(
+        tmp_path, _frames(2), sink=Poison0(), max_batch_failures=1
+    )
+    sup = QuerySupervisor(q)
+    try:
+        assert sup.tick() == 1  # batch 0 quarantined + committed
+        assert sup.health.state_of("sink.write") == HealthState.UNHEALTHY
+        assert sup.tick() == 1  # batch 1 commits cleanly
+        assert sup.health.state_of("sink.write") == HealthState.OK
+        assert sup.health.overall() == HealthState.OK
+    finally:
+        sup.close()
+
+
+def test_watchdog_flags_batch_deferring_across_ticks(tmp_path):
+    """A batch that keeps DEFERRING (sink down, rounds below the
+    quarantine threshold) ages across ticks: fast failing ticks must
+    not reset the watchdog clock each round."""
+    from sntc_tpu.serve import MemorySink
+
+    class AlwaysDown(MemorySink):
+        def add_batch(self, batch_id, frame):
+            raise IOError("down")
+
+    clk = FakeClock()
+    q, _ = _query(
+        tmp_path, _frames(1), sink=AlwaysDown(), max_batch_failures=100
+    )
+    sup = QuerySupervisor(q, max_batch_wall_time=5.0, clock=clk)
+    try:
+        assert sup.tick() == 0  # round 1 defers; batch 0 starts aging
+        clk.t = 6.0
+        assert sup.tick() == 0  # still deferring: original start kept
+        assert sup.health.check_watchdog() == [0]
+        assert sup.health.state_of("engine") == HealthState.UNHEALTHY
+    finally:
+        sup.close()
+
+
+def test_watchdog_ignores_idle_stream(tmp_path):
+    """No data and nothing in flight: the tick must not start aging a
+    PHANTOM batch — an idle watch directory is healthy, not stalled."""
+    clk = FakeClock()
+    q, _ = _query(tmp_path, [])  # empty source
+    sup = QuerySupervisor(q, max_batch_wall_time=5.0, clock=clk)
+    try:
+        assert sup.tick() == 0  # idle tick
+        clk.t = 60.0
+        assert sup.health.check_watchdog() == []
+        assert sup.health.state_of("engine") != HealthState.UNHEALTHY
+    finally:
+        sup.close()
+
+
+def test_shed_sample_not_rejournaled_while_pending(tmp_path):
+    """A sample decision awaiting consumption (dispatch deferred) must
+    not be re-journaled every poll tick."""
+    q, sink = _query(tmp_path, _frames(10))
+    sup = QuerySupervisor(q, max_pending_batches=2, shed_policy="sample")
+    try:
+        assert sup.maybe_shed() is not None
+        for _ in range(5):  # breaker-open-style ticks: nothing consumed
+            assert sup.maybe_shed() is None
+        shed_log = os.path.join(str(tmp_path / "ckpt"), "shed.jsonl")
+        assert len(open(shed_log).readlines()) == 1
+        assert len(R.recent_events(event="load_shed")) == 1
+        # once consumed, a NEW backlog decision is possible again
+        assert q.process_available() == 1
+        assert sup.maybe_shed() is None  # backlog drained
+    finally:
+        sup.close()
+
+
+def test_engine_health_recovers_after_watchdog_stall(tmp_path):
+    """UNHEALTHY from a past stall must not latch forever: the stalled
+    batch finishing (a committing tick) is the recovery evidence."""
+    q, sink = _query(tmp_path, _frames(2))
+    sup = QuerySupervisor(q)
+    try:
+        sup.health.report(
+            "engine", HealthState.UNHEALTHY, "batch 0 stalled"
+        )
+        assert sup.tick() == 1
+        assert sup.health.state_of("engine") == HealthState.OK
+    finally:
+        sup.close()
+
+
+def test_serve_cli_defaults_degrade_not_die(tmp_path, capsys):
+    """The serve CLI arms retry + quarantine by default: a poison input
+    file dead-letters and the drain exits 0 instead of the first error
+    killing the supervised process (where breakers could never open)."""
+    import csv
+    import json as _json
+    import threading
+
+    from sntc_tpu.app import main
+
+    watch = tmp_path / "in"
+    watch.mkdir()
+    for i, rows in enumerate([3, 3]):
+        with open(watch / f"in_{i}.csv", "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["x"])
+            for r in range(rows):
+                w.writerow([i * 10 + r])
+    (watch / "in_0.csv").write_text("x\nnot,a,valid,row\n1,2\n")  # torn
+
+    from sntc_tpu.feature import Binarizer
+    from sntc_tpu.mlio import save_model
+
+    model_dir = str(tmp_path / "model")
+    save_model(
+        Binarizer(inputCol="x", outputCol="prediction", threshold=5.0),
+        model_dir,
+    )
+    # run the REAL cmd_serve loop on a thread; drain via a timer
+    from sntc_tpu.resilience import supervisor as sup_mod
+
+    drained = threading.Event()
+    orig_run = sup_mod.QuerySupervisor.run
+
+    def run_and_capture(self, *a, **kw):
+        threading.Timer(0.5, lambda: self.request_drain("test")).start()
+        try:
+            return orig_run(self, *a, **kw)
+        finally:
+            drained.set()
+
+    sup_mod.QuerySupervisor.run = run_and_capture
+    try:
+        rc = main([
+            "serve", "--model", model_dir, "--watch", str(watch),
+            "--out", str(tmp_path / "out"), "--checkpoint",
+            str(tmp_path / "ckpt"), "--max-files-per-batch", "1",
+            "--poll-interval", "0.05", "--max-batch-failures", "1",
+            "--batch-retry-attempts", "1", "--platform", "cpu",
+        ])
+    finally:
+        sup_mod.QuerySupervisor.run = orig_run
+    assert rc == 0 and drained.is_set()
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["drained"] is True
+    assert out["batches"] == 2  # poison batch quarantined + committed
+    dl = tmp_path / "ckpt" / "dead_letter" / "dead_letter.jsonl"
+    assert dl.exists()  # the torn file's evidence
+    assert (tmp_path / "ckpt" / "drain_marker.json").exists()
+
+
+def test_supervisor_run_commits_and_writes_health_json(tmp_path):
+    health_path = str(tmp_path / "health.json")
+    q, sink = _query(tmp_path, _frames(3))
+    sup = QuerySupervisor(q, health_json=health_path)
+    try:
+        status = sup.run(poll_interval=0.01, max_batches=3)
+    finally:
+        sup.close()
+    assert status["engine"]["batches_done"] == 3
+    assert len(sink.frames) == 3
+    dump = json.load(open(health_path))
+    assert dump["engine"]["last_committed"] == 2
+    assert dump["health"]["overall"] == "OK"
+    assert "breakers" in dump and "events_dropped" in dump
+    assert not status["drained"]
+
+
+# ---------------------------------------------------------------------------
+# bounded, thread-safe event ring
+# ---------------------------------------------------------------------------
+
+
+def test_event_ring_bounded_with_drop_counter():
+    for i in range(600):
+        R.emit_event(event="ring_test", i=i)
+    events = R.recent_events(event="ring_test")
+    assert len(events) == 512  # hard cap
+    assert R.events_dropped() == 88  # evictions counted, not silent
+    assert events[0]["i"] == 88  # oldest records were the ones dropped
+    assert events[-1]["i"] == 599
+    R.clear_events()
+    assert R.events_dropped() == 0
+
+
+def test_event_ring_thread_safe():
+    n_threads, per_thread = 8, 300
+    errors = []
+
+    def emit_and_read(tid):
+        try:
+            for i in range(per_thread):
+                R.emit_event(event="mt_test", tid=tid, i=i)
+                if i % 50 == 0:
+                    R.recent_events(event="mt_test")
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=emit_and_read, args=(t,))
+        for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    kept = len(R.recent_events(event="mt_test"))
+    assert kept == 512
+    assert kept + R.events_dropped() == n_threads * per_thread
+
+
+# ---------------------------------------------------------------------------
+# SNTC_FAULTS grammar: every failure mode names the offending segment
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("raw, match", [
+    ("sink.write:exc:0.5:1:9", r"'sink.write:exc:0.5:1:9'.*at most 4"),
+    (":exc", r"':exc'.*empty site"),
+    ("sink.write:bogus", r"'sink.write:bogus'.*unknown kind 'bogus'"),
+    ("sink.write:exc:zzz", r"'sink.write:exc:zzz'.*not a float"),
+    ("sink.write:exc:1.5", r"'sink.write:exc:1.5'.*lie in \[0, 1\]"),
+    ("sink.write:exc:0.5:xx", r"'sink.write:exc:0.5:xx'.*not an int"),
+])
+def test_parse_faults_env_names_offending_segment(raw, match):
+    with pytest.raises(ValueError, match=match):
+        R.parse_faults_env("stream.read," + raw)  # good specs unaffected
+
+
+def test_parse_faults_env_accepts_kill_kind():
+    assert R.parse_faults_env("sink.write:kill:1.0:3") == [
+        {"site": "sink.write", "kind": "kill", "prob": 1.0, "seed": 3}
+    ]
+
+
+# ---------------------------------------------------------------------------
+# fault-site drift check (the tier-1 wiring of scripts/check_fault_sites)
+# ---------------------------------------------------------------------------
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fault_sites_documented_and_declared():
+    checker = _load_script("check_fault_sites")
+    assert checker.check() == []
+    # the checker itself must see every declared site wired
+    assert checker.code_sites() == set(R.SITES)
+
+
+# ---------------------------------------------------------------------------
+# bench journaling: resilience evidence rides along
+# ---------------------------------------------------------------------------
+
+
+def test_bench_resilience_summary_counts_events_and_breakers():
+    import sys
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    assert bench._resilience_summary() is None  # clean run: no field
+    R.emit_event(event="retry", site="sink.write", attempt=1)
+    R.emit_event(event="retry", site="sink.write", attempt=2)
+    br = R.breaker_for("sink.write", min_calls=1, failure_threshold=1.0)
+    br.record_failure()
+    summary = bench._resilience_summary()
+    assert summary["event_counts"]["retry"] == 2
+    assert summary["breakers"]["sink.write"]["state"] == "open"
+    assert summary["events_dropped"] == 0
+    # the summary is a DELTA per journal record: a multi-config sweep
+    # must not attribute config 1's retries to later configs
+    R.reset_breakers()
+    assert bench._resilience_summary() is None
+    R.emit_event(event="retry", site="stream.read", attempt=1)
+    assert bench._resilience_summary()["event_counts"] == {"retry": 1}
+
+
+# ---------------------------------------------------------------------------
+# chaos crash matrix: kill the engine at each protocol boundary in a
+# REAL child process; restart must converge to the reference state
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def chaos():
+    return _load_script("chaos_crash_matrix")
+
+
+@pytest.fixture(scope="module")
+def chaos_reference(chaos, tmp_path_factory):
+    workdir = str(tmp_path_factory.mktemp("chaos"))
+    return workdir, chaos.run_reference(workdir)
+
+
+def test_chaos_kill_matrix_exactly_once(chaos, chaos_reference):
+    workdir, reference = chaos_reference
+    # sanity on the reference itself: 4 input files -> 4 committed
+    # single-offset batches, 6 rows each
+    assert sorted(reference["commits"]) == [0, 1, 2, 3]
+    assert set(reference["rows"].values()) == {6}
+    for site in chaos.KILL_SITES:
+        verdict = chaos.run_kill_scenario(workdir, site, reference)
+        assert verdict["ok"], verdict
+
+
+def test_chaos_sigterm_drains_and_exits_zero(chaos, chaos_reference):
+    workdir, _ = chaos_reference
+    verdict = chaos.run_drain_scenario(workdir)
+    if not verdict["ok"]:
+        # timing-sensitive subprocess scenario: under full-suite load
+        # the SIGTERM/child-startup race can flake — retry ONCE with
+        # the first verdict printed, never silently absorbed (the
+        # bench rendezvous-retry pattern)
+        print("first drain verdict:", json.dumps(verdict))
+        verdict = chaos.run_drain_scenario(os.path.join(workdir, "retry"))
+    assert verdict["ok"], verdict
+    assert verdict["rc"] == 0
+    assert verdict["marker"]["reason"] == "SIGTERM"
