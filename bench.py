@@ -30,6 +30,7 @@ flagship line LAST (so the driver's one-line contract still reads config 2).
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import sys
@@ -341,17 +342,87 @@ def bench_config4(n_rows, mesh):
     }
 
 
+BENCH5_SHAPE_BUCKETS = 256
+# depth 3 + two staged reads: engine thread + delivery thread + two
+# prefetch readers.  The win comes from the heavy GIL-releasing C++
+# stages (pyarrow CSV parse and CSV write) overlapping — reads chain
+# back-to-back on the staging pool while the delivery thread writes.
+BENCH5_PIPELINE_DEPTH = 3
+BENCH5_PREFETCH = 2
+# micro-batch row counts cycle through three distinct sizes: a serial
+# engine recompiles predict per size, the bucketed one compiles once per
+# power-of-two bucket and then stays flat
+BENCH5_SIZES = (2048, 1024, 512)
+
+def _write_bench5_stream(in_dir, frame, passes=None):
+    """THE config-5 synthetic stream: micro-batch CSV part files whose
+    row counts cycle through BENCH5_SIZES, ``passes`` passes over
+    ``frame``.  One writer shared by the engine bench and the sklearn
+    proxy so the two sides of the paired ratio can never drift apart.
+    Returns the per-file row counts (len = file count, sum = total
+    stream rows — the exact ledger; the engine's recentProgress ring
+    keeps only the last 100 batches, so it cannot be the row source
+    for long streams)."""
+    import pyarrow.csv as pacsv
+
+    from sntc_tpu.data import CICIDS2017_FEATURES
+
+    os.makedirs(in_dir, exist_ok=True)
+    sizes = []
+    for _pass in range(passes or 1):
+        i = 0
+        while i < frame.num_rows:
+            size = BENCH5_SIZES[len(sizes) % len(BENCH5_SIZES)]
+            chunk = frame.slice(i, min(i + size, frame.num_rows))
+            pacsv.write_csv(
+                chunk.select(CICIDS2017_FEATURES).to_arrow(),
+                os.path.join(in_dir, f"part_{len(sizes):05d}.csv"),
+            )
+            i += chunk.num_rows
+            sizes.append(chunk.num_rows)
+    return sizes
+
+
+# each engine's stream is timed BENCH5_REPS times (fresh checkpoint/out
+# dirs, same predictor), reps interleaved between the engines; the
+# MEDIAN rep per engine is reported (best also journaled) — host-noise
+# hygiene for a seconds-scale measurement on a shared box, symmetric
+# for both engines.  The stream repeats the test split
+# BENCH5_STREAM_PASSES times so each rep is long enough to average over
+# short noise bursts.
+BENCH5_REPS = 5
+BENCH5_STREAM_PASSES = 2
+
+
 def bench_config5(n_rows, mesh):
     """Streaming inference throughput: rows/s through the micro-batch
-    engine (model fit excluded — serving is the workload [B:11])."""
+    engine over a REAL file stream — CSV micro-batches in, prediction
+    CSVs out (model fit excluded — serving is the workload [B:11]).
+
+    Runs the SAME synthetic stream through BOTH engines: the serial
+    engine (``pipeline_depth=1``, no buckets) and the pipelined engine
+    (prefetching source + shape-bucketed predict + overlapped sink
+    delivery) — the r8 software-pipelining claim measured, not asserted.
+    The sink writes the FULL enriched row (78 flow features +
+    prediction), Spark's append-mode output of the transformed frame —
+    which also makes the retire stage real work, not a one-column
+    stub.  Micro-batch row counts cycle through three distinct sizes so
+    the bucket path's compile cache is exercised;
+    ``recompiles_after_warmup`` in the ``pipeline`` evidence field must
+    stay 0.  The two engines' sink contents are compared row-for-row
+    (``sink_match``)."""
     import shutil
     import tempfile
+
+    import pyarrow as pa
+    import pyarrow.csv as pacsv
 
     from sntc_tpu.core.base import Pipeline, PipelineModel
     from sntc_tpu.models import LogisticRegression
     from sntc_tpu.serve import (
-        MemorySink,
-        MemorySource,
+        BatchPredictor,
+        CsvDirSink,
+        FileStreamSource,
         StreamingQuery,
         compile_serving,
     )
@@ -363,47 +434,177 @@ def bench_config5(n_rows, mesh):
     # serving pipeline: drop the indexer, fold the scaler into the model
     serve_model = compile_serving(PipelineModel(stages=pipe.getStages()[1:]))
 
-    n_batches = 20
-    per = max(test.num_rows // n_batches, 1)
-    batches = [
-        test.slice(i * per, min((i + 1) * per, test.num_rows))
-        for i in range(n_batches)
-    ]
-    tmp = tempfile.mkdtemp()
-    try:
-        # warmup (compile) on one batch
-        q0 = StreamingQuery(
-            serve_model, MemorySource(batches[:1]), MemorySink(),
-            os.path.join(tmp, "warm"),
+    def make_engine(tmp, name, in_dir, chunk_sizes, *, pipelined):
+        """Warm one engine's predictor and return its run context.
+        BOTH engines warm outside the timed window: one micro-batch
+        through a throwaway query (process-global first-touch costs —
+        pyarrow pools, jit, WAL/sink paths), then EVERY distinct chunk
+        row count the stream contains straight through the predictor —
+        including the ragged tail remainder, whose floor-bucket shape
+        the cycling sizes alone would miss.  ONE predictor per engine
+        across warmup and every measured rep, so compile_events is a
+        single ledger."""
+        predictor = BatchPredictor(
+            serve_model,
+            bucket_rows=BENCH5_SHAPE_BUCKETS if pipelined else 0,
         )
-        q0.process_available()
-        src = MemorySource(batches)
-        sink = MemorySink()
-        # append-mode WAL: one flushed JSONL append per batch instead of
-        # two file creates — the engine's high-throughput journal
-        q = StreamingQuery(
-            serve_model, src, sink, os.path.join(tmp, "ckpt"),
+        warm = StreamingQuery(
+            predictor, FileStreamSource(in_dir),
+            CsvDirSink(os.path.join(tmp, f"warm_{name}"), durable=False),
+            os.path.join(tmp, f"warmckpt_{name}"),
             max_batch_offsets=1, wal_mode="append",
+        )
+        warm._run_one_batch()
+        warm.stop()
+        for c in sorted(set(chunk_sizes)):
+            predictor.predict_frame(test.slice(0, c))
+        return {
+            "name": name, "pipelined": pipelined,
+            "predictor": predictor,
+            "compiles_before": predictor.compile_events,
+            "reps": [],
+        }
+
+    def run_once(tmp, eng, in_dir, rep, stream_rows, n_files):
+        """One timed pass of the whole stream; records the rep."""
+        name, pipelined = eng["name"], eng["pipelined"]
+        out_dir = os.path.join(tmp, f"out_{name}_{rep}")
+        src = FileStreamSource(
+            in_dir,
+            prefetch_batches=BENCH5_PREFETCH if pipelined else 0,
+        )
+        q = StreamingQuery(
+            eng["predictor"], src,
+            # full enriched row (all 1-D cols); durable=False for BOTH
+            # engines — page-cache publish, the pre-r8 sink semantics —
+            # so the ratio isolates pipelining from the r8 fsync feature
+            CsvDirSink(out_dir, durable=False),
+            os.path.join(tmp, f"ckpt_{name}_{rep}"),
+            max_batch_offsets=1, wal_mode="append",
+            pipeline_depth=BENCH5_PIPELINE_DEPTH if pipelined else 1,
+            overlap_sink=pipelined,
         )
         t0 = time.perf_counter()
         n_done = q.process_available()
         dt = time.perf_counter() - t0
+        # exact row ledger from the stream writer (recentProgress keeps
+        # only the last 100 batches); progress-sum fallback only if a
+        # batch somehow didn't commit
+        rows = (
+            stream_rows
+            if n_done == n_files
+            else sum(p["numInputRows"] for p in q.recentProgress)
+        )
         lat = np.asarray(
             [p["durationMs"] for p in q.recentProgress], np.float64
         )
+        stats = q.pipeline_stats()
+        q.stop()
+        src.close()
+        rec = {
+            "out_dir": out_dir, "batches": n_done, "rows": rows,
+            "dt": dt, "rows_per_s": rows / dt,
+            "latency_ms_p50": float(np.percentile(lat, 50)),
+            "latency_ms_p99": float(np.percentile(lat, 99)),
+            "stats": stats,
+        }
+        eng.setdefault("reps", []).append(rec)
+        return rec
+
+    def finish_engine(eng):
+        # MEDIAN rep = the reported measurement (robust to one noisy
+        # window on a shared host, symmetric for both engines)
+        reps = sorted(eng["reps"], key=lambda r: r["rows_per_s"])
+        median = reps[len(reps) // 2]
+        median["stats"]["recompiles_after_warmup"] = (
+            eng["predictor"].compile_events - eng["compiles_before"]
+        )
+        median["stats"]["reps"] = BENCH5_REPS
+        median["stats"]["best_rows_per_s"] = round(
+            reps[-1]["rows_per_s"], 1
+        )
+        return median
+
+    def read_sink(out_dir):
+        import pyarrow as pa
+
+        parts = [
+            pacsv.read_csv(p)
+            for p in sorted(glob.glob(os.path.join(out_dir, "batch_*.csv")))
+        ]
+        return pa.concat_tables(parts)
+
+    def sinks_match(a, b):
+        """Row-for-row equality of the two engines' full sink output."""
+        if a.column_names != b.column_names or a.num_rows != b.num_rows:
+            return False
+        return all(
+            np.array_equal(
+                a.column(c).to_numpy(), b.column(c).to_numpy()
+            )
+            for c in a.column_names
+        )
+
+    tmp = tempfile.mkdtemp()
+    # intra-op pinned to ONE thread for BOTH engines: arrow's hidden
+    # intra-file parse pool otherwise competes with the pipeline's
+    # explicit inter-batch parallelism for the same few cores, and the
+    # ratio would measure the host's core count, not engine structure.
+    # With intra-op pinned, every stage costs its single-core cost and
+    # the engines differ only in overlap — tf.data's inter-op-over-
+    # intra-op discipline (arxiv 2101.12127); see docs/PERFORMANCE.md.
+    arrow_cpus = pa.cpu_count()
+    pa.set_cpu_count(1)
+    try:
+        # one synthetic stream, micro-batch sizes cycling through three
+        # distinct row counts (the shape-bucket workload); written once,
+        # served by both engines
+        in_dir = os.path.join(tmp, "in")
+        chunk_sizes = _write_bench5_stream(
+            in_dir, test, passes=BENCH5_STREAM_PASSES
+        )
+        stream_rows, n_files = sum(chunk_sizes), len(chunk_sizes)
+        engines = [
+            make_engine(tmp, "serial", in_dir, chunk_sizes,
+                        pipelined=False),
+            make_engine(tmp, "pipe", in_dir, chunk_sizes,
+                        pipelined=True),
+        ]
+        # reps INTERLEAVE the two engines: host-speed drift on a shared
+        # box lands on both sides of the ratio instead of biasing one
+        for rep in range(BENCH5_REPS):
+            for eng in engines:
+                run_once(tmp, eng, in_dir, rep, stream_rows, n_files)
+        serial, pipe_r = (finish_engine(e) for e in engines)
+        sink_match = sinks_match(
+            read_sink(serial["out_dir"]), read_sink(pipe_r["out_dir"])
+        )
     finally:
+        pa.set_cpu_count(arrow_cpus)
         shutil.rmtree(tmp, ignore_errors=True)
-    rows = sum(f.num_rows for f in sink.frames)
+    pipeline_evidence = {
+        **pipe_r["stats"],
+        "arrow_intra_op_threads": 1,
+        "serial_rows_per_s": round(serial["rows_per_s"], 1),
+        "speedup_vs_serial": _round_ratio(
+            pipe_r["rows_per_s"] / serial["rows_per_s"]
+        ),
+        "serial_latency_ms_p50": round(serial["latency_ms_p50"], 3),
+        "serial_latency_ms_p99": round(serial["latency_ms_p99"], 3),
+        "sink_match": sink_match,
+        "batch_sizes": list(BENCH5_SIZES),
+    }
     return {
         "metric": "cicids2017_streaming_inference_rows_per_s",
         "_datasets": (train, test),
-        "value": rows / dt, "unit": "rows/s",
+        "value": pipe_r["rows_per_s"], "unit": "rows/s",
         "quality": {
-            "micro_batches": n_done,
-            "latency_ms_p50": float(np.percentile(lat, 50)),
-            "latency_ms_p99": float(np.percentile(lat, 99)),
+            "micro_batches": pipe_r["batches"],
+            "latency_ms_p50": pipe_r["latency_ms_p50"],
+            "latency_ms_p99": pipe_r["latency_ms_p99"],
+            "pipeline": pipeline_evidence,
         },
-        "n_rows": rows,
+        "n_rows": pipe_r["rows"],
     }
 
 
@@ -854,32 +1055,55 @@ def proxy_config4(train, test):
 
 
 def proxy_config5(train, test):
-    """Serving throughput proxy: fit excluded (like ours); micro-batches
-    arrive as COLUMNS (the NetFlow/Arrow record shape [B:11]) and each
-    chunk pays feature assembly, scaling, and predict."""
+    """Serving throughput proxy: fit excluded (like ours); the same
+    end-to-end job the engine is measured on since r8 — micro-batch CSV
+    files stream in, the full enriched row (features + prediction)
+    streams out as CSV — with sklearn predict in the middle.  File
+    setup is outside the timer, exactly as the engine's input stream
+    is."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.csv as pacsv
     from sklearn.linear_model import LogisticRegression as SkLR
     from sklearn.preprocessing import StandardScaler as SkScaler
-
-    from sntc_tpu.data import CICIDS2017_FEATURES
 
     X, y, _ = _proxy_xy(train)
     scaler = SkScaler().fit(X)
     clf = SkLR(max_iter=20).fit(scaler.transform(X), y)
-    cols = [
-        np.ascontiguousarray(test[c], dtype=np.float64)
-        for c in CICIDS2017_FEATURES
-    ]
-    n_test = test.num_rows
-    per = max(n_test // 20, 1)
-    t0 = time.perf_counter()
-    for i in range(20):
-        s, e = i * per, min((i + 1) * per, n_test)
-        if e > s:
-            chunk = np.stack([c[s:e] for c in cols], axis=1)
-            clf.predict_proba(scaler.transform(chunk))
-    dt = time.perf_counter() - t0
+    tmp = tempfile.mkdtemp()
+    # same arrow intra-op pinning as the engine measurement (see
+    # bench_config5) — both sides of the paired ratio parse/write CSV
+    # with one intra-op thread
+    arrow_cpus = pa.cpu_count()
+    pa.set_cpu_count(1)
+    try:
+        chunk_sizes = _write_bench5_stream(tmp, test)
+        n_files, n_test = len(chunk_sizes), sum(chunk_sizes)
+        paths = sorted(glob.glob(os.path.join(tmp, "part_*.csv")))
+        t0 = time.perf_counter()
+        for k, p in enumerate(paths):
+            table = pacsv.read_csv(p)
+            Xc = np.stack(
+                [
+                    table.column(c).to_numpy()
+                    for c in table.column_names
+                ],
+                axis=1,
+            )
+            pred = clf.predict(scaler.transform(Xc))
+            out = table.append_column(
+                "prediction", pa.array(pred.astype(np.float64))
+            )
+            pacsv.write_csv(out, os.path.join(tmp, f"out_{k:05d}.csv"))
+        dt = time.perf_counter() - t0
+    finally:
+        pa.set_cpu_count(arrow_cpus)
+        shutil.rmtree(tmp, ignore_errors=True)
     return {
-        "desc": "columnar chunked assemble+scale+predict_proba",
+        "desc": "CSV-in → assemble+scale+predict → enriched-CSV-out, "
+                f"{n_files} micro-batch files",
         "rows_per_s": n_test / dt,
         "n_rows_served": int(n_test),
     }

@@ -89,6 +89,7 @@ def sink_rows(out_dir: str) -> dict:
 def run_worker(
     watch: str, out: str, ckpt: str, *, faults: str = "",
     slow_sink_s: float = 0.0, timeout: float = 120.0,
+    pipelined: bool = False,
 ) -> subprocess.CompletedProcess:
     """One drain-and-exit engine pass in a child process."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS=faults)
@@ -97,6 +98,8 @@ def run_worker(
         sys.executable, SCRIPT, "--worker", "--watch", watch, "--out",
         out, "--ckpt", ckpt, "--slow-sink-s", str(slow_sink_s),
     ]
+    if pipelined:
+        cmd.append("--pipelined")
     return subprocess.run(
         cmd, env=env, cwd=REPO, capture_output=True, text=True,
         timeout=timeout,
@@ -119,23 +122,32 @@ def run_reference(workdir: str) -> dict:
     return {"commits": committed_state(ref_ckpt), "rows": sink_rows(ref_out)}
 
 
-def run_kill_scenario(workdir: str, site: str, reference: dict) -> dict:
+def run_kill_scenario(
+    workdir: str, site: str, reference: dict, pipelined: bool = False,
+) -> dict:
     """Kill the engine at ``site``, restart, compare against the clean
-    reference run.  Returns a verdict dict with ``ok``."""
-    d = os.path.join(workdir, site.replace(".", "_"))
+    (serial) reference run.  ``pipelined=True`` runs both the killed
+    pass and the restart with the overlapped/prefetching/bucketed
+    engine — the crash contract must converge to the SERIAL reference's
+    commits and sink rows regardless.  Returns a verdict dict with
+    ``ok``."""
+    name = site.replace(".", "_") + ("_pipelined" if pipelined else "")
+    d = os.path.join(workdir, name)
     watch = os.path.join(d, "in")
     write_inputs(watch)
 
     out, ckpt = os.path.join(d, "out"), os.path.join(d, "ckpt")
-    killed = run_worker(watch, out, ckpt, faults=f"{site}:kill")
+    killed = run_worker(watch, out, ckpt, faults=f"{site}:kill",
+                        pipelined=pipelined)
     if killed.returncode != KILL_EXIT_CODE:
-        return {"site": site, "ok": False,
+        return {"site": site, "ok": False, "pipelined": pipelined,
                 "error": f"kill run rc={killed.returncode} (expected "
                 f"{KILL_EXIT_CODE}): {killed.stderr}"}
 
-    restarted = run_worker(watch, out, ckpt)  # no faults: converge
+    # no faults: converge (same engine mode as the killed pass)
+    restarted = run_worker(watch, out, ckpt, pipelined=pipelined)
     if restarted.returncode != 0:
-        return {"site": site, "ok": False,
+        return {"site": site, "ok": False, "pipelined": pipelined,
                 "error": f"restart rc={restarted.returncode}: "
                 f"{restarted.stderr}"}
 
@@ -145,27 +157,34 @@ def run_kill_scenario(workdir: str, site: str, reference: dict) -> dict:
     want_rows = reference["rows"]
     ok = got_commits == want_commits and got_rows == want_rows
     return {
-        "site": site, "ok": ok,
+        "site": site, "ok": ok, "pipelined": pipelined,
         "commits": {str(k): v for k, v in got_commits.items()},
         "expected_commits": {str(k): v for k, v in want_commits.items()},
         "sink_rows": got_rows, "expected_sink_rows": want_rows,
     }
 
 
-def run_drain_scenario(workdir: str, timeout: float = 120.0) -> dict:
+def run_drain_scenario(
+    workdir: str, timeout: float = 120.0, pipelined: bool = False,
+) -> dict:
     """SIGTERM a supervised serving loop mid-batch; require exit 0, a
-    commit for the in-flight batch, and the drain marker."""
-    d = os.path.join(workdir, "drain")
+    commit for the in-flight batch, and the drain marker.  With
+    ``pipelined=True`` the drain must also settle the delivery thread's
+    in-air batch before the marker lands."""
+    d = os.path.join(workdir, "drain_pipelined" if pipelined else "drain")
     watch = os.path.join(d, "in")
     out, ckpt = os.path.join(d, "out"), os.path.join(d, "ckpt")
     write_inputs(watch, n_files=6)
     env = dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS="")
+    cmd = [
+        sys.executable, SCRIPT, "--worker", "--serve", "--watch",
+        watch, "--out", out, "--ckpt", ckpt, "--slow-sink-s", "0.4",
+        "--poll-interval", "0.05",
+    ]
+    if pipelined:
+        cmd.append("--pipelined")
     proc = subprocess.Popen(
-        [
-            sys.executable, SCRIPT, "--worker", "--serve", "--watch",
-            watch, "--out", out, "--ckpt", ckpt, "--slow-sink-s", "0.4",
-            "--poll-interval", "0.05",
-        ],
+        cmd,
         env=env, cwd=REPO, stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True,
     )
@@ -197,18 +216,23 @@ def run_drain_scenario(workdir: str, timeout: float = 120.0) -> dict:
     )
     return {
         "site": "drain", "ok": ok, "rc": proc.returncode,
+        "pipelined": pipelined,
         "marker": marker, "commits": {str(k): v for k, v in commits.items()},
         "sink_batches": len(rows), "stderr": stderr[-2000:],
         "stdout": stdout[-500:],
     }
 
 
-def run_matrix(workdir: str) -> dict:
+def run_matrix(workdir: str, pipelined: bool = False) -> dict:
+    """The full matrix: reference is ALWAYS the serial engine; kill and
+    drain scenarios run serial or pipelined per ``pipelined`` and must
+    converge to the serial reference either way."""
     reference = run_reference(workdir)
     results = [
-        run_kill_scenario(workdir, s, reference) for s in KILL_SITES
+        run_kill_scenario(workdir, s, reference, pipelined=pipelined)
+        for s in KILL_SITES
     ]
-    results.append(run_drain_scenario(workdir))
+    results.append(run_drain_scenario(workdir, pipelined=pipelined))
     return {"ok": all(r["ok"] for r in results), "scenarios": results}
 
 
@@ -236,9 +260,18 @@ def worker_main(args) -> int:
             real_add(batch_id, frame)
 
         sink.add_batch = slow_add
+    # --pipelined: the full r8 pipeline — prefetching source, shape-
+    # bucketed predict (floor 4 pads the 6-row inputs to 8), overlapped
+    # sink delivery — under exactly the same crash/drain contract
+    src = FileStreamSource(
+        args.watch, prefetch_batches=2 if args.pipelined else 0
+    )
     q = StreamingQuery(
-        Identity(), FileStreamSource(args.watch), sink, args.ckpt,
+        Identity(), src, sink, args.ckpt,
         max_batch_offsets=1, breakers=default_breakers(),
+        pipeline_depth=3 if args.pipelined else 2,
+        overlap_sink=args.pipelined,
+        shape_buckets=4 if args.pipelined else 0,
     )
     if not args.serve:
         n = q.process_available()
@@ -257,6 +290,11 @@ def main(argv=None) -> int:
     ap.add_argument("--worker", action="store_true")
     ap.add_argument("--serve", action="store_true",
                     help="worker: supervised loop instead of one pass")
+    ap.add_argument("--pipelined", action="store_true",
+                    help="run the engine in pipelined mode (prefetching "
+                    "source + shape buckets + overlapped sink delivery); "
+                    "the matrix still compares against the serial "
+                    "reference")
     ap.add_argument("--watch")
     ap.add_argument("--out")
     ap.add_argument("--ckpt")
@@ -272,7 +310,7 @@ def main(argv=None) -> int:
         import tempfile
 
         workdir = tempfile.mkdtemp(prefix="chaos_matrix_")
-    verdict = run_matrix(workdir)
+    verdict = run_matrix(workdir, pipelined=args.pipelined)
     print(json.dumps(verdict, indent=1))
     return 0 if verdict["ok"] else 1
 

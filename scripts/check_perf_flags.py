@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Static drift check: pipelining knobs across CLI ⇔ engine ⇔ docs.
+
+The pipelined serving surface is one feature spread over three layers —
+``python -m sntc_tpu serve`` flags, ``StreamingQuery``/``DirStreamSource``
+constructor kwargs, and the tuning documentation — and each knob must
+exist in all of them:
+
+=====================  ==========================================
+``--pipeline-depth``   ``StreamingQuery(pipeline_depth=...)``
+``--shape-buckets``    ``StreamingQuery(shape_buckets=...)``
+``--prefetch-batches`` ``DirStreamSource(prefetch_batches=...)``
+=====================  ==========================================
+
+plus the engine-only ``overlap_sink`` kwarg, which the CLI derives from
+``--pipeline-depth`` and the docs must therefore explain.  Every flag
+must appear in ``docs/PERFORMANCE.md`` AND the README serve section.
+Wired as a tier-1 test (``tests/test_streaming.py``) so the three
+layers cannot drift silently — the ``check_fault_sites.py`` discipline
+applied to the perf surface.
+
+Exit 0 when consistent; exit 1 with a per-knob report otherwise.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (CLI flag, owner import path, constructor kwarg)
+FLAGS = (
+    ("--pipeline-depth", "StreamingQuery", "pipeline_depth"),
+    ("--shape-buckets", "StreamingQuery", "shape_buckets"),
+    ("--prefetch-batches", "DirStreamSource", "prefetch_batches"),
+)
+ENGINE_ONLY_KWARGS = (("StreamingQuery", "overlap_sink"),)
+DOCS = ("docs/PERFORMANCE.md", "README.md")
+
+
+def _read(rel: str) -> str:
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def _owner(name: str):
+    sys.path.insert(0, REPO)
+    from sntc_tpu.serve.streaming import DirStreamSource, StreamingQuery
+
+    return {"StreamingQuery": StreamingQuery,
+            "DirStreamSource": DirStreamSource}[name]
+
+
+def check() -> list:
+    """Returns a list of human-readable drift complaints (empty = ok)."""
+    problems = []
+    app_src = _read(os.path.join("sntc_tpu", "app.py"))
+    doc_srcs = {rel: _read(rel) for rel in DOCS}
+    for flag, owner_name, kwarg in FLAGS:
+        if f'"{flag}"' not in app_src:
+            problems.append(
+                f"serve CLI flag {flag!r} missing from sntc_tpu/app.py"
+            )
+        params = inspect.signature(_owner(owner_name).__init__).parameters
+        if kwarg not in params:
+            problems.append(
+                f"{owner_name}.__init__ lacks the {kwarg!r} kwarg that "
+                f"{flag!r} maps to"
+            )
+        for rel, src in doc_srcs.items():
+            if flag not in src:
+                problems.append(f"{flag!r} undocumented in {rel}")
+    for owner_name, kwarg in ENGINE_ONLY_KWARGS:
+        params = inspect.signature(_owner(owner_name).__init__).parameters
+        if kwarg not in params:
+            problems.append(
+                f"{owner_name}.__init__ lacks the {kwarg!r} kwarg"
+            )
+        for rel, src in doc_srcs.items():
+            if kwarg not in src:
+                problems.append(f"{kwarg!r} undocumented in {rel}")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("pipelining-flag drift detected:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print(
+        f"ok: {len(FLAGS)} pipelining flags consistent across CLI, "
+        "engine kwargs, and docs"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

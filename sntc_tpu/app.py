@@ -279,13 +279,23 @@ def cmd_serve(args) -> int:
     # the first IOError would kill the process and the supervision
     # layer below would never see a second chance
     retries = max(1, args.batch_retry_attempts)
+    # pipelined serving (docs/PERFORMANCE.md): depth > 1 arms the
+    # overlapped retire stage (sink delivery on its own thread) and the
+    # source's background prefetch; --shape-buckets pads micro-batches
+    # to power-of-two row buckets so predict compiles once per bucket
+    pipelined = args.pipeline_depth > 1
     q = StreamingQuery(
         model,
-        FileStreamSource(args.watch),
+        FileStreamSource(
+            args.watch,
+            prefetch_batches=(args.prefetch_batches if pipelined else 0),
+        ),
         CsvDirSink(args.out, columns=out_cols),
         args.checkpoint,
         max_batch_offsets=args.max_files_per_batch,
         pipeline_depth=args.pipeline_depth,
+        shape_buckets=args.shape_buckets,
+        overlap_sink=pipelined,
         breakers=default_breakers(),
         retry_policy=(
             RetryPolicy(max_attempts=retries, base_delay_s=0.2, jitter=0.1)
@@ -389,7 +399,18 @@ def main(argv=None) -> int:
                    help="outputCol of the LABEL StringIndexer to strip "
                    "(feature-column indexers are kept)")
     p.add_argument("--max-files-per-batch", type=int, default=None)
-    p.add_argument("--pipeline-depth", type=int, default=2)
+    p.add_argument("--pipeline-depth", type=int, default=2,
+                   help="in-flight micro-batches; > 1 also arms the "
+                   "pipelined engine (overlapped sink delivery + source "
+                   "prefetch); 1 = fully serial")
+    p.add_argument("--shape-buckets", type=int, default=0,
+                   help="pad micro-batches up to power-of-two row "
+                   "buckets with this floor so the jitted predict "
+                   "compiles once per bucket, not once per batch "
+                   "shape; 0 = off")
+    p.add_argument("--prefetch-batches", type=int, default=2,
+                   help="background source reads staged ahead of the "
+                   "engine (pipelined mode only); 0 = off")
     p.add_argument("--poll-interval", type=float, default=1.0)
     p.add_argument("--once", action="store_true",
                    help="drain available files and exit")

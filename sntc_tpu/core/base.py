@@ -75,6 +75,17 @@ class Transformer(PipelineStage):
         compute and transfer — the serving micro-batch pipeline ([B:11];
         JAX dispatch is asynchronous, only materialization blocks).  The
         default runs synchronously and is always correct.
+
+        Thread contract (the pipelined engine relies on it): ``finalize``
+        may be invoked from a DIFFERENT thread than the dispatching one —
+        the overlapped retire stage materializes batch N on its delivery
+        thread while the engine thread dispatches batch N+1 — and may be
+        invoked MORE THAN ONCE (the engine's sink retry path re-invokes
+        it per delivery attempt; the serving ``BatchPredictor`` memoizes,
+        so engine deliveries materialize once, but a bare override must
+        still tolerate re-invocation — re-materializing a jax.Array is
+        fine).  Overrides must close over immutable per-call state only;
+        mutating shared transformer state inside finalize is a data race.
         """
         out = self.transform(frame)
         return lambda: out

@@ -179,6 +179,31 @@ class Frame:
             {k: a[start:stop] for k, a in self._columns.items()}, n
         )
 
+    def pad_rows(self, n_rows: int) -> "Frame":
+        """Pad to ``n_rows`` by repeating the LAST row (shape-bucketed
+        serving: micro-batches pad up to a power-of-two row bucket so the
+        jitted predict compiles once per bucket, not once per batch
+        shape).  The pad rows are copies of real data, so every row-wise
+        stage stays numerically in-domain; callers track validity (the
+        serving path threads a row-validity mask) and drop the tail after
+        finalize."""
+        if n_rows < self._num_rows:
+            raise ValueError(
+                f"pad_rows target {n_rows} < current {self._num_rows} rows"
+            )
+        if n_rows == self._num_rows:
+            return self  # immutable — safe to share
+        if self._num_rows == 0:
+            raise ValueError("cannot pad an empty frame (no row to repeat)")
+        pad = n_rows - self._num_rows
+        cols: Dict[str, np.ndarray] = {}
+        for name, a in self._columns.items():
+            if not isinstance(a, np.ndarray):
+                a = np.asarray(a)  # materialize device-resident columns
+            tail = np.broadcast_to(a[-1:], (pad,) + a.shape[1:])
+            cols[name] = np.concatenate([a, tail])
+        return Frame._wrap(cols, int(n_rows))
+
     def concat(self, other: "Frame") -> "Frame":
         return Frame.concat_all([self, other])
 

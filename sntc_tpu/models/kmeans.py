@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sntc_tpu.parallel.compat import shard_map
 from sntc_tpu.core.base import Estimator, Model
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
@@ -103,7 +104,7 @@ def _lloyd_sharded(mesh, k, max_iter, cosine):
         )
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             run, mesh=mesh,
             in_specs=(P(axis), P(axis), P(), P()),
             out_specs=(P(), P(), P(), P()),

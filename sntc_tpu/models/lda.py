@@ -40,6 +40,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 from scipy.special import gammaln, psi
 
+from sntc_tpu.parallel.compat import shard_map
 from sntc_tpu.core.base import Estimator, Model
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
@@ -114,7 +115,7 @@ def _e_step_sharded(mesh, max_iters):
         return gamma, stat * exp_elog_beta
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local, mesh=mesh,
             in_specs=(P(axis), P(axis), P(), P(), P()),
             out_specs=(P(axis), P()),

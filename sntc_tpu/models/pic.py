@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from sntc_tpu.parallel.compat import shard_map
 from sntc_tpu.core.base import Params
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
@@ -80,7 +81,7 @@ def _power_iterate_sharded(mesh, n, max_iter):
         return v, it
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local, mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
             out_specs=(P(), P()),

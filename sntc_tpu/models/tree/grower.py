@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sntc_tpu.parallel.compat import shard_map
+
 
 class Forest(NamedTuple):
     """Dense-heap forest. H = 2^(max_depth+1) - 1 slots per tree.
@@ -519,7 +521,7 @@ def _group_hist(
                 )  # [T, F, nodes*B, S]
             return jax.lax.psum(hs, axis)
 
-        hists = jax.shard_map(
+        hists = shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(P(None, axis), rs_spec, P(None, axis), P(None, axis)),

@@ -26,6 +26,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sntc_tpu.parallel.compat import shard_map
 from sntc_tpu.parallel.mesh import DATA_AXIS
 from sntc_tpu.resilience import (
     CircuitOpenError,
@@ -271,7 +272,7 @@ def make_tree_aggregate(
                 lambda t: jax.lax.psum(t, axis_name), partials
             )
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh, in_specs=in_specs, out_specs=P(),
             check_vma=check_vma,  # False for fns with pallas_call inside
         )(*arrays)

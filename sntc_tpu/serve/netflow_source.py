@@ -24,7 +24,13 @@ from sntc_tpu.serve.streaming import DirStreamSource
 
 class _CaptureDirSource(DirStreamSource):
     """Capture-file directory source: one decoded Frame per file.
-    Subclasses implement ``_decode_file(bytes) -> Frame``."""
+    Subclasses implement ``_decode_file(bytes) -> Frame``.
+
+    Inherits the full :class:`DirStreamSource` pipeline surface —
+    per-tick listing cache, parallel per-file decodes
+    (``read_workers``), and background staging (``prefetch_batches``)
+    for the pipelined engine; decode is CPU-bound Python for pcap, so
+    prefetch (one staging thread) is the lever that matters there."""
 
     def _decode_file(self, data: bytes) -> Frame:
         raise NotImplementedError
@@ -37,8 +43,8 @@ class _CaptureDirSource(DirStreamSource):
 class NetFlowDirSource(_CaptureDirSource):
     """Directory of NetFlow v5 capture files (``*.nf5``)."""
 
-    def __init__(self, path: str, pattern: str = "*.nf5"):
-        super().__init__(path, pattern)
+    def __init__(self, path: str, pattern: str = "*.nf5", **kwargs):
+        super().__init__(path, pattern, **kwargs)
 
     def _decode_file(self, data: bytes) -> Frame:
         return netflow_to_flow_frame(parse_stream(data))
@@ -103,8 +109,9 @@ class PcapDirSource(_CaptureDirSource):
         pattern: str = "*.pcap",
         flow_timeout: float = 120.0,
         activity_timeout: float = 5.0,
+        **kwargs,
     ):
-        super().__init__(path, pattern)
+        super().__init__(path, pattern, **kwargs)
         self.flow_timeout = flow_timeout
         self.activity_timeout = activity_timeout
 

@@ -53,14 +53,57 @@ class StreamSource:
 class DirStreamSource(StreamSource):
     """Shared machinery for directory-watching sources: offset = count of
     files in sorted order (the ``readStream`` file-source model: new files
-    are new data).  Subclasses implement ``_load_file(path) -> Frame``."""
+    are new data).  Subclasses implement ``_load_file(path) -> Frame``.
 
-    def __init__(self, path: str, pattern: str):
+    **One listing per poll tick**: ``latest_offset()`` globs+sorts once
+    and caches the listing; the tick's ``get_batch`` reuses it whenever
+    it covers the requested range (files are append-only in the offset
+    model, so a listing that covers ``end`` is authoritative for it).
+
+    **Parallel per-file reads**: multi-file batches fan the
+    ``_load_file`` calls across a small thread pool (pyarrow's CSV/IPC
+    readers release the GIL); concatenation order is by sorted filename,
+    exactly as the serial path produced.
+
+    **Prefetch** (``prefetch_batches=N``): :meth:`prefetch` stages a
+    bounded background read of a future ``[start, end)`` range so the
+    pipelined engine's next ``get_batch`` returns an already-parsed
+    Frame.  Purely advisory — a range with no staged read falls through
+    to the synchronous path, and a staged read that failed re-raises in
+    ``get_batch`` where the engine's retry/fault machinery already
+    wraps it.  ``N <= 0`` disables staging entirely (no threads).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        pattern: str,
+        prefetch_batches: int = 0,
+        read_workers: int = 4,
+    ):
         self.path = path
         self.pattern = pattern
+        self.prefetch_batches = int(prefetch_batches)
+        self.read_workers = max(1, int(read_workers))
+        self._listing: Optional[List[str]] = None
+        self._read_pool = None
+        self._prefetch_pool = None
+        import threading
+
+        # _pool() is reached from the engine thread (sync get_batch
+        # miss) AND from prefetch threads (staged _read_range) — the
+        # lazy create must not race two executors into existence
+        self._pool_lock = threading.Lock()
+        self._staged: dict = {}  # (start, end) -> Future[Frame]
+        self.prefetch_hits = 0
+        self.prefetch_misses = 0
+        self.prefetch_hwm = 0  # staged-queue high-water mark
 
     def _files(self) -> List[str]:
-        return sorted(glob.glob(os.path.join(self.path, self.pattern)))
+        self._listing = sorted(
+            glob.glob(os.path.join(self.path, self.pattern))
+        )
+        return self._listing
 
     def latest_offset(self) -> int:
         return len(self._files())
@@ -68,20 +111,112 @@ class DirStreamSource(StreamSource):
     def _load_file(self, path: str) -> Frame:
         raise NotImplementedError
 
-    def get_batch(self, start: int, end: int) -> Frame:
-        files = self._files()[start:end]
-        if not files:
-            raise ValueError(f"empty batch range [{start}, {end})")
+    def _pool(self):
+        with self._pool_lock:
+            if self._read_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._read_pool = ThreadPoolExecutor(
+                    max_workers=self.read_workers,
+                    thread_name_prefix="sntc-src-read",
+                )
+            return self._read_pool
+
+    def _read_files(self, files: List[str]) -> Frame:
         if len(files) == 1:  # common micro-batch case: skip the concat copy
             return self._load_file(files[0])
-        return Frame.concat_all([self._load_file(p) for p in files])
+        return Frame.concat_all(list(self._pool().map(self._load_file, files)))
+
+    def _read_range(
+        self, start: int, end: int, listing: Optional[List[str]]
+    ) -> Frame:
+        # a listing that does not cover ``end`` is re-scanned LOCALLY —
+        # the prefetch thread must never mutate the cached listing under
+        # the engine thread's feet
+        if listing is None or len(listing) < end:
+            listing = sorted(
+                glob.glob(os.path.join(self.path, self.pattern))
+            )
+        files = listing[start:end]
+        if not files:
+            raise ValueError(f"empty batch range [{start}, {end})")
+        return self._read_files(files)
+
+    def prefetch(
+        self, start: int, end: int, cursor: Optional[int] = None
+    ) -> bool:
+        """Stage a background read of ``[start, end)`` (bounded by
+        ``prefetch_batches`` outstanding ranges, which is also the
+        staging pool width — ranges parse CONCURRENTLY; pyarrow's reader
+        releases the GIL); returns True when a read was scheduled.
+        Staged ranges wholly behind ``cursor`` (the engine's planning
+        cursor; default ``start``) are stale — a load shed skipped them
+        — and are evicted first."""
+        if self.prefetch_batches <= 0 or end <= start:
+            return False
+        horizon = start if cursor is None else cursor
+        for key in [k for k in self._staged if k[1] <= horizon]:
+            self._staged.pop(key).cancel()
+        if (start, end) in self._staged:
+            return False
+        if len(self._staged) >= self.prefetch_batches:
+            return False
+        if self._prefetch_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._prefetch_pool = ThreadPoolExecutor(
+                max_workers=max(1, min(self.prefetch_batches, 4)),
+                thread_name_prefix="sntc-src-prefetch",
+            )
+        listing = (
+            list(self._listing)
+            if self._listing is not None and len(self._listing) >= end
+            else None
+        )
+        self._staged[(start, end)] = self._prefetch_pool.submit(
+            self._read_range, start, end, listing
+        )
+        self.prefetch_hwm = max(self.prefetch_hwm, len(self._staged))
+        return True
+
+    def prefetch_stats(self) -> dict:
+        return {
+            "hits": self.prefetch_hits,
+            "misses": self.prefetch_misses,
+            "hwm": self.prefetch_hwm,
+            "staged": len(self._staged),
+        }
+
+    def close(self) -> None:
+        """Shut down the reader pools (idempotent; a closed source can
+        still serve synchronous reads)."""
+        self._staged.clear()
+        for pool in (self._read_pool, self._prefetch_pool):
+            if pool is not None:
+                pool.shutdown(wait=True)
+        self._read_pool = self._prefetch_pool = None
+
+    def get_batch(self, start: int, end: int) -> Frame:
+        fut = self._staged.pop((start, end), None)
+        if fut is not None:
+            self.prefetch_hits += 1
+            # a failed staged read re-raises HERE, inside the engine's
+            # stream.read retry/fault scope; the entry was consumed, so
+            # a retry falls through to a fresh synchronous read
+            return fut.result()
+        if self.prefetch_batches > 0:
+            self.prefetch_misses += 1
+        listing = self._listing
+        if listing is not None and len(listing) < end:
+            listing = None  # stale: _read_range re-scans exactly once
+        return self._read_range(start, end, listing)
 
 
 class FileStreamSource(DirStreamSource):
     """Directory of flow CSVs."""
 
-    def __init__(self, path: str, pattern: str = "*.csv"):
-        super().__init__(path, pattern)
+    def __init__(self, path: str, pattern: str = "*.csv", **kwargs):
+        super().__init__(path, pattern, **kwargs)
 
     def _load_file(self, path: str) -> Frame:
         return load_csv(path)
@@ -128,11 +263,28 @@ class MemorySink(StreamSink):
 
 
 class CsvDirSink(StreamSink):
-    """One CSV per batch (append output mode)."""
+    """One CSV per batch (append output mode).
 
-    def __init__(self, path: str, columns: Optional[List[str]] = None):
+    ``durable=True`` (the default) fsyncs the temp file before the
+    rename publishes it: rename-without-fsync can expose an EMPTY or
+    truncated ``batch_*.csv`` after a power loss even though the rename
+    itself was atomic (the classic publish-before-data-reaches-disk
+    bug; the process-kill chaos matrix can never catch it because the
+    page cache survives a kill).  The fsync is real I/O latency on the
+    retire stage — which the pipelined engine's delivery thread hides
+    behind the next batch's read, while a serial engine stalls on it.
+    ``durable=False`` restores the page-cache-speed publish for
+    throwaway sinks (tests, dead-letter dumps)."""
+
+    def __init__(
+        self,
+        path: str,
+        columns: Optional[List[str]] = None,
+        durable: bool = True,
+    ):
         self.path = path
         self.columns = columns
+        self.durable = bool(durable)
         os.makedirs(path, exist_ok=True)
 
     def add_batch(self, batch_id: int, frame: Frame) -> None:
@@ -146,7 +298,22 @@ class CsvDirSink(StreamSink):
         final = os.path.join(self.path, f"batch_{batch_id:06d}.csv")
         tmp = final + ".tmp"
         pacsv.write_csv(frame.select(cols).to_arrow(), tmp)
+        if self.durable:
+            fd = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
         os.replace(tmp, final)
+        if self.durable:
+            # the rename is only durable once the DIRECTORY entry is on
+            # disk — without this, power loss after commit can lose the
+            # published file entirely (data fsynced, dirent not)
+            dfd = os.open(self.path, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
 
 
 class ConsoleSink(StreamSink):
@@ -188,6 +355,23 @@ class StreamingQuery:
     :class:`~sntc_tpu.resilience.supervisor.QuerySupervisor` layers
     admission control (load shedding), a batch watchdog, and
     preemption-safe drain on top of this engine.
+
+    **Pipelined mode (opt-in):** ``overlap_sink=True`` moves the retire
+    stage (finalize + ``sink.add_batch``, with its retry cycle) onto a
+    dedicated delivery thread so batch N's sink write overlaps batch
+    N+1's source read and predict dispatch; ``shape_buckets=N`` pads
+    micro-batches up to power-of-two row buckets (floor N) so the jitted
+    predict compiles once per bucket (see
+    :class:`~sntc_tpu.serve.transform.BatchPredictor`); a source with a
+    ``prefetch`` method (``DirStreamSource(prefetch_batches=...)``) is
+    additionally hinted each round to stage the NEXT batch's read in the
+    background.  The protocol order is UNCHANGED: WAL intent → read →
+    dispatch → sink → commit, commits stay on the engine thread in batch
+    order, at most ONE delivery is in the air, and the head batch leaves
+    ``_in_flight`` only after its commit lands — so breaker, quarantine,
+    drain, and crash-replay semantics are exactly the serial engine's
+    (the chaos matrix runs unchanged in pipelined mode).  See
+    ``docs/PERFORMANCE.md``.
     """
 
     _PROGRESS_KEEP = 100  # Spark keeps the last 100 progress records
@@ -200,13 +384,23 @@ class StreamingQuery:
         checkpoint_dir: str,
         max_batch_offsets: Optional[int] = None,
         pipeline_depth: int = 2,
+        shape_buckets: int = 0,
+        overlap_sink: bool = False,
         wal_mode: str = "files",
         retry_policy: Optional[RetryPolicy] = None,
         max_batch_failures: Optional[int] = None,
         dead_letter_dir: Optional[str] = None,
         breakers: Optional[dict] = None,
     ):
-        self.predictor = BatchPredictor(model)
+        # a pre-built BatchPredictor passes through unchanged (its own
+        # bucket config wins — bench warmup shares one predictor across
+        # the warmup and measured queries so compile_events is one ledger)
+        self.predictor = (
+            model
+            if isinstance(model, BatchPredictor)
+            else BatchPredictor(model, bucket_rows=shape_buckets)
+        )
+        self.shape_buckets = int(self.predictor.bucket_rows)
         self.source = source
         self.sink = sink
         self.checkpoint_dir = checkpoint_dir
@@ -220,6 +414,16 @@ class StreamingQuery:
         # restarted query replays exactly as Spark does.  Depth 1 disables
         # overlap.
         self.pipeline_depth = max(1, int(pipeline_depth))
+        # overlap mode: the retire stage runs on ONE dedicated delivery
+        # thread; the engine thread keeps planning/reading/dispatching
+        # while it runs and settles the outcome (commit / defer /
+        # quarantine) back on the engine thread — single WAL writer
+        self.overlap_sink = bool(overlap_sink)
+        self._delivery = None  # (batch_id, Future) while one is in the air
+        self._delivery_pool = None
+        self._delivery_busy_s = 0.0  # wall time the retire stage ran
+        self._delivered_batches = 0
+        self._tick_latest: Optional[int] = None
         self.retry_policy = retry_policy
         if max_batch_failures is not None and max_batch_failures < 1:
             raise ValueError("max_batch_failures must be >= 1 (or None)")
@@ -351,6 +555,17 @@ class StreamingQuery:
 
     # -- engine ------------------------------------------------------------
 
+    def _plan_end(self, start: int, latest: int) -> int:
+        """THE batch-range rule: how far past ``start`` one micro-batch
+        may reach given ``latest`` available offsets.  Single source of
+        truth shared by the intent planner and both prefetch-hint sites
+        — a hint computed by any other rule would never hit the range
+        the planner actually dispatches."""
+        end = latest
+        if self.max_batch_offsets is not None:
+            end = min(end, start + self.max_batch_offsets)
+        return end
+
     def _dispatch_next(self) -> bool:
         """WAL + read + dispatch the next micro-batch (non-blocking);
         returns False if no new data."""
@@ -359,11 +574,10 @@ class StreamingQuery:
         if intent is None:
             start = self._next_start
             latest = self.source.latest_offset()
+            self._tick_latest = latest  # reused by the prefetch hint
             if latest <= start:
                 return False
-            end = latest
-            if self.max_batch_offsets is not None:
-                end = min(end, start + self.max_batch_offsets)
+            end = self._plan_end(start, latest)
             intent = {"batch_id": batch_id, "start": start, "end": end}
             if self._sample_next is not None:
                 # sample-shed recovery batch: cover the WHOLE backlog in
@@ -377,6 +591,17 @@ class StreamingQuery:
             fault_point("stream.wal")
             # intent WAL before any processing (OffsetSeqLog)
             self._wal_intent(batch_id, intent)
+
+        # stage the FOLLOWING range before this batch's read blocks: the
+        # prefetch thread parses batch N+1 while this round waits on
+        # batch N's (staged) read — back-to-back reads, no round-trip
+        # stall (no-op for sources without prefetch)
+        pf = getattr(self.source, "prefetch", None)
+        if pf is not None and self._tick_latest is not None:
+            nxt = intent["end"]
+            if self._tick_latest > nxt:
+                pf(nxt, self._plan_end(nxt, self._tick_latest),
+                   self._next_start)
 
         t0 = time.perf_counter()
 
@@ -452,47 +677,48 @@ class StreamingQuery:
         for key in [k for k in self._batch_failures if k[0] == batch_id]:
             del self._batch_failures[key]
 
-    def _retire_oldest(self) -> bool:
-        """Materialize the oldest in-flight batch, sink it, commit.
-
-        The entry leaves ``_in_flight`` only AFTER its commit file is
-        written: if the sink raises, the batch stays queued and the next
-        ``process_available`` retries it from its WAL'd intent — popping
-        first would silently skip the batch and shift every later
-        ``batch_id`` (exactly-once violation).
-
-        With ``max_batch_failures=N`` armed, failed rounds below the
-        threshold DEFER (the batch stays queued, the engine loop stays
-        alive — under ``run()``/``start()`` each poll tick is one retry
-        round) and the N-th failed round quarantines the batch
-        (dead-letter journal + commit) so the query continues.  Returns
-        True when a batch was committed."""
-        batch_id, intent, finalize, t0, n_rows, frame = self._in_flight[0]
-
-        def _deliver() -> None:
-            fault_point("sink.write")
-            self.sink.add_batch(batch_id, finalize())
-
-        breaker = self.breakers.get("sink.write")
-        if breaker is not None and not breaker.allow():
-            return False  # breaker open: batch stays queued, loop alive
-        quarantined = False
+    def _deliver_head(self, batch_id: int, finalize) -> None:
+        """The retire stage's WORK: materialize the batch (finalize) and
+        hand it to the sink, under the retry policy.  Runs on the engine
+        thread serially, or on the delivery thread in overlap mode; the
+        outcome is settled by :meth:`_settle_head` on the engine thread
+        either way."""
+        t0 = time.perf_counter()
         try:
+
+            def _deliver() -> None:
+                fault_point("sink.write")
+                self.sink.add_batch(batch_id, finalize())
+
             if self.retry_policy is not None:
                 with_retries(_deliver, self.retry_policy, site="sink.write")
             else:
                 _deliver()
-        except Exception as e:
+        finally:
+            self._delivery_busy_s += time.perf_counter() - t0
+
+    def _settle_head(self, exc: Optional[BaseException]) -> bool:
+        """Outcome bookkeeping for ONE retirement round of the head
+        batch (``exc`` is the delivery failure, or None on success):
+        breaker outcome, failure-round accounting, quarantine at the
+        threshold, commit.  The entry leaves ``_in_flight`` only AFTER
+        its commit file is written — a failed round leaves it queued, so
+        batch ids never shift (exactly-once).  Returns True when the
+        batch committed (normally or quarantined)."""
+        batch_id, intent, finalize, t0, n_rows, frame = self._in_flight[0]
+        breaker = self.breakers.get("sink.write")
+        quarantined = False
+        if exc is not None:
             # one breaker outcome per retirement ROUND (a failure that
             # survived the whole retry cycle is real trouble)
             if breaker is not None:
                 breaker.record_failure()
             fails = self._bump_failures(batch_id, "sink.write")
             if self.max_batch_failures is None:
-                raise  # quarantine unarmed: r5 single-shot semantics
+                raise exc  # quarantine unarmed: r5 single-shot semantics
             if fails < self.max_batch_failures:
                 return False  # stays queued; retried next round
-            self._quarantine(batch_id, intent, frame, e,
+            self._quarantine(batch_id, intent, frame, exc,
                              site="sink.write")
             quarantined = True
         else:
@@ -501,7 +727,130 @@ class StreamingQuery:
         self._in_flight.pop(0)
         self._commit_batch(batch_id, intent, n_rows=n_rows, t0=t0,
                            quarantined=quarantined)
+        self._delivered_batches += 1
         return True
+
+    def _retire_oldest(self) -> bool:
+        """Serial retire: materialize the oldest in-flight batch, sink
+        it, commit — one retirement round on the engine thread.
+
+        With ``max_batch_failures=N`` armed, failed rounds below the
+        threshold DEFER (the batch stays queued, the engine loop stays
+        alive — under ``run()``/``start()`` each poll tick is one retry
+        round) and the N-th failed round quarantines the batch
+        (dead-letter journal + commit) so the query continues.  Returns
+        True when a batch was committed."""
+        batch_id, _intent, finalize, _t0, _n_rows, _frame = self._in_flight[0]
+        breaker = self.breakers.get("sink.write")
+        if breaker is not None and not breaker.allow():
+            return False  # breaker open: batch stays queued, loop alive
+        exc: Optional[BaseException] = None
+        try:
+            self._deliver_head(batch_id, finalize)
+        except Exception as e:
+            exc = e
+        return self._settle_head(exc)
+
+    # -- overlapped retire (pipelined mode) ---------------------------------
+
+    def _submit_delivery(self) -> bool:
+        """Arm the delivery thread with the head batch's retire work.
+        The sink breaker's ``allow()`` is consumed here (one reservation
+        per round, outcome recorded at settle); an OPEN breaker defers
+        exactly as in the serial path."""
+        batch_id, _intent, finalize, _t0, _n_rows, _frame = self._in_flight[0]
+        breaker = self.breakers.get("sink.write")
+        if breaker is not None and not breaker.allow():
+            return False
+        if self._delivery_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._delivery_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="sntc-sink-delivery"
+            )
+        self._delivery = (
+            batch_id,
+            self._delivery_pool.submit(self._deliver_head, batch_id,
+                                       finalize),
+        )
+        return True
+
+    def _finish_delivery(self, wait: bool) -> bool:
+        """Settle the in-air delivery (joining it when ``wait``);
+        returns True when its batch committed.  Settlement — commit,
+        deferral bookkeeping, or quarantine — runs on the engine thread,
+        so the WAL keeps its single writer."""
+        if self._delivery is None:
+            return False
+        batch_id, fut = self._delivery
+        if not wait and not fut.done():
+            return False
+        exc = fut.exception()  # joins the delivery when wait=True
+        self._delivery = None
+        if not self._in_flight or self._in_flight[0][0] != batch_id:
+            raise RuntimeError(
+                f"delivery settled for batch {batch_id} but the queue "
+                "head moved — pipeline invariant violated"
+            )
+        return self._settle_head(exc)
+
+    def _pump_delivery(self) -> None:
+        """One overlap-mode pump: settle a completed delivery, then
+        (re)arm the delivery thread with the current head so the next
+        retire runs while the engine thread plans/reads/dispatches."""
+        self._finish_delivery(wait=False)
+        if self._delivery is None and self._in_flight:
+            self._submit_delivery()
+
+    def _maybe_prefetch(self) -> None:
+        """Hint the source to stage the UPCOMING batches' reads in the
+        background (no-op for sources without ``prefetch``).  Up to the
+        source's staging capacity, ranges are hinted in dispatch order:
+        replayed WAL intents use their logged ranges, then the planned
+        ranges from this tick's offset read — exactly the ranges
+        ``_dispatch_next`` will request, so the staged Frames are hits.
+        Purely advisory; a hint the planner diverges from just misses."""
+        pf = getattr(self.source, "prefetch", None)
+        if pf is None:
+            return
+        cursor = self._next_start
+        capacity = max(1, int(getattr(self.source, "prefetch_batches", 1)))
+        bid = self.last_committed() + 1 + len(self._in_flight)
+        start = self._next_start
+        for _ in range(capacity):
+            intent = self._pending_intent(bid)
+            if intent is not None:
+                pf(intent["start"], intent["end"], cursor)
+                start = max(start, intent["end"])
+                bid += 1
+                continue
+            latest = self._tick_latest
+            if latest is None or latest <= start:
+                break
+            end = self._plan_end(start, latest)
+            pf(start, end, cursor)
+            start = end
+            bid += 1
+
+    def pipeline_stats(self) -> dict:
+        """Pipelining evidence (the bench journal's ``pipeline`` field):
+        overlap/bucket config, delivery-thread busy time, predict-shape
+        compile counters, and the source's prefetch stats when it has
+        any."""
+        stats = {
+            "overlap_sink": self.overlap_sink,
+            "pipeline_depth": self.pipeline_depth,
+            "shape_buckets": self.shape_buckets,
+            "delivery_busy_s": round(self._delivery_busy_s, 6),
+            "delivered_batches": self._delivered_batches,
+            "compile_events": self.predictor.compile_events,
+            "bucket_hits": self.predictor.bucket_hits,
+            "padded_rows_total": self.predictor.padded_rows_total,
+        }
+        src_stats = getattr(self.source, "prefetch_stats", None)
+        if src_stats is not None:
+            stats["prefetch"] = src_stats()
+        return stats
 
     def _commit_batch(self, batch_id: int, intent: dict, *, n_rows: int,
                       t0: float, quarantined: bool) -> None:
@@ -554,8 +903,12 @@ class StreamingQuery:
         }
         if frame is not None:
             try:
-                # reuse the atomic CSV sink for the raw-rows dump
-                CsvDirSink(self.dead_letter_dir).add_batch(batch_id, frame)
+                # reuse the atomic CSV sink for the raw-rows dump —
+                # best-effort evidence, page-cache speed (durable=False),
+                # like the dead_letter.jsonl record beside it
+                CsvDirSink(
+                    self.dead_letter_dir, durable=False
+                ).add_batch(batch_id, frame)
                 record["rows_file"] = f"batch_{batch_id:06d}.csv"
             except Exception as dump_err:
                 record["dump_error"] = repr(dump_err)
@@ -572,12 +925,33 @@ class StreamingQuery:
         """Advance the pipeline by one committed batch; returns False when
         no batch was committed (and nothing could be dispatched).  A
         read-poison batch quarantined inside the dispatch loop counts as
-        progress too (it commits without ever entering the pipeline)."""
+        progress too (it commits without ever entering the pipeline).
+
+        Overlap mode pumps the delivery thread BEFORE the dispatch loop
+        (so the head batch's finalize+sink runs while this round reads
+        and dispatches the next batches) and again after it (a delivery
+        that finished during the dispatch window commits now)."""
         before = self._last_committed
+        if self.overlap_sink:
+            self._pump_delivery()
+            if self._tick_latest is None:
+                # first round: one listing up front so the initial
+                # dispatches hit staged reads instead of parsing cold
+                self._tick_latest = self.source.latest_offset()
+            self._maybe_prefetch()
         while len(self._in_flight) < self.pipeline_depth:
             if not self._dispatch_next():
                 break
-        if self._in_flight:
+            if self.overlap_sink:
+                # re-arm between dispatches: a delivery that finished
+                # while this round blocked on a read settles now and the
+                # next dispatched batch goes straight onto the delivery
+                # thread instead of idling until the round ends
+                self._pump_delivery()
+        self._maybe_prefetch()
+        if self.overlap_sink:
+            self._pump_delivery()
+        elif self._in_flight:
             self._retire_oldest()
         return self._last_committed != before
 
@@ -585,10 +959,22 @@ class StreamingQuery:
         """Deterministically drain all currently-available data; returns the
         number of batches COMMITTED (test/step API) — counted by commit
         delta, so a read-quarantined batch that commits inside the
-        dispatch loop is included."""
+        dispatch loop is included.  In overlap mode a round with nothing
+        left to dispatch JOINS the in-air delivery instead of returning
+        with it unsettled — the drained guarantee is identical to the
+        serial engine's."""
         start = self._last_committed
-        while not self._stopped and self._run_one_batch():
-            pass
+        while not self._stopped:
+            if self._run_one_batch():
+                continue
+            if self.overlap_sink and self._delivery is not None:
+                # idle except for the in-air delivery: join and settle it
+                # (commit, deferral bookkeeping, or quarantine), then
+                # loop — a deferred round re-arms and eventually either
+                # commits, quarantines, or trips the breaker open
+                self._finish_delivery(wait=True)
+                continue
+            break
         return self._last_committed - start
 
     # -- supervision hooks (QuerySupervisor surface) ------------------------
@@ -707,7 +1093,14 @@ class StreamingQuery:
             len(self._in_flight) + 1
         )
         while self._in_flight and stalled_rounds < max_stalled:
-            if self._retire_oldest():
+            if self.overlap_sink:
+                if self._delivery is None and not self._submit_delivery():
+                    stalled_rounds += 1  # breaker open: defer
+                    continue
+                committed = self._finish_delivery(wait=True)
+            else:
+                committed = self._retire_oldest()
+            if committed:
                 stalled_rounds = 0
             else:
                 stalled_rounds += 1
@@ -726,6 +1119,14 @@ class StreamingQuery:
         while not self._stopped:
             before = self._last_committed
             self._run_one_batch()
+            if (
+                self.overlap_sink
+                and self._delivery is not None
+                and self._last_committed == before
+            ):
+                # idle except for the in-air delivery: join it rather
+                # than sleeping past its completion
+                self._finish_delivery(wait=True)
             delta = self._last_committed - before
             if delta:
                 done += delta
@@ -799,3 +1200,10 @@ class StreamingQuery:
             if self.wal_mode == "append":
                 self._offsets_log.close()
                 self._commits_log.close()
+            if self._delivery_pool is not None:
+                # a still-running delivery finishes (its settle never
+                # happens: the batch stays uncommitted in the WAL and a
+                # restarted query replays it — the crash contract)
+                self._delivery_pool.shutdown(wait=True)
+                self._delivery_pool = None
+                self._delivery = None

@@ -70,3 +70,16 @@ def test_parquet_cache_roundtrip(tmp_path):
     g = load_parquet(path)
     assert g.num_rows == f.num_rows
     np.testing.assert_allclose(g["Flow Duration"], f["Flow Duration"])
+
+
+def test_load_csv_dir_parallel_preserves_order(tmp_path):
+    """r8 satellite: the threaded day-file reader must concatenate rows
+    in sorted-filename order, identical to a serial read."""
+    d = str(tmp_path / "days")
+    write_day_csvs(d, n_rows_per_day=200, n_days=6, seed=9)
+    parallel = load_csv_dir(d)
+    serial = load_csv_dir(d, max_workers=1)
+    assert parallel.num_rows == serial.num_rows == 1200
+    assert parallel.columns == serial.columns
+    for col in parallel.columns:
+        np.testing.assert_array_equal(parallel[col], serial[col])

@@ -112,3 +112,23 @@ def test_derived_frames_keep_row_counts():
     assert f.select(["a"]).num_rows == 7
     assert f.drop("a").columns == []
     assert Frame.concat_all([f]) is f
+
+
+def test_pad_rows_repeats_last_row_and_validates():
+    import pytest
+
+    f = Frame({
+        "a": np.arange(3, dtype=np.float32),
+        "v": np.arange(6, dtype=np.float32).reshape(3, 2),
+        "s": np.array(["x", "y", "z"], dtype=object),
+    })
+    p = f.pad_rows(5)
+    assert p.num_rows == 5
+    np.testing.assert_array_equal(p["a"], [0, 1, 2, 2, 2])
+    np.testing.assert_array_equal(p["v"][3:], [[4, 5], [4, 5]])
+    assert list(p["s"]) == ["x", "y", "z", "z", "z"]
+    assert f.pad_rows(3) is f  # no-op shares the immutable frame
+    with pytest.raises(ValueError):
+        f.pad_rows(2)
+    with pytest.raises(ValueError):
+        Frame({}).pad_rows(4)

@@ -384,3 +384,370 @@ def test_await_termination_reraises_loop_crash(model, tmp_path):
     q.start(poll_interval=0.02)
     with pytest.raises(RuntimeError, match="sink boom"):
         q.awaitTermination(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# r8: shape-bucketed predict — padded+masked batches are bitwise-equal
+# to unpadded ones, and the compile ledger stays flat after warmup
+# ---------------------------------------------------------------------------
+
+
+def _family_models(mesh8):
+    """One fitted model per family the predictor serves (small fits)."""
+    from sntc_tpu.models import (
+        LinearSVC,
+        LogisticRegression,
+        MultilayerPerceptronClassifier,
+        NaiveBayes,
+        RandomForestClassifier,
+    )
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(240, 4)).astype(np.float32)
+    y3 = (np.abs(X[:, 0]) + X[:, 1] > 0.8).astype(np.float64) + (
+        X[:, 2] > 0.5
+    ).astype(np.float64)
+    train3 = Frame({"features": X, "label": y3})
+    ybin = (X[:, 0] > 0).astype(np.float64)
+    train2 = Frame({"features": X, "label": ybin})
+    # tiny fits: bucket correctness is about transform row-locality, not
+    # model quality — keep the tier-1 bill small
+    return {
+        "lr": LogisticRegression(mesh=mesh8, maxIter=8).fit(train2),
+        "mlp": MultilayerPerceptronClassifier(
+            mesh=mesh8, layers=[4, 8, 3], maxIter=8, seed=0
+        ).fit(train3),
+        "rf": RandomForestClassifier(
+            mesh=mesh8, numTrees=3, maxDepth=3, seed=0
+        ).fit(train3),
+        "nb": NaiveBayes(mesh=mesh8, modelType="gaussian").fit(train3),
+        "svc": LinearSVC(mesh=mesh8, maxIter=8).fit(train2),
+    }
+
+
+def test_bucketed_predict_bitwise_equal_across_families(mesh8):
+    """Satellite: padded+masked predictions == unpadded predictions for
+    every model family, and compile_events stays flat after the bucket
+    shapes are warm (varying batch sizes, same buckets)."""
+    sizes_warm = (50, 100)  # buckets 64 and 128
+    sizes_after = (49, 60, 63, 90, 127, 100)  # same two buckets
+    for name, m in _family_models(mesh8).items():
+        bp = BatchPredictor(m, bucket_rows=16)
+        for n in sizes_warm:
+            bp.predict_frame(_batch(n, n))
+        warm_events = bp.compile_events
+        assert warm_events == 2, (name, bp.compile_events)
+        for n in sizes_after:
+            f = _batch(n, n)
+            out = bp.predict_frame(f)
+            ref = m.transform(f)
+            assert out.num_rows == n, name
+            assert out.columns == ref.columns, name
+            np.testing.assert_array_equal(
+                out["prediction"], ref["prediction"], err_msg=name
+            )
+            if "probability" in ref:  # LinearSVC emits margins only
+                np.testing.assert_allclose(
+                    out["probability"], ref["probability"], rtol=1e-6,
+                    err_msg=name,
+                )
+        assert bp.compile_events == warm_events, name  # zero recompiles
+        assert bp.bucket_hits >= len(sizes_after), name
+        assert bp.padded_rows_total > 0, name
+
+
+def test_bucketed_predict_threads_mask_through_row_dropping_stage(mesh8):
+    """The row-validity mask survives a row-DROPPING stage: a pipeline
+    whose assembler skips invalid rows must yield exactly the surviving
+    real rows — tail-slicing would return the wrong rows here."""
+    from sntc_tpu.core.base import PipelineModel
+    from sntc_tpu.feature import VectorAssembler
+    from sntc_tpu.models import LogisticRegression
+
+    rng = np.random.default_rng(5)
+    cols = {f"c{i}": rng.normal(size=300).astype(np.float32)
+            for i in range(4)}
+    train = Frame(dict(cols))
+    train = train.with_column(
+        "label", (train["c0"] > 0).astype(np.float64)
+    )
+    asm = VectorAssembler(
+        inputCols=[f"c{i}" for i in range(4)], outputCol="features",
+        handleInvalid="skip",
+    )
+    lr = LogisticRegression(mesh=mesh8, maxIter=10).fit(
+        asm.transform(train)
+    )
+    pipe = PipelineModel(stages=[asm, lr])
+
+    bad = {f"c{i}": rng.normal(size=70).astype(np.float32)
+           for i in range(4)}
+    bad["c1"] = bad["c1"].copy()
+    bad["c1"][[3, 11, 42]] = np.nan  # 3 real rows get skipped
+    f = Frame(bad)
+    ref = pipe.transform(f)
+    assert ref.num_rows == 67
+    out = BatchPredictor(pipe, bucket_rows=64).predict_frame(f)
+    assert out.num_rows == 67
+    np.testing.assert_array_equal(out["prediction"], ref["prediction"])
+    np.testing.assert_array_equal(out["c0"], ref["c0"])
+
+
+def test_oversized_frame_chunked_async_dispatch(model):
+    """predict_frame_async over a frame larger than chunk_rows: all
+    chunks dispatch before finalize, one finalize concatenates, results
+    match the one-shot transform (bucketed tail chunk included)."""
+    f = _batch(1000, 9)
+    ref = model.transform(f)
+    for bucket in (0, 64):
+        bp = BatchPredictor(model, chunk_rows=256, bucket_rows=bucket)
+        fin = bp.predict_frame_async(f)
+        out = fin()
+        assert out.num_rows == 1000
+        np.testing.assert_array_equal(out["prediction"], ref["prediction"])
+        np.testing.assert_allclose(
+            out["probability"], ref["probability"], rtol=1e-6
+        )
+    # bucketed: 3 full 256-row chunks share one shape, the 232-row tail
+    # pads into the same 256 bucket — ONE compile event total
+    assert bp.compile_events == 1
+
+
+# ---------------------------------------------------------------------------
+# r8: pipelined engine — prefetching source + overlapped sink delivery
+# ---------------------------------------------------------------------------
+
+
+def _write_stream(tmp_path, n_files=6, rows=30):
+    from sntc_tpu.data import write_day_csvs
+
+    in_dir = str(tmp_path / "in")
+    write_day_csvs(in_dir, n_rows_per_day=rows, n_days=n_files, seed=4)
+    return in_dir
+
+
+def test_single_listing_serves_latest_offset_and_get_batch(
+    tmp_path, monkeypatch
+):
+    """Satellite: one glob+sort per poll tick — latest_offset() caches
+    the listing and the tick's get_batch() reuses it."""
+    import sntc_tpu.serve.streaming as S
+
+    in_dir = _write_stream(tmp_path, n_files=3)
+    src = FileStreamSource(in_dir)
+    calls = {"n": 0}
+    real_glob = S.glob.glob
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real_glob(*a, **k)
+
+    monkeypatch.setattr(S.glob, "glob", counting)
+    off = src.latest_offset()
+    assert off == 3 and calls["n"] == 1
+    f = src.get_batch(0, off)
+    assert f.num_rows == 90
+    assert calls["n"] == 1  # reused the tick's listing
+    # a range past the cached listing re-scans
+    with pytest.raises(ValueError):
+        src.get_batch(3, 5)
+    assert calls["n"] == 2
+
+
+def test_prefetch_stages_next_batch(tmp_path):
+    """prefetch(start, end) stages a background read; get_batch with
+    that exact range consumes it, other ranges fall through."""
+    in_dir = _write_stream(tmp_path, n_files=4)
+    src = FileStreamSource(in_dir, prefetch_batches=1)
+    assert src.latest_offset() == 4
+    assert src.prefetch(0, 1)
+    assert not src.prefetch(0, 1)  # already staged
+    assert not src.prefetch(0, 2)  # queue full (bound = 1)
+    f = src.get_batch(0, 1)  # consumes the staged read
+    assert f.num_rows == 30
+    assert src.prefetch(1, 2)  # slot free again
+    # a shed that skipped past offset 2 evicts the now-stale (1, 2)
+    assert src.prefetch(2, 4)
+    assert (1, 2) not in src._staged
+    f2 = src.get_batch(2, 4)
+    assert f2.num_rows == 60
+    stats = src.prefetch_stats()
+    assert stats["hits"] == 2 and stats["hwm"] == 1
+    # staged contents identical to a cold synchronous read
+    ref = FileStreamSource(in_dir).get_batch(0, 1)
+    np.testing.assert_array_equal(ref["Flow Duration"], f["Flow Duration"])
+    src.close()
+
+
+@pytest.mark.parametrize("wal_mode", ["files", "append"])
+def test_overlap_sink_query_matches_serial(model, tmp_path, wal_mode):
+    """The full pipelined engine (overlap + prefetch + buckets) commits
+    the same batches with the same contents as the serial engine."""
+    batches = [_batch(40 + 11 * i, i) for i in range(6)]
+    outs = {}
+    for mode in ("serial", "pipe"):
+        src = MemorySource(batches)
+        sink = MemorySink()
+        q = StreamingQuery(
+            model, src, sink, str(tmp_path / f"ckpt_{wal_mode}_{mode}"),
+            max_batch_offsets=1, wal_mode=wal_mode,
+            pipeline_depth=1 if mode == "serial" else 3,
+            overlap_sink=mode == "pipe",
+            shape_buckets=0 if mode == "serial" else 32,
+        )
+        assert q.process_available() == 6
+        assert q.in_flight_count() == 0
+        assert q._delivery is None
+        q.stop()
+        outs[mode] = sink
+    for (i1, f1), (i2, f2) in zip(
+        outs["serial"].batches, outs["pipe"].batches
+    ):
+        assert i1 == i2
+        assert f1.num_rows == f2.num_rows
+        np.testing.assert_array_equal(f1["prediction"], f2["prediction"])
+
+
+def test_overlap_sink_file_source_end_to_end(model, tmp_path):
+    """Pipelined engine over a real prefetching file source and CSV
+    sink: exactly-once output files, prefetch hits recorded."""
+    from sntc_tpu.data import CICIDS2017_FEATURES  # noqa: F401 — schema sanity
+
+    in_dir = _write_stream(tmp_path, n_files=5)
+    src = FileStreamSource(in_dir, prefetch_batches=2)
+
+    class Echo(MemorySink):
+        pass
+
+    sink = Echo()
+
+    from sntc_tpu.core.base import Transformer
+
+    class Identity(Transformer):
+        def transform(self, frame):
+            return frame
+
+    q = StreamingQuery(
+        Identity(), src, sink, str(tmp_path / "ckpt"),
+        max_batch_offsets=1, pipeline_depth=3, overlap_sink=True,
+        shape_buckets=16,
+    )
+    assert q.process_available() == 5
+    q.stop()
+    assert [i for i, _ in sink.batches] == [0, 1, 2, 3, 4]
+    assert all(f.num_rows == 30 for f in sink.frames)
+    stats = q.pipeline_stats()
+    assert stats["prefetch"]["hits"] >= 1
+    assert stats["delivered_batches"] == 5
+    src.close()
+
+
+def test_overlap_sink_failure_defers_not_skips(model, tmp_path):
+    """Serial-contract parity under overlap: a transient sink failure
+    leaves the batch queued (ids never shift); unarmed quarantine
+    re-raises from process_available."""
+    batches = [_batch(30, s) for s in range(4)]
+    src = MemorySource(batches)
+
+    class FlakySink(MemorySink):
+        def __init__(self):
+            super().__init__()
+            self.fail_on = {1}
+
+        def add_batch(self, batch_id, frame):
+            if batch_id in self.fail_on:
+                self.fail_on.discard(batch_id)
+                raise IOError("transient sink outage")
+            super().add_batch(batch_id, frame)
+
+    sink = FlakySink()
+    q = StreamingQuery(model, src, sink, str(tmp_path / "ckpt_flaky"),
+                       max_batch_offsets=1, pipeline_depth=2,
+                       overlap_sink=True)
+    with pytest.raises(IOError):
+        q.process_available()
+    assert q.process_available() == 3
+    assert [i for i, _ in sink.batches] == [0, 1, 2, 3]
+    assert q.last_committed() == 3
+    q.stop()
+
+
+def test_overlap_crash_between_sink_and_commit_replays(model, tmp_path):
+    """stream.commit crash in overlap mode: the delivery reached the
+    sink, the commit never landed; a restarted (pipelined) query
+    replays the batch and the sink dedupes — exactly-once preserved."""
+    import sntc_tpu.resilience as R
+
+    ckpt, out = str(tmp_path / "ckpt"), str(tmp_path / "out")
+    src = MemorySource([_batch(40, 1), _batch(25, 2)])
+    q = StreamingQuery(
+        model, src, CsvDirSink(out, columns=["prediction"]), ckpt,
+        max_batch_offsets=1, pipeline_depth=2, overlap_sink=True,
+    )
+    R.arm("stream.commit", times=1)
+    try:
+        with pytest.raises(R.InjectedFault):
+            q.process_available()
+    finally:
+        R.clear()
+    assert os.path.exists(os.path.join(out, "batch_000000.csv"))
+    assert os.listdir(os.path.join(ckpt, "commits")) == []
+    q.stop()
+    del q  # crash
+
+    q2 = StreamingQuery(
+        model, src, CsvDirSink(out, columns=["prediction"]), ckpt,
+        max_batch_offsets=1, pipeline_depth=2, overlap_sink=True,
+    )
+    assert q2.process_available() == 2
+    q2.stop()
+    with open(os.path.join(out, "batch_000000.csv")) as f:
+        assert sum(1 for _ in f) - 1 == 40  # replayed, not doubled
+    with open(os.path.join(ckpt, "commits", "0.json")) as f:
+        assert json.load(f) == {"batch_id": 0, "start": 0, "end": 1}
+
+
+def test_overlap_drain_settles_in_air_delivery(model, tmp_path):
+    """drain() in overlap mode joins the delivery thread's in-air batch
+    and commits everything in flight — the preemption contract."""
+    import time as _time
+
+    class SlowSink(MemorySink):
+        def add_batch(self, batch_id, frame):
+            _time.sleep(0.05)
+            super().add_batch(batch_id, frame)
+
+    batches = [_batch(20, s) for s in range(4)]
+    sink = SlowSink()
+    q = StreamingQuery(
+        model, MemorySource(batches), sink,
+        str(tmp_path / "ckpt"), max_batch_offsets=1, pipeline_depth=3,
+        overlap_sink=True,
+    )
+    # fill the pipeline and put one delivery in the air, then drain
+    q._run_one_batch()
+    assert q.in_flight_count() >= 1
+    q.drain()
+    assert q.in_flight_count() == 0
+    assert q._delivery is None
+    # every dispatched batch was sunk exactly once, in order, and the
+    # commit log agrees with the sink
+    ids = [i for i, _ in sink.batches]
+    assert ids == list(range(len(ids))) and len(ids) >= 1
+    assert q.last_committed() == ids[-1]
+    q.stop()
+
+
+def test_perf_flags_drift_check():
+    """CLI flags ⇔ engine kwargs ⇔ docs must agree (tier-1 wiring of
+    scripts/check_perf_flags.py)."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_perf_flags",
+        os.path.join(repo, "scripts", "check_perf_flags.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.check() == []

@@ -752,3 +752,31 @@ def test_chaos_sigterm_drains_and_exits_zero(chaos, chaos_reference):
     assert verdict["ok"], verdict
     assert verdict["rc"] == 0
     assert verdict["marker"]["reason"] == "SIGTERM"
+
+
+def test_chaos_kill_matrix_pipelined_exactly_once(chaos, chaos_reference):
+    """r8 satellite: the kill matrix in PIPELINED mode (prefetching
+    source + shape buckets + overlapped sink delivery) must converge to
+    the SERIAL reference's commits and sink rows at every boundary."""
+    workdir, reference = chaos_reference
+    for site in chaos.KILL_SITES:
+        verdict = chaos.run_kill_scenario(
+            workdir, site, reference, pipelined=True
+        )
+        assert verdict["ok"], verdict
+        assert verdict["pipelined"] is True
+
+
+def test_chaos_sigterm_drains_pipelined(chaos, chaos_reference):
+    """Drain scenario with the pipelined engine: SIGTERM must settle
+    the delivery thread's in-air batch, commit, and exit 0."""
+    workdir, _ = chaos_reference
+    verdict = chaos.run_drain_scenario(workdir, pipelined=True)
+    if not verdict["ok"]:
+        # same timing-sensitive retry discipline as the serial drain
+        print("first pipelined drain verdict:", json.dumps(verdict))
+        verdict = chaos.run_drain_scenario(
+            os.path.join(workdir, "retry_pipelined"), pipelined=True
+        )
+    assert verdict["ok"], verdict
+    assert verdict["rc"] == 0
