@@ -177,6 +177,7 @@ DEFAULT_ROWS = {
     "3": int(os.environ.get("BENCH_ROWS", 500_000)) // 2,
     "4": int(os.environ.get("BENCH_ROWS", 500_000)) // 4,
     "5": int(os.environ.get("BENCH_ROWS", 500_000)) // 4,
+    "6": int(os.environ.get("BENCH_ROWS", 500_000)) // 4,
 }
 
 
@@ -394,6 +395,29 @@ BENCH5_REPS = 5
 BENCH5_STREAM_PASSES = 2
 
 
+def _read_sink_dir(out_dir):
+    """All batch_*.csv of one engine's sink as a single Arrow table
+    (shared by configs 5 and 6 — both compare full sink contents)."""
+    import pyarrow as pa
+    import pyarrow.csv as pacsv
+
+    parts = [
+        pacsv.read_csv(p)
+        for p in sorted(glob.glob(os.path.join(out_dir, "batch_*.csv")))
+    ]
+    return pa.concat_tables(parts)
+
+
+def _sinks_match(a, b):
+    """Row-for-row equality of two engines' full sink output."""
+    if a.column_names != b.column_names or a.num_rows != b.num_rows:
+        return False
+    return all(
+        np.array_equal(a.column(c).to_numpy(), b.column(c).to_numpy())
+        for c in a.column_names
+    )
+
+
 def bench_config5(n_rows, mesh):
     """Streaming inference throughput: rows/s through the micro-batch
     engine over a REAL file stream — CSV micro-batches in, prediction
@@ -525,26 +549,6 @@ def bench_config5(n_rows, mesh):
         )
         return median
 
-    def read_sink(out_dir):
-        import pyarrow as pa
-
-        parts = [
-            pacsv.read_csv(p)
-            for p in sorted(glob.glob(os.path.join(out_dir, "batch_*.csv")))
-        ]
-        return pa.concat_tables(parts)
-
-    def sinks_match(a, b):
-        """Row-for-row equality of the two engines' full sink output."""
-        if a.column_names != b.column_names or a.num_rows != b.num_rows:
-            return False
-        return all(
-            np.array_equal(
-                a.column(c).to_numpy(), b.column(c).to_numpy()
-            )
-            for c in a.column_names
-        )
-
     tmp = tempfile.mkdtemp()
     # intra-op pinned to ONE thread for BOTH engines: arrow's hidden
     # intra-file parse pool otherwise competes with the pipeline's
@@ -576,8 +580,9 @@ def bench_config5(n_rows, mesh):
             for eng in engines:
                 run_once(tmp, eng, in_dir, rep, stream_rows, n_files)
         serial, pipe_r = (finish_engine(e) for e in engines)
-        sink_match = sinks_match(
-            read_sink(serial["out_dir"]), read_sink(pipe_r["out_dir"])
+        sink_match = _sinks_match(
+            _read_sink_dir(serial["out_dir"]),
+            _read_sink_dir(pipe_r["out_dir"]),
         )
     finally:
         pa.set_cpu_count(arrow_cpus)
@@ -608,12 +613,189 @@ def bench_config5(n_rows, mesh):
     }
 
 
+# config 6: whole-pipeline fusion, fused vs staged on the config-5-style
+# CSV stream.  The serving pipeline is DEEPER than config 5's
+# (assembler → MinMaxScaler → DCT → PCA → LR): the r5 scaler fold
+# already collapses config 5's scaler→LR pair, so measuring fusion
+# needs stages the fold cannot absorb — staged serving pays one device
+# round trip per jitted feature stage (DCT, PCA) plus the head; fused
+# serving runs ONE program with one upload and one download per batch.
+BENCH6_PCA_K = 32
+BENCH6_REPS = 5
+
+
+def bench_config6(n_rows, mesh):
+    """Fused vs staged serving throughput (rows/s) over a real file
+    stream — the whole-pipeline fusion compiler (sntc_tpu/fuse/)
+    measured, not asserted.  Methodology mirrors config 5: one synthetic
+    stream served by both engines, reps interleaved, MEDIAN reported;
+    additionally the host-serve crossover is pinned OFF for BOTH sides
+    (both run the device predict path) and both use the same shape
+    buckets, so the ratio isolates fusion — N programs + N−1 host hops
+    vs one program.  The fused model's per-segment transfer counters,
+    divided by the ENGINE's committed micro-batches, provide the
+    uploads/downloads-per-batch evidence (must be exactly 1/1)."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+
+    from sntc_tpu.core.base import Pipeline, PipelineModel
+    from sntc_tpu.feature import DCT, MinMaxScaler, PCA
+    from sntc_tpu.fuse import compile_pipeline, fused_segments
+    from sntc_tpu.models import LogisticRegression
+    from sntc_tpu.serve import (
+        BatchPredictor,
+        CsvDirSink,
+        FileStreamSource,
+        StreamingQuery,
+    )
+
+    train, test = _dataset(n_rows, binary=True)
+    pipe = Pipeline(stages=_feature_stages(mesh, with_scaler=False) + [
+        MinMaxScaler(inputCol="rawFeatures", outputCol="mm"),
+        DCT(inputCol="mm", outputCol="dct"),
+        PCA(mesh=mesh, inputCol="dct", outputCol="features",
+            k=BENCH6_PCA_K),
+        LogisticRegression(mesh=mesh, maxIter=20),
+    ]).fit(train)
+    staged_model = PipelineModel(stages=pipe.getStages()[1:])
+    fused_model = compile_pipeline(staged_model)
+    segments = fused_segments(fused_model)
+
+    def make_engine(tmp, name, in_dir, chunk_sizes, model):
+        """Warm one engine's predictor (shared across all its reps):
+        one throwaway engine batch for process-global first-touch
+        costs, then every distinct chunk size straight through the
+        predictor so bucketed shapes are all compiled."""
+        predictor = BatchPredictor(model, bucket_rows=BENCH5_SHAPE_BUCKETS)
+        warm = StreamingQuery(
+            predictor, FileStreamSource(in_dir),
+            CsvDirSink(os.path.join(tmp, f"warm_{name}"), durable=False),
+            os.path.join(tmp, f"warmckpt_{name}"),
+            max_batch_offsets=1, wal_mode="append",
+        )
+        warm._run_one_batch()
+        warm.stop()
+        for c in sorted(set(chunk_sizes)):
+            predictor.predict_frame(test.slice(0, c))
+        return {"name": name, "predictor": predictor, "reps": []}
+
+    def run_once(tmp, eng, in_dir, rep, stream_rows, n_files):
+        name = eng["name"]
+        out_dir = os.path.join(tmp, f"out_{name}_{rep}")
+        q = StreamingQuery(
+            eng["predictor"], FileStreamSource(in_dir),
+            CsvDirSink(out_dir, durable=False),
+            os.path.join(tmp, f"ckpt_{name}_{rep}"),
+            max_batch_offsets=1, wal_mode="append",
+            pipeline_depth=1,  # serial engines: the ratio is pure fusion
+        )
+        t0 = time.perf_counter()
+        n_done = q.process_available()
+        dt = time.perf_counter() - t0
+        rows = (
+            stream_rows
+            if n_done == n_files
+            else sum(p["numInputRows"] for p in q.recentProgress)
+        )
+        q.stop()
+        eng["reps"].append({
+            "out_dir": out_dir, "batches": n_done, "rows": rows,
+            "dt": dt, "rows_per_s": rows / dt,
+        })
+
+    def median_rep(eng):
+        reps = sorted(eng["reps"], key=lambda r: r["rows_per_s"])
+        rec = dict(reps[len(reps) // 2])
+        rec["best_rows_per_s"] = round(reps[-1]["rows_per_s"], 1)
+        return rec
+
+    tmp = tempfile.mkdtemp()
+    arrow_cpus = pa.cpu_count()
+    pa.set_cpu_count(1)  # same intra-op pinning discipline as config 5
+    host_rows_env = os.environ.get("SNTC_SERVE_HOST_ROWS")
+    # crossover OFF for both engines: staged must run the same device
+    # predict path the fused program embeds, or the ratio would compare
+    # device serving against host serving instead of fused vs staged
+    os.environ["SNTC_SERVE_HOST_ROWS"] = "0"
+    try:
+        in_dir = os.path.join(tmp, "in")
+        chunk_sizes = _write_bench5_stream(
+            in_dir, test, passes=BENCH5_STREAM_PASSES
+        )
+        stream_rows, n_files = sum(chunk_sizes), len(chunk_sizes)
+        engines = [
+            make_engine(tmp, "staged", in_dir, chunk_sizes, staged_model),
+            make_engine(tmp, "fused", in_dir, chunk_sizes, fused_model),
+        ]
+        # warmup is done: snapshot the fused model's per-segment transfer
+        # counters; the per-BATCH evidence divides the measured-window
+        # deltas by the ENGINE's committed micro-batches, so a pipeline
+        # broken into N segments would honestly report N per batch
+        compiles_before = sum(s.compile_events for s in segments)
+        uploads_before = sum(s.uploads for s in segments)
+        downloads_before = sum(s.downloads for s in segments)
+        for rep in range(BENCH6_REPS):
+            for eng in engines:
+                run_once(tmp, eng, in_dir, rep, stream_rows, n_files)
+        staged, fused_r = (median_rep(e) for e in engines)
+        fused_batches = sum(r["batches"] for r in engines[1]["reps"])
+        uploads = sum(s.uploads for s in segments) - uploads_before
+        downloads = sum(s.downloads for s in segments) - downloads_before
+        sink_match = _sinks_match(
+            _read_sink_dir(staged["out_dir"]),
+            _read_sink_dir(fused_r["out_dir"]),
+        )
+    finally:
+        pa.set_cpu_count(arrow_cpus)
+        if host_rows_env is None:
+            os.environ.pop("SNTC_SERVE_HOST_ROWS", None)
+        else:
+            os.environ["SNTC_SERVE_HOST_ROWS"] = host_rows_env
+        shutil.rmtree(tmp, ignore_errors=True)
+    fusion_evidence = {
+        "speedup_vs_staged": _round_ratio(
+            fused_r["rows_per_s"] / staged["rows_per_s"]
+        ),
+        "staged_rows_per_s": round(staged["rows_per_s"], 1),
+        "best_rows_per_s": fused_r["best_rows_per_s"],
+        "staged_best_rows_per_s": staged["best_rows_per_s"],
+        "uploads_per_batch": round(uploads / max(fused_batches, 1), 3),
+        "downloads_per_batch": round(
+            downloads / max(fused_batches, 1), 3
+        ),
+        "fused_segments": len(segments),
+        "fused_stages": sum(len(s.fused_stages) for s in segments),
+        "compile_events": sum(s.compile_events for s in segments),
+        "recompiles_after_warmup": sum(
+            s.compile_events for s in segments
+        ) - compiles_before,
+        "fallbacks": sum(s.fallbacks for s in segments),
+        "sink_match": sink_match,
+        "reps": BENCH6_REPS,
+        "batch_sizes": list(BENCH5_SIZES),
+        "arrow_intra_op_threads": 1,
+    }
+    return {
+        "metric": "cicids2017_fused_serving_rows_per_s",
+        "_datasets": (train, test),
+        "value": fused_r["rows_per_s"], "unit": "rows/s",
+        "quality": {
+            "micro_batches": fused_r["batches"],
+            "fusion": fusion_evidence,
+        },
+        "n_rows": fused_r["rows"],
+    }
+
+
 BENCHES = {
     "1": bench_config1,
     "2": bench_config2,
     "3": bench_config3,
     "4": bench_config4,
     "5": bench_config5,
+    "6": bench_config6,
 }
 
 
@@ -1115,6 +1297,9 @@ PROXIES = {
     "3": proxy_config3,
     "4": proxy_config4,
     "5": proxy_config5,
+    # config 6 serves the same CSV-in -> predict -> CSV-out job as
+    # config 5 (the fused pipeline is deeper, the proxy's job identical)
+    "6": proxy_config5,
 }
 
 
@@ -1129,12 +1314,12 @@ def measure_baseline(configs, rows):
 
     for cfg in configs:
         n = rows or DEFAULT_ROWS[cfg]
-        train, test = _dataset(n, binary=cfg in ("1", "5"))
+        train, test = _dataset(n, binary=cfg in ("1", "5", "6"))
         p = PROXIES[cfg](train, test)
         entry = {
             "baseline": f"sklearn CPU proxy: {p['desc']}",
             "n_rows": (
-                int(test.num_rows) if cfg == "5" else int(train.num_rows)
+                int(test.num_rows) if cfg in ("5", "6") else int(train.num_rows)
             ),
             "host_cpus": os.cpu_count(),
         }
@@ -1170,7 +1355,7 @@ def _load_baseline(cfg: str) -> dict:
 def _vs_baseline(cfg: str, result: dict, base: dict):
     if not base:
         return None
-    if cfg == "5":
+    if cfg in ("5", "6"):
         return result["value"] / base["rows_per_s"]  # throughput ratio
     scale = result["n_rows"] / max(base["n_rows"], 1)
     return (base["train_s"] * scale) / result["value"]
@@ -1268,7 +1453,7 @@ def run_config(cfg: str, rows, pair: bool = True):
         # invocation, on the same train/test split — both sides of the
         # ratio see the same host state (VERDICT r4 item 2)
         proxy = PROXIES[cfg](train, test)
-        if cfg == "5":
+        if cfg in ("5", "6"):
             line["vs_baseline"] = _round_ratio(
                 result["value"] / proxy["rows_per_s"]
             )

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Static drift check: fusion capability ⇔ documentation.
+
+The whole-pipeline fusion compiler (``sntc_tpu/fuse/``) fuses exactly
+the feature transformers whose classes register a device-fn builder in
+``sntc_tpu.fuse.registry``.  Every OTHER feature transformer silently
+falls back to its eager ``transform`` — which is correct, but must be a
+DOCUMENTED decision, not drift: a new stage added without either a
+registration or a docs entry would quietly serve slower forever.
+
+This script asserts that every ``Transformer`` exported by
+``sntc_tpu.feature`` (fitted models included, estimators excluded) is in
+exactly one of:
+
+* the capability registry (``registered_types()``), or
+* the "deliberately non-fusible stages" table of
+  ``docs/PERFORMANCE.md`` (a ``| `ClassName` | reason |`` row).
+
+and, symmetrically, that the docs table names no class that is in fact
+registered (stale row) or does not exist (typo).  Wired as a tier-1
+test (``tests/test_fuse_pipeline.py``) — the ``check_fault_sites.py`` /
+``check_perf_flags.py`` discipline applied to the fusion surface.
+
+Exit 0 when consistent; exit 1 with a per-class report otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = "docs/PERFORMANCE.md"
+TABLE_START = "<!-- non-fusible-stages -->"
+TABLE_END = "<!-- /non-fusible-stages -->"
+
+
+def _doc_table_names() -> set:
+    with open(os.path.join(REPO, DOC)) as f:
+        src = f.read()
+    if TABLE_START not in src or TABLE_END not in src:
+        raise SystemExit(
+            f"{DOC} lacks the {TABLE_START} … {TABLE_END} markers around "
+            "the non-fusible-stages table"
+        )
+    table = src.split(TABLE_START, 1)[1].split(TABLE_END, 1)[0]
+    return set(re.findall(r"^\|\s*`(\w+)`", table, flags=re.MULTILINE))
+
+
+def check() -> list:
+    """Returns a list of human-readable drift complaints (empty = ok)."""
+    sys.path.insert(0, REPO)
+    import sntc_tpu.feature as feature
+    from sntc_tpu.core.base import Estimator, Transformer
+    from sntc_tpu.fuse import registered_types
+
+    transformers = {
+        name
+        for name in feature.__all__
+        if isinstance(cls := getattr(feature, name), type)
+        and issubclass(cls, Transformer)
+        and not issubclass(cls, Estimator)
+    }
+    registered = {cls.__name__ for cls in registered_types()}
+    documented = _doc_table_names()
+
+    problems = []
+    for name in sorted(transformers - registered - documented):
+        problems.append(
+            f"{name}: neither registers a device_fn "
+            "(sntc_tpu.fuse.registry) nor appears in the non-fusible "
+            f"table of {DOC}"
+        )
+    for name in sorted(documented & registered):
+        problems.append(
+            f"{name}: listed as non-fusible in {DOC} but registers a "
+            "device_fn — stale docs row"
+        )
+    for name in sorted(documented - transformers):
+        problems.append(
+            f"{name}: in the {DOC} non-fusible table but not exported "
+            "by sntc_tpu.feature — typo or removed stage"
+        )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("fusible-stage drift detected:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    sys.path.insert(0, REPO)
+    from sntc_tpu.fuse import registered_types
+
+    print(
+        f"ok: {len(registered_types())} device-fn registrations and the "
+        f"{DOC} non-fusible table cover every feature transformer"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

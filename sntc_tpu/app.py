@@ -269,7 +269,13 @@ def cmd_serve(args) -> int:
             )]
             if labels is not None else []
         )
-        model = compile_serving(PipelineModel(stages=stages + tail))
+        model = PipelineModel(stages=stages + tail)
+        # --fuse (default): the whole-pipeline fusion compiler — scaler
+        # weight folding + one jitted device program per fusible stage
+        # run, one upload/download per micro-batch (docs/PERFORMANCE.md
+        # "Whole-pipeline fusion"); --no-fuse serves the staged pipeline
+        if args.fuse:
+            model = compile_serving(model)
         if tail:
             out_cols = ["prediction", "predictedLabel"]
     # a SERVED query degrades instead of dying: transient read/sink
@@ -411,6 +417,14 @@ def main(argv=None) -> int:
     p.add_argument("--prefetch-batches", type=int, default=2,
                    help="background source reads staged ahead of the "
                    "engine (pipelined mode only); 0 = off")
+    p.add_argument("--fuse", action="store_true", dest="fuse", default=True,
+                   help="compile the serving pipeline with the whole-"
+                   "pipeline fusion compiler: fold the scaler into the "
+                   "model and jit each fusible stage run into ONE device "
+                   "program (default)")
+    p.add_argument("--no-fuse", action="store_false", dest="fuse",
+                   help="serve the staged pipeline unfused (stage-by-"
+                   "stage transforms; debugging/verification)")
     p.add_argument("--poll-interval", type=float, default=1.0)
     p.add_argument("--once", action="store_true",
                    help="drain available files and exit")

@@ -25,6 +25,26 @@ from sntc_tpu.core.params import NO_DEFAULT, Param, Params
 class PipelineStage(Params):
     """Common base for Transformer and Estimator."""
 
+    # the conventional input-column param names this base can discover;
+    # stages reading columns through differently-named params MUST
+    # override input_columns() so pipeline rewrites (sntc_tpu.fuse) and
+    # the tuning prefix hoist see them
+    _INPUT_COL_PARAMS = ("inputCol", "featuresCol", "inputCols")
+
+    def input_columns(self) -> List[str]:
+        """Column names this stage reads — at transform time for
+        Transformers, at fit time for Estimators (unset params
+        contribute nothing — an unset stage consumes nothing yet)."""
+        out: List[str] = []
+        for name in self._INPUT_COL_PARAMS:
+            if not self.hasParam(name) or not self.isDefined(name):
+                continue
+            val = self.getOrDefault(name)
+            if val is None:
+                continue
+            out.extend(val if isinstance(val, (list, tuple)) else [val])
+        return out
+
     def save(self, path: str) -> str:
         """Persist this stage (SURVEY.md §5.4); see sntc_tpu.mlio."""
         from sntc_tpu.mlio import save_model
@@ -44,26 +64,8 @@ class PipelineStage(Params):
 
 
 class Transformer(PipelineStage):
-    # the conventional input-column param names this base can discover;
-    # stages reading columns through differently-named params MUST override
-    # input_columns() so pipeline rewrites (sntc_tpu.serve.fuse) see them
-    _INPUT_COL_PARAMS = ("inputCol", "featuresCol", "inputCols")
-
     def transform(self, frame: Frame) -> Frame:
         raise NotImplementedError
-
-    def input_columns(self) -> List[str]:
-        """Column names this stage reads at transform time (unset params
-        contribute nothing — an unset stage consumes nothing yet)."""
-        out: List[str] = []
-        for name in self._INPUT_COL_PARAMS:
-            if not self.hasParam(name) or not self.isDefined(name):
-                continue
-            val = self.getOrDefault(name)
-            if val is None:
-                continue
-            out.extend(val if isinstance(val, (list, tuple)) else [val])
-        return out
 
     def transform_async(self, frame: Frame):
         """Dispatch this transform without blocking on device results.

@@ -36,6 +36,23 @@ def object_column(values: Sequence) -> np.ndarray:
     return col
 
 
+# jax.Array, resolved lazily ONCE: _coerce_column runs per column on
+# every Frame/with_column construction (the serving hot path builds
+# several frames per micro-batch), and the per-call `import jax` it used
+# to do costs a sys.modules lookup + attribute walk each time — while a
+# module-level import would force jax into every Frame-only consumer
+_JAX_ARRAY_TYPE = None
+
+
+def _jax_array_type():
+    global _JAX_ARRAY_TYPE
+    if _JAX_ARRAY_TYPE is None:
+        import jax
+
+        _JAX_ARRAY_TYPE = jax.Array
+    return _JAX_ARRAY_TYPE
+
+
 def _coerce_column(name: str, value: ColumnLike):
     """Coerce one column to an array and validate its rank.
 
@@ -44,13 +61,14 @@ def _coerce_column(name: str, value: ColumnLike):
     without a host round trip; any numpy-only op falls back through
     ``__array__`` (which materializes).
     """
-    import jax
-
-    arr = (
-        value
-        if isinstance(value, (np.ndarray, jax.Array))
-        else np.asarray(value)
-    )
+    # fast path: the overwhelmingly common case is an ndarray column —
+    # no jax resolution, no isinstance against a lazily-imported type
+    if isinstance(value, np.ndarray):
+        arr = value
+    elif isinstance(value, _jax_array_type()):
+        arr = value
+    else:
+        arr = np.asarray(value)
     if arr.ndim not in (1, 2):
         raise ValueError(
             f"column {name!r} must be 1-D or 2-D, got shape {arr.shape}"

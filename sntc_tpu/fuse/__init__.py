@@ -1,0 +1,46 @@
+"""Whole-pipeline fusion — one jitted device program per fusible run.
+
+Public surface:
+
+* :func:`compile_pipeline` — compile a fitted ``PipelineModel`` for
+  serving (rewrite rules + maximal-segment fusion + head packing);
+* :func:`compile_serving` — the r5 entry point, now a thin alias of
+  ``compile_pipeline`` (scaler folding became rewrite rule 1);
+* :class:`FusedSegment` / :func:`fused_segments` / :func:`fusion_stats`
+  — the compiled artifact and its evidence counters;
+* :func:`register_device_fn` / :func:`device_plan_for` /
+  :func:`registered_types` — the capability registry
+  (``scripts/check_fusible_stages.py`` audits it against the
+  non-fusible table in ``docs/PERFORMANCE.md``).
+"""
+
+from sntc_tpu.fuse.planner import (
+    FusedSegment,
+    compile_pipeline,
+    fused_segments,
+    fusion_stats,
+)
+from sntc_tpu.fuse.registry import (
+    DevicePlan,
+    device_plan_for,
+    register_device_fn,
+    registered_types,
+)
+from sntc_tpu.fuse.rules import fold_scalers
+
+# the r5 serving entry point, kept as an alias: "compile for serving"
+# now means the full fusion pass (fold + partition + jit)
+compile_serving = compile_pipeline
+
+__all__ = [
+    "DevicePlan",
+    "FusedSegment",
+    "compile_pipeline",
+    "compile_serving",
+    "device_plan_for",
+    "fold_scalers",
+    "fused_segments",
+    "fusion_stats",
+    "register_device_fn",
+    "registered_types",
+]

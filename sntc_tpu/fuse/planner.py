@@ -1,0 +1,419 @@
+"""Whole-pipeline fusion compiler — one device program per fusible run.
+
+The serving hot path executed a fitted ``PipelineModel`` stage-by-stage:
+every feature transformer round-tripped its output through a host numpy
+column before the next stage ran — the ML-pipeline analog of the
+per-operator interpretation Spark's whole-stage codegen eliminates
+(SURVEY.md §2.6).  ``compile_pipeline`` compiles that interpretation
+away:
+
+1. **rewrite** — algebraic folds run first (``fuse.rules``: scaler →
+   linear/MLP weight folding), shrinking the pipeline before fusion;
+2. **partition** — the stage list splits into MAXIMAL runs of stages
+   whose fitted instances export a pure device fn via the capability
+   registry (``fuse.registry``); a classifier head with a packed device
+   serve program terminates its run;
+3. **compile** — each run becomes ONE :class:`FusedSegment`: a single
+   jitted XLA program (per input signature; shape-bucketed serving keys
+   it per bucket) with the host input columns as donated arguments, all
+   intermediate columns living only in device registers/HBM, and ONE
+   packed output per head.  Non-fusible stages (object/ragged columns,
+   row-dropping ``handleInvalid='skip'``, data-dependent validation)
+   stay eager between segments — the row-validity-mask contract of the
+   shape-bucketed engine is untouched because row-dropping stages are
+   never fused.
+
+Evidence: every segment dispatch records its host→device uploads and
+device→host materializations in the process transfer ledger
+(``sntc_tpu.utils.profiling.transfer_ledger``); a fully-fused pipeline
+serves each micro-batch with exactly ONE upload and ONE download
+(journaled by bench config 6).
+
+Scope notes: fused segments are a serving-time artifact — persist the
+ORIGINAL fitted pipeline, not the compiled one.  Output frames omit
+intermediate columns that exist only to feed a later fused stage
+(pass ``keep=('col',)`` to retain one); every column a later eager
+stage reads is kept automatically.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sntc_tpu.core.base import PipelineModel, Transformer
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.feature.vector_assembler import VectorAssembler
+from sntc_tpu.fuse.registry import (
+    F32_CAST,
+    F32_ONLY,
+    F64,
+    DevicePlan,
+    device_plan_for,
+)
+from sntc_tpu.fuse.rules import fold_scalers
+from sntc_tpu.models.base import ClassificationModel
+from sntc_tpu.utils.profiling import transfer_ledger
+
+
+def _fusible_head(stage) -> bool:
+    return isinstance(stage, ClassificationModel) and stage.has_device_serve()
+
+
+class FusedSegment(Transformer):
+    """One maximal fusible run compiled into a single device program.
+
+    ``transform_async`` uploads the segment's external input columns
+    (cast per each plan's declared policy — identical to the casts the
+    staged path applies), dispatches ONE jitted program computing every
+    fused stage plus the optional head's packed serve output, and
+    returns a finalize that materializes the outputs into a Frame.
+    Falls back to the eager stage-by-stage transform for empty frames
+    and dtype-preserving stages bound to non-float32 columns
+    (``fallbacks`` counts them).  Programs are cached per input
+    signature — ``compile_events`` mirrors the BatchPredictor shape
+    ledger, so shape-bucketed serving keeps it flat after warmup.
+    """
+
+    def __init__(
+        self,
+        stages: Sequence[Transformer],
+        plans: Sequence[DevicePlan],
+        head: Optional[ClassificationModel] = None,
+        keep: Iterable[str] = (),
+    ):
+        super().__init__()
+        if len(stages) != len(plans):
+            raise ValueError("one DevicePlan per fused stage required")
+        self._stages = list(stages)
+        self._plans = list(plans)
+        self._head = head
+        self._keep = frozenset(keep)
+        self._programs: dict = {}
+        self._lock = threading.Lock()
+        self.compile_events = 0  # distinct input signatures compiled
+        self.invocations = 0  # fused dispatches
+        self.fallbacks = 0  # eager fallbacks (empty/dtype-gated)
+        # per-SEGMENT transfer counters: fusion_stats() aggregates these
+        # per model, so one engine's evidence is never polluted by other
+        # fused models in the process (the global ledger stays the
+        # process-wide view)
+        self.uploads = 0
+        self.downloads = 0
+
+        # external inputs: the first consuming plan's read policy decides
+        # the upload cast (in-segment columns arrive as device values).
+        # Two plans reading ONE external column under DIFFERENT policies
+        # cannot share a segment — the upload cast of one would bypass
+        # the other's dtype guard and break the bitwise contract; the
+        # planner splits such runs, and this constructor enforces it.
+        external: List[Tuple[str, str]] = []
+        produced: set = set()
+        policies: dict = {}
+        for plan in self._plans:
+            for r in plan.reads:
+                if r in produced:
+                    continue
+                if r not in policies:
+                    policies[r] = plan.read_policy
+                    external.append((r, plan.read_policy))
+                elif policies[r] != plan.read_policy:
+                    raise ValueError(
+                        f"conflicting read policies for column {r!r} "
+                        f"({policies[r]} vs {plan.read_policy}): split "
+                        "these stages into separate segments"
+                    )
+            produced.update(plan.writes)
+        if head is not None:
+            # the head input is cast to float32 IN-PROGRAM (mirroring the
+            # staged ClassificationModel.transform astype), so any upload
+            # policy on an external features column is compatible
+            fc = head.getFeaturesCol()
+            if fc not in produced and fc not in policies:
+                external.append((fc, F32_CAST))
+        self._external = external
+
+        # liveness: a written column whose FINAL value is only consumed
+        # inside the segment is dead — it never leaves the device.  Leaf
+        # outputs, `keep` columns, and anything a later pipeline stage
+        # reads (folded into `keep` by compile_pipeline) materialize.
+        write_order: List[str] = []
+        last_writer: dict = {}
+        for i, plan in enumerate(self._plans):
+            for w in plan.writes:
+                if w in write_order:
+                    write_order.remove(w)
+                write_order.append(w)
+                last_writer[w] = i
+        head_reads = {head.getFeaturesCol()} if head is not None else set()
+        self._live_writes = [
+            w
+            for w in write_order
+            if w in self._keep
+            or not (
+                w in head_reads
+                or any(
+                    w in self._plans[j].reads
+                    for j in range(last_writer[w] + 1, len(self._plans))
+                )
+            )
+        ]
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def fused_stages(self) -> List[Transformer]:
+        """The original fitted stages this segment compiled (head last)."""
+        out = list(self._stages)
+        if self._head is not None:
+            out.append(self._head)
+        return out
+
+    def input_columns(self) -> List[str]:
+        return [name for name, _ in self._external]
+
+    def __repr__(self) -> str:
+        names = ", ".join(type(s).__name__ for s in self.fused_stages)
+        return f"FusedSegment[{names}]"
+
+    # -- execution ----------------------------------------------------------
+
+    def _bind(self, frame: Frame) -> Optional[List[np.ndarray]]:
+        """Host arrays for the program arguments, cast per policy;
+        None when a dtype-preserving plan sees a non-float32 column
+        (the eager path keeps the exact host semantics)."""
+        args: List[np.ndarray] = []
+        for name, policy in self._external:
+            col = frame[name]
+            if not isinstance(col, np.ndarray):
+                col = np.asarray(col)  # device-resident column: materialize
+            if policy == F32_ONLY:
+                if col.dtype != np.float32:
+                    return None
+                args.append(col)
+            elif policy == F64:
+                args.append(np.asarray(col, np.float64))
+            else:  # F32_CAST — the cast every fused stage applies itself
+                args.append(col.astype(np.float32, copy=False))
+        return args
+
+    def _program(self, args: List[np.ndarray]):
+        import jax
+
+        # donation frees the uploaded input buffers for reuse by the
+        # program's outputs; on CPU the backend ignores donation (and the
+        # host buffer may be aliased zero-copy), so gate it off there
+        donate = jax.default_backend() != "cpu"
+        sig = (
+            tuple((a.shape, a.dtype.str) for a in args),
+            donate,
+        )
+        with self._lock:
+            prog = self._programs.get(sig)
+            if prog is not None:
+                return prog
+        names = [n for n, _ in self._external]
+        plans, head, live = self._plans, self._head, self._live_writes
+
+        def run(*xs):
+            import jax.numpy as jnp
+
+            env = dict(zip(names, xs))
+            for plan in plans:
+                env.update(plan.apply(env))
+            outs = []
+            if head is not None:
+                # the staged path's ClassificationModel.transform casts
+                # features to float32 before predicting — replicate it,
+                # or an x64-produced f64 feature column would run the
+                # head in f64 and diverge from the staged output
+                x = env[head.getFeaturesCol()].astype(jnp.float32)
+                outs.append(head._predict_all_dev(x))
+            outs.extend(env[w] for w in live)
+            return tuple(outs)
+
+        prog = jax.jit(
+            run,
+            donate_argnums=tuple(range(len(names))) if donate else (),
+        )
+        with self._lock:
+            if sig not in self._programs:
+                self._programs[sig] = prog
+                self.compile_events += 1
+            prog = self._programs[sig]
+        return prog
+
+    def _transform_eager(self, frame: Frame) -> Frame:
+        out = frame
+        for stage in self._stages:
+            out = stage.transform(out)
+        if self._head is not None:
+            out = self._head.transform(out)
+        return out
+
+    def transform(self, frame: Frame) -> Frame:
+        return self.transform_async(frame)()
+
+    def transform_async(self, frame: Frame):
+        args = self._bind(frame) if frame.num_rows else None
+        if args is None:
+            self.fallbacks += 1
+            out = self._transform_eager(frame)
+            return lambda: out
+        prog = self._program(args)
+        ledger = transfer_ledger()
+        ledger.record_uploads(len(args), sum(a.nbytes for a in args))
+        outs = prog(*args)  # async dispatch; finalize materializes
+        with self._lock:
+            self.invocations += 1
+            self.uploads += len(args)
+        head, live = self._head, self._live_writes
+
+        def finalize() -> Frame:
+            host = [np.asarray(o) for o in outs]
+            ledger.record_downloads(
+                len(host), sum(h.nbytes for h in host)
+            )
+            with self._lock:
+                self.downloads += len(host)
+            out_frame = frame
+            feature_cols = host[1:] if head is not None else host
+            for name, arr in zip(live, feature_cols):
+                out_frame = out_frame.with_column(name, arr)
+            if head is not None:
+                packed = host[0]
+                k = head.num_classes
+                if head.getRawPredictionCol():
+                    out_frame = out_frame.with_column(
+                        head.getRawPredictionCol(), packed[:, :k]
+                    )
+                if head.getProbabilityCol():
+                    out_frame = out_frame.with_column(
+                        head.getProbabilityCol(), packed[:, k : 2 * k]
+                    )
+                if head.getPredictionCol():
+                    out_frame = out_frame.with_column(
+                        head.getPredictionCol(),
+                        packed[:, 2 * k].astype(np.float64),
+                    )
+            return out_frame
+
+        return finalize
+
+
+def compile_pipeline(
+    pipeline: PipelineModel,
+    keep: Iterable[str] = (),
+    fuse_heads: bool = True,
+) -> PipelineModel:
+    """Compile a fitted PipelineModel for serving: rewrite rules first
+    (scaler folding), then each maximal run of registry-fusible stages
+    (plus a terminating device-servable classifier head) becomes one
+    :class:`FusedSegment`; everything else passes through eagerly.
+
+    ``keep`` names intermediate columns to materialize even when only a
+    fused stage consumes them; columns read by later eager stages are
+    kept automatically.  ``fuse_heads=False`` restricts fusion to
+    feature stages (the head stays a plain stage).
+    """
+    stages = fold_scalers(list(pipeline.getStages()))
+    out: List[Transformer] = []
+    i, n = 0, len(stages)
+    while i < n:
+        plan = device_plan_for(stages[i])
+        if plan is None:
+            out.append(stages[i])
+            i += 1
+            continue
+        seg_stages: List[Transformer] = [stages[i]]
+        seg_plans: List[DevicePlan] = [plan]
+        seg_produced: set = set(plan.writes)
+        seg_policies: dict = {
+            r: plan.read_policy for r in plan.reads
+        }
+        i += 1
+        while i < n:
+            p = device_plan_for(stages[i])
+            if p is None:
+                break
+            # a stage reading a shared EXTERNAL column under a different
+            # upload policy than the run already requires would bypass
+            # its own dtype guard (the first reader's cast wins at bind
+            # time) — start a new segment instead, where the guard runs
+            if any(
+                r not in seg_produced
+                and seg_policies.get(r, p.read_policy) != p.read_policy
+                for r in p.reads
+            ):
+                break
+            for r in p.reads:
+                if r not in seg_produced:
+                    seg_policies.setdefault(r, p.read_policy)
+            seg_produced.update(p.writes)
+            seg_stages.append(stages[i])
+            seg_plans.append(p)
+            i += 1
+        head = None
+        if fuse_heads and i < n and _fusible_head(stages[i]):
+            head = stages[i]
+            i += 1
+        # single-upload rule: a fused VectorAssembler LEADING a segment
+        # would turn the one packed upload into one upload per input
+        # column — its host stack is the upload prep, so it runs eagerly
+        while (
+            seg_plans
+            and isinstance(seg_stages[0], VectorAssembler)
+            and len(seg_plans[0].reads) > 1
+        ):
+            out.append(seg_stages.pop(0))
+            seg_plans.pop(0)
+        if not seg_plans:
+            if head is not None:
+                out.append(head)
+            continue
+        later_reads = set(keep)
+        for later in stages[i:]:
+            later_reads.update(later.input_columns())
+        out.append(
+            FusedSegment(seg_stages, seg_plans, head=head, keep=later_reads)
+        )
+    return PipelineModel(stages=out)
+
+
+def fused_segments(model) -> List[FusedSegment]:
+    """Every FusedSegment reachable from ``model`` (PipelineModels are
+    walked recursively; a BatchPredictor's wrapped model too)."""
+    segs: List[FusedSegment] = []
+    stack = [model]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, FusedSegment):
+            segs.append(node)
+        elif isinstance(node, PipelineModel):
+            stack.extend(node.getStages())
+        elif hasattr(node, "model") and isinstance(node.model, Transformer):
+            stack.append(node.model)
+    return segs
+
+
+def fusion_stats(model) -> Optional[dict]:
+    """Fusion evidence for ``pipeline_stats()``/bench: segment count,
+    compile ledger, fallback count, and THIS model's transfer counters
+    (per-segment sums — other fused models in the process don't leak
+    in; the process-wide view lives in
+    ``sntc_tpu.utils.profiling.transfer_ledger``).  None when the model
+    contains no fused segment."""
+    segs = fused_segments(model)
+    if not segs:
+        return None
+    return {
+        "segments": len(segs),
+        "fused_stages": sum(len(s.fused_stages) for s in segs),
+        "compile_events": sum(s.compile_events for s in segs),
+        "invocations": sum(s.invocations for s in segs),
+        "fallbacks": sum(s.fallbacks for s in segs),
+        "uploads": sum(s.uploads for s in segs),
+        "downloads": sum(s.downloads for s in segs),
+    }

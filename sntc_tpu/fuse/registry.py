@@ -1,0 +1,397 @@
+"""Capability registry — which fitted feature stages export a device fn.
+
+The whole-pipeline fusion compiler (``sntc_tpu.fuse.planner``) can only
+fuse a stage it can express as a PURE function of device arrays:
+``apply(cols_in) -> cols_out`` with every parameter baked in at plan
+time.  This module is the single source of truth for that capability:
+each array-in/array-out feature transformer registers a *plan builder*
+``(fitted stage) -> DevicePlan | None`` keyed on its EXACT class (a
+subclass that overrides ``transform`` must register itself — MRO
+matching would silently fuse semantics the subclass changed).
+
+A builder returns ``None`` when THIS stage instance is non-fusible
+(row-dropping ``handleInvalid='skip'``, data-dependent validation such
+as ``handleInvalid='error'`` NaN checks or closed-ended Bucketizer
+ranges, float64 math without ``jax_enable_x64``); the planner then
+falls back to the stage's eager ``transform``, splitting the fused
+segment — semantics are never approximated.
+
+Bitwise contract: every ``apply`` replicates its stage's host
+``transform`` arithmetic operation-for-operation (same casts, same
+operation order) so the fused program is bitwise-equal to the staged
+path — elementwise float32 ops are exact IEEE and matmuls reuse the
+same jitted kernels the staged path dispatches.
+
+Stages that cannot honor that contract stay off the registry and are
+listed in the non-fusible table of ``docs/PERFORMANCE.md`` —
+``scripts/check_fusible_stages.py`` (tier-1) asserts every feature
+transformer is in exactly one of the two places.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+# read-binding policies: how the planner uploads an EXTERNAL host column
+# this plan reads (in-segment columns arrive as device values already)
+F32_CAST = "f32cast"  # host-cast to float32 first (the stage's own astype)
+F32_ONLY = "f32only"  # dtype-preserving op: require float32, else fall back
+F64 = "f64"  # float64 math — builders gate these on jax_enable_x64
+
+
+class DevicePlan:
+    """One fused stage: ``apply`` maps a dict of device columns to the
+    stage's written columns, tracing exactly the host transform's math."""
+
+    __slots__ = ("reads", "writes", "apply", "read_policy")
+
+    def __init__(
+        self,
+        reads: List[str],
+        writes: List[str],
+        apply: Callable[[dict], dict],
+        read_policy: str = F32_CAST,
+    ):
+        self.reads = list(reads)
+        self.writes = list(writes)
+        self.apply = apply
+        self.read_policy = read_policy
+
+
+_REGISTRY: Dict[type, Callable] = {}
+
+
+def register_device_fn(cls: type):
+    """Class decorator target: ``@register_device_fn(StageType)`` marks
+    ``builder(stage) -> DevicePlan | None`` as StageType's exporter."""
+
+    def deco(builder):
+        _REGISTRY[cls] = builder
+        return builder
+
+    return deco
+
+
+def registered_types() -> frozenset:
+    return frozenset(_REGISTRY)
+
+
+def device_plan_for(stage) -> Optional[DevicePlan]:
+    """The stage's device plan, or None when it (or this configuration
+    of it) must run eagerly.  Exact-type lookup, never MRO."""
+    builder = _REGISTRY.get(type(stage))
+    if builder is None:
+        return None
+    return builder(stage)
+
+
+def _x64_enabled() -> bool:
+    import jax
+
+    return bool(jax.config.jax_enable_x64)
+
+
+# ---------------------------------------------------------------------------
+# plan builders
+# ---------------------------------------------------------------------------
+# Builders import their stage classes lazily-at-module-load (this module
+# is imported by the planner, which serving already pays for); each
+# closes over plain numpy constants so the traced fn embeds them as XLA
+# constants — the fitted parameters ARE the program.
+
+
+def _register_builtin() -> None:
+    import jax.numpy as jnp
+
+    from sntc_tpu.feature.chisq_selector import ChiSqSelectorModel
+    from sntc_tpu.feature.dct import DCT, _dct_basis
+    from sntc_tpu.feature.discretizers import Bucketizer
+    from sntc_tpu.feature.encoders import ElementwiseProduct, VectorSlicer
+    from sntc_tpu.feature.expansion import (
+        Interaction,
+        PolynomialExpansion,
+        _expansion_plan,
+    )
+    from sntc_tpu.feature.pca import PCAModel
+    from sntc_tpu.feature.scalers import (
+        MaxAbsScalerModel,
+        MinMaxScalerModel,
+        RobustScalerModel,
+    )
+    from sntc_tpu.feature.standard_scaler import StandardScalerModel
+    from sntc_tpu.feature.univariate_selector import (
+        UnivariateFeatureSelectorModel,
+    )
+    from sntc_tpu.feature.variance_selector import (
+        VarianceThresholdSelectorModel,
+    )
+    from sntc_tpu.feature.vector_assembler import VectorAssembler
+
+    @register_device_fn(StandardScalerModel)
+    def _standard_scaler(m):
+        mu, f = m.affine()  # float64 single source of truth
+        mu32, f32 = mu.astype(np.float32), f.astype(np.float32)
+        with_mean, with_std = m.getWithMean(), m.getWithStd()
+        inp, out = m.getInputCol(), m.getOutputCol()
+
+        def apply(cols):
+            x = cols[inp].astype(jnp.float32)
+            if with_mean:
+                x = x - jnp.asarray(mu32)[None, :]
+            if with_std:
+                x = x * jnp.asarray(f32)[None, :]
+            return {out: x}
+
+        return DevicePlan([inp], [out], apply)
+
+    @register_device_fn(MinMaxScalerModel)
+    def _minmax_scaler(m):
+        lo, hi = m.originalMin, m.originalMax  # float32
+        span = hi - lo
+        out_lo, out_hi = float(m.getMin()), float(m.getMax())
+        # identical constant arithmetic to the host transform (np.divide
+        # with where; midpoint for constant features)
+        scale = np.divide(
+            out_hi - out_lo, span, out=np.zeros_like(span), where=span > 0
+        )
+        mid32 = np.float32(0.5 * (out_lo + out_hi))
+        ok = span > 0
+        inp, out = m.getInputCol(), m.getOutputCol()
+
+        def apply(cols):
+            x = cols[inp].astype(jnp.float32)
+            scaled = (x - jnp.asarray(lo)[None, :]) * jnp.asarray(scale)[
+                None, :
+            ] + jnp.float32(out_lo)
+            return {
+                out: jnp.where(jnp.asarray(ok)[None, :], scaled, mid32)
+            }
+
+        return DevicePlan([inp], [out], apply)
+
+    @register_device_fn(MaxAbsScalerModel)
+    def _maxabs_scaler(m):
+        inv = np.divide(
+            1.0, m.maxAbs, out=np.zeros_like(m.maxAbs), where=m.maxAbs > 0
+        )
+        inp, out = m.getInputCol(), m.getOutputCol()
+
+        def apply(cols):
+            x = cols[inp].astype(jnp.float32)
+            return {out: x * jnp.asarray(inv)[None, :]}
+
+        return DevicePlan([inp], [out], apply)
+
+    @register_device_fn(RobustScalerModel)
+    def _robust_scaler(m):
+        median = m.median  # float32
+        inv = np.divide(
+            1.0, m.range, out=np.zeros_like(m.range), where=m.range > 0
+        )
+        centering, scaling = m.getWithCentering(), m.getWithScaling()
+        inp, out = m.getInputCol(), m.getOutputCol()
+
+        def apply(cols):
+            x = cols[inp].astype(jnp.float32)
+            if centering:
+                x = x - jnp.asarray(median)[None, :]
+            if scaling:
+                x = x * jnp.asarray(inv)[None, :]
+            return {out: x}
+
+        return DevicePlan([inp], [out], apply)
+
+    @register_device_fn(PCAModel)
+    def _pca(m):
+        pc = m.pc  # [D, k] float32
+        inp, out = m.getInputCol(), m.getOutputCol()
+
+        def apply(cols):
+            return {out: cols[inp].astype(jnp.float32) @ jnp.asarray(pc)}
+
+        return DevicePlan([inp], [out], apply)
+
+    @register_device_fn(DCT)
+    def _dct(m):
+        import jax
+
+        inverse = bool(m.getInverse())
+        inp, out = m.getInputCol(), m.getOutputCol()
+
+        def apply(cols):
+            x = cols[inp]
+            if x.ndim != 2:  # trace-time shape check == eager ValueError
+                raise ValueError("inputCol must be a vector column")
+            basis = _dct_basis(x.shape[1], inverse)
+            return {
+                out: jnp.matmul(
+                    x.astype(jnp.float32),
+                    jnp.asarray(basis),
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+            }
+
+        return DevicePlan([inp], [out], apply)
+
+    @register_device_fn(ElementwiseProduct)
+    def _elementwise_product(m):
+        w = m.getScalingVec()
+        if w is None:
+            return None  # unset: the eager path raises the right error
+        w32 = np.asarray(w, np.float32)
+        inp, out = m.getInputCol(), m.getOutputCol()
+
+        def apply(cols):
+            x = cols[inp]
+            if w32.shape != (x.shape[1],):
+                raise ValueError(
+                    f"scalingVec length {w32.shape[0]} != vector width "
+                    f"{x.shape[1]}"
+                )
+            return {out: x * jnp.asarray(w32)[None, :]}
+
+        # dtype-preserving on host (f64 in -> f64 out): fuse f32 only
+        return DevicePlan([inp], [out], apply, read_policy=F32_ONLY)
+
+    def _gather_plan(inp, out, idx):
+        idx = np.asarray(idx, np.int64)
+
+        def apply(cols):
+            x = cols[inp]
+            if len(idx) and (idx.min() < 0 or idx.max() >= x.shape[1]):
+                raise ValueError(
+                    f"indices out of range for vector width {x.shape[1]}"
+                )
+            return {out: jnp.take(x, jnp.asarray(idx), axis=1)}
+
+        return DevicePlan([inp], [out], apply, read_policy=F32_ONLY)
+
+    @register_device_fn(VectorSlicer)
+    def _vector_slicer(m):
+        idx = m.getIndices()
+        if not idx:
+            return None
+        return _gather_plan(m.getInputCol(), m.getOutputCol(), idx)
+
+    @register_device_fn(ChiSqSelectorModel)
+    def _chisq_selector(m):
+        return _gather_plan(
+            m.getFeaturesCol(), m.getOutputCol(), m.selected_features
+        )
+
+    @register_device_fn(UnivariateFeatureSelectorModel)
+    def _univariate_selector(m):
+        return _gather_plan(
+            m.getFeaturesCol(), m.getOutputCol(), m.selected_features
+        )
+
+    @register_device_fn(VarianceThresholdSelectorModel)
+    def _variance_selector(m):
+        return _gather_plan(
+            m.getFeaturesCol(), m.getOutputCol(), m.selectedFeatures
+        )
+
+    @register_device_fn(VectorAssembler)
+    def _vector_assembler(m):
+        # 'error' needs a data-dependent NaN raise, 'skip' drops rows —
+        # both are host semantics a pure device fn cannot express
+        if m.getHandleInvalid() != "keep":
+            return None
+        ins = m.getInputCols()
+        if not ins:
+            return None
+        out = m.getOutputCol()
+
+        def apply(cols):
+            parts = []
+            for name in ins:
+                c = cols[name].astype(jnp.float32)
+                parts.append(c[:, None] if c.ndim == 1 else c)
+            return {out: jnp.concatenate(parts, axis=1)}
+
+        return DevicePlan(list(ins), [out], apply)
+
+    @register_device_fn(PolynomialExpansion)
+    def _poly_expansion(m):
+        if not _x64_enabled():
+            return None  # host math is float64; f32 would drift
+        degree = int(m.getDegree())
+        inp, out = m.getInputCol(), m.getOutputCol()
+
+        def apply(cols):
+            x = cols[inp]
+            if x.ndim != 2:
+                raise ValueError(
+                    f"inputCol {inp!r} must be a vector column"
+                )
+            x = x.astype(jnp.float64)
+            plan = _expansion_plan(x.shape[1], degree)
+            outs = []
+            for idxs in plan:
+                col = x[:, idxs[0]]
+                for i in idxs[1:]:  # same multiply order as the host loop
+                    col = col * x[:, i]
+                outs.append(col)
+            return {out: jnp.stack(outs, axis=1)}
+
+        return DevicePlan([inp], [out], apply, read_policy=F64)
+
+    @register_device_fn(Interaction)
+    def _interaction(m):
+        if not _x64_enabled():
+            return None
+        names = m.getInputCols()
+        if not names or len(names) < 2:
+            return None
+        out = m.getOutputCol()
+
+        def apply(cols):
+            mats = []
+            for name in names:
+                c = cols[name].astype(jnp.float64)
+                mats.append(c[:, None] if c.ndim == 1 else c)
+            acc = mats[0]
+            for mat in mats[1:]:  # Spark foldRight: LAST varies fastest
+                acc = (acc[:, :, None] * mat[:, None, :]).reshape(
+                    acc.shape[0], -1
+                )
+            return {out: acc}
+
+        return DevicePlan(list(names), [out], apply, read_policy=F64)
+
+    @register_device_fn(Bucketizer)
+    def _bucketizer(m):
+        if not _x64_enabled():
+            return None  # indices + comparisons are float64 on host
+        if m.getInputCols():
+            return None  # multi-column mode: eager (scope: scalar mode)
+        if m.getHandleInvalid() != "keep":
+            return None  # 'error' raises on NaN, 'skip' drops rows
+        try:
+            splits = m._splits()
+        except ValueError:
+            return None  # malformed splits: the eager path raises
+        if not (np.isneginf(splits[0]) and np.isposinf(splits[-1])):
+            # closed ends ALWAYS raise on out-of-range values (Spark
+            # semantics) — a data-dependent check only the host can run
+            return None
+        n_buckets = len(splits) - 1
+        inp, out = m.getInputCol(), m.getOutputCol()
+
+        def apply(cols):
+            v = cols[inp].astype(jnp.float64)
+            idx = (
+                jnp.searchsorted(
+                    jnp.asarray(splits), v, side="right"
+                ).astype(jnp.float64)
+                - 1.0
+            )
+            idx = jnp.where(v == splits[-1], n_buckets - 1.0, idx)
+            return {out: jnp.where(jnp.isnan(v), float(n_buckets), idx)}
+
+        return DevicePlan([inp], [out], apply, read_policy=F64)
+
+
+_register_builtin()

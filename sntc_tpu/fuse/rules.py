@@ -1,0 +1,107 @@
+"""Algebraic rewrite rules the fusion pass runs BEFORE partitioning.
+
+Rule 1 (scaler folding, the r5 ``serve/fuse.py`` optimization promoted
+to a planner rewrite): a ``StandardScalerModel`` feeding a linear head
+(LogisticRegression) or an MLP first layer folds EXACTLY into the
+head's weights:
+
+    x' = (x - μ)·f        (f = 1/σ, 0 for constant features)
+    x'W + b  =  x(f⊙W) + (b - (μ⊙f)W)
+
+Folding beats fusing for these pairs — the scaler stage disappears
+entirely instead of costing an elementwise pass inside the fused
+program — so the planner applies it first and fuses whatever remains.
+The scaler is dropped only when the head is its SOLE consumer; if any
+later stage reads the scaled column the pair is left for the fusion
+partitioner, which keeps the column alive.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sntc_tpu.core.base import Transformer
+from sntc_tpu.feature.standard_scaler import StandardScalerModel
+from sntc_tpu.models.logistic_regression import LogisticRegressionModel
+from sntc_tpu.models.mlp import (
+    MultilayerPerceptronClassificationModel,
+    _layer_sizes,
+)
+
+
+def _fold_into_lr(
+    scaler: StandardScalerModel, model: LogisticRegressionModel
+) -> LogisticRegressionModel:
+    mu, f = scaler.affine()
+    W = model.coefficientMatrix.astype(np.float64)  # [K, D]
+    b = model.interceptVector.astype(np.float64)
+    W2 = W * f[None, :]
+    b2 = b - W2 @ mu
+    folded = LogisticRegressionModel(
+        coefficient_matrix=W2.astype(np.float32),
+        intercepts=b2.astype(np.float32),
+        is_binomial=model.is_binomial,
+    )
+    folded.setParams(**model.paramValues())
+    folded.set("featuresCol", scaler.getInputCol())
+    return folded
+
+
+def _fold_into_mlp(
+    scaler: StandardScalerModel, model: MultilayerPerceptronClassificationModel
+) -> MultilayerPerceptronClassificationModel:
+    mu, f = scaler.affine()
+    layers = tuple(int(v) for v in model.getLayers())
+    d_in, d_h = _layer_sizes(layers)[0]
+    theta = model.weights.astype(np.float64).copy()
+    W1 = theta[: d_in * d_h].reshape(d_in, d_h)
+    b1 = theta[d_in * d_h : d_in * d_h + d_h]
+    W1_new = f[:, None] * W1
+    b1_new = b1 - (mu * f) @ W1
+    theta[: d_in * d_h] = W1_new.reshape(-1)
+    theta[d_in * d_h : d_in * d_h + d_h] = b1_new
+    folded = MultilayerPerceptronClassificationModel(
+        weights=theta.astype(np.float32), layers=list(layers)
+    )
+    folded.setParams(**{
+        k: v for k, v in model.paramValues().items() if k != "layers"
+    })
+    folded.set("featuresCol", scaler.getInputCol())
+    return folded
+
+
+_FOLDABLE = {
+    LogisticRegressionModel: _fold_into_lr,
+    MultilayerPerceptronClassificationModel: _fold_into_mlp,
+}
+
+
+def _consumes(stage: Transformer, col: str) -> bool:
+    # total, not heuristic: Transformer.input_columns() covers the standard
+    # input params and is overridable by stages with nonstandard ones
+    return col in stage.input_columns()
+
+
+def fold_scalers(stages: list) -> list:
+    """Apply rule 1 over a fitted stage list; non-matching patterns pass
+    through untouched.  Returns a NEW list (input never mutated)."""
+    out: list = []
+    i = 0
+    while i < len(stages):
+        s = stages[i]
+        nxt = stages[i + 1] if i + 1 < len(stages) else None
+        fold = _FOLDABLE.get(type(nxt)) if nxt is not None else None
+        if (
+            isinstance(s, StandardScalerModel)
+            and fold is not None
+            and nxt.getFeaturesCol() == s.getOutputCol()
+            and not any(
+                _consumes(later, s.getOutputCol()) for later in stages[i + 2:]
+            )
+        ):
+            out.append(fold(s, nxt))
+            i += 2
+        else:
+            out.append(s)
+            i += 1
+    return out

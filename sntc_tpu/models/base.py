@@ -192,6 +192,18 @@ class ClassificationModel(ClassifierParams, Model):
         fused device program (callers fall back to the sync transform)."""
         return None
 
+    def has_device_serve(self) -> bool:
+        """True when ``_predict_all_dev`` returns a real packed program
+        for THIS model — the static capability the fusion planner
+        (``sntc_tpu.fuse``) checks before fusing a head into a segment.
+        Subclasses whose device path is conditional (e.g. gaussian
+        NaiveBayes) must override; ``_predict_all_dev`` must never
+        return None when this returns True."""
+        return (
+            type(self)._predict_all_dev
+            is not ClassificationModel._predict_all_dev
+        )
+
     def _predict_raw_prob_host(self, X: np.ndarray):
         """Optional pure-host (numpy) predict path, or None.  Used for
         micro-batches below the host-serve crossover: at small batch sizes

@@ -183,8 +183,13 @@ class LinearSVCModel(_SvcParams, ClassificationModel):
         return m
 
     def _margin(self, X: np.ndarray) -> np.ndarray:
+        # C-layout pinned: BLAS accumulates the f64 matvec in stride
+        # order, so an F-contiguous feature matrix (the assembler's
+        # stacked-.T fast path) rounds ~1e-14 differently than the same
+        # values laid out C-contiguously (what a fused segment
+        # materializes) — normalize so the margin is layout-invariant
         return (
-            X.astype(np.float64, copy=False) @ self.coefficients
+            np.ascontiguousarray(X, dtype=np.float64) @ self.coefficients
             + self.intercept
         )
 

@@ -304,6 +304,11 @@ class NaiveBayesModel(_NbParams, ClassificationModel):
         e = np.exp(shifted)
         return e / e.sum(axis=1, keepdims=True)
 
+    def has_device_serve(self) -> bool:
+        # the gaussian log-likelihood runs in float64 on host (class
+        # variances cancel in f32) — no packed device program to fuse
+        return self.getModelType() != "gaussian"
+
     def _predict_all_dev(self, X: np.ndarray):
         if self.getModelType() == "gaussian":
             return None  # host fallback path builds the columns

@@ -1,5 +1,5 @@
 from sntc_tpu.serve.transform import BatchPredictor
-from sntc_tpu.serve.fuse import compile_serving
+from sntc_tpu.serve.fuse import compile_pipeline, compile_serving
 from sntc_tpu.serve.netflow_source import (
     NetFlowDirSource,
     PcapDirSource,
@@ -16,6 +16,7 @@ from sntc_tpu.serve.streaming import (
 
 __all__ = [
     "BatchPredictor",
+    "compile_pipeline",
     "compile_serving",
     "StreamingQuery",
     "FileStreamSource",

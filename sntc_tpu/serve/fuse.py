@@ -1,112 +1,22 @@
-"""Serving-time stage fusion — fold StandardScaler into model weights.
+"""Serving-time pipeline compilation — moved to :mod:`sntc_tpu.fuse`.
 
-The serving hot path (config 5 [B:11]) runs VectorAssembler → scaler →
-classifier per micro-batch.  The scaler is an affine map, so for linear
-heads and MLP first layers it folds EXACTLY into the weights:
-
-    x' = (x - μ)·f        (f = 1/σ, 0 for constant features)
-    x'W + b  =  x(f⊙W) + (b - (μ⊙f)W)
-
-``compile_serving`` rewrites a fitted PipelineModel, merging each
-(StandardScalerModel, LogisticRegressionModel | MLP model) pair into one
-stage that consumes the scaler's input column — one fewer full pass over
-every batch, and the whole predict stays in a single jit program.  This
-is the kind of cross-stage fusion Spark's whole-stage codegen does for
-relational operators (SURVEY.md §2.6), applied to the ML pipeline.
+This module was the r5 pairwise scaler→classifier fold.  r9 promoted it
+into a whole-pipeline fusion compiler (``sntc_tpu/fuse/``): the scaler
+fold is now rewrite rule 1 of that pass (``fuse.rules.fold_scalers``),
+and ``compile_serving`` — kept here as the stable import path — aliases
+:func:`sntc_tpu.fuse.compile_pipeline`, which additionally partitions
+the pipeline into maximal fusible segments and jit-compiles each into
+one device program (see ``docs/PERFORMANCE.md``, "Whole-pipeline
+fusion").
 """
 
 from __future__ import annotations
 
-import numpy as np
+from sntc_tpu.fuse import compile_pipeline, compile_serving
+from sntc_tpu.fuse.rules import _fold_into_lr, _fold_into_mlp, fold_scalers
 
-from sntc_tpu.core.base import PipelineModel, Transformer
-from sntc_tpu.feature.standard_scaler import StandardScalerModel
-from sntc_tpu.models.logistic_regression import LogisticRegressionModel
-from sntc_tpu.models.mlp import (
-    MultilayerPerceptronClassificationModel,
-    _layer_sizes,
-)
-
-
-def _fold_into_lr(
-    scaler: StandardScalerModel, model: LogisticRegressionModel
-) -> LogisticRegressionModel:
-    mu, f = scaler.affine()
-    W = model.coefficientMatrix.astype(np.float64)  # [K, D]
-    b = model.interceptVector.astype(np.float64)
-    W2 = W * f[None, :]
-    b2 = b - W2 @ mu
-    folded = LogisticRegressionModel(
-        coefficient_matrix=W2.astype(np.float32),
-        intercepts=b2.astype(np.float32),
-        is_binomial=model.is_binomial,
-    )
-    folded.setParams(**model.paramValues())
-    folded.set("featuresCol", scaler.getInputCol())
-    return folded
-
-
-def _fold_into_mlp(
-    scaler: StandardScalerModel, model: MultilayerPerceptronClassificationModel
-) -> MultilayerPerceptronClassificationModel:
-    mu, f = scaler.affine()
-    layers = tuple(int(v) for v in model.getLayers())
-    d_in, d_h = _layer_sizes(layers)[0]
-    theta = model.weights.astype(np.float64).copy()
-    W1 = theta[: d_in * d_h].reshape(d_in, d_h)
-    b1 = theta[d_in * d_h : d_in * d_h + d_h]
-    W1_new = f[:, None] * W1
-    b1_new = b1 - (mu * f) @ W1
-    theta[: d_in * d_h] = W1_new.reshape(-1)
-    theta[d_in * d_h : d_in * d_h + d_h] = b1_new
-    folded = MultilayerPerceptronClassificationModel(
-        weights=theta.astype(np.float32), layers=list(layers)
-    )
-    folded.setParams(**{
-        k: v for k, v in model.paramValues().items() if k != "layers"
-    })
-    folded.set("featuresCol", scaler.getInputCol())
-    return folded
-
-
-_FOLDABLE = {
-    LogisticRegressionModel: _fold_into_lr,
-    MultilayerPerceptronClassificationModel: _fold_into_mlp,
-}
-
-
-def _consumes(stage, col: str) -> bool:
-    # total, not heuristic: Transformer.input_columns() covers the standard
-    # input params and is overridable by stages with nonstandard ones
-    return col in stage.input_columns()
-
-
-def compile_serving(pipeline: PipelineModel) -> PipelineModel:
-    """Return an equivalent PipelineModel with scaler→classifier pairs
-    fused (non-matching stage patterns pass through untouched).
-
-    The scaler stage is dropped only when the classifier is its SOLE
-    consumer — if any later stage also reads the scaled column, the pair
-    is left unfused so that column still exists at transform time.
-    """
-    stages = list(pipeline.getStages())
-    out = []
-    i = 0
-    while i < len(stages):
-        s = stages[i]
-        nxt = stages[i + 1] if i + 1 < len(stages) else None
-        fold = _FOLDABLE.get(type(nxt)) if nxt is not None else None
-        if (
-            isinstance(s, StandardScalerModel)
-            and fold is not None
-            and nxt.getFeaturesCol() == s.getOutputCol()
-            and not any(
-                _consumes(later, s.getOutputCol()) for later in stages[i + 2:]
-            )
-        ):
-            out.append(fold(s, nxt))
-            i += 2
-        else:
-            out.append(s)
-            i += 1
-    return PipelineModel(stages=out)
+__all__ = [
+    "compile_pipeline",
+    "compile_serving",
+    "fold_scalers",
+]

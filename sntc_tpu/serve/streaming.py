@@ -850,6 +850,9 @@ class StreamingQuery:
         src_stats = getattr(self.source, "prefetch_stats", None)
         if src_stats is not None:
             stats["prefetch"] = src_stats()
+        fusion = self.predictor.fusion_stats()
+        if fusion is not None:
+            stats["fusion"] = fusion
         return stats
 
     def _commit_batch(self, batch_id: int, intent: dict, *, n_rows: int,

@@ -192,6 +192,16 @@ class BatchPredictor:
 
         return self._memo(finalize)
 
+    def fusion_stats(self) -> Union[dict, None]:
+        """Whole-pipeline-fusion evidence when the wrapped model contains
+        fused segments (``sntc_tpu.fuse``): segment count, per-signature
+        compile ledger (flat after warmup under shape buckets — padded
+        batches reuse the bucket's program), fallbacks, and the process
+        transfer ledger.  None for unfused models."""
+        from sntc_tpu.fuse import fusion_stats
+
+        return fusion_stats(self.model)
+
     def predict_batch(
         self, batch: Union[pa.RecordBatch, pa.Table]
     ) -> pa.Table:

@@ -25,7 +25,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from sntc_tpu.core.base import Estimator, Model
+from sntc_tpu.core.base import Estimator, Model, Pipeline, PipelineModel
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
 from sntc_tpu.resilience import (
@@ -54,10 +54,90 @@ def _is_batched(estimator, grid) -> bool:
     )
 
 
+def _pipeline_grid_plan(estimator, grid):
+    """``(prefix_stages, head_estimator)`` when ``estimator`` is a
+    Pipeline whose grid params ALL target its final stage (an
+    Estimator) — the plan that lets tuning fit the feature prefix ONCE
+    per fold/split and sweep only the head.  None otherwise (including
+    an empty grid, where there is nothing to sweep).
+
+    Name-based grids on a Pipeline are resolved against the final
+    estimator by definition; a grid key no stage can own still fails
+    loudly in ``copy`` exactly as before."""
+    if not isinstance(estimator, Pipeline):
+        return None
+    keys = set().union(*grid) if grid else set()
+    if not keys:
+        return None
+    stages = estimator.getStages()
+    if not stages or not isinstance(stages[-1], Estimator):
+        return None
+    head = stages[-1]
+    if not all(head.hasParam(k) for k in keys):
+        return None
+    return list(stages[:-1]), head
+
+
+def _estimator_reads(head) -> list:
+    """Columns the head estimator's fit consumes: its declared input
+    columns (``PipelineStage.input_columns`` — overridable by stages
+    with nonstandard input params) plus label/weight, which only exist
+    at fit time — so the fused prefix keeps every column the head sweep
+    needs."""
+    out = list(head.input_columns())
+    for name in ("labelCol", "weightCol"):
+        if not head.hasParam(name) or not head.isDefined(name):
+            continue
+        val = head.getOrDefault(name)
+        if val:
+            out.append(val)
+    return out
+
+
+def _fit_prefix_transform(prefix_stages, head, frame: Frame):
+    """Fit the feature prefix on ``frame`` and transform it ONCE through
+    the whole-pipeline fusion compiler (``sntc_tpu.fuse``): one device
+    program per fusible run instead of a per-stage host round trip, and
+    the result is reused across every grid point.  Returns
+    ``(prefix PipelineModel, fused prefix or None, transformed frame)``."""
+    from sntc_tpu.fuse import compile_pipeline
+
+    if not prefix_stages:
+        return PipelineModel(stages=[]), None, frame
+    prefix = Pipeline(stages=list(prefix_stages)).fit(frame)
+    fused = compile_pipeline(
+        prefix, keep=_estimator_reads(head), fuse_heads=False
+    )
+    return prefix, fused, fused.transform(frame)
+
+
+def _fit_with_params(estimator, frame: Frame, params, plan=None):
+    """One full fit of ``estimator`` under a grid-point override map,
+    honoring the pipeline-grid plan (params bind to the head stage)."""
+    if plan is None:
+        return estimator.copy(params).fit(frame)
+    prefix_stages, head = plan
+    return Pipeline(
+        stages=list(prefix_stages) + [head.copy(params)]
+    ).fit(frame)
+
+
 def _grid_fit(estimator, train: Frame, grid):
     """Yields one fitted model per grid point, in order: one vmapped
     program when the estimator supports it, otherwise a sequential loop
-    (lazy, so the caller holds at most one sequential model at a time)."""
+    (lazy, so the caller holds at most one sequential model at a time).
+    Pipeline estimators with a head-only grid fit the feature prefix
+    ONCE and sweep just the head (batched when the head supports it),
+    yielding full PipelineModels."""
+    plan = _pipeline_grid_plan(estimator, grid)
+    if plan is not None:
+        prefix_stages, head = plan
+        prefix, _, head_train = _fit_prefix_transform(
+            prefix_stages, head, train
+        )
+        for model in _grid_fit(head, head_train, grid):
+            yield PipelineModel(stages=prefix.getStages() + [model])
+        return
     if _is_batched(estimator, grid):
         yield from estimator._fit_grid(train, grid)
         return
@@ -168,10 +248,25 @@ class CrossValidator(_TuningParams, Estimator):
             [[] for _ in grid] if self.getCollectSubModels() else None
         )
 
-        _warn_parallelism_noop(self.estimator, grid, self.getParallelism())
+        plan = _pipeline_grid_plan(self.estimator, grid)
+        # the hoisted head is what actually sweeps the grid — warn about
+        # ITS batching capability, not the (never-batched) Pipeline shell
+        _warn_parallelism_noop(
+            self.estimator if plan is None else plan[1], grid,
+            self.getParallelism(),
+        )
         if self.getFaultTolerant():
             self._fit_folds_tolerant(frame, fold_of, k, grid, metrics,
-                                     sub_models)
+                                     sub_models, plan)
+        elif plan is not None:
+            # Pipeline estimator, head-only grid: per fold, fit the
+            # feature prefix ONCE and push train AND valid through the
+            # fused prefix program once — every grid point reuses the
+            # on-device-transformed features instead of re-running the
+            # whole feature chain (sntc_tpu.fuse; the head sweep still
+            # batches on-device when the head supports grids)
+            self._fit_folds_pipeline(frame, fold_of, k, grid, metrics,
+                                     sub_models, plan)
         else:
             # strongest path: the whole k-fold × grid sweep as one vmapped
             # device program (folds are per-lane weight masks; data uploads
@@ -218,7 +313,9 @@ class CrossValidator(_TuningParams, Estimator):
         else:
             avg = metrics.mean(axis=1)
         best_idx = int(np.argmax(avg)) if larger else int(np.argmin(avg))
-        refit = lambda: self.estimator.copy(grid[best_idx]).fit(frame)
+        refit = lambda: _fit_with_params(
+            self.estimator, frame, grid[best_idx], plan
+        )
         if self.getFaultTolerant():
             # the final refit deserves the same transient-flake cover as
             # the cells — losing the whole surviving sweep to one blip
@@ -239,14 +336,45 @@ class CrossValidator(_TuningParams, Estimator):
             estimatorParamMaps=grid,
         )
 
+    def _fit_folds_pipeline(self, frame, fold_of, k, grid, metrics,
+                            sub_models, plan) -> None:
+        """The hoisted pipeline sweep: per fold, the feature prefix is
+        fit once and both splits flow through the fused prefix program
+        once; grid points fit and score on the ALREADY-transformed
+        frames (metrics are identical to fitting the whole pipeline per
+        cell — the prefix has no grid params by construction).
+        Sub-models are full PipelineModels, as the sequential path
+        produces."""
+        prefix_stages, head = plan
+        for fold in range(k):
+            prefix, fused_prefix, head_train = _fit_prefix_transform(
+                prefix_stages, head, frame.filter(fold_of != fold)
+            )
+            head_valid = (
+                fused_prefix.transform(frame.filter(fold_of == fold))
+                if fused_prefix is not None
+                else frame.filter(fold_of == fold)
+            )
+            for gi, model in enumerate(_grid_fit(head, head_train, grid)):
+                metrics[gi, fold] = self.evaluator.evaluate(
+                    model.transform(head_valid)
+                )
+                if sub_models is not None:
+                    sub_models[gi].append(
+                        PipelineModel(stages=prefix.getStages() + [model])
+                    )
+
     def _fit_folds_tolerant(self, frame, fold_of, k, grid, metrics,
-                            sub_models) -> None:
+                            sub_models, plan=None) -> None:
         """Per-(fold, grid-point) execution under the resilience policy:
         each cell fit+evaluate retries per ``retryPolicy`` (site
         ``cv.fit``), and on exhaustion the cell records NaN with a
         structured ``cv_cell_degraded`` event — the grid search
         continues.  Cell-granular by construction: the batched vmapped
-        sweep cannot isolate one lane's failure."""
+        sweep cannot isolate one lane's failure (and the pipeline-grid
+        plan's prefix hoist is likewise skipped — a cell is the WHOLE
+        pipeline fit, so one cell's poison cannot leak into another's
+        shared features)."""
         policy = self.retryPolicy or _DEFAULT_CV_POLICY
         for fold in range(k):
             valid = frame.filter(fold_of == fold)
@@ -254,7 +382,9 @@ class CrossValidator(_TuningParams, Estimator):
             for gi, params in enumerate(grid):
                 def _cell(params=params):
                     fault_point("cv.fit")
-                    model = self.estimator.copy(params).fit(train)
+                    model = _fit_with_params(
+                        self.estimator, train, params, plan
+                    )
                     return model, self.evaluator.evaluate(
                         model.transform(valid)
                     )
@@ -391,18 +521,50 @@ class TrainValidationSplit(_TvsParams, Estimator):
         sub_models: Optional[List[Model]] = (
             [] if self.getCollectSubModels() else None
         )
-        _warn_parallelism_noop(self.estimator, grid, self.getParallelism())
-        for model in _grid_fit(self.estimator, train, grid):
-            metrics.append(self.evaluator.evaluate(model.transform(valid)))
-            if sub_models is not None:
-                sub_models.append(model)
+        plan = _pipeline_grid_plan(self.estimator, grid)
+        # the hoisted head is what actually sweeps the grid — warn about
+        # ITS batching capability, not the (never-batched) Pipeline shell
+        _warn_parallelism_noop(
+            self.estimator if plan is None else plan[1], grid,
+            self.getParallelism(),
+        )
+        if plan is not None:
+            # pipeline-grid hoist (mirrors CrossValidator): the feature
+            # prefix fits once and BOTH splits flow through the fused
+            # prefix program once; only the head sweeps the grid
+            prefix_stages, head = plan
+            prefix, fused_prefix, head_train = _fit_prefix_transform(
+                prefix_stages, head, train
+            )
+            head_valid = (
+                fused_prefix.transform(valid)
+                if fused_prefix is not None
+                else valid
+            )
+            for model in _grid_fit(head, head_train, grid):
+                metrics.append(
+                    self.evaluator.evaluate(model.transform(head_valid))
+                )
+                if sub_models is not None:
+                    sub_models.append(
+                        PipelineModel(stages=prefix.getStages() + [model])
+                    )
+        else:
+            for model in _grid_fit(self.estimator, train, grid):
+                metrics.append(
+                    self.evaluator.evaluate(model.transform(valid))
+                )
+                if sub_models is not None:
+                    sub_models.append(model)
         arr = np.asarray(metrics)
         best_idx = (
             int(np.argmax(arr))
             if self.evaluator.isLargerBetter()
             else int(np.argmin(arr))
         )
-        best_model = self.estimator.copy(grid[best_idx]).fit(frame)
+        best_model = _fit_with_params(
+            self.estimator, frame, grid[best_idx], plan
+        )
         return TrainValidationSplitModel(
             bestModel=best_model, validationMetrics=metrics,
             bestIndex=best_idx, subModels=sub_models,

@@ -10,6 +10,7 @@ host-visible phases (ingest, fit, transform).
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Dict, Optional
 
@@ -25,6 +26,66 @@ def profile_trace(log_dir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+class TransferLedger:
+    """Host↔device transfer accounting for the fused serving path.
+
+    The whole-pipeline fusion compiler (``sntc_tpu.fuse``) exists to
+    collapse per-stage host round trips into one program; this ledger is
+    the EVIDENCE — every fused-segment dispatch records how many host
+    arrays it uploaded and how many device outputs its finalize
+    materialized.  Counts are per-DISPATCH (one fused program call):
+    the per-MICRO-BATCH evidence the bench journals divides the upload/
+    download deltas by the ENGINE's committed batch count, so a pipeline
+    broken into N segments honestly reports N uploads per batch instead
+    of hiding behind a per-dispatch ratio that is ~1 by construction.
+    Thread-safe: the pipelined engine dispatches on the engine thread
+    and finalizes on the delivery thread.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.dispatches = 0
+        self.uploads = 0
+        self.downloads = 0
+        self.upload_bytes = 0
+        self.download_bytes = 0
+
+    def record_uploads(self, count: int, nbytes: int = 0) -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.uploads += int(count)
+            self.upload_bytes += int(nbytes)
+
+    def record_downloads(self, count: int, nbytes: int = 0) -> None:
+        with self._lock:
+            self.downloads += int(count)
+            self.download_bytes += int(nbytes)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "dispatches": self.dispatches,
+                "uploads": self.uploads,
+                "downloads": self.downloads,
+                "upload_bytes": self.upload_bytes,
+                "download_bytes": self.download_bytes,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.dispatches = self.uploads = self.downloads = 0
+            self.upload_bytes = self.download_bytes = 0
+
+
+# process-global instance the fused segments write to; bench/tests diff
+# snapshots around a measured window (see sntc_tpu.fuse.planner)
+_TRANSFER_LEDGER = TransferLedger()
+
+
+def transfer_ledger() -> TransferLedger:
+    return _TRANSFER_LEDGER
 
 
 class StepTimer:

@@ -74,8 +74,14 @@ def test_non_matching_stages_untouched(mesh8):
     assert len(compile_serving(pm2).getStages()) == 1
 
 
-def test_no_fold_when_later_stage_consumes_scaled(mesh8):
-    """The scaler must survive if another stage also reads its output."""
+def test_no_fold_when_later_stage_consumes_scaled(mesh8, monkeypatch):
+    """The scaler's OUTPUT must survive if another stage also reads it:
+    the weight fold is blocked, and the planner instead fuses
+    scaler+head into one segment that keeps 'scaled' materialized for
+    the second consumer."""
+    from sntc_tpu.fuse import FusedSegment
+
+    monkeypatch.setenv("SNTC_SERVE_HOST_ROWS", "0")  # staged = device path
     f = _frame(seed=3)
     pm = _pipeline(
         LogisticRegression(mesh=mesh8, featuresCol="scaled", maxIter=30), mesh8
@@ -86,7 +92,11 @@ def test_no_fold_when_later_stage_consumes_scaled(mesh8):
                                 probabilityCol="pr2").fit(scaler.transform(f))
     pm3 = PipelineModel(stages=[scaler, lr, second])
     fused = compile_serving(pm3)
-    assert len(fused.getStages()) == 3  # untouched: "scaled" has 2 consumers
+    stages = fused.getStages()
+    assert len(stages) == 2  # [FusedSegment(scaler+lr), second]
+    assert isinstance(stages[0], FusedSegment)
+    assert "scaled" in stages[0]._live_writes  # 2nd consumer keeps it live
     a, b = pm3.transform(f), fused.transform(f)
+    np.testing.assert_array_equal(a["scaled"], b["scaled"])
     np.testing.assert_array_equal(a["prediction"], b["prediction"])
     np.testing.assert_array_equal(a["p2"], b["p2"])

@@ -1,0 +1,394 @@
+"""Whole-pipeline fusion compiler (sntc_tpu.fuse): bitwise parity of the
+fused path against the staged serving path across classifier heads, with
+and without shape buckets; fallback partitioning around non-fusible
+stages; the transfer-ledger single-upload/single-download contract; the
+CrossValidator pipeline-grid hoist; and the registry⇔docs drift check."""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.base import Pipeline, PipelineModel
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.feature import (
+    DCT,
+    MinMaxScaler,
+    PCA,
+    PolynomialExpansion,
+    StandardScaler,
+    VectorAssembler,
+)
+from sntc_tpu.fuse import (
+    FusedSegment,
+    compile_pipeline,
+    fused_segments,
+    fusion_stats,
+)
+from sntc_tpu.models import (
+    LinearSVC,
+    LogisticRegression,
+    MultilayerPerceptronClassifier,
+    NaiveBayes,
+    RandomForestClassifier,
+)
+from sntc_tpu.serve.transform import BatchPredictor
+from sntc_tpu.utils.profiling import transfer_ledger
+
+
+@pytest.fixture(autouse=True)
+def _device_staged_path(monkeypatch):
+    """Parity target is the staged DEVICE path: the host-serve crossover
+    (SNTC_SERVE_HOST_ROWS) would route small staged batches through the
+    float64 numpy predict, which is a different numerical path by
+    design — fused serving always runs on device."""
+    monkeypatch.setenv("SNTC_SERVE_HOST_ROWS", "0")
+
+
+D = 6
+
+
+def _scalar_frame(n=300, seed=0, nan_rows=0):
+    """Raw scalar columns c0..c5 + label (the CSV-shaped serving input);
+    ``nan_rows`` poisons the first rows of c1 for handleInvalid tests."""
+    rng = np.random.default_rng(seed)
+    X = np.abs(rng.normal(3.0, 2.0, size=(n, D))).astype(np.float32)
+    X[:, D - 1] = 5.0  # constant feature exercises span/std == 0 paths
+    cols = {f"c{i}": X[:, i].copy() for i in range(D)}
+    if nan_rows:
+        c1 = cols["c1"]
+        c1[:nan_rows] = np.nan
+    cols["label"] = (X[:, 0] > 3.0).astype(np.float64)
+    return Frame(cols)
+
+
+def _head_pipeline(head, handle_invalid="error"):
+    return Pipeline(stages=[
+        VectorAssembler(inputCols=[f"c{i}" for i in range(D)],
+                        outputCol="features",
+                        handleInvalid=handle_invalid),
+        MinMaxScaler(inputCol="features", outputCol="scaled"),
+        head,
+    ])
+
+
+def _heads(mesh):
+    return {
+        "lr": LogisticRegression(mesh=mesh, featuresCol="scaled",
+                                 maxIter=30),
+        "mlp": MultilayerPerceptronClassifier(
+            mesh=mesh, featuresCol="scaled", layers=[D, 8, 2], maxIter=30
+        ),
+        "nb": NaiveBayes(mesh=mesh, featuresCol="scaled",
+                         modelType="multinomial"),
+        "svc": LinearSVC(mesh=mesh, featuresCol="scaled", maxIter=30),
+        "rf": RandomForestClassifier(mesh=mesh, featuresCol="scaled",
+                                     numTrees=5, maxDepth=4, seed=0),
+    }
+
+
+def _assert_bitwise(a: Frame, b: Frame):
+    cols = [c for c in ("rawPrediction", "probability", "prediction")
+            if c in a and c in b]
+    assert cols, "no prediction columns to compare"
+    assert a.num_rows == b.num_rows
+    for c in cols:
+        np.testing.assert_array_equal(
+            np.asarray(a[c]), np.asarray(b[c]), err_msg=c
+        )
+
+
+@pytest.mark.parametrize("head_name", ["lr", "mlp", "nb", "svc", "rf"])
+def test_fused_bitwise_parity(mesh8, head_name):
+    f = _scalar_frame()
+    pm = _head_pipeline(_heads(mesh8)[head_name]).fit(f)
+    serve = f.drop("label")
+    fused = compile_pipeline(pm)
+    assert fused_segments(fused), "pipeline produced no fused segment"
+    staged_out = BatchPredictor(pm).predict_frame(serve)
+    fused_out = BatchPredictor(fused).predict_frame(serve)
+    _assert_bitwise(staged_out, fused_out)
+
+
+@pytest.mark.parametrize("head_name", ["lr", "mlp", "nb", "svc", "rf"])
+def test_fused_bitwise_parity_shape_buckets(mesh8, head_name):
+    """--shape-buckets analog: padded rows + the row-validity mask flow
+    through a row-dropping handleInvalid='skip' assembler identically on
+    the fused and staged paths (the skip stage is never fused — it runs
+    eagerly ahead of the segment and filters the mask in lockstep)."""
+    f = _scalar_frame(n=300, nan_rows=7)
+    pm = _head_pipeline(
+        _heads(mesh8)[head_name], handle_invalid="skip"
+    ).fit(f)
+    serve = f.drop("label")
+    fused = compile_pipeline(pm)
+    staged_out = BatchPredictor(pm, bucket_rows=64).predict_frame(serve)
+    fused_out = BatchPredictor(fused, bucket_rows=64).predict_frame(serve)
+    assert staged_out.num_rows == 300 - 7  # NaN rows dropped, pad stripped
+    _assert_bitwise(staged_out, fused_out)
+
+
+def test_fallback_partition_two_segments(mesh8):
+    """A non-fusible stage mid-pipeline splits the plan into two fused
+    segments bridged by the eager stage — results identical to staged."""
+    f = _scalar_frame(n=200, seed=3)
+    pm = Pipeline(stages=[
+        VectorAssembler(inputCols=[f"c{i}" for i in range(D)],
+                        outputCol="features", handleInvalid="error"),
+        MinMaxScaler(inputCol="features", outputCol="s1"),
+        # float64 host math: non-fusible without jax_enable_x64
+        PolynomialExpansion(inputCol="s1", outputCol="poly", degree=2),
+        MinMaxScaler(inputCol="poly", outputCol="s2"),
+        LogisticRegression(mesh=mesh8, featuresCol="s2", maxIter=20),
+    ]).fit(f)
+    fused = compile_pipeline(pm)
+    segs = fused_segments(fused)
+    assert len(segs) == 2  # [mm1] and [mm2 + lr head]
+    kinds = [type(s).__name__ for s in fused.getStages()]
+    assert kinds == [
+        "VectorAssembler", "FusedSegment", "PolynomialExpansion",
+        "FusedSegment",
+    ]
+    assert segs[-1]._head is not None or segs[0]._head is not None
+    serve = f.drop("label")
+    _assert_bitwise(
+        BatchPredictor(pm).predict_frame(serve),
+        BatchPredictor(fused).predict_frame(serve),
+    )
+
+
+def _vector_frame(n=256, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0.0, 1.0, size=(n, D)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    return Frame({"features": X, "label": y})
+
+
+def _deep_fused(mesh, frame):
+    """A fully-fusible 3-feature-stage + head pipeline compiled into ONE
+    segment (scaler can't fold through DCT, so it fuses instead)."""
+    pm = Pipeline(stages=[
+        StandardScaler(mesh=mesh, inputCol="features", outputCol="sc",
+                       withMean=True),
+        DCT(inputCol="sc", outputCol="dct"),
+        PCA(mesh=mesh, inputCol="dct", outputCol="pca", k=4),
+        LogisticRegression(mesh=mesh, featuresCol="pca", maxIter=20),
+    ]).fit(frame)
+    fused = compile_pipeline(pm)
+    segs = fused_segments(fused)
+    assert len(fused.getStages()) == 1 and len(segs) == 1
+    assert len(segs[0].fused_stages) == 4  # 3 feature stages + head
+    return pm, fused, segs[0]
+
+
+def test_single_upload_single_download_per_batch(mesh8):
+    f = _vector_frame()
+    pm, fused, seg = _deep_fused(mesh8, f)
+    serve = f.drop("label")
+    _assert_bitwise(
+        BatchPredictor(pm).predict_frame(serve),
+        BatchPredictor(fused).predict_frame(serve),
+    )
+    ledger = transfer_ledger()
+    before = ledger.snapshot()
+    seg_before = (seg.invocations, seg.uploads, seg.downloads)
+    out = fused.transform(serve)
+    after = ledger.snapshot()
+    assert after["dispatches"] - before["dispatches"] == 1
+    assert after["uploads"] - before["uploads"] == 1
+    assert after["downloads"] - before["downloads"] == 1
+    # per-segment counters carry the same evidence, isolated per model
+    assert (seg.invocations, seg.uploads, seg.downloads) == tuple(
+        v + 1 for v in seg_before
+    )
+    # intermediates (sc/dct/pca) live only on device — never materialized
+    for col in ("sc", "dct", "pca"):
+        assert col not in out
+    stats = fusion_stats(fused)
+    assert stats["segments"] == 1 and stats["fallbacks"] == 0
+    assert stats["uploads"] == seg.uploads
+    assert stats["downloads"] == seg.downloads
+
+
+def test_compile_ledger_flat_across_buckets(mesh8):
+    """Shape-bucketed serving keys the fused program per bucket: ragged
+    micro-batches that pad to one bucket share ONE compile."""
+    f = _vector_frame(n=64)
+    _pm, fused, seg = _deep_fused(mesh8, f)
+    predictor = BatchPredictor(fused, bucket_rows=64)
+    for n in (50, 57, 64, 41):
+        predictor.predict_frame(f.slice(0, n).drop("label"))
+    assert seg.compile_events == 1
+    assert predictor.compile_events == 1
+
+
+def test_shared_column_policy_conflict_splits_segment():
+    """Two fused stages reading ONE external column under different
+    upload policies (a casting scaler vs a dtype-preserving
+    ElementwiseProduct) must not share a segment: the first reader's
+    f32 cast would bypass the second's dtype guard.  The planner splits
+    them, the guard falls back eagerly on float64 input, and the fused
+    output stays bitwise-equal to the staged path."""
+    from sntc_tpu.feature import ElementwiseProduct
+    from sntc_tpu.parallel.context import get_default_mesh
+
+    rng = np.random.default_rng(2)
+    X64 = rng.normal(3.0, 2.0, size=(100, D))  # float64, as load_csv yields
+    f = Frame({"x": X64})
+    pm = Pipeline(stages=[
+        MinMaxScaler(inputCol="x", outputCol="a"),
+        ElementwiseProduct(inputCol="x", outputCol="b",
+                           scalingVec=[2.0] * D),
+    ]).fit(f)
+    fused = compile_pipeline(pm)
+    segs = fused_segments(fused)
+    assert len(segs) == 2  # conflict split, never one shared upload
+    staged_out = pm.transform(f)
+    fused_out = fused.transform(f)
+    for col in ("a", "b"):
+        np.testing.assert_array_equal(
+            np.asarray(staged_out[col]), np.asarray(fused_out[col]),
+            err_msg=col,
+        )
+    assert fused_out["b"].dtype == staged_out["b"].dtype
+    # the dtype-preserving segment fell back eagerly on the f64 column
+    assert sum(s.fallbacks for s in segs) == 1
+
+
+def test_keep_retains_intermediate_and_eager_fallback(mesh8):
+    f = _vector_frame(n=128)
+    pm = Pipeline(stages=[
+        StandardScaler(mesh=mesh8, inputCol="features", outputCol="sc"),
+        DCT(inputCol="sc", outputCol="dct"),
+    ]).fit(f)
+    fused = compile_pipeline(pm, keep=("sc",))
+    out = fused.transform(f)
+    staged = pm.transform(f)
+    np.testing.assert_array_equal(out["sc"], np.asarray(staged["sc"]))
+    np.testing.assert_array_equal(out["dct"], np.asarray(staged["dct"]))
+    # empty frames take the eager fallback and stay correct
+    seg = fused_segments(fused)[0]
+    before = seg.fallbacks
+    empty = fused.transform(f.slice(0, 0))
+    assert empty.num_rows == 0 and "dct" in empty
+    assert seg.fallbacks == before + 1
+
+
+def test_streaming_pipeline_stats_fusion(mesh8):
+    """The engine journals fusion evidence: fused segments dispatch per
+    micro-batch (bucket-padded batches included — the validity-mask
+    column passes through the segment untouched) and pipeline_stats()
+    exposes the compile + transfer ledgers bench config 6 reads."""
+    import tempfile
+
+    from sntc_tpu.serve import MemorySink, MemorySource, StreamingQuery
+
+    f = _vector_frame(n=64)
+    _pm, fused, seg = _deep_fused(mesh8, f)
+    serve = f.drop("label")
+    src = MemorySource([serve.slice(0, 32), serve.slice(32, 50)])
+    sink = MemorySink()
+    with tempfile.TemporaryDirectory() as tmp:
+        q = StreamingQuery(
+            fused, src, sink, tmp, max_batch_offsets=1, shape_buckets=32
+        )
+        assert q.process_available() == 2
+        stats = q.pipeline_stats()
+    fusion = stats["fusion"]
+    assert fusion["segments"] == 1
+    assert fusion["invocations"] >= 2
+    assert fusion["fallbacks"] == 0
+    assert seg.compile_events == 1  # both batches pad to the 32 bucket
+    assert [fr.num_rows for fr in sink.frames] == [32, 18]
+
+
+def test_cv_pipeline_grid_reuses_prefix(mesh8, monkeypatch):
+    """CrossValidator over a Pipeline with a head-only grid: the feature
+    prefix fits once per fold and both splits flow through the fused
+    prefix once; metrics match the naive whole-pipeline-per-cell sweep."""
+    from sntc_tpu.evaluation import MulticlassClassificationEvaluator
+    from sntc_tpu.tuning import CrossValidator, ParamGridBuilder
+
+    monkeypatch.setenv("SNTC_TUNING_BATCH", "0")  # sequential head fits
+    f = _vector_frame(n=400, seed=5)
+    grid = ParamGridBuilder().addGrid("regParam", [1e-4, 10.0]).build()
+
+    def make_pipe(reg=0.0):
+        return Pipeline(stages=[
+            MinMaxScaler(inputCol="features", outputCol="scaled"),
+            LogisticRegression(mesh=mesh8, featuresCol="scaled",
+                               maxIter=20, regParam=reg),
+        ])
+
+    cv = CrossValidator(
+        estimator=make_pipe(),
+        estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(
+            metricName="accuracy", mesh=mesh8
+        ),
+        numFolds=2,
+        seed=7,
+    )
+    model = cv.fit(f)
+    assert isinstance(model.bestModel, PipelineModel)
+    assert model.bestIndex == 0  # regParam=10 cripples the model
+
+    # the naive sweep: whole pipeline fit per (fold, grid point)
+    rng = np.random.default_rng(7)
+    fold_of = rng.integers(0, 2, size=f.num_rows)
+    expected = np.zeros((len(grid), 2))
+    ev = MulticlassClassificationEvaluator(metricName="accuracy",
+                                           mesh=mesh8)
+    for fold in range(2):
+        train = f.filter(fold_of != fold)
+        valid = f.filter(fold_of == fold)
+        for gi, params in enumerate(grid):
+            m = make_pipe(params["regParam"]).fit(train)
+            expected[gi, fold] = ev.evaluate(m.transform(valid))
+    np.testing.assert_allclose(
+        model.avgMetrics, expected.mean(axis=1), rtol=1e-6
+    )
+
+
+def test_tvs_pipeline_grid(mesh8):
+    from sntc_tpu.evaluation import MulticlassClassificationEvaluator
+    from sntc_tpu.tuning import ParamGridBuilder, TrainValidationSplit
+
+    f = _vector_frame(n=400, seed=6)
+    tvs = TrainValidationSplit(
+        estimator=Pipeline(stages=[
+            MinMaxScaler(inputCol="features", outputCol="scaled"),
+            LogisticRegression(mesh=mesh8, featuresCol="scaled",
+                               maxIter=20),
+        ]),
+        estimatorParamMaps=ParamGridBuilder()
+        .addGrid("regParam", [1e-4, 10.0]).build(),
+        evaluator=MulticlassClassificationEvaluator(
+            metricName="accuracy", mesh=mesh8
+        ),
+    )
+    model = tvs.fit(f)
+    assert isinstance(model.bestModel, PipelineModel)
+    assert model.bestIndex == 0
+    assert len(model.validationMetrics) == 2
+
+
+# registry ⇔ docs drift check (the tier-1 wiring of
+# scripts/check_fusible_stages.py, mirroring check_perf_flags)
+
+
+def _load_script(name):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(repo, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_every_feature_transformer_registered_or_documented():
+    checker = _load_script("check_fusible_stages")
+    problems = checker.check()
+    assert not problems, "\n".join(problems)
