@@ -1,0 +1,478 @@
+#!/usr/bin/env python
+"""Corrupt-input chaos for the data plane (r10).
+
+Generates seeded CSV / pcap / NetFlow corpora, corrupts them
+deterministically, and runs FULL engine passes (source → admission →
+predict → sink → commit) over the corrupt inputs.  Proof obligations:
+
+1. **no crash** — every scenario's engine drains all batches and
+   commits them (salvage degrades, never dies);
+2. **byte-identical clean output** — rows untouched by corruption
+   produce sink bytes identical to an uncorrupted reference run
+   (admission may excise rows, never perturb survivors);
+3. **every rejected row accounted for** — the row-level dead-letter
+   (``<ckpt>/dead_letter_rows/``) carries exactly the corrupted rows
+   (script-side corruption: count equality; SNTC_FAULTS-injected
+   corruption: reference rows = sink rows + dead-lettered rows).
+
+Scenarios:
+
+==================  =====================================================
+``csv_salvage``     K seeded corruptions (ragged line / garbage text /
+                    Infinity) across a CSV corpus; salvage admission
+``csv_fault_kinds`` ``source.parse`` armed with the ``ragged`` DATA kind
+                    (the SNTC_FAULTS grammar path), conservation law
+``pcap``            one capture truncated mid-record, one byte-flipped;
+                    clean captures' flows byte-identical, truncation
+                    events emitted
+``netflow``         capture torn mid-datagram: record-granular tail
+                    salvage, clean captures byte-identical
+==================  =====================================================
+
+Run directly (``python scripts/chaos_corrupt_corpus.py``) for a JSON
+verdict; ``tests/test_admission.py`` drives the same functions in
+tier-1 with a small corpus.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+# ---------------------------------------------------------------------------
+# shared plumbing
+# ---------------------------------------------------------------------------
+
+
+def _identity():
+    from sntc_tpu.core.base import Transformer
+
+    class Identity(Transformer):
+        def transform(self, frame):
+            return frame
+
+    return Identity()
+
+
+def _contract(mode: str = "salvage"):
+    from sntc_tpu.data.schema import ColumnSpec, SchemaContract
+
+    return SchemaContract(
+        {"x": ColumnSpec(fill=0.0), "y": ColumnSpec(fill=0.0)}, mode=mode
+    )
+
+
+def sink_lines(out_dir: str) -> dict:
+    """Per published batch CSV: the data lines (header stripped)."""
+    out = {}
+    for p in sorted(glob.glob(os.path.join(out_dir, "batch_*.csv"))):
+        with open(p) as f:
+            out[os.path.basename(p)] = f.read().splitlines()[1:]
+    return out
+
+
+def dead_letter_rows(ckpt_dir: str) -> list:
+    rows = []
+    pattern = os.path.join(ckpt_dir, "dead_letter_rows", "*.jsonl")
+    for p in sorted(glob.glob(pattern)):
+        with open(p) as f:
+            rows.extend(json.loads(line) for line in f if line.strip())
+    return rows
+
+
+def run_csv_engine(watch: str, out: str, ckpt: str, mode: str = "salvage"):
+    """One drained engine pass over a CSV dir with salvage admission
+    armed; returns the query (caller inspects stats/ledgers).  No
+    retry/quarantine: an unexpected error CRASHES the scenario, which
+    is exactly the proof we want."""
+    from sntc_tpu.serve.streaming import (
+        CsvDirSink,
+        FileStreamSource,
+        StreamingQuery,
+    )
+
+    q = StreamingQuery(
+        _identity(),
+        FileStreamSource(watch, parse_salvage=True),
+        CsvDirSink(out, columns=["x", "y"], durable=False),
+        ckpt,
+        max_batch_offsets=1,
+        shape_buckets=4,
+        schema_contract=_contract(mode),
+    )
+    q.process_available()
+    return q
+
+
+def write_csv_corpus(
+    watch: str, n_files: int = 4, rows: int = 12, seed: int = 0
+) -> list:
+    """Seeded two-column float corpus; returns the per-file data lines."""
+    rng = np.random.default_rng(seed)
+    os.makedirs(watch, exist_ok=True)
+    corpus = []
+    for i in range(n_files):
+        lines = [
+            f"{rng.uniform(0, 100):.4f},{rng.uniform(0, 100):.4f}"
+            for _ in range(rows)
+        ]
+        with open(os.path.join(watch, f"in_{i:03d}.csv"), "w") as f:
+            f.write("x,y\n" + "\n".join(lines) + "\n")
+        corpus.append(lines)
+    return corpus
+
+
+# ---------------------------------------------------------------------------
+# scenario 1: seeded script-side corruption, exact accounting
+# ---------------------------------------------------------------------------
+
+_CSV_CORRUPTIONS = ("ragged", "garbage", "infinity")
+
+
+def corrupt_csv_corpus(
+    watch: str, corpus: list, n_corrupt: int, seed: int
+) -> set:
+    """Corrupt ``n_corrupt`` distinct data rows in place (seeded),
+    rotating through ragged / garbage-text / Infinity; returns the
+    corrupted ``(file_idx, row_idx)`` set."""
+    rng = np.random.default_rng(seed + 1)
+    n_files, rows = len(corpus), len(corpus[0])
+    picks: set = set()
+    while len(picks) < n_corrupt:
+        picks.add(
+            (int(rng.integers(0, n_files)), int(rng.integers(0, rows)))
+        )
+    for k, (fi, ri) in enumerate(sorted(picks)):
+        lines = list(corpus[fi])
+        kind = _CSV_CORRUPTIONS[k % len(_CSV_CORRUPTIONS)]
+        if kind == "ragged":
+            lines[ri] = lines[ri] + ",999999"  # wrong field count
+        elif kind == "garbage":
+            x = lines[ri].split(",")[0]
+            lines[ri] = f"{x},@@not-a-number@@"
+        else:  # infinity
+            x = lines[ri].split(",")[0]
+            lines[ri] = f"{x},Infinity"
+        corpus[fi] = lines
+        with open(os.path.join(watch, f"in_{fi:03d}.csv"), "w") as f:
+            f.write("x,y\n" + "\n".join(lines) + "\n")
+    return picks
+
+
+def scenario_csv_salvage(
+    workdir: str, n_files: int = 4, rows: int = 12, n_corrupt: int = 7,
+    seed: int = 0,
+) -> dict:
+    """K seeded corruptions; prove no crash + byte-identical survivors
+    + dead-letter count == K."""
+    import sntc_tpu.resilience as R
+
+    R.clear()
+    ref_d = os.path.join(workdir, "csv_ref")
+    cor_d = os.path.join(workdir, "csv_corrupt")
+    ref_corpus = write_csv_corpus(
+        os.path.join(ref_d, "in"), n_files, rows, seed
+    )
+    cor_corpus = write_csv_corpus(
+        os.path.join(cor_d, "in"), n_files, rows, seed
+    )
+    run_csv_engine(
+        os.path.join(ref_d, "in"), os.path.join(ref_d, "out"),
+        os.path.join(ref_d, "ckpt"),
+    )
+    picks = corrupt_csv_corpus(
+        os.path.join(cor_d, "in"), cor_corpus, n_corrupt, seed
+    )
+    q = run_csv_engine(
+        os.path.join(cor_d, "in"), os.path.join(cor_d, "out"),
+        os.path.join(cor_d, "ckpt"),
+    )
+
+    ref_lines = sink_lines(os.path.join(ref_d, "out"))
+    got_lines = sink_lines(os.path.join(cor_d, "out"))
+    # expected = the reference output minus exactly the corrupted rows
+    expect = {}
+    for fi, name in enumerate(sorted(ref_lines)):
+        expect[name] = [
+            line
+            for ri, line in enumerate(ref_lines[name])
+            if (fi, ri) not in picks
+        ]
+    dead = dead_letter_rows(os.path.join(cor_d, "ckpt"))
+    committed = q.last_committed() + 1
+    ok = (
+        committed == n_files
+        and got_lines == expect
+        and len(dead) == n_corrupt
+        # salvage must never change a dispatched shape: every batch has
+        # `rows` input rows -> one bucket -> exactly ONE compile event
+        and q.predictor.compile_events == 1
+    )
+    return {
+        "scenario": "csv_salvage", "ok": bool(ok),
+        "committed": committed, "expected_batches": n_files,
+        "corrupted": len(picks), "dead_letter_rows": len(dead),
+        "compile_events": q.predictor.compile_events,
+        "sink_match": got_lines == expect,
+        "admission": q.admission_stats(),
+        "reasons": sorted({r["reason"] for r in dead}),
+    }
+
+
+# ---------------------------------------------------------------------------
+# scenario 2: the SNTC_FAULTS grammar path (ragged DATA kind)
+# ---------------------------------------------------------------------------
+
+
+def scenario_csv_fault_kinds(
+    workdir: str, n_files: int = 6, rows: int = 10, seed: int = 7,
+) -> dict:
+    """Arm ``source.parse`` with the ``ragged`` DATA kind (prob 0.5,
+    seeded — the ``SNTC_FAULTS=source.parse:ragged:0.5:<seed>`` path)
+    and prove the conservation law: reference rows = sink rows +
+    dead-lettered rows, zero crashes."""
+    import sntc_tpu.resilience as R
+
+    R.clear()
+    d = os.path.join(workdir, "csv_faults")
+    write_csv_corpus(os.path.join(d, "in"), n_files, rows, seed)
+    total_rows = n_files * rows
+    R.arm("source.parse", kind="ragged", prob=0.5, seed=seed, times=None)
+    try:
+        q = run_csv_engine(
+            os.path.join(d, "in"), os.path.join(d, "out"),
+            os.path.join(d, "ckpt"),
+        )
+    finally:
+        R.clear()
+    got = sum(len(v) for v in sink_lines(os.path.join(d, "out")).values())
+    dead = dead_letter_rows(os.path.join(d, "ckpt"))
+    committed = q.last_committed() + 1
+    ok = committed == n_files and got + len(dead) == total_rows
+    return {
+        "scenario": "csv_fault_kinds", "ok": bool(ok),
+        "committed": committed, "expected_batches": n_files,
+        "reference_rows": total_rows, "sink_rows": got,
+        "dead_letter_rows": len(dead),
+        "faults_injected": sum(
+            1 for e in R.recent_events()
+            if e.get("event") == "fault_injected"
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# scenario 3 & 4: binary captures (pcap / netflow)
+# ---------------------------------------------------------------------------
+
+
+def _run_capture_engine(source, out: str, ckpt: str):
+    from sntc_tpu.serve.streaming import CsvDirSink, StreamingQuery
+
+    q = StreamingQuery(
+        _identity(), source, CsvDirSink(out, durable=False), ckpt,
+        max_batch_offsets=1,
+    )
+    q.process_available()
+    return q
+
+
+def scenario_pcap(workdir: str, n_files: int = 3, seed: int = 3) -> dict:
+    """Truncate one capture mid-record and byte-flip another; prove the
+    engine drains every batch, clean captures' flow output is
+    byte-identical, and truncation surfaced as structured events."""
+    import sntc_tpu.resilience as R
+    from sntc_tpu.native.pcap import make_packet, make_pcap
+    from sntc_tpu.serve.netflow_source import PcapDirSource
+
+    R.clear()
+    R.clear_events()
+    rng = np.random.default_rng(seed)
+    caps = []
+    for i in range(n_files):
+        pkts = [
+            (
+                1000.0 + i + p * 0.01,
+                make_packet(
+                    int(rng.integers(1, 2**31)), int(rng.integers(1, 2**31)),
+                    int(rng.integers(1, 65000)), 80,
+                    payload=int(rng.integers(10, 200)),
+                ),
+            )
+            for p in range(8)
+        ]
+        caps.append(make_pcap(pkts))
+
+    def _write(d, blobs):
+        os.makedirs(d, exist_ok=True)
+        for i, blob in enumerate(blobs):
+            with open(os.path.join(d, f"cap_{i:03d}.pcap"), "wb") as f:
+                f.write(blob)
+
+    ref_d = os.path.join(workdir, "pcap_ref")
+    cor_d = os.path.join(workdir, "pcap_corrupt")
+    _write(os.path.join(ref_d, "in"), caps)
+    corrupted = list(caps)
+    corrupted[1] = caps[1][: len(caps[1]) - 37]  # torn mid-record
+    flipped = bytearray(caps[2])
+    for pos in rng.integers(24, len(flipped), size=8):
+        flipped[int(pos)] ^= 0xFF
+    corrupted[2] = bytes(flipped)
+    _write(os.path.join(cor_d, "in"), corrupted)
+
+    _run_capture_engine(
+        PcapDirSource(os.path.join(ref_d, "in")),
+        os.path.join(ref_d, "out"), os.path.join(ref_d, "ckpt"),
+    )
+    q = _run_capture_engine(
+        PcapDirSource(os.path.join(cor_d, "in")),
+        os.path.join(cor_d, "out"), os.path.join(cor_d, "ckpt"),
+    )
+    ref = sink_lines(os.path.join(ref_d, "out"))
+    got = sink_lines(os.path.join(cor_d, "out"))
+    clean = "batch_000000.csv"  # file 0 untouched
+    truncation_events = [
+        e for e in R.recent_events()
+        if e.get("event") == "parse_truncated" and e.get("format") == "pcap"
+    ]
+    committed = q.last_committed() + 1
+    ok = (
+        committed == n_files
+        and got.get(clean) == ref.get(clean)
+        and len(truncation_events) >= 1
+    )
+    return {
+        "scenario": "pcap", "ok": bool(ok), "committed": committed,
+        "expected_batches": n_files,
+        "clean_capture_match": got.get(clean) == ref.get(clean),
+        "truncation_events": len(truncation_events),
+    }
+
+
+def scenario_netflow(workdir: str, n_files: int = 3, seed: int = 5) -> dict:
+    """Tear one capture mid-datagram; prove record-granular tail
+    salvage (expected record count survives), clean captures
+    byte-identical, zero crashes."""
+    import sntc_tpu.resilience as R
+    from sntc_tpu.native.netflow import make_datagram
+    from sntc_tpu.serve.netflow_source import NetFlowDirSource
+
+    R.clear()
+    R.clear_events()
+    rng = np.random.default_rng(seed)
+
+    def _records(n):
+        out = []
+        for _ in range(n):
+            first = int(rng.integers(0, 1_000_000))
+            out.append((
+                int(rng.integers(0, 2**32)), int(rng.integers(0, 2**32)),
+                int(rng.integers(0, 65536)), int(rng.integers(0, 65536)),
+                6, 0x18, 0, int(rng.integers(1, 1000)),
+                int(rng.integers(40, 100_000)), first,
+                first + int(rng.integers(0, 60_000)), 1, 2, 0, 0,
+            ))
+        return out
+
+    blobs = [
+        make_datagram(_records(6), seq=i) + make_datagram(_records(4), seq=i)
+        for i in range(n_files)
+    ]
+
+    def _write(d, payloads):
+        os.makedirs(d, exist_ok=True)
+        for i, blob in enumerate(payloads):
+            with open(os.path.join(d, f"cap_{i:03d}.nf5"), "wb") as f:
+                f.write(blob)
+
+    ref_d = os.path.join(workdir, "nf_ref")
+    cor_d = os.path.join(workdir, "nf_corrupt")
+    _write(os.path.join(ref_d, "in"), blobs)
+    corrupted = list(blobs)
+    # tear the SECOND datagram of file 1 mid-record: 2 of its 4 records
+    # fit -> 6 + 2 rows survive at record granularity
+    torn_at = len(make_datagram([])) + 6 * 48 + (24 + 2 * 48 + 17)
+    corrupted[1] = blobs[1][:torn_at]
+    _write(os.path.join(cor_d, "in"), corrupted)
+
+    _run_capture_engine(
+        NetFlowDirSource(os.path.join(ref_d, "in")),
+        os.path.join(ref_d, "out"), os.path.join(ref_d, "ckpt"),
+    )
+    q = _run_capture_engine(
+        NetFlowDirSource(os.path.join(cor_d, "in")),
+        os.path.join(cor_d, "out"), os.path.join(cor_d, "ckpt"),
+    )
+    ref = sink_lines(os.path.join(ref_d, "out"))
+    got = sink_lines(os.path.join(cor_d, "out"))
+    clean = [f"batch_{i:06d}.csv" for i in (0, 2)]
+    torn = "batch_000001.csv"
+    truncation_events = [
+        e for e in R.recent_events()
+        if e.get("event") == "parse_truncated"
+        and e.get("format") == "netflow"
+    ]
+    committed = q.last_committed() + 1
+    ok = (
+        committed == n_files
+        and all(got.get(c) == ref.get(c) for c in clean)
+        and len(got.get(torn, [])) == 6 + 2  # record-granular salvage
+        # the surviving prefix rows are byte-identical too
+        and got.get(torn, []) == ref.get(torn, [])[: 6 + 2]
+        and len(truncation_events) >= 1
+    )
+    return {
+        "scenario": "netflow", "ok": bool(ok), "committed": committed,
+        "expected_batches": n_files,
+        "clean_capture_match": all(
+            got.get(c) == ref.get(c) for c in clean
+        ),
+        "torn_rows": len(got.get(torn, [])),
+        "expected_torn_rows": 8,
+        "truncation_events": len(truncation_events),
+    }
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def run_all(workdir: str, seed: int = 0) -> dict:
+    results = [
+        scenario_csv_salvage(workdir, seed=seed),
+        scenario_csv_fault_kinds(workdir, seed=seed + 7),
+        scenario_pcap(workdir, seed=seed + 3),
+        scenario_netflow(workdir, seed=seed + 5),
+    ]
+    return {"ok": all(r["ok"] for r in results), "scenarios": results}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a fresh tempdir)")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    workdir = args.workdir
+    if workdir is None:
+        import tempfile
+
+        workdir = tempfile.mkdtemp(prefix="chaos_corrupt_")
+    verdict = run_all(workdir, seed=args.seed)
+    print(json.dumps(verdict, indent=1))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,12 +1,16 @@
 #!/usr/bin/env python
-"""Static drift check: fault sites in code ⇔ docs/RESILIENCE.md.
+"""Static drift check: fault sites AND kinds in code ⇔ docs/RESILIENCE.md.
 
-Every ``fault_point("<site>")`` call site wired in ``sntc_tpu/`` must
-be (a) declared in ``sntc_tpu.resilience.SITES`` and (b) documented in
-the site table of ``docs/RESILIENCE.md`` — and vice versa: a
-documented or declared site with no live call site is drift too.
-Wired as a tier-1 test (``tests/test_supervision.py``) so the three
-sources cannot diverge silently.
+Every ``fault_point("<site>")`` / ``fault_data("<site>", ...)`` call
+site wired in ``sntc_tpu/`` must be (a) declared in
+``sntc_tpu.resilience.SITES`` and (b) documented in the site table of
+``docs/RESILIENCE.md`` — and vice versa: a documented or declared site
+with no live call site is drift too.  The SNTC_FAULTS *kind*
+vocabulary (``sntc_tpu.resilience.ALL_KINDS`` — exc/io/timeout/kill
+plus the r10 data-corruption kinds corrupt_bytes/truncate/ragged) must
+likewise match the marker-delimited kinds table in the docs.  Wired as
+a tier-1 test (``tests/test_supervision.py``) so code, grammar, and
+docs cannot diverge silently.
 
 Exit 0 when consistent; exit 1 with a per-direction report otherwise.
 """
@@ -19,9 +23,15 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-_CALL_RE = re.compile(r"""fault_point\(\s*["']([A-Za-z0-9_.]+)["']\s*\)""")
+_CALL_RE = re.compile(
+    r"""fault_(?:point|data)\(\s*["']([A-Za-z0-9_.]+)["']"""
+)
 # docs table rows: | `site.name` | description |
 _DOC_RE = re.compile(r"^\|\s*`([A-Za-z0-9_.]+)`\s*\|", re.MULTILINE)
+# the kinds table lives between these markers in docs/RESILIENCE.md
+_KINDS_BEGIN = "<!-- fault-kinds:begin -->"
+_KINDS_END = "<!-- fault-kinds:end -->"
+_KIND_ROW_RE = re.compile(r"^\|\s*`([A-Za-z0-9_]+)`\s*\|", re.MULTILINE)
 
 
 def code_sites(root: str = None) -> set:
@@ -56,6 +66,46 @@ def documented_sites(doc_path: str = None) -> set:
     return {s for s in _DOC_RE.findall(text) if "." in s and s != "site"}
 
 
+def declared_kinds() -> set:
+    sys.path.insert(0, REPO)
+    from sntc_tpu.resilience import ALL_KINDS
+
+    return set(ALL_KINDS)
+
+
+def documented_kinds(doc_path: str = None) -> set:
+    doc_path = doc_path or os.path.join(REPO, "docs", "RESILIENCE.md")
+    with open(doc_path) as f:
+        text = f.read()
+    if _KINDS_BEGIN not in text or _KINDS_END not in text:
+        return set()  # reported as a drift problem by check()
+    table = text.split(_KINDS_BEGIN, 1)[1].split(_KINDS_END, 1)[0]
+    return {k for k in _KIND_ROW_RE.findall(table) if k != "kind"}
+
+
+def check_kinds() -> list:
+    """Kind-vocabulary drift complaints (empty = ok)."""
+    declared = declared_kinds()
+    documented = documented_kinds()
+    if not documented:
+        return [
+            "docs/RESILIENCE.md is missing the marker-delimited fault-"
+            f"kinds table ({_KINDS_BEGIN} ... {_KINDS_END})"
+        ]
+    problems = []
+    for kind in sorted(declared - documented):
+        problems.append(
+            f"fault kind {kind!r} is in sntc_tpu.resilience.ALL_KINDS "
+            "but missing from the docs/RESILIENCE.md kinds table"
+        )
+    for kind in sorted(documented - declared):
+        problems.append(
+            f"docs/RESILIENCE.md kinds table documents {kind!r} but the "
+            "SNTC_FAULTS grammar (ALL_KINDS) does not accept it"
+        )
+    return problems
+
+
 def check() -> list:
     """Returns a list of human-readable drift complaints (empty = ok)."""
     in_code = code_sites()
@@ -82,6 +132,7 @@ def check() -> list:
             f"docs/RESILIENCE.md documents {site!r} but no "
             f"fault_point({site!r}) call site exists in sntc_tpu/"
         )
+    problems.extend(check_kinds())
     return problems
 
 
@@ -93,7 +144,11 @@ def main() -> int:
             print(f"  - {p}", file=sys.stderr)
         return 1
     n = len(code_sites())
-    print(f"ok: {n} fault sites consistent across code, SITES, and docs")
+    k = len(declared_kinds())
+    print(
+        f"ok: {n} fault sites and {k} kinds consistent across code, "
+        "SITES/ALL_KINDS, and docs"
+    )
     return 0
 
 

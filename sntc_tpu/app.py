@@ -290,11 +290,24 @@ def cmd_serve(args) -> int:
     # source's background prefetch; --shape-buckets pads micro-batches
     # to power-of-two row buckets so predict compiles once per bucket
     pipelined = args.pipeline_depth > 1
+    # --row-policy salvage|permissive arms the data-plane admission
+    # layer against the canonical CICIDS2017 contract: poison ROWS are
+    # excised (and journaled to <checkpoint>/dead_letter_rows/ with
+    # file/line/raw/reason) while the clean rows keep serving — and the
+    # CSV parser itself salvages ragged lines instead of failing the
+    # batch.  "strict" keeps today's trust-the-input behavior: the
+    # whole batch fails and the poison-batch machinery owns it.
+    contract = None
+    if args.row_policy != "strict":
+        from sntc_tpu.data import CICIDS2017_CONTRACT
+
+        contract = CICIDS2017_CONTRACT.with_mode(args.row_policy)
     q = StreamingQuery(
         model,
         FileStreamSource(
             args.watch,
             prefetch_batches=(args.prefetch_batches if pipelined else 0),
+            parse_salvage=contract is not None,
         ),
         CsvDirSink(args.out, columns=out_cols),
         args.checkpoint,
@@ -310,6 +323,8 @@ def cmd_serve(args) -> int:
         max_batch_failures=(
             args.max_batch_failures if args.max_batch_failures > 0 else None
         ),
+        schema_contract=contract,
+        row_dead_letter_dir=args.row_dead_letter,
     )
     if args.once:
         n = q.process_available()
@@ -441,6 +456,19 @@ def main(argv=None) -> int:
     p.add_argument("--max-batch-wall-time", type=float, default=None,
                    metavar="S", help="watchdog: flag a batch running "
                    "longer than this as UNHEALTHY (watchdog_stall event)")
+    p.add_argument("--row-policy", default="strict",
+                   choices=["strict", "salvage", "permissive"],
+                   help="data-plane admission against the canonical "
+                   "CICIDS2017 contract: strict = a poison batch fails "
+                   "whole (today's behavior); salvage = poison ROWS are "
+                   "excised to the row dead-letter and clean rows keep "
+                   "serving; permissive = coerce what's coercible "
+                   "(numeric strings, non-finite -> 0), then salvage")
+    p.add_argument("--row-dead-letter", default=None, metavar="DIR",
+                   help="row-level dead-letter directory (default: "
+                   "<checkpoint>/dead_letter_rows): one JSONL per "
+                   "batch with file/line/raw text/reason per excised "
+                   "row")
     p.add_argument("--batch-retry-attempts", type=int, default=2,
                    help="in-place attempts per read/sink stage before a "
                    "round counts as failed (1 = no retry)")

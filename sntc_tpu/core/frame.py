@@ -222,6 +222,44 @@ class Frame:
             cols[name] = np.concatenate([a, tail])
         return Frame._wrap(cols, int(n_rows))
 
+    def fill_invalid_rows(self, valid: np.ndarray) -> "Frame":
+        """Replace every row where ``valid`` is False with a copy of the
+        nearest PRECEDING valid row (the first valid row for a leading
+        invalid run; all-zeros/empty-string rows when no row is valid).
+
+        The row-salvage counterpart of :meth:`pad_rows`: admission
+        (``sntc_tpu.data.schema.SchemaContract``) excises poison rows
+        via the serving row-validity mask WITHOUT changing the frame's
+        shape — so the donor values only exist to keep device compute
+        numerically in-domain and are dropped at finalize, exactly like
+        bucket-padding rows."""
+        valid = np.asarray(valid)
+        if valid.dtype != np.bool_ or valid.shape != (self._num_rows,):
+            raise ValueError(
+                "fill_invalid_rows mask must be a boolean (N,) array"
+            )
+        if valid.all():
+            return self  # immutable — safe to share
+        n = self._num_rows
+        if valid.any():
+            # donor[i] = index of the nearest valid row at or before i
+            # (leading invalid rows borrow the first valid row)
+            idx = np.where(valid, np.arange(n), -1)
+            donor = np.maximum.accumulate(idx)
+            donor[donor < 0] = int(np.flatnonzero(valid)[0])
+            return Frame._wrap(
+                {name: a[donor] for name, a in self._columns.items()}, n
+            )
+        cols: Dict[str, np.ndarray] = {}
+        for name, a in self._columns.items():
+            if not isinstance(a, np.ndarray):
+                a = np.asarray(a)
+            if a.dtype.kind in "OUS":
+                cols[name] = np.full(a.shape, "", dtype=a.dtype)
+            else:
+                cols[name] = np.zeros(a.shape, dtype=a.dtype)
+        return Frame._wrap(cols, n)
+
     def concat(self, other: "Frame") -> "Frame":
         return Frame.concat_all([self, other])
 

@@ -1,11 +1,25 @@
-from sntc_tpu.data.schema import CICIDS2017_FEATURES, CICIDS2017_LABELS, NUM_FEATURES
+from sntc_tpu.data.schema import (
+    CICIDS2017_CONTRACT,
+    CICIDS2017_FEATURES,
+    CICIDS2017_LABELS,
+    NUM_FEATURES,
+    AdmissionResult,
+    ColumnSpec,
+    SchemaContract,
+    SchemaViolation,
+)
 from sntc_tpu.data.synth import generate_frame, write_day_csvs
 from sntc_tpu.data.ingest import clean_flows, load_csv, load_csv_dir, cache_parquet
 
 __all__ = [
     "CICIDS2017_FEATURES",
     "CICIDS2017_LABELS",
+    "CICIDS2017_CONTRACT",
     "NUM_FEATURES",
+    "AdmissionResult",
+    "ColumnSpec",
+    "SchemaContract",
+    "SchemaViolation",
     "generate_frame",
     "write_day_csvs",
     "clean_flows",

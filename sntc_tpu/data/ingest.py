@@ -22,20 +22,100 @@ import pyarrow.parquet as pq
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.data.schema import (
     LABEL_COLUMN,
+    REASON_RAGGED_ROW,
     normalize_feature_name,
     normalize_label,
 )
+from sntc_tpu.resilience import data_fault_armed, fault_data
 
 
-def load_csv(path: str) -> Frame:
-    """Read one flow CSV with pyarrow, normalizing column names."""
-    table = pacsv.read_csv(
-        path,
-        convert_options=pacsv.ConvertOptions(
-            # the raw files spell missing/infinite rates several ways
-            null_values=["", "NaN", "nan"],
-        ),
-    )
+def load_csv(
+    path: str,
+    *,
+    salvage: bool = False,
+    rejects: Optional[List[dict]] = None,
+) -> Frame:
+    """Read one flow CSV with pyarrow, normalizing column names.
+
+    Parse errors always NAME the offending file (and, for ragged rows,
+    the 1-based line number plus the raw text) — never a bare
+    ``ArrowInvalid``.  ``salvage=True`` arms per-line salvage instead:
+    ragged lines are excised, the clean rows parse normally, and each
+    excised line is appended to ``rejects`` as ``{"file", "line",
+    "raw", "reason"}`` — the row-granular degradation the streaming
+    admission layer rides (docs/RESILIENCE.md "Data-plane admission").
+
+    The raw bytes pass through the ``source.parse`` fault site
+    (``SNTC_FAULTS=source.parse:ragged:...``), so corrupt-input chaos
+    can mutate real ingest payloads deterministically.
+    """
+    if data_fault_armed("source.parse"):
+        # chaos path only: buffer the payload so the armed DATA fault
+        # can mutate it.  Unarmed (production), pyarrow streams from
+        # the path — no whole-file copy in memory per in-flight read.
+        with open(path, "rb") as f:
+            data = fault_data("source.parse", f.read())
+    else:
+        data = None
+
+    def _parse(single_thread: bool, bad: List[tuple]):
+        def _on_invalid_row(row) -> str:
+            # row.number is pyarrow's 1-based physical line number —
+            # only attributed on single-threaded reads
+            bad.append(
+                (row.number, row.text, row.expected_columns,
+                 row.actual_columns)
+            )
+            return "skip" if salvage else "error"
+
+        return pacsv.read_csv(
+            pa.BufferReader(data) if data is not None else path,
+            read_options=pacsv.ReadOptions(use_threads=not single_thread),
+            parse_options=pacsv.ParseOptions(
+                invalid_row_handler=_on_invalid_row
+            ),
+            convert_options=pacsv.ConvertOptions(
+                # the raw files spell missing/infinite rates several ways
+                null_values=["", "NaN", "nan"],
+            ),
+        )
+
+    bad_rows: List[tuple] = []
+    try:
+        table = _parse(single_thread=False, bad=bad_rows)
+    except pa.ArrowInvalid as e:
+        # rare path: re-parse single-threaded so the error can NAME the
+        # line (the parallel reader cannot attribute row numbers)
+        located: List[tuple] = []
+        try:
+            _parse(single_thread=True, bad=located)
+        except pa.ArrowInvalid:
+            pass
+        reportable = located or bad_rows
+        if reportable and not salvage:
+            line, text, expected, actual = reportable[-1]
+            where = f"line {line}" if line is not None else "unknown line"
+            raise ValueError(
+                f"{path}: {where}: ragged row ({actual} fields, expected "
+                f"{expected}): {text!r}"
+            ) from e
+        raise ValueError(f"{path}: unparsable CSV: {e}") from e
+    if salvage and bad_rows and rejects is not None:
+        # the fast parallel parse cannot attribute line numbers — this
+        # file demonstrably has bad lines, so pay one single-threaded
+        # re-parse to journal each excised line with its exact location
+        located = []
+        _parse(single_thread=True, bad=located)
+        for line, text, expected, actual in located or bad_rows:
+            rejects.append(
+                {
+                    "file": path,
+                    "line": line,
+                    "raw": text,
+                    "reason": REASON_RAGGED_ROW,
+                    "detail": f"{actual} fields, expected {expected}",
+                }
+            )
     names = [normalize_feature_name(c) for c in table.column_names]
     # Real MachineLearningCVE day files contain 'Fwd Header Length' TWICE;
     # pandas-style dedup (second copy -> '.1') matches the schema's
@@ -54,25 +134,37 @@ def load_csv(path: str) -> Frame:
 
 
 def load_csv_dir(
-    path: str, pattern: str = "*.csv", max_workers: int = 8
+    path: str,
+    pattern: str = "*.csv",
+    max_workers: int = 8,
+    *,
+    salvage: bool = False,
+    rejects: Optional[List[dict]] = None,
 ) -> Frame:
     """Read and concatenate all day CSVs in a directory (the all-days config
     [B:10] loads 8 files).  Files parse in a small thread pool —
     pyarrow's C++ CSV reader releases the GIL, so day files parse in
     parallel — but concatenate in sorted-filename order, byte-identical
-    to the serial read."""
+    to the serial read.  Parse errors name the offending file and line
+    (see :func:`load_csv`); ``salvage``/``rejects`` forward to the
+    per-file reader (``list.append`` is atomic, so one shared rejects
+    list is safe across the pool)."""
     paths = sorted(glob.glob(os.path.join(path, pattern)))
     if not paths:
         raise FileNotFoundError(f"no {pattern} files under {path}")
+
+    def _load(p: str) -> Frame:
+        return load_csv(p, salvage=salvage, rejects=rejects)
+
     if len(paths) == 1 or max_workers <= 1:
-        return Frame.concat_all([load_csv(p) for p in paths])
+        return Frame.concat_all([_load(p) for p in paths])
     from concurrent.futures import ThreadPoolExecutor
 
     with ThreadPoolExecutor(
         max_workers=min(max_workers, len(paths))
     ) as pool:
         # executor.map preserves input order regardless of completion order
-        frames = list(pool.map(load_csv, paths))
+        frames = list(pool.map(_load, paths))
     return Frame.concat_all(frames)
 
 
@@ -88,7 +180,16 @@ def clean_flows(
       "drop"``, the common treatment of CICIDS2017) or zero-impute
       (``"zero"``),
     * canonicalize label strings (strip + mojibake aliases).
-    """
+
+    **NaN/Inf policy contract**: this is the training-time face of
+    :data:`sntc_tpu.data.schema.CICIDS2017_CONTRACT` — a non-finite
+    value in ANY feature column poisons exactly that row, and the two
+    treatments map 1:1 onto the serve-time admission modes:
+    ``handle_invalid="drop"`` ≡ ``salvage`` (the row is excised),
+    ``"zero"`` ≡ ``permissive`` (the cell takes the contract's declared
+    ``fill=0.0`` and the row survives).  ``tests/test_admission.py``
+    asserts the row-for-row equivalence, so training-time cleaning and
+    serve-time admission cannot drift apart."""
     if handle_invalid not in ("drop", "zero"):
         raise ValueError("handle_invalid must be 'drop' or 'zero'")
     feature_cols = [c for c in frame.columns if c != label_col]

@@ -21,7 +21,13 @@ away:
    row-dropping ``handleInvalid='skip'``, data-dependent validation)
    stay eager between segments — the row-validity-mask contract of the
    shape-bucketed engine is untouched because row-dropping stages are
-   never fused.
+   never fused.  The ``VALID_COL`` mask column itself is never a plan
+   read or write, so :class:`FusedSegment` carries it through verbatim
+   (outputs layer onto the INPUT frame): bucket padding AND the r10
+   admission layer's row salvage both compose with fusion — an excised
+   row rides the fused program inside the batch's unchanged shape and
+   is filtered only at the predictor's finalize, so ``compile_events``
+   stays flat under salvage.
 
 Evidence: every segment dispatch records its host→device uploads and
 device→host materializations in the process transfer ledger

@@ -101,8 +101,55 @@ def _parse_stream_py(data: bytes) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _emit_truncated(reason: str, valid_bytes: int, dropped: int) -> None:
+    from sntc_tpu.resilience import emit_event
+
+    emit_event(
+        event="parse_truncated", site="source.parse", format="netflow",
+        reason=reason, valid_bytes=valid_bytes, dropped_bytes=dropped,
+    )
+
+
+def scan_stream(data: bytes) -> tuple:
+    """Bounds-check a concatenated-datagram stream: returns
+    ``(clean_len, reason)`` where ``data[:clean_len]`` is the longest
+    prefix of complete datagrams and ``reason`` is ``None`` (clean),
+    ``"truncated"`` (tail cut mid-datagram) or ``"bad_header"``
+    (mid-stream bytes that are not a v5 header — corruption)."""
+    off, n = 0, len(data)
+    while off + 24 <= n:
+        version, count = struct.unpack(">HH", data[off : off + 4])
+        if version != 5 or count > 30:
+            return off, "bad_header"
+        end = off + 24 + count * 48
+        if end > n:
+            return off, "truncated"
+        off = end
+    if off < n:
+        return off, "truncated"
+    return off, None
+
+
 def parse_datagram(data: bytes) -> Optional[np.ndarray]:
-    """One datagram -> [count, NF5_FIELDS] float64, or None if malformed."""
+    """One datagram -> [count, NF5_FIELDS] float64, or None if malformed.
+
+    A datagram whose header is sound but whose body was cut short
+    (partial capture write) salvages the records that fully fit — the
+    valid prefix parses, the torn tail is reported as a structured
+    ``parse_truncated`` event instead of failing the whole datagram."""
+    if len(data) >= 24:
+        version, count = struct.unpack(">HH", data[:4])
+        want = 24 + count * 48
+        if version == 5 and count <= 30 and len(data) < want:
+            n_fit = (len(data) - 24) // 48
+            clean = 24 + n_fit * 48
+            _emit_truncated("truncated", clean, len(data) - clean)
+            # re-frame the valid prefix so both parsers see a
+            # self-consistent datagram (header count must match body)
+            data = (
+                data[:2] + struct.pack(">H", n_fit) + data[4:24]
+                + data[24:clean]
+            )
     lib = _get_lib()
     if lib is None:
         return _parse_py(data)
@@ -118,16 +165,38 @@ def parse_datagram(data: bytes) -> Optional[np.ndarray]:
 
 
 def parse_stream(data: bytes, max_records: int = 1_000_000) -> np.ndarray:
-    """Concatenated datagrams (a capture file) -> stacked records."""
+    """Concatenated datagrams (a capture file) -> stacked records.
+
+    Bounds-checked: a stream torn mid-datagram, or poisoned mid-stream
+    with bytes that are not a v5 header, yields the longest clean
+    datagram prefix plus a structured ``parse_truncated`` event naming
+    the reason and the dropped byte count — never an exception, never
+    a silent stop.  A torn TAIL datagram with a sound header is
+    additionally salvaged at record granularity (the records that
+    fully fit parse; :func:`parse_datagram` emits the event)."""
+    clean_len, reason = scan_stream(data)
+    tail_rows: Optional[np.ndarray] = None
+    if reason is not None:
+        tail = data[clean_len:]
+        if reason == "truncated" and len(tail) >= 24:
+            tail_rows = parse_datagram(tail)
+        else:
+            _emit_truncated(reason, clean_len, len(tail))
+        data = data[:clean_len]
     lib = _get_lib()
     if lib is None:
-        return _parse_stream_py(data)
-    out = np.zeros((max_records, NF5_FIELDS), np.float64)
-    wrote = lib.nf5_parse_stream(
-        data, len(data),
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), max_records,
-    )
-    return out[: max(wrote, 0)].copy()
+        out = _parse_stream_py(data)
+    else:
+        buf = np.zeros((max_records, NF5_FIELDS), np.float64)
+        wrote = lib.nf5_parse_stream(
+            data, len(data),
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            max_records,
+        )
+        out = buf[: max(wrote, 0)].copy()
+    if tail_rows is not None and tail_rows.shape[0]:
+        out = np.concatenate([out, tail_rows], axis=0)
+    return out
 
 
 def make_datagram(

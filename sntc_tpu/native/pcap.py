@@ -145,14 +145,54 @@ def _parse_pcap_py(data: bytes) -> Optional[np.ndarray]:
 # ---------------------------------------------------------------------------
 
 
+def scan_truncation(data: bytes) -> tuple:
+    """Bounds-check the capture's record framing WITHOUT parsing packet
+    bodies: returns ``(clean_len, dropped_bytes)`` where
+    ``data[:clean_len]`` is the longest prefix made of complete records
+    and ``dropped_bytes`` is the torn tail (0 = clean capture).  A
+    header too short/bad to carry records reports the whole payload as
+    clean (the parser's bad-header path owns that verdict)."""
+    if len(data) < 24:
+        return len(data), 0
+    (magic_be,) = struct.unpack(">I", data[:4])
+    if magic_be not in _MAGICS:
+        return len(data), 0
+    endian, _ = _MAGICS[magic_be]
+    off = 24
+    n = len(data)
+    rec = struct.Struct(endian + "IIII")
+    while off + 16 <= n:
+        incl = rec.unpack_from(data, off)[2]
+        if off + 16 + incl > n:
+            break  # record header promises more bytes than exist
+        off += 16 + incl
+    return off, n - off
+
+
 def parse_pcap(data: bytes) -> Optional[np.ndarray]:
     """Capture bytes -> ``[n, PCAP_FIELDS]`` float64 packet matrix
     (IPv4 TCP/UDP packets only), or None if the global header is bad.
+
+    A capture torn mid-record (partial write, corrupt length field)
+    does NOT raise and is never silently absorbed either: the longest
+    complete-record prefix parses normally and the dropped tail is
+    reported as a structured ``parse_truncated`` event on the
+    ``source.parse`` site — the row-granular salvage contract applied
+    at the byte level (docs/RESILIENCE.md "Data-plane admission").
 
     The output buffer is sized from the data itself (every packet record
     costs at least 16 header bytes), so small micro-batch captures stay
     cheap and large ones are never truncated.
     """
+    clean_len, dropped = scan_truncation(data)
+    if dropped:
+        from sntc_tpu.resilience import emit_event
+
+        emit_event(
+            event="parse_truncated", site="source.parse", format="pcap",
+            valid_bytes=clean_len, dropped_bytes=dropped,
+        )
+        data = data[:clean_len]
     lib = _get_lib()
     if lib is None:
         return _parse_pcap_py(data)

@@ -11,6 +11,8 @@ from sntc_tpu.resilience.circuit import (
     reset_breakers,
 )
 from sntc_tpu.resilience.faults import (
+    ALL_KINDS,
+    DATA_KINDS,
     KILL_EXIT_CODE,
     SITES,
     InjectedFault,
@@ -19,7 +21,9 @@ from sntc_tpu.resilience.faults import (
     arm,
     call_count,
     clear,
+    data_fault_armed,
     disarm,
+    fault_data,
     fault_point,
     parse_faults_env,
 )
@@ -48,6 +52,8 @@ __all__ = [
     "remove_event_observer",
     "clear_events",
     "fault_point",
+    "fault_data",
+    "data_fault_armed",
     "arm",
     "disarm",
     "clear",
@@ -57,6 +63,8 @@ __all__ = [
     "InjectedIOFault",
     "InjectedTimeoutFault",
     "SITES",
+    "ALL_KINDS",
+    "DATA_KINDS",
     "KILL_EXIT_CODE",
     "CircuitBreaker",
     "CircuitOpenError",

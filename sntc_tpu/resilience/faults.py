@@ -15,6 +15,8 @@ Wired sites:
 ``stream.read``         ``StreamingQuery`` micro-batch source read
 ``stream.commit``       ``StreamingQuery`` after sink delivery, before commit
 ``sink.write``          ``StreamingQuery`` sink delivery (per batch)
+``source.parse``        byte-level parse boundaries (CSV/pcap/netflow) —
+                        a :func:`fault_data` site taking the DATA kinds
 ``ckpt.save``           ``mlio.save_model`` (before the atomic publish)
 ``ckpt.load``           ``mlio.load_model`` (before manifest verification)
 ``probe.init``          ``utils.backend_probe`` backend-liveness attempt
@@ -27,8 +29,10 @@ Env grammar (comma-separated specs)::
     SNTC_FAULTS=site[:kind[:prob[:seed]]][,site2:...]
 
 ``kind`` is ``exc`` (RuntimeError), ``io`` (OSError), ``timeout``
-(TimeoutError) or ``kill`` (``os._exit`` — the chaos-harness process
-crash); ``prob`` in [0, 1] is evaluated per call with a
+(TimeoutError), ``kill`` (``os._exit`` — the chaos-harness process
+crash), or a DATA kind — ``corrupt_bytes``/``truncate``/``ragged`` —
+which mutates the payload at a :func:`fault_data` site instead of
+raising; ``prob`` in [0, 1] is evaluated per call with a
 generator seeded by ``seed`` — the same env string yields the same
 fault sequence in every run.  Example: arm the sink to fail ~30% of
 writes deterministically::
@@ -76,6 +80,21 @@ _KINDS = {
 KILL_KIND = "kill"
 KILL_EXIT_CODE = 137
 
+# Data-corruption kinds: instead of raising, an armed DATA kind mutates
+# the bytes flowing through a :func:`fault_data` site (``source.parse``)
+# on the same deterministic schedule — the corrupt-input chaos analog
+# of ``kill``.  ``corrupt_bytes`` overwrites a few bytes with seeded
+# garbage, ``truncate`` drops a seeded-length tail (a partial write /
+# torn capture), ``ragged`` splices an extra delimited field into one
+# line (the classic ragged-CSV row).  A data kind armed at a plain
+# ``fault_point`` site is inert, and vice versa.
+DATA_KINDS = ("corrupt_bytes", "truncate", "ragged")
+
+#: every kind the SNTC_FAULTS grammar accepts (docs/RESILIENCE.md keeps
+#: a matching marker-delimited table; scripts/check_fault_sites.py
+#: fails tier-1 when the two drift)
+ALL_KINDS = tuple(sorted(_KINDS)) + (KILL_KIND,) + DATA_KINDS
+
 # the documented wired sites (arming others is allowed — custom call
 # sites can declare their own — but a typo'd WIRED site should be loud)
 SITES = (
@@ -83,6 +102,7 @@ SITES = (
     "stream.read",
     "stream.commit",
     "sink.write",
+    "source.parse",
     "ckpt.save",
     "ckpt.load",
     "probe.init",
@@ -105,10 +125,10 @@ class _Armed:
     rng: np.random.Generator = field(default=None)  # type: ignore[assignment]
 
     def __post_init__(self):
-        if self.kind not in _KINDS and self.kind != KILL_KIND:
+        if self.kind not in ALL_KINDS:
             raise ValueError(
                 f"unknown fault kind {self.kind!r}; expected one of "
-                f"{sorted(_KINDS) + [KILL_KIND]}"
+                f"{list(ALL_KINDS)}"
             )
         if not 0.0 <= self.prob <= 1.0:
             raise ValueError(f"fault prob must lie in [0, 1], got {self.prob}")
@@ -200,11 +220,10 @@ def parse_faults_env(raw: str) -> list:
             )
         spec = {"site": parts[0]}
         if len(parts) > 1:
-            if parts[1] not in _KINDS and parts[1] != KILL_KIND:
+            if parts[1] not in ALL_KINDS:
                 raise ValueError(
                     f"malformed SNTC_FAULTS spec {chunk!r}: unknown kind "
-                    f"{parts[1]!r}; expected one of "
-                    f"{sorted(_KINDS) + [KILL_KIND]}"
+                    f"{parts[1]!r}; expected one of {list(ALL_KINDS)}"
                 )
             spec["kind"] = parts[1]
         if len(parts) > 2:
@@ -269,10 +288,12 @@ def _sync_env() -> None:
 
 
 def fault_point(site: str) -> None:
-    """The per-site hook real code calls; raises when armed + scheduled."""
+    """The per-site hook real code calls; raises when armed + scheduled.
+    A spec armed with a DATA kind is inert here — byte corruption only
+    makes sense where bytes flow (:func:`fault_data`)."""
     _sync_env()
     spec = _registry.get(site)
-    if spec is None:
+    if spec is None or spec.kind in DATA_KINDS:
         return
     with _lock:
         fire = spec.decide()
@@ -288,3 +309,88 @@ def fault_point(site: str) -> None:
         raise _KINDS[spec.kind](
             f"injected {spec.kind} fault at site {site!r} (call {call})"
         )
+
+
+def _mutate(kind: str, data: bytes, draws: "np.ndarray") -> bytes:
+    """Apply one deterministic corruption to ``data``.  ``draws`` is a
+    flat vector of uniform [0, 1) floats consumed positionally, so the
+    mutation depends only on (seed, call index, payload length)."""
+    n = len(data)
+    if n == 0:
+        return data
+    if kind == "truncate":
+        # keep a strict prefix: the torn-write / partial-capture shape
+        return data[: int(draws[0] * n)]
+    if kind == "corrupt_bytes":
+        buf = bytearray(data)
+        k = max(1, n // 64)
+        for i in range(k):
+            pos = int(draws[2 * i] * n)
+            buf[pos] = int(draws[2 * i + 1] * 256) % 256
+        return bytes(buf)
+    # ragged: splice an extra delimited field into one line.  Pick a
+    # DATA line when the payload is line-structured (never the header);
+    # otherwise splice at a raw offset — for binary payloads this is
+    # mid-stream junk, the framing analog of a ragged row.
+    lines = data.split(b"\n")
+    if len(lines) > 2:
+        li = 1 + int(draws[0] * max(1, len(lines) - 2))
+        lines[li] = lines[li] + b",__sntc_ragged__"
+        return b"\n".join(lines)
+    pos = int(draws[0] * n)
+    return data[:pos] + b",__sntc_ragged__," + data[pos:]
+
+
+def data_fault_armed(site: str) -> bool:
+    """True when a DATA kind is armed at ``site`` — callers that would
+    have to buffer a whole payload just to route it through
+    :func:`fault_data` (e.g. a CSV reader that otherwise streams from
+    the path) check this first and skip the buffering when unarmed."""
+    _sync_env()
+    spec = _registry.get(site)
+    return spec is not None and spec.kind in DATA_KINDS
+
+
+def fault_data(site: str, data: bytes) -> bytes:
+    """The byte-corruption hook parse boundaries call on their raw
+    input (``source.parse``).  Unarmed — or armed with a non-DATA
+    kind — it returns ``data`` untouched; armed with ``corrupt_bytes``
+    / ``truncate`` / ``ragged`` it deterministically mutates the
+    payload.
+
+    Unlike :func:`fault_point`, the fire decision and the mutation
+    randomness derive from ``(seed, payload bytes)`` — NOT from the
+    shared call-order rng — because parse sites run on reader/prefetch
+    threads whose interleaving varies run to run: the same corpus under
+    the same ``SNTC_FAULTS`` string corrupts the same payloads the same
+    way regardless of reader concurrency.  ``after``/``times`` are
+    still honored (bookkept under the registry lock), but which
+    payloads they gate depends on arrival order — use ``prob`` for
+    reproducible multi-threaded chaos."""
+    import zlib
+
+    _sync_env()
+    spec = _registry.get(site)
+    if spec is None or spec.kind not in DATA_KINDS:
+        return data
+    with _lock:
+        spec.calls += 1
+        call = spec.calls
+        if call <= spec.after or (
+            spec.times is not None and spec.raised >= spec.times
+        ):
+            return data
+    rng = np.random.default_rng(
+        [spec.seed, zlib.crc32(data), len(data)]
+    )
+    if not (spec.prob >= 1.0 or float(rng.uniform()) < spec.prob):
+        return data
+    with _lock:
+        spec.raised += 1
+    draws = rng.uniform(size=2 * max(1, len(data) // 64))
+    mutated = _mutate(spec.kind, data, draws)
+    emit_event(
+        event="fault_injected", site=site, kind=spec.kind, call=call,
+        bytes_in=len(data), bytes_out=len(mutated),
+    )
+    return mutated

@@ -72,6 +72,11 @@ _EVENT_STATES: Dict[str, HealthState] = {
     "breaker_closed": HealthState.OK,
     "load_shed": HealthState.DEGRADED,
     "watchdog_stall": HealthState.UNHEALTHY,
+    # data-plane admission (r10): rejected rows / torn captures mark the
+    # SOURCE degraded — the query keeps serving the clean rows, but a
+    # rising reject rate is operator-visible through the same stream
+    "rows_rejected": HealthState.DEGRADED,
+    "parse_truncated": HealthState.DEGRADED,
 }
 
 

@@ -19,6 +19,7 @@ from typing import List, Optional
 
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.native import netflow_to_flow_frame, parse_stream
+from sntc_tpu.resilience import fault_data
 from sntc_tpu.serve.streaming import DirStreamSource
 
 
@@ -30,14 +31,19 @@ class _CaptureDirSource(DirStreamSource):
     per-tick listing cache, parallel per-file decodes
     (``read_workers``), and background staging (``prefetch_batches``)
     for the pipelined engine; decode is CPU-bound Python for pcap, so
-    prefetch (one staging thread) is the lever that matters there."""
+    prefetch (one staging thread) is the lever that matters there.
+
+    Raw capture bytes pass through the ``source.parse`` fault site
+    (``fault_data``) before decode, so the corrupt-input chaos kinds
+    (``corrupt_bytes``/``truncate``/``ragged``) exercise the binary
+    parsers' bounds-checked salvage exactly like the CSV path's."""
 
     def _decode_file(self, data: bytes) -> Frame:
         raise NotImplementedError
 
     def _load_file(self, path: str) -> Frame:
         with open(path, "rb") as f:
-            return self._decode_file(f.read())
+            return self._decode_file(fault_data("source.parse", f.read()))
 
 
 class NetFlowDirSource(_CaptureDirSource):

@@ -80,11 +80,17 @@ class DirStreamSource(StreamSource):
         pattern: str,
         prefetch_batches: int = 0,
         read_workers: int = 4,
+        parse_salvage: bool = False,
     ):
         self.path = path
         self.pattern = pattern
         self.prefetch_batches = int(prefetch_batches)
         self.read_workers = max(1, int(read_workers))
+        # parse_salvage=True arms per-line salvage in the file loaders
+        # that support it (CSV): unparsable lines are excised at parse
+        # time and collected as reject records — the engine drains them
+        # via take_rejects() into its row-level dead-letter
+        self.parse_salvage = bool(parse_salvage)
         self._listing: Optional[List[str]] = None
         self._read_pool = None
         self._prefetch_pool = None
@@ -94,6 +100,8 @@ class DirStreamSource(StreamSource):
         # miss) AND from prefetch threads (staged _read_range) — the
         # lazy create must not race two executors into existence
         self._pool_lock = threading.Lock()
+        self._rejects_lock = threading.Lock()
+        self._parse_rejects: List[dict] = []
         self._staged: dict = {}  # (start, end) -> Future[Frame]
         self.prefetch_hits = 0
         self.prefetch_misses = 0
@@ -110,6 +118,45 @@ class DirStreamSource(StreamSource):
 
     def _load_file(self, path: str) -> Frame:
         raise NotImplementedError
+
+    def _note_rejects(self, records: List[dict]) -> None:
+        """Collect parse-time reject records (thread-safe: loaders run
+        on read/prefetch pool threads)."""
+        with self._rejects_lock:
+            self._parse_rejects.extend(records)
+
+    def take_rejects(self, files: Optional[List[str]] = None) -> List[dict]:
+        """Drain the parse-time reject records collected since the last
+        drain (the engine journals them into the row-level dead-letter
+        with the batch that consumed the read).  ``files`` restricts the
+        drain to records from those files — a prefetch thread may have
+        parsed a FUTURE batch's file already, and its rejects must wait
+        for the batch that actually covers that file."""
+        with self._rejects_lock:
+            if files is None:
+                out = self._parse_rejects
+                self._parse_rejects = []
+                return out
+            allowed = set(files)
+            kept: List[dict] = []
+            out: List[dict] = []
+            for r in self._parse_rejects:
+                if r.get("file") in allowed or r.get("file") is None:
+                    out.append(r)
+                else:
+                    kept.append(r)
+            self._parse_rejects = kept
+            return out
+
+    def files_for_range(self, start: int, end: int) -> List[str]:
+        """The files a ``[start, end)`` batch covers, for dead-letter
+        attribution (re-lists when the cached listing is stale)."""
+        listing = self._listing
+        if listing is None or len(listing) < end:
+            listing = sorted(
+                glob.glob(os.path.join(self.path, self.pattern))
+            )
+        return listing[start:end]
 
     def _pool(self):
         with self._pool_lock:
@@ -213,13 +260,22 @@ class DirStreamSource(StreamSource):
 
 
 class FileStreamSource(DirStreamSource):
-    """Directory of flow CSVs."""
+    """Directory of flow CSVs.  With ``parse_salvage=True`` ragged
+    lines are excised per-line (file + line number journaled) instead
+    of failing the whole batch — see :func:`sntc_tpu.data.ingest
+    .load_csv`."""
 
     def __init__(self, path: str, pattern: str = "*.csv", **kwargs):
         super().__init__(path, pattern, **kwargs)
 
     def _load_file(self, path: str) -> Frame:
-        return load_csv(path)
+        if not self.parse_salvage:
+            return load_csv(path)
+        recs: List[dict] = []
+        frame = load_csv(path, salvage=True, rejects=recs)
+        if recs:
+            self._note_rejects(recs)
+        return frame
 
 
 class MemorySource(StreamSource):
@@ -356,6 +412,22 @@ class StreamingQuery:
     admission control (load shedding), a batch watchdog, and
     preemption-safe drain on top of this engine.
 
+    **Row admission (opt-in, r10):** ``schema_contract=SchemaContract``
+    validates every read batch against per-column dtype / NaN / Inf /
+    range / domain policies (``row_policy`` overrides the contract's
+    mode).  ``strict`` fails the batch on any violation (the poison-
+    batch machinery above owns it); ``salvage``/``permissive`` excise
+    only the poison rows — via the SAME row-validity mask that bucket
+    padding threads, applied inside the already-bucketed frame, so
+    excision never changes a dispatched shape and the jitted/fused
+    predict programs never recompile.  Excised rows land in a row-level
+    dead-letter (``row_dead_letter_dir``, default
+    ``<checkpoint_dir>/dead_letter_rows/``) with batch id, source file,
+    row/line, raw text, and a machine-readable reason code, and a
+    ``rows_rejected`` event rides the structured stream (HealthMonitor
+    marks the source DEGRADED).  See docs/RESILIENCE.md "Data-plane
+    admission".
+
     **Pipelined mode (opt-in):** ``overlap_sink=True`` moves the retire
     stage (finalize + ``sink.add_batch``, with its retry cycle) onto a
     dedicated delivery thread so batch N's sink write overlaps batch
@@ -391,6 +463,9 @@ class StreamingQuery:
         max_batch_failures: Optional[int] = None,
         dead_letter_dir: Optional[str] = None,
         breakers: Optional[dict] = None,
+        schema_contract=None,
+        row_policy: Optional[str] = None,
+        row_dead_letter_dir: Optional[str] = None,
     ):
         # a pre-built BatchPredictor passes through unchanged (its own
         # bucket config wins — bench warmup shares one predictor across
@@ -431,6 +506,28 @@ class StreamingQuery:
         self.dead_letter_dir = dead_letter_dir or os.path.join(
             checkpoint_dir, "dead_letter"
         )
+        # data-plane admission (r10): a SchemaContract validates every
+        # read batch.  strict = any violation fails the batch (the
+        # poison-batch machinery above takes over); salvage/permissive =
+        # poison rows are excised via the row-validity mask INSIDE the
+        # already-bucketed frame (no shape change, no recompile) and
+        # journaled row-by-row to the dead-letter below
+        self.schema_contract = schema_contract
+        if row_policy is not None and schema_contract is None:
+            raise ValueError(
+                "row_policy requires a schema_contract to enforce"
+            )
+        self.row_policy = row_policy or (
+            schema_contract.mode if schema_contract is not None else None
+        )
+        self.row_dead_letter_dir = row_dead_letter_dir or os.path.join(
+            checkpoint_dir, "dead_letter_rows"
+        )
+        self._rows_rejected_total = 0
+        self._rows_coerced_total = 0
+        self._batches_salvaged = 0
+        self._rows_journaled: set = set()  # batch ids already journaled
+        self._admission_counted: set = set()  # batch ids stat-counted
         # per-site circuit breakers (sink.write / predict.dispatch): an
         # OPEN breaker defers the stage — the batch stays queued and the
         # loop stays alive — instead of hammering a dead dependency
@@ -605,13 +702,59 @@ class StreamingQuery:
 
         t0 = time.perf_counter()
 
-        def _read() -> Frame:
+        def _read() -> tuple:
             fault_point("stream.read")
             frame = self.source.get_batch(intent["start"], intent["end"])
             stride = intent.get("sample_stride", 1)
             if stride > 1:
                 frame = frame.take(np.arange(0, frame.num_rows, stride))
-            return frame
+            # one listing snapshot serves the selective drain AND the
+            # journal's file attribution (journaling from a second,
+            # later listing could name a different snapshot)
+            files_for = getattr(self.source, "files_for_range", None)
+            batch_files = (
+                files_for(intent["start"], intent["end"])
+                if files_for is not None
+                else None
+            )
+            # drain parse-time rejects (per-line CSV salvage) BEFORE
+            # admission so a read retry cannot leave them stranded —
+            # restricted to THIS batch's files, because a prefetch
+            # thread may already have parsed (and rejected lines from)
+            # a future batch's file
+            take = getattr(self.source, "take_rejects", None)
+            rejects = list(take(batch_files)) if take is not None else []
+            mask = None
+            if self.schema_contract is not None:
+                original = frame
+                # strict mode raises SchemaViolation here — the batch
+                # fails exactly like any other stream.read poison and
+                # the retry/quarantine machinery owns it
+                res = self.schema_contract.admit(
+                    frame, mode=self.row_policy
+                )
+                frame = res.frame
+                if not res.valid.all():
+                    mask = res.valid
+                if res.rejects:
+                    # best-effort raw text: the row's 1-D values in
+                    # column order (the parser layer records the true
+                    # raw line for the lines it excised itself).  The
+                    # column arrays are hoisted once — a poison-heavy
+                    # batch must not pay a per-reject column walk
+                    cols_1d = [
+                        original[c] for c in original.columns
+                        if original[c].ndim == 1
+                    ]
+                    for r in res.rejects:
+                        rec = dict(r)
+                        row = rec["row"]
+                        rec["raw"] = ",".join(
+                            str(a[row]) for a in cols_1d
+                        )
+                        rejects.append(rec)
+                return frame, mask, rejects, res.coerced, batch_files
+            return frame, mask, rejects, 0, batch_files
 
         frame = None
         stage = "stream.read"
@@ -624,7 +767,7 @@ class StreamingQuery:
         if br_predict is not None and br_predict.state == "open":
             return False
         try:
-            frame = (
+            frame, row_mask, rejects, coerced, batch_files = (
                 with_retries(_read, self.retry_policy, site="stream.read")
                 if self.retry_policy is not None
                 else _read()
@@ -635,8 +778,24 @@ class StreamingQuery:
             stage = "predict.dispatch"
             if br_predict is not None and not br_predict.allow():
                 return False  # breaker open: defer, intent replays later
+            # the journal write is idempotent per batch id (atomic
+            # rewrite), so a WAL replay or sink-retry round cannot
+            # double-count rejected rows
+            if rejects:
+                self._journal_rejected_rows(
+                    batch_id, intent, rejects, batch_files or []
+                )
+            if batch_id not in self._admission_counted:
+                # a deferred batch re-reads on its retry round — count
+                # its admission outcome once, not once per round
+                self._admission_counted.add(batch_id)
+                if row_mask is not None:
+                    self._batches_salvaged += 1
+                self._rows_coerced_total += coerced
             try:
-                finalize = self.predictor.predict_frame_async(frame)
+                finalize = self.predictor.predict_frame_async(
+                    frame, row_valid=row_mask
+                )
             except Exception:
                 if br_predict is not None:
                     br_predict.record_failure()
@@ -853,6 +1012,9 @@ class StreamingQuery:
         fusion = self.predictor.fusion_stats()
         if fusion is not None:
             stats["fusion"] = fusion
+        admission = self.admission_stats()
+        if admission is not None:
+            stats["admission"] = admission
         return stats
 
     def _commit_batch(self, batch_id: int, intent: dict, *, n_rows: int,
@@ -867,6 +1029,11 @@ class StreamingQuery:
         fault_point("stream.commit")
         self._wal_commit(batch_id, intent)
         self._clear_failures(batch_id)
+        # a committed batch never re-reads in this process — drop its
+        # admission-idempotence bookkeeping so the sets stay bounded by
+        # the in-flight window, not the query's lifetime
+        self._rows_journaled.discard(batch_id)
+        self._admission_counted.discard(batch_id)
         self._last_committed = batch_id
         self._end_offset = intent["end"]
         dur = time.perf_counter() - t0
@@ -881,6 +1048,107 @@ class StreamingQuery:
         self.recentProgress.append(progress)
         if len(self.recentProgress) > self._PROGRESS_KEEP:
             del self.recentProgress[0]
+
+    def _journal_rejected_rows(
+        self, batch_id: int, intent: dict, rejects: List[dict],
+        batch_files: List[str],
+    ) -> None:
+        """The ROW-level dead-letter: one JSONL file per batch under
+        ``row_dead_letter_dir``, each record carrying batch_id, source
+        file (exact for parse-time rejects; the batch's file list
+        otherwise), row index/line number, raw text, and a
+        machine-readable reason code.  Written atomically and keyed by
+        batch id, so a WAL replay rewrites — never duplicates — the
+        evidence; a ``rows_rejected`` event rides the structured stream
+        so :class:`~sntc_tpu.resilience.health.HealthMonitor` marks the
+        source DEGRADED on rising reject rates.  ``batch_files`` is the
+        listing snapshot the read itself used (one glob serves drain
+        and attribution)."""
+        seen: set = set()
+        records: List[dict] = []
+        for r in rejects:
+            key = (
+                r.get("file"), r.get("line"), r.get("row"), r.get("raw"),
+                r.get("reason"),
+            )
+            if key in seen:  # a retried read re-parses the same lines
+                continue
+            seen.add(key)
+            rec = {
+                "batch_id": batch_id,
+                "file": r.get("file") or (
+                    batch_files[0] if len(batch_files) == 1 else None
+                ),
+                "line": r.get("line"),
+                "row": r.get("row"),
+                "raw": r.get("raw"),
+                "reason": r.get("reason"),
+                "column": r.get("column"),
+                "value": r.get("value"),
+                "detail": r.get("detail"),
+                "ts": time.time(),
+            }
+            if rec["file"] is None and batch_files:
+                rec["batch_files"] = batch_files
+            records.append(rec)
+        if not records:
+            return
+        # a deferred batch re-reads (and re-admits) on its retry round:
+        # rewrite the evidence, but never double-count it
+        first_journal = batch_id not in self._rows_journaled
+        self._rows_journaled.add(batch_id)
+        os.makedirs(self.row_dead_letter_dir, exist_ok=True)
+        final = os.path.join(
+            self.row_dead_letter_dir, f"batch_{batch_id:06d}.jsonl"
+        )
+        if os.path.exists(final):
+            # merge with what an earlier round (or a pre-crash run, on
+            # WAL replay) journaled — a rewrite must never SHRINK the
+            # evidence (e.g. a record a prefetch thread attributed here
+            # before the selective drain existed)
+            def _key(r):
+                return (
+                    r.get("file"), r.get("line"), r.get("row"),
+                    r.get("raw"), r.get("reason"),
+                )
+
+            with open(final) as f:
+                prior = [
+                    json.loads(line) for line in f if line.strip()
+                ]
+            fresh = {_key(r) for r in records}
+            records = [
+                r for r in prior if _key(r) not in fresh
+            ] + records
+        tmp = final + ".tmp"
+        with open(tmp, "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+        os.replace(tmp, final)  # atomic + idempotent on WAL replay
+        if not first_journal:
+            return
+        self._rows_rejected_total += len(records)
+        reasons: dict = {}
+        for rec in records:
+            reasons[rec["reason"]] = reasons.get(rec["reason"], 0) + 1
+        emit_event(
+            event="rows_rejected", site="source.parse",
+            batch_id=batch_id, count=len(records), reasons=reasons,
+        )
+
+    def admission_stats(self) -> Optional[dict]:
+        """Row-admission evidence (None when no contract is armed):
+        active policy, rows rejected/coerced, batches that needed the
+        salvage mask, and the row dead-letter location."""
+        if self.schema_contract is None:
+            return None
+        return {
+            "policy": self.row_policy,
+            "rows_rejected": self._rows_rejected_total,
+            "rows_coerced": self._rows_coerced_total,
+            "batches_salvaged": self._batches_salvaged,
+            "row_dead_letter_dir": self.row_dead_letter_dir,
+        }
 
     def _quarantine(
         self, batch_id: int, intent: dict, frame: Optional[Frame],

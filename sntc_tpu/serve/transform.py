@@ -92,18 +92,31 @@ class BatchPredictor:
                 self.compile_events += 1
             self.padded_rows_total += padded
 
-    def _dispatch_one(self, frame: Frame) -> Callable[[], Frame]:
+    def _dispatch_one(
+        self,
+        frame: Frame,
+        row_valid: "np.ndarray | None" = None,
+    ) -> Callable[[], Frame]:
         """Dispatch ONE at-most-chunk_rows frame through the model's
         async transform, bucket-padded when armed; the returned finalize
-        strips the pad tail via the validity mask."""
+        strips the pad tail via the validity mask.
+
+        ``row_valid`` is the admission layer's salvage mask (True =
+        admitted row): excised rows ride INSIDE the dispatched frame —
+        already sanitized by the contract — and are filtered at
+        finalize through the same ``VALID_COL`` mechanism as bucket
+        padding, so salvage never changes the dispatched shape and the
+        jitted programs never recompile (``compile_events`` stays
+        flat)."""
         n = frame.num_rows
         target = bucket_rows_for(n, self.bucket_rows)
-        if target == n or n == 0:
+        all_admitted = row_valid is None or bool(np.all(row_valid))
+        if (target == n or n == 0) and all_admitted:
             self._record_shape(n)
             return self.model.transform_async(frame)
         self._record_shape(target, padded=target - n)
         valid = np.zeros(target, dtype=bool)
-        valid[:n] = True
+        valid[:n] = True if row_valid is None else row_valid
         padded = frame.pad_rows(target).with_column(VALID_COL, valid)
         fin = self.model.transform_async(padded)
 
@@ -153,8 +166,10 @@ class BatchPredictor:
 
     # -- public surface -----------------------------------------------------
 
-    def predict_frame(self, frame: Frame) -> Frame:
-        return self.predict_frame_async(frame)()
+    def predict_frame(
+        self, frame: Frame, row_valid: "np.ndarray | None" = None
+    ) -> Frame:
+        return self.predict_frame_async(frame, row_valid=row_valid)()
 
     # oversized frames keep at most this many chunk dispatches in
     # flight: chunk_rows exists to bound device memory, and dispatching
@@ -162,23 +177,44 @@ class BatchPredictor:
     # resident at once
     CHUNK_WINDOW = 2
 
-    def predict_frame_async(self, frame: Frame) -> Callable[[], Frame]:
+    def predict_frame_async(
+        self, frame: Frame, row_valid: "np.ndarray | None" = None
+    ) -> Callable[[], Frame]:
         """Dispatch without blocking; returns a zero-arg finalize
         producing the output Frame (see Transformer.transform_async).
-        Oversized frames dispatch chunk-by-chunk through a small sliding
+        ``row_valid`` (the admission salvage mask, True = admitted)
+        rides the dispatch shape-preservingly — excised rows are
+        filtered only at finalize (see ``_dispatch_one``).  Oversized
+        frames dispatch chunk-by-chunk through a small sliding
         window (``CHUNK_WINDOW`` outstanding: chunk i+W dispatches
         before chunk i materializes — overlap without unbounding device
         memory), single finalize, one concat.  The pre-r8 path silently
         fell back to a fully synchronous chunked transform, serializing
         the pipelined engine's overlap away."""
+        if row_valid is not None:
+            row_valid = np.asarray(row_valid, dtype=bool)
+            if row_valid.shape != (frame.num_rows,):
+                raise ValueError(
+                    f"row_valid has shape {row_valid.shape}, expected "
+                    f"({frame.num_rows},)"
+                )
         if frame.num_rows <= self.chunk_rows:
-            return self._memo(self._dispatch_one(frame))
+            return self._memo(self._dispatch_one(frame, row_valid))
         chunks = [
             frame.slice(s, min(s + self.chunk_rows, frame.num_rows))
             for s in range(0, frame.num_rows, self.chunk_rows)
         ]
+        masks = [
+            None
+            if row_valid is None
+            else row_valid[s : min(s + self.chunk_rows, frame.num_rows)]
+            for s in range(0, frame.num_rows, self.chunk_rows)
+        ]
         fins: List[Callable[[], Frame]] = [
-            self._dispatch_one(c) for c in chunks[: self.CHUNK_WINDOW]
+            self._dispatch_one(c, m)
+            for c, m in zip(
+                chunks[: self.CHUNK_WINDOW], masks[: self.CHUNK_WINDOW]
+            )
         ]
 
         def finalize() -> Frame:
@@ -186,7 +222,7 @@ class BatchPredictor:
             for i in range(len(chunks)):
                 nxt = i + self.CHUNK_WINDOW
                 if nxt < len(chunks):  # refill the window, THEN block
-                    fins.append(self._dispatch_one(chunks[nxt]))
+                    fins.append(self._dispatch_one(chunks[nxt], masks[nxt]))
                 outs.append(fins[i]())
             return Frame.concat_all(outs)
 

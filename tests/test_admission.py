@@ -1,0 +1,587 @@
+"""Data-plane admission (r10): SchemaContract modes, clean_flows policy
+unity, parser salvage with file+line attribution, the source.parse
+fault grammar (DATA kinds), row-level dead-letter accounting, salvage ×
+shape buckets × fusion bitwise parity with a flat compile ledger, and
+the corrupt-corpus chaos harness in tier-1."""
+
+import glob
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import sntc_tpu.resilience as R
+from sntc_tpu.core.base import Pipeline, Transformer
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.data.ingest import clean_flows, load_csv, load_csv_dir
+from sntc_tpu.data.schema import (
+    CICIDS2017_CONTRACT,
+    CICIDS2017_FEATURES,
+    ColumnSpec,
+    SchemaContract,
+    SchemaViolation,
+)
+from sntc_tpu.data.synth import generate_frame
+from sntc_tpu.feature import MinMaxScaler, VectorAssembler
+from sntc_tpu.models import LogisticRegression, NaiveBayes
+from sntc_tpu.resilience import HealthMonitor, HealthState
+from sntc_tpu.serve.streaming import (
+    FileStreamSource,
+    MemorySink,
+    MemorySource,
+    StreamingQuery,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    R.clear()
+    R.clear_events()
+    yield
+    R.clear()
+    R.clear_events()
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _Identity(Transformer):
+    def transform(self, frame):
+        return frame
+
+
+# ---------------------------------------------------------------------------
+# SchemaContract unit behavior
+# ---------------------------------------------------------------------------
+
+
+def _xy_contract(**kw):
+    return SchemaContract(
+        {"x": ColumnSpec(fill=0.0), "y": ColumnSpec(fill=0.0)}, **kw
+    )
+
+
+def test_strict_raises_with_reasons():
+    f = Frame({"x": np.array([1.0, np.nan]), "y": np.array([1.0, 2.0])})
+    with pytest.raises(SchemaViolation) as ei:
+        _xy_contract().admit(f, mode="strict")
+    assert ei.value.reasons == [
+        {"column": "x", "reason": "non_finite", "count": 1}
+    ]
+
+
+def test_salvage_masks_and_sanitizes():
+    f = Frame({
+        "x": np.array([1.0, np.nan, 3.0, np.inf]),
+        "y": np.array([1.0, 2.0, 3.0, 4.0]),
+    })
+    res = _xy_contract().admit(f, mode="salvage")
+    np.testing.assert_array_equal(
+        res.valid, [True, False, True, False]
+    )
+    # shape preserved; excised rows hold finite donor copies
+    assert res.frame.num_rows == 4
+    assert np.isfinite(res.frame["x"]).all()
+    assert res.frame["x"].dtype == np.float32
+    assert [r["row"] for r in res.rejects] == [1, 3]
+    assert {r["reason"] for r in res.rejects} == {"non_finite"}
+
+
+def test_permissive_coerces_then_salvages():
+    f = Frame({
+        "x": np.array(["1.5", "junk", "inf"], dtype=object),
+        "y": np.array([np.nan, 2.0, -1.0]),
+    })
+    c = SchemaContract({
+        "x": ColumnSpec(fill=0.0),
+        "y": ColumnSpec(fill=0.0, min_value=0.0),
+    })
+    res = c.admit(f, mode="permissive")
+    # "1.5" parses, "junk" takes the fill, "inf" is non-finite -> fill;
+    # y NaN takes the fill, y=-1 is out of range -> row poison
+    np.testing.assert_array_equal(res.valid, [True, True, False])
+    np.testing.assert_array_equal(
+        res.frame["x"][:2], np.array([1.5, 0.0], np.float32)
+    )
+    assert res.rejects[0]["reason"] == "out_of_range"
+    assert res.coerced > 0
+
+
+def test_range_domain_and_missing_column():
+    c = SchemaContract({
+        "x": ColumnSpec(min_value=0.0, max_value=10.0),
+        "tag": ColumnSpec(dtype="str", domain=("a", "b")),
+    })
+    f = Frame({
+        "x": np.array([5.0, 11.0, 2.0]),
+        "tag": np.array(["a", "b", "z"], dtype=object),
+    })
+    res = c.admit(f, mode="salvage")
+    np.testing.assert_array_equal(res.valid, [True, False, False])
+    assert {r["reason"] for r in res.rejects} == {
+        "out_of_range", "out_of_domain",
+    }
+    with pytest.raises(SchemaViolation) as ei:
+        c.admit(Frame({"x": np.array([1.0])}), mode="salvage")
+    assert ei.value.reasons[0]["reason"] == "missing_column"
+
+
+def test_with_mode_and_validation():
+    c = _xy_contract(mode="salvage")
+    assert c.with_mode("salvage") is c
+    assert c.with_mode("strict").mode == "strict"
+    assert c.columns is c.with_mode("strict").columns
+    with pytest.raises(ValueError):
+        SchemaContract({"x": ColumnSpec()}, mode="wat")
+
+
+def test_fill_invalid_rows_donor_semantics():
+    f = Frame({
+        "x": np.array([9.0, 1.0, 2.0, 3.0]),
+        "v": np.arange(8.0).reshape(4, 2),
+        "s": np.array(["a", "b", "c", "d"], dtype=object),
+    })
+    out = f.fill_invalid_rows(np.array([False, True, False, True]))
+    # leading invalid row borrows the FIRST valid row; later ones the
+    # nearest preceding valid row
+    np.testing.assert_array_equal(out["x"], [1.0, 1.0, 1.0, 3.0])
+    np.testing.assert_array_equal(out["v"][2], out["v"][1])
+    assert list(out["s"]) == ["b", "b", "b", "d"]
+    # no valid rows: zero/empty fill, shape kept
+    out = f.fill_invalid_rows(np.zeros(4, bool))
+    assert out.num_rows == 4 and (np.asarray(out["x"]) == 0).all()
+    with pytest.raises(ValueError):
+        f.fill_invalid_rows(np.ones(3, bool))
+
+
+# ---------------------------------------------------------------------------
+# clean_flows <-> CICIDS2017_CONTRACT policy unity (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_clean_flows_drop_equals_contract_salvage():
+    f = generate_frame(1500, seed=4, dirty=True)
+    dropped = clean_flows(f)  # handle_invalid="drop"
+    res = CICIDS2017_CONTRACT.admit(f, mode="salvage")
+    salvaged = res.frame.filter(res.valid)
+    assert salvaged.num_rows == dropped.num_rows < f.num_rows
+    for c in CICIDS2017_FEATURES:
+        np.testing.assert_array_equal(
+            salvaged[c], dropped[c], err_msg=c
+        )
+
+
+def test_clean_flows_zero_equals_contract_permissive():
+    f = generate_frame(1500, seed=5, dirty=True)
+    zeroed = clean_flows(f, handle_invalid="zero")
+    res = CICIDS2017_CONTRACT.admit(f, mode="permissive")
+    assert res.valid.all()  # fill=0.0 repairs every non-finite cell
+    assert res.coerced > 0
+    for c in CICIDS2017_FEATURES:
+        np.testing.assert_array_equal(
+            res.frame[c], zeroed[c], err_msg=c
+        )
+
+
+# ---------------------------------------------------------------------------
+# CSV parser: file+line attribution and per-line salvage (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _ragged_fixture(tmp_path, name="day.csv"):
+    p = tmp_path / name
+    p.write_text("x,y\n1.0,2.0\n3.0,4.0,5.0\n6.0,7.0\n")
+    return str(p)
+
+
+def test_load_csv_error_names_file_and_line(tmp_path):
+    p = _ragged_fixture(tmp_path)
+    with pytest.raises(ValueError) as ei:
+        load_csv(p)
+    msg = str(ei.value)
+    assert p in msg and "line 3" in msg and "3,4,5" in msg.replace(
+        "3.0,4.0,5.0", "3,4,5"
+    )
+
+
+def test_load_csv_dir_error_names_offending_file(tmp_path):
+    d = tmp_path / "days"
+    d.mkdir()
+    (d / "a.csv").write_text("x,y\n1.0,2.0\n")
+    bad = _ragged_fixture(d, name="b.csv")
+    with pytest.raises(ValueError) as ei:
+        load_csv_dir(str(d))
+    assert bad in str(ei.value) and "line 3" in str(ei.value)
+
+
+def test_load_csv_salvage_excises_with_location(tmp_path):
+    p = _ragged_fixture(tmp_path)
+    rejects = []
+    f = load_csv(p, salvage=True, rejects=rejects)
+    assert f.num_rows == 2
+    np.testing.assert_array_equal(f["x"], [1.0, 6.0])
+    assert rejects == [{
+        "file": p, "line": 3, "raw": "3.0,4.0,5.0",
+        "reason": "ragged_row", "detail": "3 fields, expected 2",
+    }]
+
+
+def test_pcap_truncation_emits_event():
+    from sntc_tpu.native.pcap import (
+        make_packet, make_pcap, parse_pcap, scan_truncation,
+    )
+
+    cap = make_pcap(
+        [(1.0 + i, make_packet(1, 2, 10, 20, payload=40))
+         for i in range(4)]
+    )
+    clean_len, dropped = scan_truncation(cap[:-10])
+    assert dropped == (len(cap) - 10) - clean_len > 0
+    got = parse_pcap(cap[:-10])
+    assert got.shape[0] == 3  # valid prefix
+    np.testing.assert_array_equal(got, parse_pcap(cap)[:3])
+    ev = [e for e in R.recent_events()
+          if e.get("event") == "parse_truncated"]
+    assert ev and ev[-1]["format"] == "pcap"
+
+
+# ---------------------------------------------------------------------------
+# SNTC_FAULTS grammar: DATA kinds + fault_data
+# ---------------------------------------------------------------------------
+
+
+def test_grammar_accepts_data_kinds():
+    specs = R.parse_faults_env(
+        "source.parse:ragged:0.5:7,source.parse:corrupt_bytes,"
+        "stream.read:exc"
+    )
+    assert specs[0] == {
+        "site": "source.parse", "kind": "ragged", "prob": 0.5, "seed": 7,
+    }
+    assert specs[1]["kind"] == "corrupt_bytes"
+    with pytest.raises(ValueError, match="unknown kind"):
+        R.parse_faults_env("source.parse:shred")
+
+
+def test_fault_data_deterministic_and_kind_scoped():
+    payload = b"x,y\n1,2\n3,4\n5,6\n"
+    R.arm("source.parse", kind="ragged", times=None)
+    a = R.fault_data("source.parse", payload)
+    assert a != payload and b"__sntc_ragged__" in a
+    # header (line 0) is never the spliced line
+    assert a.split(b"\n")[0] == b"x,y"
+    R.arm("source.parse", kind="ragged", times=None)
+    assert R.fault_data("source.parse", payload) == a  # same seed+call
+    # truncate strictly shortens; corrupt_bytes preserves length
+    R.arm("source.parse", kind="truncate", times=None)
+    assert len(R.fault_data("source.parse", payload)) < len(payload)
+    R.arm("source.parse", kind="corrupt_bytes", times=None)
+    mutated = R.fault_data("source.parse", payload)
+    assert len(mutated) == len(payload) and mutated != payload
+    # a DATA kind is inert at a plain fault_point, and vice versa
+    R.arm("source.parse", kind="ragged", times=None)
+    R.fault_point("source.parse")  # must not raise
+    R.arm("source.parse", kind="exc", times=None)
+    assert R.fault_data("source.parse", payload) == payload
+
+
+# ---------------------------------------------------------------------------
+# engine admission: dead-letter accounting, events, health
+# ---------------------------------------------------------------------------
+
+
+def _poison_frames():
+    return [
+        Frame({"x": np.array([1.0, 2.0, np.nan, 4.0])}),
+        Frame({"x": np.array([5.0, np.inf, 7.0, 8.0])}),
+    ]
+
+
+def test_engine_salvage_dead_letters_rows(tmp_path):
+    contract = SchemaContract({"x": ColumnSpec()}, mode="salvage")
+    monitor = HealthMonitor().attach()
+    try:
+        sink = MemorySink()
+        q = StreamingQuery(
+            _Identity(), MemorySource(_poison_frames()), sink,
+            str(tmp_path / "ckpt"), max_batch_offsets=1,
+            schema_contract=contract,
+        )
+        assert q.process_available() == 2
+    finally:
+        monitor.detach()
+    np.testing.assert_array_equal(sink.frames[0]["x"], [1.0, 2.0, 4.0])
+    np.testing.assert_array_equal(sink.frames[1]["x"], [5.0, 7.0, 8.0])
+    rows = []
+    for p in sorted(
+        glob.glob(str(tmp_path / "ckpt" / "dead_letter_rows" / "*.jsonl"))
+    ):
+        with open(p) as f:
+            rows += [json.loads(line) for line in f]
+    assert len(rows) == 2
+    assert rows[0]["batch_id"] == 0 and rows[0]["row"] == 2
+    assert rows[0]["reason"] == "non_finite" and rows[0]["column"] == "x"
+    assert rows[0]["raw"]  # best-effort raw rendering present
+    stats = q.admission_stats()
+    assert stats["rows_rejected"] == 2
+    assert stats["batches_salvaged"] == 2
+    events = [e for e in R.recent_events()
+              if e.get("event") == "rows_rejected"]
+    assert [e["count"] for e in events] == [1, 1]
+    # rising rejects mark the SOURCE degraded through the event stream
+    assert monitor.state_of("source.parse") == HealthState.DEGRADED
+
+
+def test_engine_strict_mode_quarantines_batch(tmp_path):
+    contract = SchemaContract({"x": ColumnSpec()})
+    q = StreamingQuery(
+        _Identity(), MemorySource(_poison_frames()), MemorySink(),
+        str(tmp_path / "ckpt"), max_batch_offsets=1,
+        schema_contract=contract, row_policy="strict",
+        max_batch_failures=1,
+    )
+    assert q.process_available() == 2
+    assert all(p.get("quarantined") for p in q.recentProgress)
+    # batch-level dead letter, not row-level
+    assert os.path.isdir(str(tmp_path / "ckpt" / "dead_letter"))
+    assert not os.path.isdir(str(tmp_path / "ckpt" / "dead_letter_rows"))
+
+
+def test_row_policy_requires_contract(tmp_path):
+    with pytest.raises(ValueError, match="schema_contract"):
+        StreamingQuery(
+            _Identity(), MemorySource([]), MemorySink(),
+            str(tmp_path / "ckpt"), row_policy="salvage",
+        )
+
+
+def test_file_source_parse_salvage_attributes_file_and_line(tmp_path):
+    watch = tmp_path / "in"
+    watch.mkdir()
+    (watch / "a.csv").write_text("x\n1.0\nbad,row\n3.0\n")
+    contract = SchemaContract({"x": ColumnSpec()}, mode="salvage")
+    sink = MemorySink()
+    q = StreamingQuery(
+        _Identity(),
+        FileStreamSource(str(watch), parse_salvage=True),
+        sink, str(tmp_path / "ckpt"),
+        schema_contract=contract,
+    )
+    assert q.process_available() == 1
+    np.testing.assert_array_equal(sink.frames[0]["x"], [1.0, 3.0])
+    rows = []
+    for p in glob.glob(
+        str(tmp_path / "ckpt" / "dead_letter_rows" / "*.jsonl")
+    ):
+        with open(p) as f:
+            rows += [json.loads(line) for line in f]
+    assert len(rows) == 1
+    assert rows[0]["file"].endswith("a.csv")
+    assert rows[0]["line"] == 3 and rows[0]["raw"] == "bad,row"
+    assert rows[0]["reason"] == "ragged_row"
+
+
+def test_take_rejects_is_file_scoped(tmp_path):
+    """A prefetch thread may parse (and reject lines from) a FUTURE
+    batch's file before the current batch drains — the drain must only
+    take the current batch's files' records and leave the rest."""
+    watch = tmp_path / "in"
+    watch.mkdir()
+    (watch / "a.csv").write_text("x\n1.0\nbad,a\n")
+    (watch / "b.csv").write_text("x\n2.0\nbad,b\n")
+    src = FileStreamSource(str(watch), parse_salvage=True)
+    src.latest_offset()
+    src.get_batch(0, 2)  # parses both files, collects both rejects
+    a = str(watch / "a.csv")
+    b = str(watch / "b.csv")
+    got = src.take_rejects([a])
+    assert [r["file"] for r in got] == [a]
+    got = src.take_rejects([b])
+    assert [r["file"] for r in got] == [b]
+    assert src.take_rejects() == []
+
+
+def test_dead_letter_journal_merges_never_shrinks(tmp_path):
+    """A rewrite of a batch's row journal (deferred-batch retry round,
+    WAL replay) must merge with the prior records, never drop them."""
+    contract = SchemaContract({"x": ColumnSpec()}, mode="salvage")
+    q = StreamingQuery(
+        _Identity(), MemorySource(_poison_frames()), MemorySink(),
+        str(tmp_path / "ckpt"), max_batch_offsets=1,
+        schema_contract=contract,
+    )
+    stray = {"file": "elsewhere.csv", "line": 9, "raw": "bad",
+             "reason": "ragged_row"}
+    q._journal_rejected_rows(0, {"start": 0, "end": 1}, [stray], [])
+    q._journal_rejected_rows(
+        0, {"start": 0, "end": 1},
+        [{"row": 2, "column": "x", "reason": "non_finite",
+          "value": "nan", "raw": "nan"}], [],
+    )
+    p = tmp_path / "ckpt" / "dead_letter_rows" / "batch_000000.jsonl"
+    recs = [json.loads(line) for line in p.read_text().splitlines()]
+    assert len(recs) == 2  # the stray record survived the rewrite
+    assert {r["reason"] for r in recs} == {"ragged_row", "non_finite"}
+
+
+def test_coerced_counts_only_permissive_repairs():
+    f = Frame({"x": np.array(["1.5", "2.5"], dtype=object),
+               "y": np.array([1.0, 2.0])})
+    c = _xy_contract()
+    assert c.admit(f, mode="salvage").coerced == 0  # reading ≠ repair
+    assert c.admit(f, mode="permissive").coerced == 2
+
+
+def test_admit_shares_clean_columns():
+    x = np.array([1.0, 2.0], np.float32)
+    f = Frame({"x": x, "y": np.array([3.0, 4.0], np.float32)})
+    res = _xy_contract().admit(f, mode="salvage")
+    assert res.valid.all()
+    assert res.frame["x"] is x  # clean column: zero copies, shared
+
+
+# ---------------------------------------------------------------------------
+# salvage × shape buckets × fusion: bitwise parity + flat compile ledger
+# ---------------------------------------------------------------------------
+
+D = 4
+
+
+def _serve_pipeline(mesh, head_name):
+    head = {
+        "lr": LogisticRegression(mesh=mesh, featuresCol="scaled",
+                                 maxIter=25),
+        "nb": NaiveBayes(mesh=mesh, featuresCol="scaled",
+                         modelType="multinomial"),
+    }[head_name]
+    rng = np.random.default_rng(0)
+    X = np.abs(rng.normal(3.0, 2.0, size=(400, D))).astype(np.float32)
+    train = Frame(
+        {f"c{i}": X[:, i].copy() for i in range(D)}
+        | {"label": (X[:, 0] > 3.0).astype(np.float64)}
+    )
+    pipe = Pipeline(stages=[
+        VectorAssembler(inputCols=[f"c{i}" for i in range(D)],
+                        outputCol="features"),
+        MinMaxScaler(inputCol="features", outputCol="scaled"),
+        head,
+    ])
+    return pipe.fit(train)
+
+
+def _stream_frames(n_batches=3, rows=8, seed=9):
+    """Per batch: (poisoned frame, valid mask). Poison = NaN in c1."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in range(n_batches):
+        X = np.abs(rng.normal(3.0, 2.0, size=(rows, D))).astype(np.float32)
+        cols = {f"c{i}": X[:, i].copy() for i in range(D)}
+        frame = Frame(cols)
+        valid = np.ones(rows, bool)
+        for r in rng.choice(rows, size=2, replace=False):
+            cols["c1"][r] = np.nan
+            valid[r] = False
+        out.append((Frame(dict(cols)), valid))
+    return out
+
+
+@pytest.mark.parametrize("head_name", ["lr", "nb"])
+def test_salvage_buckets_fusion_bitwise_flat_compiles(
+    tmp_path, mesh8, head_name, monkeypatch
+):
+    """The acceptance contract: with shape buckets AND fusion on, row
+    salvage yields sink output bitwise-equal (for the surviving rows)
+    to serving the pre-cleaned stream, and the compile ledgers stay
+    FLAT — excision never changes a dispatched shape."""
+    from sntc_tpu.fuse import compile_pipeline, fusion_stats
+
+    monkeypatch.setenv("SNTC_SERVE_HOST_ROWS", "0")
+    model = compile_pipeline(_serve_pipeline(mesh8, head_name))
+    assert fusion_stats(model)["segments"] >= 1
+    batches = _stream_frames()
+    contract = SchemaContract(
+        {f"c{i}": ColumnSpec() for i in range(D)}, mode="salvage"
+    )
+
+    def _run(frames, ckpt, with_contract):
+        sink = MemorySink()
+        q = StreamingQuery(
+            model, MemorySource(frames), sink, str(tmp_path / ckpt),
+            max_batch_offsets=1, shape_buckets=8,
+            schema_contract=contract if with_contract else None,
+        )
+        assert q.process_available() == len(frames)
+        return q, sink
+
+    q_ref, sink_ref = _run(
+        [f.filter(v) for f, v in batches], "ref", False
+    )
+    # the fused segments (and their compile ledgers) are SHARED by both
+    # queries — the salvage run must add zero new program signatures
+    fused_compiles_after_ref = fusion_stats(model)["compile_events"]
+    q_sal, sink_sal = _run([f for f, _ in batches], "salvage", True)
+
+    for (_, ref), (_, got) in zip(sink_ref.batches, sink_sal.batches):
+        assert got.num_rows == ref.num_rows
+        for c in ("rawPrediction", "probability", "prediction"):
+            if c in ref and c in got:
+                np.testing.assert_array_equal(
+                    np.asarray(got[c]), np.asarray(ref[c]), err_msg=c
+                )
+    # salvage never changed a dispatched shape: every batch is 8 rows
+    # -> ONE bucket -> one predictor compile event, and zero NEW fused
+    # program signatures beyond the reference run's
+    assert q_sal.predictor.compile_events == 1
+    assert q_sal.pipeline_stats()["compile_events"] == 1
+    assert (
+        fusion_stats(model)["compile_events"] == fused_compiles_after_ref
+    )
+    assert q_sal.admission_stats()["rows_rejected"] == 6
+
+
+# ---------------------------------------------------------------------------
+# corrupt-corpus chaos in tier-1 (satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def chaos():
+    return _load_script("chaos_corrupt_corpus")
+
+
+def test_chaos_corrupt_csv_exact_accounting(chaos, tmp_path):
+    verdict = chaos.scenario_csv_salvage(
+        str(tmp_path), n_files=3, rows=8, n_corrupt=5, seed=0
+    )
+    assert verdict["ok"], verdict
+    assert verdict["dead_letter_rows"] == 5
+    assert verdict["sink_match"] and verdict["compile_events"] == 1
+
+
+def test_chaos_fault_kind_conservation(chaos, tmp_path):
+    verdict = chaos.scenario_csv_fault_kinds(
+        str(tmp_path), n_files=4, rows=8, seed=7
+    )
+    assert verdict["ok"], verdict
+    assert (
+        verdict["sink_rows"] + verdict["dead_letter_rows"]
+        == verdict["reference_rows"]
+    )
+
+
+def test_chaos_binary_captures(chaos, tmp_path):
+    pcap = chaos.scenario_pcap(str(tmp_path), seed=3)
+    assert pcap["ok"], pcap
+    nf = chaos.scenario_netflow(str(tmp_path), seed=5)
+    assert nf["ok"], nf
+    assert nf["torn_rows"] == nf["expected_torn_rows"]

@@ -55,10 +55,30 @@ def test_malformed_rejected():
     assert parse_datagram(b"\x00" * 23) is None
     good = make_datagram(_records(2))
     assert parse_datagram(b"\x00\x09" + good[2:]) is None  # version 9
-    truncated = good[:-10]
-    assert parse_datagram(truncated) is None
     with pytest.raises(ValueError):
         make_datagram(_records(31))
+
+
+def test_truncated_datagram_salvages_valid_prefix():
+    """r10: a datagram cut mid-record no longer vanishes into None —
+    the records that fully fit parse, and the torn tail is reported as
+    a structured ``parse_truncated`` event (docs/RESILIENCE.md
+    "Data-plane admission")."""
+    import sntc_tpu.resilience as R
+
+    R.clear_events()
+    good = make_datagram(_records(2))
+    got = parse_datagram(good[:-10])  # second record torn
+    assert got is not None and got.shape == (1, NF5_FIELDS)
+    np.testing.assert_array_equal(got[0], _parse_py(good)[0])
+    events = [
+        e for e in R.recent_events() if e.get("event") == "parse_truncated"
+    ]
+    assert len(events) == 1
+    assert events[0]["format"] == "netflow"
+    assert events[0]["dropped_bytes"] == 48 - 10
+    # header-only torn datagram: zero records, still no exception
+    assert parse_datagram(good[:30]).shape == (0, NF5_FIELDS)
 
 
 def test_parse_stream_concatenated():
