@@ -45,6 +45,22 @@ SCRIPT = os.path.abspath(__file__)
 KILL_SITES = ("stream.wal", "sink.write", "stream.commit")
 KILL_EXIT_CODE = 137  # mirrors sntc_tpu.resilience.KILL_EXIT_CODE
 
+# kill-mid-promotion points (r11): where the model-lifecycle promotion
+# protocol dies.  pre_publish = before anything reached disk (the
+# promotion is simply lost; the incumbent keeps serving); pre_swap =
+# the candidate checkpoint + marker are published but the in-engine
+# swap never ran (a restart loads and serves the candidate); post_swap
+# = the predictor already swapped when the process died (restart
+# converges identically to pre_swap — the swap itself holds no
+# durable state beyond the publish).
+PROMOTE_KILL_POINTS = ("pre_publish", "pre_swap", "post_swap")
+# which model must serve the post-recovery batches per kill point
+PROMOTE_EXPECT_CANDIDATE = {
+    "pre_publish": False,
+    "pre_swap": True,
+    "post_swap": True,
+}
+
 
 # ---------------------------------------------------------------------------
 # scenario inputs / state readers (parent side; no sntc_tpu import)
@@ -223,6 +239,136 @@ def run_drain_scenario(
     }
 
 
+def sink_predictions(out_dir: str) -> dict:
+    """Per-batch-CSV set of served ``prediction`` values (the evidence
+    of WHICH model served the batch: the promotion scenarios' incumbent
+    predicts class 0 everywhere, the candidate class 1)."""
+    out = {}
+    for p in sorted(glob.glob(os.path.join(out_dir, "batch_*.csv"))):
+        with open(p) as f:
+            rows = list(csv.DictReader(f))
+        out[os.path.basename(p)] = sorted(
+            {float(r["prediction"]) for r in rows}
+        )
+    return out
+
+
+def run_promote_worker(
+    d: str, *, promote: bool, kill_point: str = "",
+    faults: str = "", timeout: float = 120.0,
+) -> subprocess.CompletedProcess:
+    """One promotion-scenario engine pass (the worker loads the serving
+    model from ``<d>/model``, the candidate from ``<d>/candidate``)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS=faults)
+    env.pop("SNTC_RESILIENCE_LOG", None)
+    cmd = [
+        sys.executable, SCRIPT, "--worker", "--watch",
+        os.path.join(d, "in"), "--out", os.path.join(d, "out"),
+        "--ckpt", os.path.join(d, "ckpt"), "--model-dir",
+        os.path.join(d, "model"), "--candidate-dir",
+        os.path.join(d, "candidate"),
+    ]
+    if promote:
+        cmd.append("--promote")
+    if kill_point:
+        cmd += ["--kill-point", kill_point]
+    return subprocess.run(
+        cmd, env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+def _setup_promotion_dir(d: str) -> None:
+    """Inputs + incumbent/candidate model checkpoints for one
+    promotion scenario (models are built in a child process — the
+    parent side of the matrix never imports sntc_tpu)."""
+    write_inputs(os.path.join(d, "in"))
+    setup = subprocess.run(
+        [
+            sys.executable, SCRIPT, "--worker", "--setup-models",
+            "--model-dir", os.path.join(d, "model"),
+            "--candidate-dir", os.path.join(d, "candidate"),
+        ],
+        env=dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS=""),
+        cwd=REPO, capture_output=True, text=True, timeout=120.0,
+    )
+    if setup.returncode != 0:
+        raise RuntimeError(f"model setup failed: {setup.stderr}")
+
+
+def run_promotion_reference(workdir: str) -> dict:
+    """One uninterrupted promote run: 2 batches under the incumbent,
+    promotion, the rest under the candidate."""
+    d = os.path.join(workdir, "promote_reference")
+    _setup_promotion_dir(d)
+    ref = run_promote_worker(d, promote=True)
+    if ref.returncode != 0:
+        raise RuntimeError(
+            f"promotion reference rc={ref.returncode}: {ref.stderr}"
+        )
+    return {
+        "commits": committed_state(os.path.join(d, "ckpt")),
+        "predictions": sink_predictions(os.path.join(d, "out")),
+    }
+
+
+def run_promotion_kill_scenario(
+    workdir: str, point: str, reference: dict,
+) -> dict:
+    """Kill the engine mid-promotion at ``point``, restart WITHOUT
+    re-promoting, and require (a) committed offsets converge to the
+    uninterrupted reference and (b) the post-recovery batches were
+    served by the CORRECT model — the incumbent when the kill landed
+    before the publish, the promoted candidate once the publish
+    reached disk."""
+    d = os.path.join(workdir, f"promote_{point}")
+    _setup_promotion_dir(d)
+    faults = {
+        "pre_publish": "model.publish:kill",
+        # model.swap fires twice per promotion: post-publish/pre-swap
+        # and post-swap; the env kind kills the FIRST call, the
+        # post_swap point arms the second programmatically in-worker
+        "pre_swap": "model.swap:kill",
+        "post_swap": "",
+    }[point]
+    killed = run_promote_worker(
+        d, promote=True, faults=faults,
+        kill_point=point if point == "post_swap" else "",
+    )
+    if killed.returncode != KILL_EXIT_CODE:
+        return {"site": f"promote.{point}", "ok": False,
+                "error": f"kill run rc={killed.returncode} (expected "
+                f"{KILL_EXIT_CODE}): {killed.stderr}"}
+
+    # restart on the same checkpoint, no faults, NO re-promotion: the
+    # serving model is whatever the crashed promotion left durable
+    restarted = run_promote_worker(d, promote=False)
+    if restarted.returncode != 0:
+        return {"site": f"promote.{point}", "ok": False,
+                "error": f"restart rc={restarted.returncode}: "
+                f"{restarted.stderr}"}
+
+    got_commits = committed_state(os.path.join(d, "ckpt"))
+    want_commits = reference["commits"]
+    preds = sink_predictions(os.path.join(d, "out"))
+    candidate_serves = PROMOTE_EXPECT_CANDIDATE[point]
+    # batches 0-1 committed under the incumbent before the kill; the
+    # post-recovery batches carry the recovered model's predictions
+    want_preds = {
+        "batch_000000.csv": [0.0], "batch_000001.csv": [0.0],
+        "batch_000002.csv": [1.0] if candidate_serves else [0.0],
+        "batch_000003.csv": [1.0] if candidate_serves else [0.0],
+    }
+    ok = got_commits == want_commits and preds == want_preds
+    return {
+        "site": f"promote.{point}", "ok": ok,
+        "candidate_serves": candidate_serves,
+        "commits": {str(k): v for k, v in got_commits.items()},
+        "expected_commits": {str(k): v for k, v in want_commits.items()},
+        "predictions": preds, "expected_predictions": want_preds,
+    }
+
+
 def run_matrix(workdir: str, pipelined: bool = False) -> dict:
     """The full matrix: reference is ALWAYS the serial engine; kill and
     drain scenarios run serial or pipelined per ``pipelined`` and must
@@ -233,12 +379,98 @@ def run_matrix(workdir: str, pipelined: bool = False) -> dict:
         for s in KILL_SITES
     ]
     results.append(run_drain_scenario(workdir, pipelined=pipelined))
+    promo_ref = run_promotion_reference(workdir)
+    results.extend(
+        run_promotion_kill_scenario(workdir, p, promo_ref)
+        for p in PROMOTE_KILL_POINTS
+    )
     return {"ok": all(r["ok"] for r in results), "scenarios": results}
 
 
 # ---------------------------------------------------------------------------
 # worker (child side)
 # ---------------------------------------------------------------------------
+
+
+def _const_class_pipeline(positive: bool):
+    """A real servable pipeline predicting ONE class everywhere: zero
+    coefficients, an intercept that pins the sigmoid — incumbent (class
+    0) and candidate (class 1) outputs are trivially distinguishable in
+    the sink, which is the whole point of the promotion scenarios."""
+    import numpy as np
+
+    from sntc_tpu.core.base import PipelineModel
+    from sntc_tpu.feature import VectorAssembler
+    from sntc_tpu.models.logistic_regression import (
+        LogisticRegressionModel,
+    )
+
+    head = LogisticRegressionModel(
+        coefficient_matrix=np.zeros((2, 1), np.float32),
+        intercepts=np.asarray(
+            [0.0, 50.0 if positive else -50.0], np.float32
+        ),
+        is_binomial=True,
+    )
+    return PipelineModel(stages=[
+        VectorAssembler(inputCols=["x"], outputCol="features"),
+        head,
+    ])
+
+
+def setup_models_main(args) -> int:
+    """Write the incumbent (class-0) and candidate (class-1) serving
+    checkpoints for a promotion scenario."""
+    sys.path.insert(0, REPO)
+    from sntc_tpu.mlio import save_model
+
+    save_model(_const_class_pipeline(False), args.model_dir)
+    save_model(_const_class_pipeline(True), args.candidate_dir)
+    print(json.dumps({"model": args.model_dir,
+                      "candidate": args.candidate_dir}))
+    return 0
+
+
+def promote_worker_main(args) -> int:
+    """Promotion-scenario engine pass: serve 2 batches under the model
+    loaded from ``--model-dir``, then (``--promote``) publish + swap
+    the ``--candidate-dir`` checkpoint through the full ModelPromoter
+    protocol — the armed kill fault fires inside it — and drain the
+    rest.  Without ``--promote`` (the restart pass) the worker simply
+    serves whatever checkpoint the crashed promotion left at the
+    serving path."""
+    sys.path.insert(0, REPO)
+    from sntc_tpu.lifecycle import LifecycleManager, ModelPromoter
+    from sntc_tpu.mlio import load_model
+    from sntc_tpu.resilience import arm
+    from sntc_tpu.serve import CsvDirSink, FileStreamSource, StreamingQuery
+
+    model = load_model(args.model_dir)
+    sink = CsvDirSink(args.out, columns=["x", "prediction"])
+    src = FileStreamSource(args.watch)
+    promoter = ModelPromoter(
+        model, incumbent_raw=model, serving_path=args.model_dir,
+        checkpoint_dir=args.ckpt, probation_batches=1,
+    )
+    mgr = LifecycleManager(promoter=promoter)
+    q = StreamingQuery(
+        model, src, sink, args.ckpt,
+        max_batch_offsets=1, pipeline_depth=1, lifecycle=mgr,
+    )
+    if args.promote:
+        q.run(max_batches=2, poll_interval=0.01)
+        if args.kill_point == "post_swap":
+            # the second model.swap call of THIS promotion runs right
+            # after the in-engine swap — Nth-call precision the env
+            # grammar has no syntax for
+            arm("model.swap", kind="kill", after=1, times=1)
+        promoter.load_candidate(args.candidate_dir)
+        # direct promotion (the gated path is exercised in tier-1 unit
+        # tests; chaos targets the publish/swap protocol itself)
+        promoter.promote()
+    n = q.process_available()
+    print(json.dumps({"batches": n, "swapped": q.models_swapped}))
+    return 0
 
 
 def worker_main(args) -> int:
@@ -300,10 +532,27 @@ def main(argv=None) -> int:
     ap.add_argument("--ckpt")
     ap.add_argument("--slow-sink-s", type=float, default=0.0)
     ap.add_argument("--poll-interval", type=float, default=0.05)
+    ap.add_argument("--setup-models", action="store_true",
+                    help="worker: write the promotion scenario's "
+                    "incumbent/candidate checkpoints and exit")
+    ap.add_argument("--model-dir", default=None,
+                    help="worker: serving-model checkpoint (doubles as "
+                    "the promotion publish target)")
+    ap.add_argument("--candidate-dir", default=None,
+                    help="worker: candidate checkpoint to promote")
+    ap.add_argument("--promote", action="store_true",
+                    help="worker: run the mid-stream promotion pass")
+    ap.add_argument("--kill-point", default="",
+                    help="worker: post_swap arms the SECOND model.swap "
+                    "call programmatically (after=1)")
     ap.add_argument("--workdir", default=None,
                     help="matrix scratch dir (default: a fresh tempdir)")
     args = ap.parse_args(argv)
     if args.worker:
+        if args.setup_models:
+            return setup_models_main(args)
+        if args.model_dir:
+            return promote_worker_main(args)
         return worker_main(args)
     workdir = args.workdir
     if workdir is None:

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Static drift check: model-lifecycle knobs across CLI ⇔ lifecycle ⇔ docs.
+
+The live-model lifecycle surface is one feature spread over three
+layers — ``python -m sntc_tpu serve`` flags, the
+``sntc_tpu.lifecycle`` constructor kwargs/methods they map to, and the
+documentation — and each knob must exist in all of them:
+
+====================  ==============================================
+``--partial-fit``     ``LifecycleManager(partial_fit=...)``
+``--drift-window``    ``DriftMonitor(window=...)``
+``--drift-threshold`` ``DriftMonitor(threshold=...)``
+``--promote-from``    ``ModelPromoter.load_candidate(...)``
+``--shadow-window``   ``ModelPromoter(window=...)``
+====================  ==============================================
+
+Every flag must appear in ``docs/RESILIENCE.md`` AND the README serve
+section.  Wired as a tier-1 test (``tests/test_lifecycle.py``) so the
+three layers cannot drift silently — the ``check_perf_flags.py``
+discipline applied to the lifecycle surface.
+
+Exit 0 when consistent; exit 1 with a per-knob report otherwise.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (CLI flag, owner class name, kwarg-or-method it maps to)
+FLAGS = (
+    ("--partial-fit", "LifecycleManager", "partial_fit"),
+    ("--drift-window", "DriftMonitor", "window"),
+    ("--drift-threshold", "DriftMonitor", "threshold"),
+    ("--promote-from", "ModelPromoter", "load_candidate"),
+    ("--shadow-window", "ModelPromoter", "window"),
+    ("--promote-margin", "ModelPromoter", "margin"),
+)
+DOCS = ("docs/RESILIENCE.md", "README.md")
+
+
+def _read(rel: str) -> str:
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def _owner(name: str):
+    sys.path.insert(0, REPO)
+    from sntc_tpu.lifecycle import (
+        DriftMonitor,
+        LifecycleManager,
+        ModelPromoter,
+    )
+
+    return {
+        "LifecycleManager": LifecycleManager,
+        "DriftMonitor": DriftMonitor,
+        "ModelPromoter": ModelPromoter,
+    }[name]
+
+
+def check() -> list:
+    """Returns a list of human-readable drift complaints (empty = ok)."""
+    problems = []
+    app_src = _read(os.path.join("sntc_tpu", "app.py"))
+    doc_srcs = {rel: _read(rel) for rel in DOCS}
+    for flag, owner_name, target in FLAGS:
+        if f'"{flag}"' not in app_src:
+            problems.append(
+                f"serve CLI flag {flag!r} missing from sntc_tpu/app.py"
+            )
+        owner = _owner(owner_name)
+        params = inspect.signature(owner.__init__).parameters
+        if target not in params and not callable(
+            getattr(owner, target, None)
+        ):
+            problems.append(
+                f"{owner_name} has neither a {target!r} kwarg nor a "
+                f"{target!r} method for {flag!r} to map to"
+            )
+        for rel, src in doc_srcs.items():
+            if flag not in src:
+                problems.append(f"{flag!r} undocumented in {rel}")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("lifecycle-flag drift detected:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print(
+        f"ok: {len(FLAGS)} lifecycle flags consistent across CLI, "
+        "lifecycle kwargs, and docs"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

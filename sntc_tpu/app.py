@@ -253,7 +253,17 @@ def cmd_serve(args) -> int:
     )
 
     model = load_model(args.model)
+    raw_model = model  # persistable form: the lifecycle publish target
+    # model lifecycle (r11): any of the drift / shadow-promotion /
+    # incremental-fit flags arms the LifecycleManager on the engine
+    lifecycle_armed = bool(
+        args.partial_fit or args.drift_window > 0 or args.promote_from
+    )
+    # only a config that can SWAP models needs the head kept out of the
+    # fused segments; drift-only monitoring keeps full head fusion
+    swap_armed = bool(args.partial_fit or args.promote_from)
     out_cols = ["prediction"]
+    labels = None
     if isinstance(model, PipelineModel):
         # no labels on live flows: drop the LABEL indexer (the one writing
         # --label-index-col; indexers on feature columns are kept) and map
@@ -273,9 +283,17 @@ def cmd_serve(args) -> int:
         # --fuse (default): the whole-pipeline fusion compiler — scaler
         # weight folding + one jitted device program per fusible stage
         # run, one upload/download per micro-batch (docs/PERFORMANCE.md
-        # "Whole-pipeline fusion"); --no-fuse serves the staged pipeline
+        # "Whole-pipeline fusion"); --no-fuse serves the staged pipeline.
+        # With promotion or partial-fit armed the HEAD stays a plain
+        # stage (fuse_heads=False): a fused head's weights are
+        # constants of the segment's program, so hot-swapping it would
+        # recompile the whole prefix — plain heads swap with zero
+        # prefix recompiles while the feature prefix still fuses.
+        # Drift-only monitoring never swaps, so it keeps full fusion.
         if args.fuse:
-            model = compile_serving(model)
+            model = compile_serving(
+                model, fuse_heads=not swap_armed
+            )
         if tail:
             out_cols = ["prediction", "predictedLabel"]
     # a SERVED query degrades instead of dying: transient read/sink
@@ -302,6 +320,56 @@ def cmd_serve(args) -> int:
         from sntc_tpu.data import CICIDS2017_CONTRACT
 
         contract = CICIDS2017_CONTRACT.with_mode(args.row_policy)
+    # live-model lifecycle: --drift-window arms the divergence monitor
+    # (drift_detected events, model DEGRADED); --promote-from shadow-
+    # scores a candidate checkpoint and promotes it through the atomic
+    # publish + between-batches hot-swap; --partial-fit incrementally
+    # refits the candidate head from live labeled batches (LR/NB)
+    lifecycle = None
+    if lifecycle_armed:
+        from sntc_tpu.lifecycle import (
+            DriftMonitor,
+            LifecycleManager,
+            ModelPromoter,
+        )
+
+        drift = None
+        if args.drift_window > 0:
+            drift = DriftMonitor(
+                window=args.drift_window,
+                threshold=args.drift_threshold,
+            ).attach()
+        promoter = None
+        if args.promote_from or args.partial_fit:
+            promoter = ModelPromoter(
+                model,
+                incumbent_raw=raw_model,
+                serving_path=args.model,
+                checkpoint_dir=args.checkpoint,
+                window=args.shadow_window,
+                margin=args.promote_margin,
+                label_col="Label",
+                labels=labels,
+                bucket_rows=args.shape_buckets,
+            )
+            if args.partial_fit:
+                from sntc_tpu.lifecycle import (
+                    incremental_estimator_for,
+                    terminal_head,
+                )
+
+                try:  # fail fast on a head with no partial_fit path
+                    incremental_estimator_for(terminal_head(model))
+                except ValueError as e:
+                    raise SystemExit(f"--partial-fit: {e}")
+            if args.promote_from:
+                promoter.load_candidate(args.promote_from)
+        lifecycle = LifecycleManager(
+            drift=drift,
+            promoter=promoter,
+            partial_fit=args.partial_fit,
+            n_classes=len(labels) if labels is not None else None,
+        )
     q = StreamingQuery(
         model,
         FileStreamSource(
@@ -325,6 +393,7 @@ def cmd_serve(args) -> int:
         ),
         schema_contract=contract,
         row_dead_letter_dir=args.row_dead_letter,
+        lifecycle=lifecycle,
     )
     if args.once:
         n = q.process_available()
@@ -469,6 +538,32 @@ def main(argv=None) -> int:
                    "<checkpoint>/dead_letter_rows): one JSONL per "
                    "batch with file/line/raw text/reason per excised "
                    "row")
+    p.add_argument("--partial-fit", action="store_true",
+                   help="incrementally refit a candidate head (LR/NB "
+                   "sufficient-statistic partial_fit) from live "
+                   "labeled batches and shadow it for promotion")
+    p.add_argument("--drift-window", type=int, default=0, metavar="N",
+                   help="arm the drift monitor: Jensen-Shannon "
+                   "divergence of the last N committed batches' "
+                   "prediction-mix/score histograms against the first "
+                   "N (drift_detected event + model DEGRADED on "
+                   "breach); 0 = off")
+    p.add_argument("--drift-threshold", type=float, default=0.25,
+                   help="divergence breach level for --drift-window")
+    p.add_argument("--promote-from", default=None, metavar="DIR",
+                   help="candidate model checkpoint to shadow-score on "
+                   "live batches; promoted (atomic publish over "
+                   "--model, incumbent retained at .prev, "
+                   "between-batches hot-swap) when its macro-F1 beats "
+                   "the incumbent over --shadow-window batches")
+    p.add_argument("--shadow-window", type=int, default=8, metavar="N",
+                   help="labeled batches the promotion gate averages "
+                   "macro-F1 over")
+    p.add_argument("--promote-margin", type=float, default=0.05,
+                   help="macro-F1 lead the candidate must hold over "
+                   "the incumbent to promote; with --partial-fit the "
+                   "candidate is a refit of the incumbent, so refit "
+                   "jitter re-promotes every window at margin 0")
     p.add_argument("--batch-retry-attempts", type=int, default=2,
                    help="in-place attempts per read/sink stage before a "
                    "round counts as failed (1 = no retry)")

@@ -8,7 +8,12 @@ from sntc_tpu.data.schema import (
     SchemaContract,
     SchemaViolation,
 )
-from sntc_tpu.data.synth import generate_frame, write_day_csvs
+from sntc_tpu.data.synth import (
+    generate_drift_frames,
+    generate_frame,
+    write_day_csvs,
+    write_drift_stream,
+)
 from sntc_tpu.data.ingest import clean_flows, load_csv, load_csv_dir, cache_parquet
 
 __all__ = [
@@ -21,7 +26,9 @@ __all__ = [
     "SchemaContract",
     "SchemaViolation",
     "generate_frame",
+    "generate_drift_frames",
     "write_day_csvs",
+    "write_drift_stream",
     "clean_flows",
     "load_csv",
     "load_csv_dir",

@@ -126,6 +126,32 @@ def generate_frame(
     return Frame(cols)
 
 
+def _write_raw_csv(frame: Frame, path: str) -> str:
+    """One CSV in the raw "MachineLearningCVE" style: erratic
+    leading-space column headers, 'Fwd Header Length' duplicated (the
+    ingest dedup maps the second occurrence to 'Fwd Header
+    Length.1')."""
+    raw_names = [
+        "Fwd Header Length" if c == "Fwd Header Length.1" else c
+        for c in frame.columns
+    ]
+    header = ",".join(
+        (" " + c if i % 2 else c) for i, c in enumerate(raw_names)
+    )
+    with open(path, "w") as f:
+        f.write(header + "\n")
+        cols = [frame[c] for c in frame.columns]
+        for i in range(frame.num_rows):
+            f.write(
+                ",".join(
+                    str(col[i]) if col.dtype == object else repr(float(col[i]))
+                    for col in cols
+                )
+                + "\n"
+            )
+    return path
+
+
 def write_day_csvs(
     out_dir: str,
     n_rows_per_day: int = 1000,
@@ -135,30 +161,94 @@ def write_day_csvs(
     """Emulate the 8 "MachineLearningCVE" day files as CSVs on disk, with the
     raw files' erratic leading-space column headers, for ingest tests."""
     os.makedirs(out_dir, exist_ok=True)
-    paths = []
-    for day in range(n_days):
-        frame = generate_frame(n_rows_per_day, seed=seed + day)
-        path = os.path.join(out_dir, f"day{day}.csv")
-        # raw CICIDS2017 headers have leading spaces on most columns, and
-        # 'Fwd Header Length' appears twice (the ingest dedup maps the second
-        # occurrence to 'Fwd Header Length.1')
-        raw_names = [
-            "Fwd Header Length" if c == "Fwd Header Length.1" else c
-            for c in frame.columns
-        ]
-        header = ",".join(
-            (" " + c if i % 2 else c) for i, c in enumerate(raw_names)
+    return [
+        _write_raw_csv(
+            generate_frame(n_rows_per_day, seed=seed + day),
+            os.path.join(out_dir, f"day{day}.csv"),
         )
-        with open(path, "w") as f:
-            f.write(header + "\n")
-            cols = [frame[c] for c in frame.columns]
-            for i in range(frame.num_rows):
-                f.write(
-                    ",".join(
-                        str(col[i]) if col.dtype == object else repr(float(col[i]))
-                        for col in cols
-                    )
-                    + "\n"
-                )
-        paths.append(path)
-    return paths
+        for day in range(n_days)
+    ]
+
+
+def generate_drift_frames(
+    n_batches: int,
+    rows_per_batch: int = 512,
+    shift_at: Optional[int] = None,
+    seed: int = 0,
+    n_classes: int = 8,
+    shift_seed: int = 101,
+    shift_priors: Optional[List[float]] = None,
+) -> List[Frame]:
+    """A two-day CICIDS-style micro-batch stream with a DETERMINISTIC
+    distribution shift at batch ``shift_at`` (default: halfway) — the
+    drift-replay fixture the lifecycle tests and bench drive.
+
+    Phase A batches slice one day drawn with the standard benign-heavy
+    priors and the ``seed`` concept (class signatures); phase B slices
+    a second day with ``shift_priors`` (default: benign collapses to
+    ~15% and the attack mass spreads evenly — the day-boundary mix
+    shift) AND a re-drawn concept from ``shift_seed`` — so both the
+    prediction mix and the class-conditional structure move, degrading
+    an incumbent trained on phase A.  Slicing two per-phase frames (not
+    one frame per batch) keeps each phase's concept FIXED across its
+    batches, which is what makes detection latency a deterministic
+    constant the tests can pin.
+    """
+    if shift_at is None:
+        shift_at = n_batches // 2
+    if not 0 < shift_at <= n_batches:
+        raise ValueError("shift_at must lie in (0, n_batches]")
+    if shift_priors is None:
+        shift_priors = [0.15] + [0.85 / (n_classes - 1)] * (n_classes - 1)
+    pre = generate_frame(
+        shift_at * rows_per_batch, seed=seed, n_classes=n_classes,
+        dirty=False,
+    )
+    frames = [
+        pre.slice(i * rows_per_batch, (i + 1) * rows_per_batch)
+        for i in range(shift_at)
+    ]
+    n_post = n_batches - shift_at
+    if n_post:
+        post = generate_frame(
+            n_post * rows_per_batch, seed=shift_seed,
+            n_classes=n_classes, dirty=False,
+            class_priors=shift_priors,
+        )
+        frames.extend(
+            post.slice(i * rows_per_batch, (i + 1) * rows_per_batch)
+            for i in range(n_post)
+        )
+    return frames
+
+
+def write_drift_stream(
+    out_dir: str,
+    n_batches: int,
+    rows_per_batch: int = 512,
+    shift_at: Optional[int] = None,
+    seed: int = 0,
+    n_classes: int = 8,
+    shift_seed: int = 101,
+    shift_priors: Optional[List[float]] = None,
+    frames: Optional[List[Frame]] = None,
+) -> List[str]:
+    """The :func:`generate_drift_frames` fixture as one raw-header CSV
+    per micro-batch (``part_NNNN.csv``) — drop it under a serve
+    ``--watch`` directory and each file is one engine micro-batch.
+
+    Pass ``frames`` to write an already-generated fixture (the bench
+    scores and streams the same frames — regenerating them here would
+    double the setup cost); the generation kwargs are ignored then.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    if frames is None:
+        frames = generate_drift_frames(
+            n_batches, rows_per_batch, shift_at=shift_at, seed=seed,
+            n_classes=n_classes, shift_seed=shift_seed,
+            shift_priors=shift_priors,
+        )
+    return [
+        _write_raw_csv(f, os.path.join(out_dir, f"part_{i:04d}.csv"))
+        for i, f in enumerate(frames)
+    ]

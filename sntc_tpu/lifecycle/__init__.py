@@ -1,0 +1,69 @@
+"""Live-model lifecycle: incremental fit, drift detection, shadow
+promotion, and crash-safe hot-swap on the serving stream (r11).
+
+Four cooperating layers, all riding the contracts PRs 1-5 established:
+
+* **Incremental fit** (:mod:`~sntc_tpu.lifecycle.incremental`) —
+  ``partial_fit`` for LogisticRegression / NaiveBayes as device-side
+  sufficient-statistic updates (the summarizer pass training already
+  runs), accumulated across shards in a decayable host-f64 state;
+* **Drift monitor** (:mod:`~sntc_tpu.lifecycle.drift`) — per-batch
+  prediction-mix and score-histogram statistics ride the structured
+  event stream as ``batch_scored`` events; a windowed
+  Jensen-Shannon divergence against a frozen reference window emits
+  ``drift_detected`` and flips the ``model`` component to DEGRADED in
+  :class:`~sntc_tpu.resilience.health.HealthMonitor`;
+* **Shadow promotion** (:mod:`~sntc_tpu.lifecycle.promote`) — a
+  :class:`ModelPromoter` shadow-scores a candidate on live batches
+  through the same bucketed/fused predict path (zero new
+  feature-prefix compile signatures), gates promotion on macro-F1
+  beating the incumbent over a window, and journals every verdict;
+* **Crash-safe hot-swap** — promotion publishes the candidate through
+  the PR-1 atomic-checkpoint machinery (``save_model`` retains
+  ``<path>.prev``), swaps the engine predictor only BETWEEN
+  micro-batches (never mid-delivery in ``overlap_sink`` mode), and
+  rolls back to ``.prev`` on a post-swap failure-rate breach via the
+  PR-2 ``predict.dispatch`` circuit breaker.  The WAL/replay contract
+  holds across a swap — proven by the kill-mid-promotion scenarios in
+  ``scripts/chaos_crash_matrix.py``.
+
+:class:`~sntc_tpu.lifecycle.manager.LifecycleManager` composes the
+layers behind the ``StreamingQuery(lifecycle=...)`` hook.  See
+``docs/RESILIENCE.md`` "Model lifecycle".
+"""
+
+from sntc_tpu.lifecycle.drift import (
+    DriftMonitor,
+    batch_score_stats,
+    js_divergence,
+)
+from sntc_tpu.lifecycle.incremental import (
+    LRPartialFitState,
+    NBPartialFitState,
+    incremental_estimator_for,
+)
+from sntc_tpu.lifecycle.manager import LifecycleManager
+from sntc_tpu.lifecycle.promote import (
+    MODEL_MARKER,
+    ModelPromoter,
+    graft_head,
+    macro_f1,
+    read_model_marker,
+    terminal_head,
+)
+
+__all__ = [
+    "DriftMonitor",
+    "batch_score_stats",
+    "js_divergence",
+    "LRPartialFitState",
+    "NBPartialFitState",
+    "incremental_estimator_for",
+    "LifecycleManager",
+    "ModelPromoter",
+    "MODEL_MARKER",
+    "graft_head",
+    "macro_f1",
+    "read_model_marker",
+    "terminal_head",
+]

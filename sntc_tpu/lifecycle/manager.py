@@ -1,0 +1,213 @@
+"""LifecycleManager — the one object the engine talks to.
+
+``StreamingQuery(lifecycle=LifecycleManager(...))`` wires the whole
+model-lifecycle subsystem into the serving loop through three
+duck-typed hooks:
+
+* ``on_batch(batch_id, frame, finalize)`` — after every CLEAN commit
+  (engine thread): emit the ``batch_scored`` event (feeding any
+  attached :class:`DriftMonitor`), optionally ``partial_fit`` the
+  candidate head from the batch's labels, and shadow-score /
+  gate-check via the :class:`ModelPromoter`;
+* ``on_tick(query)`` — once per engine round: probation breach check
+  (rollback on an open ``predict.dispatch`` breaker);
+* ``take_pending_swap()`` / ``on_swap_applied(old)`` — the deferred
+  hot-swap handshake: the engine applies a pending swap only BETWEEN
+  micro-batches (settling any in-air delivery first) and reports back
+  so the promoter advances its state machine and the drift monitor
+  resets its baseline for the new model.
+
+A lifecycle hook failure must degrade, never kill, the serving loop:
+the engine wraps ``on_batch`` and emits ``lifecycle_error`` on an
+exception.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from sntc_tpu.lifecycle.drift import DriftMonitor, batch_score_stats
+from sntc_tpu.lifecycle.promote import ModelPromoter
+from sntc_tpu.resilience import emit_event
+
+
+class LifecycleManager:
+    """Compose drift monitoring, incremental refit, and promotion.
+
+    ``drift`` and ``promoter`` are each optional — a manager with only
+    a DriftMonitor just scores batches; one with only a ModelPromoter
+    just shadows/promotes.  ``partial_fit=True`` arms the online-
+    learning loop: every labeled batch incrementally refits a candidate
+    head cloned from the incumbent (via
+    :func:`~sntc_tpu.lifecycle.incremental.incremental_estimator_for`)
+    and keeps it shadowed for the promotion gate.
+    """
+
+    def __init__(
+        self,
+        *,
+        drift: Optional[DriftMonitor] = None,
+        promoter: Optional[ModelPromoter] = None,
+        partial_fit: bool = False,
+        n_classes: Optional[int] = None,
+        prediction_col: str = "prediction",
+        probability_col: str = "probability",
+        mesh=None,
+    ):
+        self.drift = drift
+        self.promoter = promoter
+        self.partial_fit = bool(partial_fit)
+        if self.partial_fit and promoter is None:
+            raise ValueError(
+                "partial_fit=True needs a ModelPromoter to shadow the "
+                "refit candidate"
+            )
+        self.prediction_col = prediction_col
+        self.probability_col = probability_col
+        self._mesh = mesh
+        self._n_classes = n_classes
+        self._pf_estimator = None
+        self._pf_state = None
+        self.batches_scored = 0
+        self.partial_fit_batches = 0
+
+    # -- engine hooks --------------------------------------------------------
+
+    def _resolve_classes(self, out_frame) -> int:
+        if self._n_classes is None:
+            if self.promoter is not None:
+                try:
+                    from sntc_tpu.lifecycle.promote import terminal_head
+
+                    self._n_classes = terminal_head(
+                        self.promoter.incumbent
+                    ).num_classes
+                except (ValueError, NotImplementedError):
+                    pass
+            if self._n_classes is None:
+                prob = out_frame.column(self.probability_col) if (
+                    self.probability_col in out_frame
+                ) else None
+                self._n_classes = (
+                    int(prob.shape[1]) if prob is not None and
+                    prob.ndim == 2
+                    else int(
+                        np.asarray(
+                            out_frame[self.prediction_col]
+                        ).max(initial=0)
+                    ) + 1
+                )
+        return self._n_classes
+
+    def on_batch(self, batch_id: int, frame, finalize) -> None:
+        out = finalize()  # memoized by the predictor: a cached read
+        k = self._resolve_classes(out)
+        stats = batch_score_stats(
+            out, k,
+            prediction_col=self.prediction_col,
+            probability_col=self.probability_col,
+        )
+        self.batches_scored += 1
+        # the drift monitor (and anything else listening) reads this
+        # off the structured stream — scoring statistics are events,
+        # not private state
+        emit_event(
+            event="batch_scored", site="model.score",
+            batch_id=batch_id, **stats,
+        )
+        if self.promoter is None:
+            return
+        # test-then-train: the gate scores the candidate BEFORE it sees
+        # this batch's labels, so incumbent and candidate are judged on
+        # the same unseen data (a candidate scored on its own training
+        # batch would beat the incumbent spuriously on noisy
+        # micro-batches)
+        self.promoter.on_batch(batch_id, frame, out)
+        if self.partial_fit:
+            self._partial_fit_candidate(frame, out)
+
+    def _partial_fit_candidate(self, frame, out_frame) -> None:
+        """Fold one labeled batch into the incremental candidate head.
+        Features come from the OUTPUT frame (the fused prefix keeps
+        the head's input column alive because the head is a later
+        reader), labels from the promoter's label mapping."""
+        from sntc_tpu.lifecycle.incremental import (
+            incremental_estimator_for,
+        )
+        from sntc_tpu.lifecycle.promote import terminal_head
+
+        y = self.promoter._labels_from(frame)
+        if y is None:
+            return
+        known = y >= 0
+        if not known.any():
+            return
+        if self._pf_estimator is None:
+            self._pf_estimator = incremental_estimator_for(
+                terminal_head(self.promoter.incumbent), mesh=self._mesh
+            )
+        head = terminal_head(self.promoter.incumbent)
+        feats_col = head.getFeaturesCol()
+        if feats_col not in out_frame:
+            return
+        from sntc_tpu.core.frame import Frame
+
+        X_all = np.asarray(out_frame[feats_col])
+        if X_all.shape[0] != y.shape[0]:
+            # a row-dropping stage broke input/output row alignment
+            # (same skip rule as the promoter's shadow scoring)
+            return
+        X = X_all[known]
+        batch = Frame({
+            self._pf_estimator.getFeaturesCol(): X,
+            self._pf_estimator.getLabelCol(): y[known].astype(
+                np.float64
+            ),
+        })
+        # the incumbent's label universe fixes the state's class count:
+        # the first live mini-batch rarely carries every class, and a
+        # state frozen at a partial class set would reject later shards
+        try:
+            k = int(head.num_classes)
+        except (NotImplementedError, TypeError):
+            k = self._n_classes
+        model, self._pf_state = self._pf_estimator.partial_fit(
+            batch, self._pf_state, n_classes=k
+        )
+        self.partial_fit_batches += 1
+        self.promoter.update_candidate(model)
+
+    def on_tick(self, query=None) -> None:
+        if self.promoter is not None:
+            self.promoter.on_tick(query)
+
+    def take_pending_swap(self):
+        if self.promoter is None:
+            return None
+        return self.promoter.take_pending_swap()
+
+    def rearm_pending_swap(self, model) -> None:
+        if self.promoter is not None:
+            self.promoter.rearm_pending_swap(model)
+
+    def on_swap_applied(self, old_model) -> None:
+        if self.promoter is not None:
+            self.promoter.on_swap_applied(old_model)
+        if self.drift is not None:
+            # the promoted (or restored) model earns a fresh baseline —
+            # its healthy prediction mix IS expected to differ
+            self.drift.reset()
+
+    def stats(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "batches_scored": self.batches_scored,
+            "partial_fit": self.partial_fit,
+            "partial_fit_batches": self.partial_fit_batches,
+        }
+        if self.drift is not None:
+            out["drift"] = self.drift.stats()
+        if self.promoter is not None:
+            out["promoter"] = self.promoter.stats()
+        return out

@@ -1,0 +1,613 @@
+"""Shadow promotion and crash-safe hot-swap of the serving model.
+
+:class:`ModelPromoter` owns the candidate's whole life:
+
+1. **shadow** — the candidate head is grafted onto the INCUMBENT's
+   feature prefix (:func:`graft_head` reuses the same fitted stage /
+   ``FusedSegment`` instances, so shadow dispatches hit the prefix's
+   already-compiled programs — zero new feature-prefix compile
+   signatures) and scored on every live labeled batch through a
+   :class:`~sntc_tpu.serve.transform.BatchPredictor` sharing the
+   engine's bucket config (same padded shapes, same program cache);
+2. **gate** — per-batch macro-F1 verdicts (incumbent vs candidate) are
+   journaled to ``<checkpoint>/promotion.jsonl``; when the candidate's
+   mean beats the incumbent's over a full ``window`` (+ ``margin``),
+   promotion fires;
+3. **publish** — the candidate is persisted OVER the serving model
+   path via the PR-1 atomic checkpoint machinery (``save_model``
+   stages, seals, renames; the incumbent is retained at
+   ``<path>.prev``), then an atomic ``model_marker.json`` records the
+   new generation.  Kill points: ``model.publish`` (pre-publish —
+   nothing changed on disk), ``model.swap`` first call (post-publish /
+   pre-swap — a restart loads and serves the candidate), ``model.swap``
+   second call (post-swap);
+4. **swap** — the in-engine swap is DEFERRED to the engine's next
+   safe point (``StreamingQuery`` applies pending swaps only between
+   micro-batches, never mid-delivery in ``overlap_sink`` mode);
+5. **probation / rollback** — after the swap, ``probation_batches``
+   clean commits must land while the ``predict.dispatch`` circuit
+   breaker stays closed; a breach rolls back to the retained
+   ``<path>.prev`` snapshot (in-memory, the exact incumbent object —
+   predictions restore bitwise) and republishes the incumbent.
+
+The promoter is engine-facing through the duck-typed hooks
+``on_batch`` / ``on_tick`` / ``take_pending_swap`` (usually composed
+by :class:`~sntc_tpu.lifecycle.manager.LifecycleManager`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from sntc_tpu.core.base import PipelineModel
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.models.base import ClassificationModel
+from sntc_tpu.resilience import emit_event, fault_point
+from sntc_tpu.serve.transform import BatchPredictor
+
+MODEL_MARKER = "model_marker.json"
+PROMOTION_JOURNAL = "promotion.jsonl"
+
+
+def macro_f1(y_true, y_pred, n_classes: Optional[int] = None) -> float:
+    """Unweighted mean of per-class F1 over every class seen in labels
+    or predictions, 0/0 → 0 — the gating metric ([B:2]'s metric of
+    record), as plain numpy so per-batch scoring costs no collective."""
+    y = np.asarray(y_true, np.int64)
+    p = np.asarray(y_pred, np.int64)
+    if y.size == 0:
+        return 0.0
+    classes = np.union1d(np.unique(y), np.unique(p))
+    if n_classes is not None:
+        classes = classes[classes < n_classes]
+    f1s: List[float] = []
+    for c in classes:
+        tp = float(np.sum((y == c) & (p == c)))
+        fp = float(np.sum((y != c) & (p == c)))
+        fn = float(np.sum((y == c) & (p != c)))
+        prec = tp / (tp + fp) if tp + fp else 0.0
+        rec = tp / (tp + fn) if tp + fn else 0.0
+        f1s.append(
+            2.0 * prec * rec / (prec + rec) if prec + rec else 0.0
+        )
+    return float(np.mean(f1s)) if f1s else 0.0
+
+
+def _locate_head(stages: List) -> int:
+    """Index of the terminal plain-stage ClassificationModel; raises
+    when the head was fused INTO a segment (its weights are constants
+    of the segment's program — swapping it would recompile the whole
+    prefix; lifecycle serving compiles with ``fuse_heads=False``)."""
+    from sntc_tpu.fuse import FusedSegment
+
+    for i in range(len(stages) - 1, -1, -1):
+        stage = stages[i]
+        if isinstance(stage, ClassificationModel):
+            return i
+        if isinstance(stage, FusedSegment) and stage._head is not None:
+            raise ValueError(
+                "classifier head is fused into a FusedSegment; compile "
+                "the serving pipeline with fuse_heads=False to make the "
+                "head hot-swappable (the feature-prefix segments stay "
+                "fused and their compiled programs are reused across "
+                "swaps)"
+            )
+    raise ValueError("no ClassificationModel head found in pipeline")
+
+
+def terminal_head(model) -> ClassificationModel:
+    """The serving model's classifier head (the swap unit)."""
+    if isinstance(model, ClassificationModel):
+        return model
+    if isinstance(model, PipelineModel):
+        return model.getStages()[_locate_head(model.getStages())]
+    raise ValueError(
+        f"cannot locate a classifier head in {type(model).__name__}"
+    )
+
+
+def graft_head(serving, head: ClassificationModel):
+    """A serving model with ``head`` in place of the terminal
+    classifier, REUSING every other fitted stage object — compiled
+    feature-prefix programs (``FusedSegment`` caches, module-level
+    jitted serve programs) carry over, so a swap or shadow adds no
+    feature-prefix compile signatures."""
+    head = terminal_head(head)
+    if isinstance(serving, ClassificationModel):
+        return head
+    if not isinstance(serving, PipelineModel):
+        raise ValueError(
+            f"cannot graft a head onto {type(serving).__name__}"
+        )
+    stages = list(serving.getStages())
+    idx = _locate_head(stages)
+    old = stages[idx]
+    if head.getFeaturesCol() != old.getFeaturesCol():
+        raise ValueError(
+            f"candidate head reads {head.getFeaturesCol()!r} but the "
+            f"incumbent prefix produces {old.getFeaturesCol()!r}"
+        )
+    stages[idx] = head
+    return PipelineModel(stages=stages)
+
+
+def read_model_marker(checkpoint_dir: str) -> Optional[Dict[str, Any]]:
+    """The last published model-generation record, or None."""
+    path = os.path.join(checkpoint_dir, MODEL_MARKER)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+class ModelPromoter:
+    """Candidate lifecycle: shadow-score → gate → publish → swap →
+    probation/rollback (see module docstring).
+
+    ``incumbent`` is the live SERVING model (what the engine's
+    predictor wraps); ``incumbent_raw`` the persistable form published
+    to ``serving_path`` (the raw fitted pipeline — fused segments are
+    a serving-time artifact and are never saved).  ``labels`` maps the
+    stream's label strings to class indices (None = the label column
+    already holds indices).  ``bucket_rows`` mirrors the engine
+    predictor's shape buckets so shadow dispatches reuse its padded
+    shapes.
+    """
+
+    def __init__(
+        self,
+        incumbent,
+        *,
+        incumbent_raw=None,
+        serving_path: Optional[str] = None,
+        checkpoint_dir: Optional[str] = None,
+        window: int = 8,
+        margin: float = 0.0,
+        label_col: str = "label",
+        labels: Optional[List[str]] = None,
+        bucket_rows: int = 0,
+        probation_batches: int = 8,
+        breaker=None,
+        health=None,
+    ):
+        if window < 1:
+            raise ValueError("window must be >= 1")
+        if (
+            serving_path is not None
+            and incumbent_raw is None
+            and isinstance(incumbent, PipelineModel)
+        ):
+            # publishing without the raw form would save a bare
+            # classifier head over a PIPELINE checkpoint — the live
+            # process keeps serving, but a restart loads a model that
+            # cannot transform raw flow columns.  Fail at construction,
+            # not at the first promotion.
+            raise ValueError(
+                "ModelPromoter with a serving_path and a pipeline "
+                "incumbent needs incumbent_raw (the persistable fitted "
+                "pipeline) so promotions publish a restart-servable "
+                "checkpoint"
+            )
+        self.incumbent = incumbent
+        self.incumbent_raw = incumbent_raw
+        self.serving_path = serving_path
+        self.checkpoint_dir = checkpoint_dir
+        self.window = int(window)
+        self.margin = float(margin)
+        self.label_col = label_col
+        self.labels = list(labels) if labels is not None else None
+        self._label_index = (
+            {str(v): i for i, v in enumerate(self.labels)}
+            if self.labels is not None
+            else None
+        )
+        self.bucket_rows = int(bucket_rows)
+        self.probation_batches = int(probation_batches)
+        self.breaker = breaker
+        self.health = health
+        self.candidate = None  # serving form (grafted onto the prefix)
+        self.candidate_head: Optional[ClassificationModel] = None
+        self.candidate_source: Optional[str] = None
+        self._shadow: Optional[BatchPredictor] = None
+        self._full_shadow: Optional[BatchPredictor] = None
+        self._scores: deque = deque(maxlen=self.window)
+        self._pending_swap = None
+        self._swap_kind: Optional[str] = None
+        # the retained previous generation for in-memory rollback: the
+        # EXACT incumbent objects, so restored predictions are bitwise
+        self._previous = None  # (serving, raw)
+        marker = (
+            read_model_marker(checkpoint_dir)
+            if checkpoint_dir is not None
+            else None
+        )
+        self.generation = int(marker["generation"]) if marker else 0
+        self.state = "idle"
+        self._probation_left = 0
+        self.promotions = 0
+        self.rollbacks = 0
+
+    # -- candidate management ----------------------------------------------
+
+    def _resolve_head(self, model) -> ClassificationModel:
+        """The candidate's swap-unit head, normalized to the incumbent
+        prefix's output column: when the serving pipeline was compiled
+        with the scaler→head weight fold (the default serve path), the
+        incumbent head reads the PRE-scaler column — applying the same
+        fold to the candidate pipeline bakes ITS OWN scaler into its
+        head so both heads read the same prefix output."""
+        head = terminal_head(model)
+        inc_col = terminal_head(self.incumbent).getFeaturesCol()
+        if head.getFeaturesCol() == inc_col or not isinstance(
+            model, PipelineModel
+        ):
+            return head
+        from sntc_tpu.fuse.rules import fold_scalers
+
+        folded = PipelineModel(
+            stages=fold_scalers(list(model.getStages()))
+        )
+        folded_head = terminal_head(folded)
+        if folded_head.getFeaturesCol() == inc_col:
+            return folded_head
+        return head  # graft_head names the mismatch
+
+    def set_candidate(self, model, source: Optional[str] = None) -> None:
+        """Arm shadow scoring for ``model`` (a bare classifier head or
+        a pipeline whose terminal classifier is extracted, scaler-fold
+        normalized to the incumbent prefix — see ``_resolve_head``)."""
+        head = self._resolve_head(model)
+        self.candidate_head = head
+        self.candidate = graft_head(self.incumbent, head)
+        # shadow the HEAD alone: scoring reads the incumbent's own
+        # prefix output off the served frame, so shadowing re-runs
+        # zero feature-prefix work (the full graft is only the swap
+        # target, and the scoring fallback when the prefix output
+        # column is not retained)
+        self._shadow = BatchPredictor(head, bucket_rows=self.bucket_rows)
+        self._full_shadow = None
+        self._scores.clear()
+        self.candidate_source = source
+        self.state = "shadowing"
+
+    def update_candidate(self, model) -> None:
+        """Refresh the shadowed head in place (the ``--partial-fit``
+        loop refits the candidate every labeled batch); scoring history
+        is KEPT — the gate judges the candidate line, not one frozen
+        snapshot."""
+        if self.state in ("probation", "promoting"):
+            # probation guards the JUST-promoted generation (and
+            # "promoting" the one whose swap is still pending): arming
+            # a fresh candidate here would flip the state machine back
+            # to shadowing and silently disable the breach-rollback
+            # check.  The refit loop keeps accumulating; the first
+            # labeled batch after this resolves re-arms the shadow.
+            return
+        if self.state != "shadowing":
+            self.set_candidate(model)
+            return
+        head = terminal_head(model)
+        self.candidate_head = head
+        self.candidate = graft_head(self.incumbent, head)
+        self._shadow.swap_model(head)
+        self._full_shadow = None
+
+    def load_candidate(self, path: str) -> None:
+        """Load a candidate checkpoint and arm shadow scoring."""
+        from sntc_tpu.mlio import load_model
+
+        self.set_candidate(load_model(path), source=path)
+
+    # -- engine hooks --------------------------------------------------------
+
+    def _labels_from(self, frame) -> Optional[np.ndarray]:
+        if self.label_col not in frame:
+            return None
+        col = frame[self.label_col]
+        if self._label_index is not None:
+            return np.asarray(
+                [self._label_index.get(str(v), -1) for v in col],
+                np.int64,
+            )
+        try:
+            return np.asarray(col).astype(np.int64)
+        except (TypeError, ValueError):
+            return None
+
+    def on_batch(self, batch_id: int, frame, out_frame) -> None:
+        """One clean committed batch: advance probation, shadow-score
+        the candidate when one is armed and the batch carries labels."""
+        if self.state == "probation":
+            self._probation_left -= 1
+            if self._probation_left <= 0:
+                self.state = "idle"
+                self._journal({
+                    "action": "probation_passed",
+                    "generation": self.generation,
+                    "batch_id": batch_id,
+                })
+        if self.state != "shadowing" or self._shadow is None:
+            return
+        y = self._labels_from(frame)
+        if y is None:
+            return
+        known = y >= 0
+        if not known.any():
+            return
+        head = self.candidate_head
+        pred_col = head.getPredictionCol()
+        inc_pred = np.asarray(out_frame[pred_col])
+        if inc_pred.shape[0] != y.shape[0]:
+            # a row-dropping stage (handleInvalid=skip) excised rows
+            # between the input labels and the served output — the
+            # label mask no longer aligns row-for-row, so skip scoring
+            # this batch rather than index with a misaligned mask
+            return
+        feats_col = head.getFeaturesCol()
+        if feats_col in out_frame:
+            # score on the incumbent's OWN prefix output: the head was
+            # normalized to read exactly this column, so shadowing
+            # costs one head dispatch and no prefix work
+            cand_out = self._shadow.predict_frame(
+                Frame({feats_col: out_frame[feats_col]})
+            )
+        else:
+            if self._full_shadow is None:
+                self._full_shadow = BatchPredictor(
+                    self.candidate, bucket_rows=self.bucket_rows
+                )
+            cand_out = self._full_shadow.predict_frame(frame)
+        f1_inc = macro_f1(y[known], inc_pred[known])
+        f1_cand = macro_f1(
+            y[known], np.asarray(cand_out[pred_col])[known]
+        )
+        self._scores.append((f1_inc, f1_cand))
+        filled = len(self._scores) == self.window
+        mean_inc = float(np.mean([a for a, _ in self._scores]))
+        mean_cand = float(np.mean([b for _, b in self._scores]))
+        decision = "hold"
+        if filled and mean_cand > mean_inc + self.margin:
+            decision = "promote"
+        self._journal({
+            "action": "shadow_score", "batch_id": batch_id,
+            "f1_incumbent": round(f1_inc, 6),
+            "f1_candidate": round(f1_cand, 6),
+            "mean_incumbent": round(mean_inc, 6),
+            "mean_candidate": round(mean_cand, 6),
+            "window_filled": filled, "decision": decision,
+        })
+        if decision == "promote":
+            self.promote()
+
+    def on_tick(self, query=None) -> None:
+        """Per-engine-round probation check: a ``predict.dispatch``
+        breaker that OPENED after the swap is the failure-rate breach
+        that triggers rollback (the batch itself is deferred by the
+        breaker, so no ``on_batch`` would ever see it)."""
+        if self.state != "probation":
+            return
+        br = self.breaker
+        if br is None and query is not None:
+            br = getattr(query, "breakers", {}).get("predict.dispatch")
+        if br is not None and br.state == "open":
+            self.rollback(
+                "predict.dispatch breaker open during post-swap "
+                "probation"
+            )
+
+    def take_pending_swap(self):
+        swap, self._pending_swap = self._pending_swap, None
+        return swap
+
+    def rearm_pending_swap(self, model) -> None:
+        """Put a taken-but-unapplied swap back (the engine's safe point
+        failed before the predictor flip — e.g. the in-air delivery
+        settle raised).  ``_swap_kind`` is untouched: only a landed
+        swap (``on_swap_applied``) resolves it, so a re-armed rollback
+        is still a rollback on the retry."""
+        self._pending_swap = model
+
+    def on_swap_applied(self, old_model) -> None:
+        """Called by the engine (via the lifecycle manager) right after
+        the in-engine predictor swap landed."""
+        # kill point post-swap: the predictor already serves the new
+        # model; a crash here must restart into the same model (second
+        # call of the model.swap site — chaos arms after=1)
+        fault_point("model.swap")
+        if self._swap_kind is None:
+            # a duplicate apply of an already-resolved swap (nothing is
+            # armed): mutating the state machine here would clobber the
+            # incumbent with a cleared candidate
+            return
+        if self._swap_kind == "rollback":
+            emit_event(
+                event="model_swapped", component="model",
+                generation=self.generation, kind="rollback",
+            )
+            self.state = "rolled_back"
+            self._swap_kind = None
+            return
+        emit_event(
+            event="model_swapped", component="model",
+            generation=self.generation, kind="promote",
+        )
+        self._previous = (self.incumbent, self.incumbent_raw)
+        self.incumbent = self.candidate
+        if self.candidate_head is not None and (
+            self.incumbent_raw is not None
+        ):
+            # same form promote() published (folds the raw prefix when
+            # the serving compile folded its scaler into the heads)
+            self.incumbent_raw = self._publish_form()
+        self.candidate = None
+        self.candidate_head = None
+        self._shadow = None
+        self._full_shadow = None
+        self._scores.clear()
+        self._swap_kind = None
+        self.state = "probation"
+        self._probation_left = self.probation_batches
+
+    # -- promote / rollback --------------------------------------------------
+
+    def _write_marker(self, record: Dict[str, Any]) -> None:
+        if self.checkpoint_dir is None:
+            return
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        path = os.path.join(self.checkpoint_dir, MODEL_MARKER)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1)
+        os.replace(tmp, path)
+
+    def _journal(self, record: Dict[str, Any]) -> None:
+        if self.checkpoint_dir is None:
+            return
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        record = dict(record, ts=time.time())
+        with open(
+            os.path.join(self.checkpoint_dir, PROMOTION_JOURNAL), "a"
+        ) as f:
+            f.write(json.dumps(record) + "\n")
+
+    def _publish_form(self):
+        """The restart-servable pipeline naming the candidate: the raw
+        incumbent's stages with the candidate head grafted in.  When
+        the serving compile folded a scaler into the heads — so the
+        normalized candidate head reads the PRE-scaler column while the
+        raw incumbent's head reads the scaler output — the raw prefix
+        is folded the same way before grafting; the published
+        checkpoint is then the fold-equivalent pipeline, servable on
+        restart and reading exactly the columns the candidate head was
+        trained on."""
+        if not isinstance(self.incumbent_raw, PipelineModel):
+            return self.candidate_head
+        target = self.incumbent_raw
+        if (
+            terminal_head(target).getFeaturesCol()
+            != self.candidate_head.getFeaturesCol()
+        ):
+            from sntc_tpu.fuse.rules import fold_scalers
+
+            target = PipelineModel(
+                stages=fold_scalers(list(target.getStages()))
+            )
+        return graft_head(target, self.candidate_head)
+
+    def promote(self) -> None:
+        """Publish the candidate durably, then defer the in-engine swap
+        to the engine's next between-batches safe point."""
+        if self.candidate is None:
+            raise RuntimeError("promote() with no candidate armed")
+        from sntc_tpu.mlio import save_model
+
+        # kill point pre-publish: nothing on disk has changed — a
+        # restart serves the incumbent and the promotion is simply lost
+        fault_point("model.publish")
+        published = None
+        if self.serving_path is not None:
+            publish_form = self._publish_form()
+            # atomic publish; the incumbent checkpoint is retained at
+            # <serving_path>.prev — the rollback snapshot
+            save_model(publish_form, self.serving_path)
+            published = self.serving_path
+        self.generation += 1
+        self._write_marker({
+            "generation": self.generation,
+            "action": "promoted",
+            "path": published,
+            "source": self.candidate_source,
+            "ts": time.time(),
+        })
+        # kill point post-publish / pre-swap: the serving path and the
+        # marker already name the candidate — a restart loads and
+        # serves it, and the WAL replays in-flight batches under it
+        fault_point("model.swap")
+        self._pending_swap = self.candidate
+        self._swap_kind = "promote"
+        # the gate must not fire again between publish and the engine's
+        # swap safe point: a labeled batch settled in that window (e.g.
+        # by swap_model's own delivery settle) would re-promote and the
+        # stale second apply would wipe the incumbent
+        self.state = "promoting"
+        self.promotions += 1
+        self._journal({
+            "action": "promote", "generation": self.generation,
+            "path": published, "source": self.candidate_source,
+        })
+
+    def rollback(self, reason: str) -> None:
+        """Restore the previous generation: the retained in-memory
+        incumbent when this process promoted it (bitwise-identical
+        predictions), else the ``<serving_path>.prev`` snapshot; the
+        restored model is republished so a restart serves it too."""
+        restored = restored_raw = None
+        if self._previous is not None:
+            restored, restored_raw = self._previous
+        elif self.serving_path is not None:
+            from sntc_tpu.mlio import load_model, prev_checkpoint_path
+
+            raw = load_model(
+                prev_checkpoint_path(self.serving_path), fallback=False
+            )
+            restored_raw = raw
+            # _resolve_head folds the .prev pipeline's scaler into its
+            # head when the serving compile folded the incumbent's —
+            # the restored head must read the compiled prefix's column
+            restored = graft_head(self.incumbent, self._resolve_head(raw))
+        if restored is None:
+            raise RuntimeError(
+                "rollback with no previous generation retained and no "
+                "serving_path to recover .prev from"
+            )
+        publish = restored_raw
+        if publish is None and not isinstance(restored, PipelineModel):
+            # a bare classifier-head incumbent IS its persistable form
+            # — without republishing, a restart would load the rolled-
+            # back candidate the marker claims was replaced
+            publish = restored
+        if self.serving_path is not None and publish is not None:
+            from sntc_tpu.mlio import save_model
+
+            save_model(publish, self.serving_path)
+        self.generation += 1
+        self._write_marker({
+            "generation": self.generation,
+            "action": "rolled_back",
+            "reason": reason,
+            "path": self.serving_path,
+            "ts": time.time(),
+        })
+        emit_event(
+            event="model_rollback", component="model", reason=reason,
+            generation=self.generation,
+        )
+        self.incumbent = restored
+        self.incumbent_raw = restored_raw
+        self._previous = None
+        self._pending_swap = restored
+        self._swap_kind = "rollback"
+        self.rollbacks += 1
+        self.state = "rolling_back"
+        self._journal({
+            "action": "rollback", "generation": self.generation,
+            "reason": reason,
+        })
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "state": self.state,
+            "generation": self.generation,
+            "promotions": self.promotions,
+            "rollbacks": self.rollbacks,
+            "shadow_window": self.window,
+            "scores_buffered": len(self._scores),
+            "probation_left": self._probation_left,
+            "candidate_source": self.candidate_source,
+        }

@@ -1,3 +1,7 @@
-from sntc_tpu.mlio.save_load import load_model, save_model
+from sntc_tpu.mlio.save_load import (
+    load_model,
+    prev_checkpoint_path,
+    save_model,
+)
 
-__all__ = ["save_model", "load_model"]
+__all__ = ["save_model", "load_model", "prev_checkpoint_path"]

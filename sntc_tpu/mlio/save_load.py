@@ -202,6 +202,13 @@ def _prev_path(path: str) -> str:
     return os.path.normpath(path) + ".prev"
 
 
+def prev_checkpoint_path(path: str) -> str:
+    """The retained previous-snapshot location for a checkpoint at
+    ``path`` (written by :func:`save_model`'s atomic publish; the
+    lifecycle layer rolls a failed promotion back to it)."""
+    return _prev_path(path)
+
+
 def save_model(stage: PipelineStage, path: str) -> str:
     """Persist a stage (or whole Pipeline/PipelineModel) to ``path``.
 

@@ -866,6 +866,133 @@ class LogisticRegression(_LrParams, CheckpointParams, ClassifierEstimator):
             res.x, prep, res.n_iters, res.history, use_bounds=use_bounds
         )
 
+    def partial_fit(self, frame: Frame, state=None, decay: float = 1.0,
+                    n_classes: int = None):
+        """One incremental update (the MLlib streaming-linear-model
+        recipe): fold this mini-batch's summarizer moments into
+        ``state`` and advance the solution with a warm-started run of
+        the SAME jitted LBFGS program the batch fit uses; returns
+        ``(model, state)``.
+
+        The standardization moments and class counts are additive and
+        accumulate EXACTLY (``decay`` < 1 down-weights history), so
+        every call standardizes against all data seen — matching the
+        batch fit's preprocessing on the concatenation.  The logistic
+        loss has no finite sufficient statistic, so the optimization
+        itself is approximate: each call minimizes the CURRENT shard's
+        objective from the previous solution (the decayed-state
+        gradient-step family).  The equivalence contract is therefore
+        behavioral — held-out predictions agree with the batch fit on
+        concatenated iid shards within the documented tolerance
+        (docs/RESILIENCE.md "Model lifecycle";
+        tests/test_lifecycle.py pins it).  The family/class count is
+        fixed by the first call — pass ``n_classes`` there when the
+        label universe is known, since a mini-batch rarely carries
+        every class; bound constraints and mid-fit checkpointing are
+        unsupported here."""
+        from sntc_tpu.lifecycle.incremental import LRPartialFitState
+
+        if any(
+            self.paramValues().get(p) is not None for p in _BOUND_PARAMS
+        ):
+            raise ValueError(
+                "partial_fit does not support bound constraints"
+            )
+        if self._would_checkpoint():
+            raise ValueError(
+                "partial_fit does not support mid-fit checkpointing"
+            )
+        mesh = self._mesh or get_default_mesh()
+        X, y, w = self._extract(frame)
+        n, d = X.shape
+        if state is None:
+            binomial, k = self._resolve_family(y, n)
+            if n_classes is not None:
+                if k > int(n_classes):
+                    raise ValueError(
+                        f"label {int(y.max())} outside the declared "
+                        f"n_classes={int(n_classes)}"
+                    )
+                k = max(int(n_classes), 2)
+                family = self.getFamily()
+                binomial = k == 2 and family != "multinomial"
+                if family == "binomial" and k > 2:
+                    raise ValueError(
+                        f"binomial family with {k} classes; use "
+                        "multinomial"
+                    )
+            state = LRPartialFitState(d=d, k=k, binomial=binomial)
+        else:
+            if d != state.d:
+                raise ValueError(
+                    f"partial_fit feature width {d} != state's {state.d}"
+                )
+            if n and int(y.max()) >= state.k:
+                raise ValueError(
+                    f"label {int(y.max())} outside the class set fixed "
+                    f"at the first partial_fit call ({state.k} classes)"
+                )
+        xs, ys, _ = shard_batch(mesh, X, y.astype(np.int32))
+        ws = shard_weights(mesh, w, xs.shape[0])
+        s1, s2, cnt, cc = _lr_summarize(xs, ys, ws, state.k)
+        state.update(
+            np.asarray(s1, np.float64), np.asarray(s2, np.float64),
+            float(cnt), np.asarray(cc, np.float64), n_rows=n,
+            decay=decay,
+        )
+        std, inv_std, class_counts = self._moments_to_stats(
+            state.s1, state.s2, state.cnt, state.class_counts
+        )
+        prep = {
+            "xs": xs, "ys": ys, "ws": ws, "n": n, "d": d, "k": state.k,
+            "binomial": state.binomial, "std": std, "inv_std": inv_std,
+            "class_counts": class_counts, "frame": None,
+        }
+        vec = self._grid_vectors(prep)
+        n_coef, n_int = vec["n_coef"], vec["n_int"]
+        theta0 = vec["theta0"]
+        if state.coef_orig is not None:
+            # warm start: the previous ORIGINAL-space solution rescaled
+            # into THIS call's standardization space (std moves as the
+            # moments accumulate; original space is the invariant)
+            theta0 = theta0.copy()
+            theta0[:n_coef] = (
+                state.coef_orig * std[:, None]
+            ).reshape(-1).astype(np.float32)
+            if n_int:
+                theta0[n_coef:] = state.intercepts
+        z = np.zeros(n_coef + n_int, np.float32)
+        res, _opt_state = _lr_optimize(
+            xs, ys, ws,
+            jnp.asarray(inv_std, jnp.float32),
+            jnp.asarray(vec["l2"], jnp.float32),
+            jnp.asarray(vec["pen_l2"]),
+            jnp.asarray(vec["l1_vec"]),
+            jnp.asarray(theta0, jnp.float32),
+            None,
+            jnp.asarray(self.getMaxIter(), jnp.int32),
+            jnp.asarray(z), jnp.asarray(z),
+            binomial=state.binomial,
+            fit_intercept=self.getFitIntercept(),
+            k=state.k,
+            max_iter=self.getMaxIter(),
+            tol=self.getTol(),
+            use_l1=bool(vec["use_l1"]),
+        )
+        theta = np.asarray(res.x, np.float64)
+        state.coef_orig = (
+            theta[:n_coef].reshape(d, state.rows) * inv_std[:, None]
+        )
+        state.intercepts = (
+            theta[n_coef:].astype(np.float32)
+            if n_int
+            else np.zeros(state.rows, np.float32)
+        )
+        model = self._theta_to_model(
+            theta, prep, res.n_iters, res.history
+        )
+        return model, state
+
 
 @jax.jit
 def _margins(X, coefT, intercepts):

@@ -113,34 +113,30 @@ class NaiveBayes(_NbParams, ClassifierEstimator):
         super().__init__(**kwargs)
         self._mesh = mesh
 
-    def _fit(self, frame: Frame) -> "NaiveBayesModel":
-        mesh = self._mesh or get_default_mesh()
-        X, y, w = self._extract(frame)
-        mt = self.getModelType()
-        lam = float(self.getSmoothing())
-        k = max(int(y.max()) + 1 if len(y) else 2, 2)
-        D = X.shape[1]
-
-        Xh = np.asarray(X)
+    def _validate_features(self, Xh: np.ndarray, mt: str) -> None:
         if mt in ("multinomial", "complement") and (Xh < 0).any():
             raise ValueError(f"{mt} NaiveBayes requires non-negative features")
         if mt == "bernoulli" and not np.isin(Xh, (0.0, 1.0)).all():
             raise ValueError("bernoulli NaiveBayes requires 0/1 features")
 
-        xs, ys, _ = shard_batch(mesh, X, y)
-        ws = shard_weights(mesh, w, xs.shape[0])
-        pilot = np.asarray(Xh[0], np.float32) if len(Xh) else np.zeros(D, np.float32)
-        m = _class_moments_agg(mesh, k)(xs, ys, ws, jnp.asarray(pilot))
-        cw = np.asarray(m["cw"], np.float64)  # [C]
-        s_sh = np.asarray(m["s"], np.float64)  # [C, F] about the pilot
-        sq_sh = np.asarray(m["sq"], np.float64)  # [C, F] about the pilot
-        p64 = pilot.astype(np.float64)
-        # raw weighted sums, reconstructed exactly in f64
-        s = s_sh + cw[:, None] * p64[None, :]
+    def _with_params(self, model: "NaiveBayesModel") -> "NaiveBayesModel":
+        model.setParams(
+            **{k2: v for k2, v in self.paramValues().items()
+               if model.hasParam(k2)}
+        )
+        return model
+
+    def _discrete_model(self, cw, s, k, D) -> "NaiveBayesModel":
+        """multinomial/complement/bernoulli model from the f64 class
+        weights ``cw`` [C] and raw weighted feature sums ``s`` [C, F] —
+        the ONE stats→model path shared by the batch fit and
+        ``partial_fit`` (the statistics are additive, so both produce
+        the same model up to device summation order)."""
+        mt = self.getModelType()
+        lam = float(self.getSmoothing())
         n = cw.sum()
-        # gaussian: unsmoothed (the sklearn-oracle contract); discrete
-        # types: Spark's λ-smoothed prior log((n_c + λ)/(n + Cλ))
         log_pi = np.log(np.maximum(cw, 1e-300)) - np.log(max(n, 1e-300))
+        # Spark's λ-smoothed prior log((n_c + λ)/(n + Cλ))
         log_pi_smoothed = np.log(cw + lam) - np.log(max(n + k * lam, 1e-300))
 
         if mt == "multinomial":
@@ -160,13 +156,68 @@ class NaiveBayes(_NbParams, ClassifierEstimator):
             # complement NB drops the class prior (Rennie et al.; both
             # Spark's complementCalculation and sklearn do the same)
             bias = np.zeros_like(log_pi)
-        elif mt == "bernoulli":
+        else:  # bernoulli
             p = (s + lam) / (cw[:, None] + 2.0 * lam)  # P(x_j=1 | c)
             logp, log1mp = np.log(p), np.log1p(-p)
             # Σ_j x_j·logp + (1-x_j)·log1mp = x·(logp - log1mp) + Σ log1mp
             theta = logp - log1mp
             bias = log_pi_smoothed + log1mp.sum(axis=1)
-        else:  # gaussian — two-pass: means above, then deviations about
+        return self._with_params(NaiveBayesModel(
+            theta=theta.astype(np.float32), bias=bias.astype(np.float32),
+            pi=log_pi, n_classes=k,
+        ))
+
+    def _gaussian_model(self, cw, mu, sq_c, k) -> "NaiveBayesModel":
+        """gaussian model from class weights, f64 class means, and the
+        per-(class, feature) squared deviations about those means
+        (``sq_c`` = Σ_c w·(x−μ_c)²) — shared by the batch fit (which
+        computes ``sq_c`` in a second device pass) and ``partial_fit``
+        (which derives it from the accumulated pilot-shifted moments)."""
+        n = cw.sum()
+        # gaussian: unsmoothed priors (the sklearn-oracle contract)
+        log_pi = np.log(np.maximum(cw, 1e-300)) - np.log(max(n, 1e-300))
+        var = sq_c / np.maximum(cw[:, None], 1e-300)
+        var = np.maximum(var, 0.0)
+        # variance smoothing ε = 1e-9 · largest GLOBAL feature
+        # variance (sklearn's var_smoothing semantics — the global
+        # variance decomposes as within + between from the class
+        # moments; the per-class max differs by ~10× on flow data
+        # and shifts every small-variance likelihood)
+        if var.size and n > 0:
+            mu_bar = (cw[:, None] * mu).sum(axis=0) / n
+            between = (cw[:, None] * (mu - mu_bar[None, :]) ** 2).sum(axis=0)
+            global_var = (sq_c.sum(axis=0) + between) / n
+            eps = 1e-9 * float(global_var.max())
+        else:
+            eps = 1e-12
+        var = var + max(eps, 1e-12)
+        return self._with_params(NaiveBayesModel(
+            theta=None, bias=None, pi=log_pi,
+            gaussian_mu=mu,  # f64: f32 mu at 1e9 scale loses the
+            gaussian_var=var,  # class signal the f64 fit computed
+            n_classes=k,
+        ))
+
+    def _fit(self, frame: Frame) -> "NaiveBayesModel":
+        mesh = self._mesh or get_default_mesh()
+        X, y, w = self._extract(frame)
+        mt = self.getModelType()
+        k = max(int(y.max()) + 1 if len(y) else 2, 2)
+        D = X.shape[1]
+
+        Xh = np.asarray(X)
+        self._validate_features(Xh, mt)
+
+        xs, ys, _ = shard_batch(mesh, X, y)
+        ws = shard_weights(mesh, w, xs.shape[0])
+        pilot = np.asarray(Xh[0], np.float32) if len(Xh) else np.zeros(D, np.float32)
+        m = _class_moments_agg(mesh, k)(xs, ys, ws, jnp.asarray(pilot))
+        cw = np.asarray(m["cw"], np.float64)  # [C]
+        s_sh = np.asarray(m["s"], np.float64)  # [C, F] about the pilot
+        p64 = pilot.astype(np.float64)
+
+        if mt == "gaussian":
+            # two-pass: means from the first pass, then deviations about
             # each class's own mean (single-pass variance cancels when a
             # feature's overall spread dwarfs a class's variance)
             mu_sh = s_sh / np.maximum(cw[:, None], 1e-300)
@@ -177,43 +228,92 @@ class NaiveBayes(_NbParams, ClassifierEstimator):
                 ),
                 np.float64,
             )
-            var = sq_c / np.maximum(cw[:, None], 1e-300)
-            var = np.maximum(var, 0.0)
-            # variance smoothing ε = 1e-9 · largest GLOBAL feature
-            # variance (sklearn's var_smoothing semantics — the global
-            # variance decomposes as within + between from the class
-            # moments; the per-class max differs by ~10× on flow data
-            # and shifts every small-variance likelihood)
-            if var.size and n > 0:
-                mu_bar = (cw[:, None] * mu).sum(axis=0) / n
-                between = (cw[:, None] * (mu - mu_bar[None, :]) ** 2).sum(axis=0)
-                global_var = (sq_c.sum(axis=0) + between) / n
-                eps = 1e-9 * float(global_var.max())
-            else:
-                eps = 1e-12
-            var = var + max(eps, 1e-12)
-            model = NaiveBayesModel(
-                theta=None, bias=None, pi=log_pi,
-                gaussian_mu=mu,  # f64: f32 mu at 1e9 scale loses the
-                gaussian_var=var,  # class signal the f64 fit computed
+            return self._gaussian_model(cw, mu, sq_c, k)
+        # raw weighted sums, reconstructed exactly in f64
+        s = s_sh + cw[:, None] * p64[None, :]
+        return self._discrete_model(cw, s, k, D)
 
-                n_classes=k,
-            )
-            model.setParams(
-                **{k2: v for k2, v in self.paramValues().items()
-                   if model.hasParam(k2)}
-            )
-            return model
+    def partial_fit(self, frame: Frame, state=None, decay: float = 1.0,
+                    n_classes: int = None):
+        """One incremental update (the streaming-MLlib analog): fold
+        this mini-batch's per-(class, feature) device moments into
+        ``state`` and return ``(model, state)``.
 
-        model = NaiveBayesModel(
-            theta=theta.astype(np.float32), bias=bias.astype(np.float32),
-            pi=log_pi, n_classes=k,
+        The statistics are additive, so ``partial_fit`` over K shards
+        matches the batch fit on their concatenation up to f32 device
+        summation order (discrete types: θ within ~1e-5 rel).  The
+        gaussian variance comes from the accumulated pilot-shifted
+        moments via the one-pass shift identity Σw(x−μ)² = Σw(x−p)² −
+        n_c(μ−p)² where the batch fit runs a second pass about the
+        class means — same statistic, looser rounding (documented
+        tolerance in docs/RESILIENCE.md "Model lifecycle").  ``decay``
+        < 1 down-weights history per update (forgetful streaming).
+        The class count and feature width are FIXED by the first call —
+        pass ``n_classes`` there when the label universe is known (a
+        mini-batch rarely carries every class; the lifecycle layer
+        passes the incumbent's count) — and a later shard introducing
+        an out-of-range class raises."""
+        from sntc_tpu.lifecycle.incremental import NBPartialFitState
+
+        mesh = self._mesh or get_default_mesh()
+        X, y, w = self._extract(frame)
+        mt = self.getModelType()
+        Xh = np.asarray(X)
+        self._validate_features(Xh, mt)
+        if state is None:
+            k = max(int(y.max()) + 1 if len(y) else 2, 2)
+            if n_classes is not None:
+                if k > int(n_classes):
+                    raise ValueError(
+                        f"label {int(y.max())} outside the declared "
+                        f"n_classes={int(n_classes)}"
+                    )
+                k = max(int(n_classes), 2)
+            pilot = (
+                np.asarray(Xh[0], np.float32)
+                if len(Xh)
+                else np.zeros(X.shape[1], np.float32)
+            )
+            state = NBPartialFitState(
+                n_classes=k, n_features=X.shape[1], pilot=pilot
+            )
+        else:
+            if X.shape[1] != state.n_features:
+                raise ValueError(
+                    f"partial_fit feature width {X.shape[1]} != state's "
+                    f"{state.n_features}"
+                )
+            if len(y) and int(y.max()) >= state.n_classes:
+                raise ValueError(
+                    f"label {int(y.max())} outside the class set fixed "
+                    f"at the first partial_fit call ({state.n_classes} "
+                    "classes)"
+                )
+        xs, ys, _ = shard_batch(mesh, X, y)
+        ws = shard_weights(mesh, w, xs.shape[0])
+        m = _class_moments_agg(mesh, state.n_classes)(
+            xs, ys, ws, jnp.asarray(state.pilot)
         )
-        model.setParams(
-            **{k2: v for k2, v in self.paramValues().items()
-               if model.hasParam(k2)}
+        state.update(
+            np.asarray(m["cw"], np.float64),
+            np.asarray(m["s"], np.float64),
+            np.asarray(m["sq"], np.float64),
+            n_rows=len(y), decay=decay,
         )
-        return model
+        return self._model_from_state(state), state
+
+    def _model_from_state(self, state) -> "NaiveBayesModel":
+        cw, s_sh, sq_sh = state.cw, state.s_sh, state.sq_sh
+        p64 = state.pilot.astype(np.float64)
+        k = state.n_classes
+        if self.getModelType() == "gaussian":
+            mu_sh = s_sh / np.maximum(cw[:, None], 1e-300)
+            mu = p64[None, :] + mu_sh
+            # one-pass shift identity: Σw(x−μ_c)² = Σw(x−p)² − n_c(μ_c−p)²
+            sq_c = np.maximum(sq_sh - cw[:, None] * mu_sh**2, 0.0)
+            return self._gaussian_model(cw, mu, sq_c, k)
+        s = s_sh + cw[:, None] * p64[None, :]
+        return self._discrete_model(cw, s, k, state.n_features)
 
 
 def _gaussian_raw(X, mu, var, log_pi):

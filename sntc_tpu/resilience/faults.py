@@ -22,6 +22,10 @@ Wired sites:
 ``probe.init``          ``utils.backend_probe`` backend-liveness attempt
 ``collective.dispatch`` ``parallel.collectives`` aggregate dispatch
 ``cv.fit``              ``CrossValidator`` per-(fold, grid-point) fit
+``model.publish``       ``lifecycle.ModelPromoter`` before the candidate
+                        checkpoint publish
+``model.swap``          ``lifecycle`` promotion: post-publish/pre-swap
+                        (first call) and post-swap (second call)
 ======================  =====================================================
 
 Env grammar (comma-separated specs)::
@@ -108,6 +112,8 @@ SITES = (
     "probe.init",
     "collective.dispatch",
     "cv.fit",
+    "model.publish",
+    "model.swap",
 )
 
 

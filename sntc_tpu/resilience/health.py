@@ -77,6 +77,15 @@ _EVENT_STATES: Dict[str, HealthState] = {
     # rising reject rate is operator-visible through the same stream
     "rows_rejected": HealthState.DEGRADED,
     "parse_truncated": HealthState.DEGRADED,
+    # model lifecycle (r11): the drift monitor flips the model
+    # component DEGRADED on a divergence breach; a completed hot-swap
+    # is the recovery signal; a rollback records that the promoted
+    # candidate misbehaved (the restored incumbent recovers it on the
+    # next swap event); a lifecycle hook failure degrades, not kills
+    "drift_detected": HealthState.DEGRADED,
+    "model_swapped": HealthState.OK,
+    "model_rollback": HealthState.DEGRADED,
+    "lifecycle_error": HealthState.DEGRADED,
 }
 
 

@@ -314,7 +314,7 @@ class QuerySupervisor:
         # process-registry breakers (collective.dispatch &c.) ride along
         for site, snap in breakers_snapshot().items():
             breakers.setdefault(site, snap)
-        return {
+        out = {
             "health": self.health.snapshot(),
             "breakers": breakers,
             "engine": {
@@ -329,6 +329,16 @@ class QuerySupervisor:
             "drain_requested": self.drain_requested,
             "drained": self.drained,
         }
+        # model-lifecycle evidence (drift / promotion / swap state)
+        # rides the same dump when the engine has a lifecycle armed
+        lc = getattr(q, "lifecycle", None)
+        lc_stats = getattr(lc, "stats", None) if lc is not None else None
+        if lc_stats is not None:
+            out["lifecycle"] = dict(
+                lc_stats(),
+                models_swapped=getattr(q, "models_swapped", 0),
+            )
+        return out
 
     def write_health_json(self, latest: Optional[int] = None) -> str:
         """Atomically (re)write the status dump; returns the path."""

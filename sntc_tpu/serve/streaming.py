@@ -466,6 +466,7 @@ class StreamingQuery:
         schema_contract=None,
         row_policy: Optional[str] = None,
         row_dead_letter_dir: Optional[str] = None,
+        lifecycle=None,
     ):
         # a pre-built BatchPredictor passes through unchanged (its own
         # bucket config wins — bench warmup shares one predictor across
@@ -528,6 +529,14 @@ class StreamingQuery:
         self._batches_salvaged = 0
         self._rows_journaled: set = set()  # batch ids already journaled
         self._admission_counted: set = set()  # batch ids stat-counted
+        # model lifecycle (r11): a duck-typed hook object — usually a
+        # sntc_tpu.lifecycle.LifecycleManager — observing every clean
+        # committed batch (on_batch), checked once per engine round
+        # (on_tick), and supplying deferred hot-swaps
+        # (take_pending_swap / on_swap_applied).  Swaps land only
+        # BETWEEN micro-batches; see swap_model().
+        self.lifecycle = lifecycle
+        self.models_swapped = 0
         # per-site circuit breakers (sink.write / predict.dispatch): an
         # OPEN breaker defers the stage — the batch stays queued and the
         # loop stays alive — instead of hammering a dead dependency
@@ -818,7 +827,7 @@ class StreamingQuery:
             self._next_start = max(self._next_start, intent["end"])
             return True
         self._in_flight.append((batch_id, intent, finalize, t0,
-                                frame.num_rows, frame))
+                                frame.num_rows, frame, row_mask))
         # max(): a replayed WAL intent can end BELOW a cursor that an
         # 'oldest' shed already advanced — moving it back would undo the
         # journaled shed and double-count it on the next tick
@@ -864,7 +873,8 @@ class StreamingQuery:
         its commit file is written — a failed round leaves it queued, so
         batch ids never shift (exactly-once).  Returns True when the
         batch committed (normally or quarantined)."""
-        batch_id, intent, finalize, t0, n_rows, frame = self._in_flight[0]
+        (batch_id, intent, finalize, t0, n_rows, frame,
+         row_mask) = self._in_flight[0]
         breaker = self.breakers.get("sink.write")
         quarantined = False
         if exc is not None:
@@ -887,6 +897,24 @@ class StreamingQuery:
         self._commit_batch(batch_id, intent, n_rows=n_rows, t0=t0,
                            quarantined=quarantined)
         self._delivered_batches += 1
+        if not quarantined and self.lifecycle is not None:
+            # drift scoring / shadow promotion observe the committed
+            # batch (finalize is memoized — a cached read, not a
+            # re-materialization).  A lifecycle hook failure degrades,
+            # never kills, the serving loop.  Under row salvage the
+            # admitted frame is filtered to the SURVIVING rows so its
+            # labels align row-for-row with finalize()'s output (which
+            # excises the same mask).
+            try:
+                lc_frame = (
+                    frame if row_mask is None else frame.filter(row_mask)
+                )
+                self.lifecycle.on_batch(batch_id, lc_frame, finalize)
+            except Exception as e:
+                emit_event(
+                    event="lifecycle_error", component="model",
+                    batch_id=batch_id, error=repr(e),
+                )
         return True
 
     def _retire_oldest(self) -> bool:
@@ -899,7 +927,7 @@ class StreamingQuery:
         round) and the N-th failed round quarantines the batch
         (dead-letter journal + commit) so the query continues.  Returns
         True when a batch was committed."""
-        batch_id, _intent, finalize, _t0, _n_rows, _frame = self._in_flight[0]
+        batch_id, _intent, finalize = self._in_flight[0][:3]
         breaker = self.breakers.get("sink.write")
         if breaker is not None and not breaker.allow():
             return False  # breaker open: batch stays queued, loop alive
@@ -917,7 +945,7 @@ class StreamingQuery:
         The sink breaker's ``allow()`` is consumed here (one reservation
         per round, outcome recorded at settle); an OPEN breaker defers
         exactly as in the serial path."""
-        batch_id, _intent, finalize, _t0, _n_rows, _frame = self._in_flight[0]
+        batch_id, _intent, finalize = self._in_flight[0][:3]
         breaker = self.breakers.get("sink.write")
         if breaker is not None and not breaker.allow():
             return False
@@ -991,6 +1019,74 @@ class StreamingQuery:
             start = end
             bid += 1
 
+    # -- model lifecycle (hot-swap) ------------------------------------------
+
+    def swap_model(self, model: Transformer) -> Transformer:
+        """Atomic in-engine hot-swap: replace the served model BETWEEN
+        micro-batches, keeping the predictor's bucket config and
+        compile ledger (`BatchPredictor.swap_model`).
+
+        A swap must NEVER land while a sink delivery is in the air
+        (``overlap_sink`` mode): the head batch is settled first —
+        commit, deferral, or quarantine on this thread — and only then
+        does the predictor flip.  Batches already dispatched finalize
+        against the model they were dispatched with; the swap takes
+        effect from the next dispatch.  Returns the replaced model.
+        Call from the engine thread only (the loop applies lifecycle
+        swaps via its own safe point; tests drive it directly between
+        ``process_available`` steps)."""
+        if self._delivery is not None:
+            # settle the in-air delivery first: its finalize is bound
+            # to the old model's dispatch and its outcome bookkeeping
+            # must complete under the old generation
+            self._finish_delivery(wait=True)
+        if self._delivery is not None:  # pragma: no cover - invariant
+            raise RuntimeError(
+                "model swap attempted with a delivery still in air"
+            )
+        old = self.predictor.swap_model(model)
+        self.models_swapped += 1
+        return old
+
+    def _lifecycle_tick(self) -> None:
+        """Once per engine round: probation checks, then apply any
+        pending hot-swap at this between-batches safe point.  The same
+        degrade-never-kill contract as ``on_batch``: a failure anywhere
+        in the tick (probation rollback I/O, the swap itself) emits
+        ``lifecycle_error`` instead of killing the serving loop."""
+        lc = self.lifecycle
+        if lc is None:
+            return
+        pending = None
+        try:
+            on_tick = getattr(lc, "on_tick", None)
+            if on_tick is not None:
+                on_tick(self)
+            take = getattr(lc, "take_pending_swap", None)
+            pending = take() if take is not None else None
+            if pending is not None:
+                old = self.swap_model(pending)
+                # the flip landed: past this point a failure must NOT
+                # re-arm (retrying would swap the same model twice)
+                pending = None
+                applied = getattr(lc, "on_swap_applied", None)
+                if applied is not None:
+                    applied(old)
+        except Exception as e:
+            if pending is not None:
+                # the safe point failed BEFORE the predictor flip —
+                # put the swap back so the next tick retries instead
+                # of silently dropping it (a dropped rollback would
+                # wedge the promoter in "rolling_back" while the disk
+                # checkpoint already names the restored model)
+                rearm = getattr(lc, "rearm_pending_swap", None)
+                if rearm is not None:
+                    rearm(pending)
+            emit_event(
+                event="lifecycle_error", component="model",
+                error=repr(e),
+            )
+
     def pipeline_stats(self) -> dict:
         """Pipelining evidence (the bench journal's ``pipeline`` field):
         overlap/bucket config, delivery-thread busy time, predict-shape
@@ -1015,6 +1111,12 @@ class StreamingQuery:
         admission = self.admission_stats()
         if admission is not None:
             stats["admission"] = admission
+        if self.lifecycle is not None:
+            lc_stats = getattr(self.lifecycle, "stats", None)
+            stats["lifecycle"] = dict(
+                lc_stats() if lc_stats is not None else {},
+                models_swapped=self.models_swapped,
+            )
         return stats
 
     def _commit_batch(self, batch_id: int, intent: dict, *, n_rows: int,
@@ -1203,6 +1305,7 @@ class StreamingQuery:
         and dispatches the next batches) and again after it (a delivery
         that finished during the dispatch window commits now)."""
         before = self._last_committed
+        self._lifecycle_tick()
         if self.overlap_sink:
             self._pump_delivery()
             if self._tick_latest is None:

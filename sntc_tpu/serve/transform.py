@@ -81,6 +81,17 @@ class BatchPredictor:
 
         self._ledger_lock = threading.Lock()
 
+    def swap_model(self, model: Transformer) -> Transformer:
+        """Hot-swap the wrapped model IN PLACE, keeping the shape /
+        compile ledger and bucket config (the lifecycle hot-swap: the
+        ledger's flatness across a swap is the evidence that the new
+        model reused the incumbent's compiled programs).  Dispatches
+        already in flight finalize against the OLD model — their
+        closures bound it at dispatch time; the engine only calls this
+        between micro-batches.  Returns the replaced model."""
+        old, self.model = self.model, model
+        return old
+
     # -- bucketed dispatch --------------------------------------------------
 
     def _record_shape(self, n_rows: int, padded: int = 0) -> None:
@@ -96,6 +107,7 @@ class BatchPredictor:
         self,
         frame: Frame,
         row_valid: "np.ndarray | None" = None,
+        model=None,
     ) -> Callable[[], Frame]:
         """Dispatch ONE at-most-chunk_rows frame through the model's
         async transform, bucket-padded when armed; the returned finalize
@@ -111,14 +123,16 @@ class BatchPredictor:
         n = frame.num_rows
         target = bucket_rows_for(n, self.bucket_rows)
         all_admitted = row_valid is None or bool(np.all(row_valid))
+        if model is None:
+            model = self.model
         if (target == n or n == 0) and all_admitted:
             self._record_shape(n)
-            return self.model.transform_async(frame)
+            return model.transform_async(frame)
         self._record_shape(target, padded=target - n)
         valid = np.zeros(target, dtype=bool)
         valid[:n] = True if row_valid is None else row_valid
         padded = frame.pad_rows(target).with_column(VALID_COL, valid)
-        fin = self.model.transform_async(padded)
+        fin = model.transform_async(padded)
 
         def finalize() -> Frame:
             out = fin()
@@ -210,8 +224,12 @@ class BatchPredictor:
             else row_valid[s : min(s + self.chunk_rows, frame.num_rows)]
             for s in range(0, frame.num_rows, self.chunk_rows)
         ]
+        # bind the dispatch-time model: later chunks dispatch lazily
+        # from finalize(), which may run AFTER a lifecycle hot-swap —
+        # one committed batch must never mix two models' predictions
+        bound = self.model
         fins: List[Callable[[], Frame]] = [
-            self._dispatch_one(c, m)
+            self._dispatch_one(c, m, model=bound)
             for c, m in zip(
                 chunks[: self.CHUNK_WINDOW], masks[: self.CHUNK_WINDOW]
             )
@@ -222,7 +240,11 @@ class BatchPredictor:
             for i in range(len(chunks)):
                 nxt = i + self.CHUNK_WINDOW
                 if nxt < len(chunks):  # refill the window, THEN block
-                    fins.append(self._dispatch_one(chunks[nxt], masks[nxt]))
+                    fins.append(
+                        self._dispatch_one(
+                            chunks[nxt], masks[nxt], model=bound
+                        )
+                    )
                 outs.append(fins[i]())
             return Frame.concat_all(outs)
 

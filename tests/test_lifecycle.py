@@ -1,0 +1,897 @@
+"""Model-lifecycle tests (r11) — the four layers of
+``sntc_tpu/lifecycle/`` and their engine wiring:
+
+* ``partial_fit`` shard equivalence for NaiveBayes (all four model
+  types) and LogisticRegression (both families) against a batch fit on
+  the concatenated shards, at the tolerances documented in
+  docs/RESILIENCE.md "Model lifecycle";
+* drift detection with a DETERMINISTIC latency on the synthetic
+  two-day CICIDS-style drift stream (``generate_drift_frames``);
+* shadow promotion + hot-swap under shape buckets AND whole-pipeline
+  fusion with the compile ledger staying flat for the feature prefix
+  (zero recompiles from shadowing or swapping);
+* rollback restoring the incumbent's predictions bitwise;
+* the end-to-end engine loops (gated promotion; ``partial_fit``
+  online learning) and the lifecycle-flag drift check
+  (``scripts/check_lifecycle_flags.py``).
+"""
+
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.base import Pipeline
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.feature import PCA, StandardScaler, VectorAssembler
+from sntc_tpu.lifecycle import (
+    DriftMonitor,
+    LifecycleManager,
+    ModelPromoter,
+    batch_score_stats,
+    graft_head,
+    js_divergence,
+    macro_f1,
+    read_model_marker,
+    terminal_head,
+)
+from sntc_tpu.models import LogisticRegression, NaiveBayes
+from sntc_tpu.serve import (
+    BatchPredictor,
+    MemorySink,
+    MemorySource,
+    StreamingQuery,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+K_SHARDS = 4
+
+
+# ---------------------------------------------------------------------------
+# synthetic concepts
+# ---------------------------------------------------------------------------
+
+
+def _gauss(n, seed, k=3, d=6):
+    """Gaussian blobs: class c centered at c*1.5 along every feature."""
+    r = np.random.default_rng(seed)
+    y = r.integers(0, k, n)
+    X = (y[:, None] * 1.5 + r.normal(size=(n, d))).astype(np.float32)
+    return Frame({"features": X, "label": y.astype(np.float64)})
+
+
+def _counts(n, seed, k=3, d=6):
+    """Poisson count features (multinomial/complement-NB friendly)."""
+    r = np.random.default_rng(seed)
+    y = r.integers(0, k, n)
+    rates = 1.0 + 3.0 * ((y[:, None] + np.arange(d)[None, :]) % k)
+    X = r.poisson(rates).astype(np.float32)
+    return Frame({"features": X, "label": y.astype(np.float64)})
+
+
+def _binary(n, seed, k=3, d=6):
+    r = np.random.default_rng(seed)
+    y = r.integers(0, k, n)
+    p = 0.2 + 0.6 * ((y[:, None] + np.arange(d)[None, :]) % k == 0)
+    X = (r.random((n, d)) < p).astype(np.float32)
+    return Frame({"features": X, "label": y.astype(np.float64)})
+
+
+def _blobs3(n, seed, flip=False):
+    """3-column named-feature frame for the fused-pipeline tests; the
+    flipped concept swaps the class means so a candidate fit on it
+    genuinely disagrees with the incumbent."""
+    r = np.random.default_rng(seed)
+    y = r.integers(0, 2, n)
+    mu = np.where(y[:, None] == 1, 2.0, -2.0)
+    if flip:
+        mu = -mu
+    X = (mu + r.normal(size=(n, 3))).astype(np.float32)
+    return Frame({
+        "a": X[:, 0], "b": X[:, 1], "c": X[:, 2],
+        "label": y.astype(np.float64),
+    })
+
+
+def _shards(frame):
+    per = frame.num_rows // K_SHARDS
+    return [
+        frame.slice(i * per, (i + 1) * per) for i in range(K_SHARDS)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# partial_fit shard equivalence (the documented tolerance contract)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "model_type,gen",
+    [
+        ("multinomial", _counts),
+        ("complement", _counts),
+        ("bernoulli", _binary),
+        ("gaussian", _gauss),
+    ],
+)
+def test_nb_partial_fit_matches_batch_fit(model_type, gen, mesh8):
+    train = gen(1200, 7)
+    est = NaiveBayes(mesh=mesh8, modelType=model_type)
+    batch = est.fit(train)
+    state = None
+    for shard in _shards(train):
+        inc, state = est.partial_fit(shard, state)
+    assert state.batches_seen == K_SHARDS
+    assert state.rows_seen == 1200
+    if model_type == "gaussian":
+        # one-pass shifted moments vs the batch fit's second pass:
+        # same statistic, different rounding (documented tolerance)
+        np.testing.assert_allclose(
+            inc.gaussian_mu, batch.gaussian_mu, rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            inc.gaussian_var, batch.gaussian_var, rtol=1e-2
+        )
+    else:
+        # additive sufficient statistics: θ within f32 device
+        # summation order of the batch fit
+        np.testing.assert_allclose(inc.theta, batch.theta, rtol=1e-5)
+        np.testing.assert_allclose(inc.bias, batch.bias, rtol=1e-5)
+    test = gen(500, 77)
+    agree = float(np.mean(
+        np.asarray(batch.transform(test)["prediction"])
+        == np.asarray(inc.transform(test)["prediction"])
+    ))
+    assert agree >= 0.99, f"{model_type}: agreement {agree}"
+
+
+def test_nb_partial_fit_state_contracts(mesh8):
+    est = NaiveBayes(mesh=mesh8)
+    f = _counts(40, 0)
+    _, state = est.partial_fit(f, None)
+    with pytest.raises(ValueError, match="feature width"):
+        est.partial_fit(
+            Frame({"features": np.ones((5, 3), np.float32),
+                   "label": np.zeros(5)}),
+            state,
+        )
+    with pytest.raises(ValueError, match="outside the class set"):
+        est.partial_fit(
+            Frame({"features": np.ones((5, 6), np.float32),
+                   "label": np.full(5, 7.0)}),
+            state,
+        )
+    with pytest.raises(ValueError, match="decay"):
+        est.partial_fit(f, state, decay=0.0)
+
+
+def test_nb_partial_fit_decay_downweights_history(mesh8):
+    """decay=γ multiplies every accumulated statistic before the new
+    shard folds in — the streaming forgetfulness knob."""
+    est = NaiveBayes(mesh=mesh8)
+    a, b = _counts(200, 1), _counts(200, 2)
+    _, s_plain = est.partial_fit(a, None)
+    cw_a = s_plain.cw.copy()
+    _, s_plain = est.partial_fit(b, s_plain)
+    _, s_decay = est.partial_fit(a, None)
+    _, s_decay = est.partial_fit(b, s_decay, decay=0.25)
+    np.testing.assert_allclose(
+        s_decay.cw, s_plain.cw - 0.75 * cw_a, rtol=1e-12
+    )
+
+
+@pytest.mark.parametrize("k", [2, 3])
+def test_lr_partial_fit_matches_batch_fit(k, mesh8):
+    """No finite sufficient statistic exists for the logistic loss, so
+    the LR contract is behavioral: ≥95% held-out prediction agreement
+    with the batch fit over iid shards (warm-started LBFGS on exactly
+    accumulated standardization moments)."""
+    train = _gauss(1200, 5, k=k)
+    est = LogisticRegression(mesh=mesh8, maxIter=30)
+    batch = est.fit(train)
+    state = None
+    for shard in _shards(train):
+        inc, state = est.partial_fit(shard, state)
+    assert state.binomial == (k == 2)
+    assert state.rows_seen == 1200
+    # the standardization moments are additive and accumulate EXACTLY
+    X = np.asarray(train["features"], np.float64)
+    np.testing.assert_allclose(state.s1, X.sum(axis=0), rtol=1e-5)
+    np.testing.assert_allclose(state.s2, (X**2).sum(axis=0), rtol=1e-5)
+    test = _gauss(600, 88, k=k)
+    agree = float(np.mean(
+        np.asarray(batch.transform(test)["prediction"])
+        == np.asarray(inc.transform(test)["prediction"])
+    ))
+    assert agree >= 0.95, f"k={k}: agreement {agree}"
+
+
+def test_lr_partial_fit_rejects_unsupported(mesh8):
+    est = LogisticRegression(
+        mesh=mesh8, lowerBoundsOnCoefficients=np.zeros((1, 6))
+    )
+    with pytest.raises(ValueError, match="bound constraints"):
+        est.partial_fit(_gauss(40, 0, k=2), None)
+
+
+# ---------------------------------------------------------------------------
+# drift detection
+# ---------------------------------------------------------------------------
+
+
+def test_js_divergence_properties():
+    assert js_divergence([1, 2, 3], [1, 2, 3]) == pytest.approx(0.0)
+    assert js_divergence([1, 0], [0, 1]) == pytest.approx(
+        np.log(2.0), rel=1e-9
+    )
+    p, q = [0.7, 0.2, 0.1], [0.2, 0.3, 0.5]
+    assert js_divergence(p, q) == pytest.approx(js_divergence(q, p))
+    assert 0.0 < js_divergence(p, q) < np.log(2.0)
+
+
+def test_drift_monitor_event_stream_once_per_episode():
+    """Attached monitor folds ``batch_scored`` events; the breach emits
+    ``drift_detected`` exactly once per episode and reset() re-arms."""
+    from sntc_tpu.resilience import (
+        add_event_observer,
+        emit_event,
+        remove_event_observer,
+    )
+
+    seen = []
+    obs = lambda rec: seen.append(rec) if (  # noqa: E731
+        rec.get("event") == "drift_detected"
+    ) else None
+    add_event_observer(obs)
+    mon = DriftMonitor(window=2, threshold=0.2).attach()
+    try:
+        ref = {"prediction_mix": [100, 0], "score_hist": [50, 50]}
+        shifted = {"prediction_mix": [0, 100], "score_hist": [50, 50]}
+        for i in range(4):  # 2 reference + 2 current (no drift)
+            emit_event(event="batch_scored", batch_id=i, **ref)
+        assert not mon.detected
+        for i in range(4, 6):
+            emit_event(event="batch_scored", batch_id=i, **shifted)
+        # the half-shifted window [3, 4] already crosses the threshold
+        assert mon.detected and mon.detected_batch == 4
+        for i in range(6, 9):  # still breached: no repeat emission
+            emit_event(event="batch_scored", batch_id=i, **shifted)
+        assert len(seen) == 1
+        assert seen[0]["divergence"] > 0.2
+        mon.reset()
+        assert not mon.detected and mon.stats()["batches_seen"] == 9
+    finally:
+        mon.detach()
+        remove_event_observer(obs)
+
+
+def test_drift_detection_latency_on_synthetic_shift(mesh8):
+    """The drift-replay fixture: a two-day CICIDS-style stream with the
+    mix+concept shift at batch 6.  Detection latency is DETERMINISTIC —
+    window 3 freezes batches 0-2 as the reference and the divergence
+    crosses the threshold exactly 2 batches after the shift."""
+    from sntc_tpu.data import clean_flows, generate_drift_frames
+    from sntc_tpu.resilience.health import HealthMonitor
+
+    frames = generate_drift_frames(
+        12, rows_per_batch=256, shift_at=6, seed=0, n_classes=8
+    )
+    assert len(frames) == 12
+    train = clean_flows(Frame.concat_all(frames[:6]))
+    feat_cols = [c for c in train.columns if c != "Label"]
+    from sntc_tpu.feature import StringIndexer
+
+    model = Pipeline(stages=[
+        StringIndexer(inputCol="Label", outputCol="label"),
+        VectorAssembler(inputCols=feat_cols, outputCol="features"),
+        NaiveBayes(mesh=mesh8, modelType="gaussian"),
+    ]).fit(train)
+
+    health = HealthMonitor()
+    mon = DriftMonitor(window=3, threshold=0.04, health=health)
+    for i, f in enumerate(frames):
+        stats = batch_score_stats(model.transform(clean_flows(f)), 8)
+        stats["batch_id"] = i
+        mon.observe(stats)
+        if i < 6:  # phase A: healthy baseline, no false positive
+            assert not mon.detected, f"false positive at batch {i}"
+    assert mon.detected
+    assert mon.detected_batch == 8  # latency: 2 batches past the shift
+    assert mon.detected_batch - 6 == 2
+    snap = health.snapshot()["components"]["model"]
+    assert snap["state"] == "DEGRADED"
+
+
+def test_write_drift_stream_is_deterministic(tmp_path):
+    from sntc_tpu.data import write_drift_stream
+
+    d1, d2 = str(tmp_path / "s1"), str(tmp_path / "s2")
+    p1 = write_drift_stream(d1, 4, rows_per_batch=16, shift_at=2)
+    p2 = write_drift_stream(d2, 4, rows_per_batch=16, shift_at=2)
+    assert [os.path.basename(p) for p in p1] == [
+        f"part_{i:04d}.csv" for i in range(4)
+    ]
+    for a, b in zip(p1, p2):
+        with open(a, "rb") as fa, open(b, "rb") as fb:
+            assert fa.read() == fb.read()
+    # the shifted day genuinely differs from the first
+    with open(p1[0], "rb") as fa, open(p1[2], "rb") as fb:
+        assert fa.read() != fb.read()
+
+
+# ---------------------------------------------------------------------------
+# shadow promotion + hot-swap under buckets and fusion
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fused_pair(mesh8):
+    """(incumbent serving pipeline compiled with a fused feature prefix
+    and a PLAIN swappable head, raw fitted incumbent, candidate head
+    fit on the flipped concept)."""
+    from sntc_tpu.fuse import compile_pipeline
+
+    pipe = Pipeline(stages=[
+        VectorAssembler(inputCols=["a", "b", "c"], outputCol="features"),
+        StandardScaler(inputCol="features", outputCol="scaled"),
+        PCA(inputCol="scaled", outputCol="pca", k=2),
+        LogisticRegression(mesh=mesh8, featuresCol="pca", maxIter=25),
+    ])
+    fitted = pipe.fit(_blobs3(600, 1))
+    candidate = terminal_head(pipe.fit(_blobs3(600, 2, flip=True)))
+    serving = compile_pipeline(fitted, fuse_heads=False)
+    return serving, fitted, candidate
+
+
+def test_fused_head_is_not_swappable(fused_pair, mesh8):
+    """A head fused INTO a segment cannot be located/swapped — the
+    lifecycle serving path compiles with fuse_heads=False and the
+    guard names that fix."""
+    from sntc_tpu.fuse import compile_pipeline
+
+    _, fitted, _ = fused_pair
+    fully_fused = compile_pipeline(fitted, fuse_heads=True)
+    with pytest.raises(ValueError, match="fuse_heads=False"):
+        terminal_head(fully_fused)
+
+
+def test_hot_swap_adds_zero_prefix_recompiles(fused_pair):
+    """Shadow scoring AND the hot-swap reuse the incumbent's compiled
+    feature-prefix programs: the fused segment's compile ledger and the
+    predictor's shape ledger both stay flat, and only because
+    graft_head reuses the very same fitted stage objects."""
+    from sntc_tpu.fuse import fused_segments
+
+    serving, _, candidate = fused_pair
+    segs = fused_segments(serving)
+    assert len(segs) == 1
+    bp = BatchPredictor(serving, bucket_rows=32)
+    for n, s in ((20, 10), (40, 11), (25, 12), (37, 13)):
+        bp.predict_frame(_blobs3(n, s))
+    seg_warm = [s.compile_events for s in segs]
+    bp_warm = bp.compile_events
+    assert bp_warm == 2  # two pow2 buckets: 32 and 64
+
+    cand_serving = graft_head(serving, candidate)
+    # the prefix is the SAME object, not an equivalent recompile
+    assert fused_segments(cand_serving)[0] is segs[0]
+
+    # shadow scoring through a second predictor with the same buckets
+    shadow = BatchPredictor(cand_serving, bucket_rows=32)
+    for n, s in ((20, 20), (40, 21)):
+        shadow.predict_frame(_blobs3(n, s))
+    assert [s.compile_events for s in segs] == seg_warm
+
+    # hot-swap, then the same shapes again: zero new compile events
+    old = bp.swap_model(cand_serving)
+    for n, s in ((20, 30), (40, 31), (25, 32)):
+        bp.predict_frame(_blobs3(n, s))
+    assert [s.compile_events for s in segs] == seg_warm
+    assert bp.compile_events == bp_warm
+
+    # the swap genuinely changed the served model...
+    probe = _blobs3(64, 99)
+    ref_old = old.transform(probe)
+    assert not np.array_equal(
+        np.asarray(bp.predict_frame(probe)["prediction"]),
+        np.asarray(ref_old["prediction"]),
+    )
+    # ...and swapping back restores incumbent predictions BITWISE
+    bp.swap_model(old)
+    out = bp.predict_frame(probe)
+    np.testing.assert_array_equal(
+        np.asarray(out["prediction"]),
+        np.asarray(ref_old["prediction"]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out["probability"]),
+        np.asarray(ref_old["probability"]),
+    )
+
+
+def test_promoter_gate_and_engine_swap(fused_pair, tmp_path):
+    """End-to-end gated promotion on the engine: shadow-score the
+    candidate over the window, publish atomically (marker + journal +
+    ``.prev`` retained), hot-swap between micro-batches, and keep the
+    WAL/commit contract."""
+    from sntc_tpu.mlio import load_model, prev_checkpoint_path, save_model
+
+    serving, fitted, candidate = fused_pair
+    serving_path = str(tmp_path / "model")
+    ckpt = str(tmp_path / "ckpt")
+    save_model(fitted, serving_path)
+
+    # stream labels follow the FLIPPED concept: the incumbent loses
+    # the gate, the candidate (fit on it) wins
+    batches = [_blobs3(64, 100 + i, flip=True) for i in range(8)]
+    sink = MemorySink()
+    promoter = ModelPromoter(
+        serving, incumbent_raw=fitted, serving_path=serving_path,
+        checkpoint_dir=ckpt, window=3, probation_batches=2,
+    )
+    promoter.set_candidate(candidate)
+    q = StreamingQuery(
+        serving, MemorySource(batches), sink, ckpt,
+        max_batch_offsets=1,
+        lifecycle=LifecycleManager(promoter=promoter),
+    )
+    assert q.process_available() == 8
+    assert q.models_swapped == 1
+    assert promoter.promotions == 1 and promoter.rollbacks == 0
+    assert promoter.state == "idle"  # probation passed
+    # WAL/commit contract: all 8 batches committed exactly once
+    assert q.last_committed() == 7
+    # the sink flips from incumbent predictions to candidate ones:
+    # window fills at batch 2, the swap lands at the next safe point
+    y0 = np.asarray(batches[0]["label"], np.int64)
+    f1_first = macro_f1(y0, np.asarray(sink.frames[0]["prediction"]))
+    y7 = np.asarray(batches[7]["label"], np.int64)
+    f1_last = macro_f1(y7, np.asarray(sink.frames[7]["prediction"]))
+    assert f1_first < 0.2 < 0.9 < f1_last
+    # durable state: marker generation, journal verdicts, .prev
+    marker = read_model_marker(ckpt)
+    assert marker["generation"] == 1 and marker["action"] == "promoted"
+    with open(os.path.join(ckpt, "promotion.jsonl")) as f:
+        journal = [json.loads(line) for line in f]
+    actions = [r["action"] for r in journal]
+    assert "promote" in actions and "probation_passed" in actions
+    assert any(
+        r["action"] == "shadow_score" and r["decision"] == "promote"
+        for r in journal
+    )
+    assert os.path.isdir(prev_checkpoint_path(serving_path))
+    # the published checkpoint serves the candidate after a restart
+    republished = terminal_head(load_model(serving_path))
+    np.testing.assert_array_equal(
+        np.asarray(republished.coefficientMatrix),
+        np.asarray(candidate.coefficientMatrix),
+    )
+    lc = q.pipeline_stats()["lifecycle"]
+    assert lc["models_swapped"] == 1
+    assert lc["promoter"]["generation"] == 1
+    q.stop()
+
+
+def test_probation_breach_rolls_back_bitwise(fused_pair, tmp_path):
+    """An OPEN predict.dispatch breaker during post-swap probation
+    triggers rollback: the engine swaps the EXACT retained incumbent
+    back (bitwise-identical predictions) and republishes it."""
+    from sntc_tpu.mlio import load_model, save_model
+
+    serving, fitted, candidate = fused_pair
+    serving_path = str(tmp_path / "model")
+    ckpt = str(tmp_path / "ckpt")
+    save_model(fitted, serving_path)
+
+    class OpenableBreaker:
+        state = "closed"
+
+    breaker = OpenableBreaker()
+    promoter = ModelPromoter(
+        serving, incumbent_raw=fitted, serving_path=serving_path,
+        checkpoint_dir=ckpt, window=2, probation_batches=4,
+        breaker=breaker,
+    )
+    promoter.set_candidate(candidate)
+    batches = [_blobs3(64, 200 + i, flip=True) for i in range(3)]
+    sink = MemorySink()
+    q = StreamingQuery(
+        serving, MemorySource(batches), sink, ckpt,
+        max_batch_offsets=1,
+        lifecycle=LifecycleManager(promoter=promoter),
+    )
+    probe = _blobs3(64, 999)
+    ref_incumbent = serving.transform(probe)
+    assert q.process_available() == 3
+    assert q.models_swapped == 1 and promoter.state == "probation"
+
+    # the breaker opens mid-probation; more stream data arrives
+    breaker.state = "open"
+    src2 = q.source
+    src2.add(_blobs3(64, 300))
+    assert q.process_available() == 1
+    assert promoter.rollbacks == 1
+    assert q.models_swapped == 2  # promote swap + rollback swap
+    assert promoter.state == "rolled_back"
+    # the served model is the EXACT incumbent object again: bitwise
+    out = q.predictor.model.transform(probe)
+    np.testing.assert_array_equal(
+        np.asarray(out["prediction"]),
+        np.asarray(ref_incumbent["prediction"]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out["probability"]),
+        np.asarray(ref_incumbent["probability"]),
+    )
+    # durable: the marker records the rollback, the serving path loads
+    # the incumbent head again
+    marker = read_model_marker(ckpt)
+    assert marker["action"] == "rolled_back"
+    restored = terminal_head(load_model(serving_path))
+    np.testing.assert_array_equal(
+        np.asarray(restored.coefficientMatrix),
+        np.asarray(terminal_head(fitted).coefficientMatrix),
+    )
+    q.stop()
+
+
+def test_rollback_from_prev_checkpoint_without_memory(
+    fused_pair, tmp_path
+):
+    """A promoter that never promoted in-process (fresh restart) rolls
+    back from the durable ``<serving_path>.prev`` snapshot."""
+    from sntc_tpu.mlio import save_model
+
+    serving, fitted, candidate = fused_pair
+    serving_path = str(tmp_path / "model")
+    save_model(fitted, serving_path)  # generation 0
+    save_model(
+        graft_head(fitted, candidate), serving_path
+    )  # candidate published; incumbent retained at .prev
+
+    promoter = ModelPromoter(
+        graft_head(serving, candidate),
+        incumbent_raw=graft_head(fitted, candidate),
+        serving_path=serving_path,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    promoter.rollback("operator-forced")
+    restored = promoter.take_pending_swap()
+    assert restored is not None
+    probe = _blobs3(32, 5)
+    np.testing.assert_array_equal(
+        np.asarray(restored.transform(probe)["prediction"]),
+        np.asarray(fitted.transform(probe)["prediction"]),
+    )
+
+
+def test_candidate_scaler_fold_normalization(mesh8):
+    """The default serve path folds a scaler directly feeding the head
+    into the head's weights, so the incumbent head reads the PRE-scaler
+    column; an external candidate checkpoint arrives UNfolded.  The
+    promoter must apply the same fold to the candidate (baking the
+    candidate's OWN scaler into its head) before grafting — the r11
+    serve-CLI regression."""
+    from sntc_tpu.fuse import compile_pipeline
+
+    pipe = Pipeline(stages=[
+        VectorAssembler(inputCols=["a", "b", "c"], outputCol="raw"),
+        StandardScaler(inputCol="raw", outputCol="features"),
+        LogisticRegression(mesh=mesh8, maxIter=20),
+    ])
+    incumbent_raw = pipe.fit(_blobs3(400, 1))
+    candidate_raw = pipe.fit(_blobs3(400, 2, flip=True))
+    serving = compile_pipeline(incumbent_raw, fuse_heads=False)
+    # the fold happened: the serving head reads the assembler output
+    assert terminal_head(serving).getFeaturesCol() == "raw"
+    promoter = ModelPromoter(serving, incumbent_raw=incumbent_raw)
+    promoter.set_candidate(candidate_raw)  # must not raise
+    assert promoter.candidate_head.getFeaturesCol() == "raw"
+    # the folded graft serves the candidate's EXACT decision function
+    probe = _blobs3(64, 9)
+    np.testing.assert_array_equal(
+        np.asarray(promoter.candidate.transform(probe)["prediction"]),
+        np.asarray(candidate_raw.transform(probe)["prediction"]),
+    )
+
+
+def test_promote_and_prev_rollback_with_scaler_fold(mesh8, tmp_path):
+    """``promote()`` on the default serve path (scaler folded into the
+    head, so the candidate head reads the PRE-scaler column) must
+    publish a restart-servable checkpoint — the raw prefix is folded
+    the same way before the graft — and a post-restart rollback (no
+    in-memory ``_previous``) must normalize the ``.prev`` head back
+    onto the compiled prefix instead of raising."""
+    from sntc_tpu.fuse import compile_pipeline
+    from sntc_tpu.mlio import load_model, save_model
+
+    pipe = Pipeline(stages=[
+        VectorAssembler(inputCols=["a", "b", "c"], outputCol="raw"),
+        StandardScaler(inputCol="raw", outputCol="features"),
+        LogisticRegression(mesh=mesh8, maxIter=20),
+    ])
+    incumbent_raw = pipe.fit(_blobs3(400, 1))
+    candidate_raw = pipe.fit(_blobs3(400, 2, flip=True))
+    serving = compile_pipeline(incumbent_raw, fuse_heads=False)
+    assert terminal_head(serving).getFeaturesCol() == "raw"
+    serving_path = str(tmp_path / "model")
+    save_model(incumbent_raw, serving_path)
+    promoter = ModelPromoter(
+        serving, incumbent_raw=incumbent_raw, serving_path=serving_path,
+        checkpoint_dir=str(tmp_path / "ckpt"), window=1,
+        probation_batches=1,
+    )
+    promoter.set_candidate(candidate_raw)
+    promoter.promote()  # must not raise on the folded mismatch
+    probe = _blobs3(64, 9)
+    want = np.asarray(candidate_raw.transform(probe)["prediction"])
+    # the published checkpoint transforms RAW flow columns on restart
+    np.testing.assert_array_equal(
+        np.asarray(load_model(serving_path).transform(probe)["prediction"]),
+        want,
+    )
+    # land the swap, then simulate a restart: the retained in-memory
+    # previous generation is gone, rollback must go through .prev
+    promoter.on_swap_applied(serving)
+    promoter._previous = None
+    promoter.rollback("probation breach")
+    inc_want = np.asarray(incumbent_raw.transform(probe)["prediction"])
+    np.testing.assert_array_equal(
+        np.asarray(promoter.incumbent.transform(probe)["prediction"]),
+        inc_want,
+    )
+    # ...and republish the restored model for the next restart
+    np.testing.assert_array_equal(
+        np.asarray(load_model(serving_path).transform(probe)["prediction"]),
+        inc_want,
+    )
+
+
+def test_promote_gate_disarmed_until_swap_applies(fused_pair, tmp_path):
+    """A labeled batch settled between publish and the engine's swap
+    safe point (overlap mode settles one during the swap itself) must
+    NOT re-promote: ``promote()`` moves the machine to ``promoting``,
+    and a stale duplicate ``on_swap_applied`` is a no-op instead of
+    clobbering the incumbent with the cleared candidate."""
+    from sntc_tpu.mlio import save_model
+
+    serving, fitted, candidate = fused_pair
+    serving_path = str(tmp_path / "model")
+    save_model(fitted, serving_path)
+    promoter = ModelPromoter(
+        serving, incumbent_raw=fitted, serving_path=serving_path,
+        checkpoint_dir=str(tmp_path / "ckpt"), window=1,
+        probation_batches=2,
+    )
+    promoter.set_candidate(candidate)
+    # head-only shadow: scoring must not re-run the feature prefix
+    assert promoter._shadow.model is promoter.candidate_head
+
+    batch = _blobs3(64, 100, flip=True)
+    out = BatchPredictor(serving).predict_frame(batch)
+    promoter.on_batch(0, batch, out)  # window=1: gate fires
+    assert promoter.state == "promoting" and promoter.promotions == 1
+    # the in-between batch: gate disarmed, no second publish
+    promoter.on_batch(1, batch, out)
+    assert promoter.promotions == 1 and promoter.generation == 1
+    # ...and the partial-fit refit loop cannot reset the machine either
+    promoter.update_candidate(candidate)
+    assert promoter.state == "promoting"
+
+    swap = promoter.take_pending_swap()
+    assert swap is not None
+    promoter.on_swap_applied(serving)
+    assert promoter.state == "probation"
+    assert promoter.incumbent is not None
+    # stale duplicate apply (nothing armed): a no-op
+    promoter.on_swap_applied(serving)
+    assert promoter.state == "probation"
+    assert promoter.incumbent is not None
+
+
+def test_rollback_republishes_bare_head_incumbent(tmp_path, mesh8):
+    """A bare classifier-head incumbent has no ``incumbent_raw``; after
+    a rollback the restored head itself must be republished to
+    ``serving_path`` — otherwise a restart loads the rolled-back
+    candidate the marker claims was replaced."""
+    from sntc_tpu.mlio import load_model, save_model
+
+    incumbent = NaiveBayes(mesh=mesh8, modelType="gaussian").fit(
+        _gauss(300, 0)
+    )
+    candidate = NaiveBayes(mesh=mesh8, modelType="gaussian").fit(
+        _gauss(300, 1)
+    )
+    serving_path = str(tmp_path / "model")
+    save_model(incumbent, serving_path)
+    promoter = ModelPromoter(
+        incumbent, serving_path=serving_path,
+        checkpoint_dir=str(tmp_path / "ckpt"), window=1,
+        probation_batches=2,
+    )
+    promoter.set_candidate(candidate)
+    promoter.promote()
+    promoter.take_pending_swap()
+    promoter.on_swap_applied(incumbent)
+    promoter.rollback("probation breach")
+    probe = _gauss(200, 9)
+    np.testing.assert_array_equal(
+        np.asarray(load_model(serving_path).transform(probe)["prediction"]),
+        np.asarray(incumbent.transform(probe)["prediction"]),
+    )
+
+
+def test_lifecycle_tick_rearms_swap_when_safe_point_fails(tmp_path, mesh8):
+    """A failure BEFORE the predictor flip (e.g. settling the in-air
+    delivery raises) must put the taken swap back for the next tick —
+    dropping it would wedge a rollback in ``rolling_back`` while the
+    disk checkpoint already names the restored model."""
+    from sntc_tpu.resilience import add_event_observer, remove_event_observer
+
+    incumbent = NaiveBayes(mesh=mesh8, modelType="gaussian").fit(
+        _gauss(200, 0)
+    )
+    replacement = NaiveBayes(mesh=mesh8, modelType="gaussian").fit(
+        _gauss(200, 1)
+    )
+
+    class OneSwap:
+        def __init__(self, model):
+            self.pending = model
+            self.rearmed = 0
+            self.applied = 0
+
+        def on_batch(self, batch_id, frame, finalize):
+            pass
+
+        def take_pending_swap(self):
+            pending, self.pending = self.pending, None
+            return pending
+
+        def rearm_pending_swap(self, model):
+            self.pending = model
+            self.rearmed += 1
+
+        def on_swap_applied(self, old):
+            self.applied += 1
+
+    class FlakySwap(StreamingQuery):
+        def swap_model(self, model):
+            if getattr(self, "_fail_once", True):
+                self._fail_once = False
+                raise RuntimeError("delivery settle failed")
+            return super().swap_model(model)
+
+    lc = OneSwap(replacement)
+    errors = []
+    obs = lambda r: errors.append(r) if (  # noqa: E731
+        r.get("event") == "lifecycle_error"
+    ) else None
+    add_event_observer(obs)
+    try:
+        q = FlakySwap(
+            incumbent, MemorySource([_gauss(32, 2), _gauss(32, 3)]),
+            MemorySink(), str(tmp_path / "ckpt"),
+            max_batch_offsets=1, lifecycle=lc,
+        )
+        assert q.process_available() == 2
+        assert lc.rearmed == 1 and lc.applied == 1
+        assert q.predictor.model is replacement
+        assert len(errors) == 1
+        q.stop()
+    finally:
+        remove_event_observer(obs)
+
+
+def test_online_partial_fit_loop_recovers_f1(tmp_path, mesh8):
+    """The full online-learning arc on the engine: the incumbent is
+    blind to the shifted concept, ``partial_fit`` refits a candidate
+    from live labeled batches, the gate promotes it, and post-swap
+    macro-F1 recovers."""
+    from sntc_tpu.mlio import save_model
+
+    def shifted(n, seed, shift=False, k=3, d=4):
+        r = np.random.default_rng(seed)
+        y = r.integers(0, k, n)
+        centers = ((y[:, None] + 1) % k if shift else y[:, None]) * 2.0
+        X = (centers + r.normal(size=(n, d))).astype(np.float32)
+        return Frame({"features": X, "label": y.astype(np.float64)})
+
+    incumbent = NaiveBayes(mesh=mesh8, modelType="gaussian").fit(
+        shifted(900, 0)
+    )
+    serving_path = str(tmp_path / "model")
+    ckpt = str(tmp_path / "ckpt")
+    save_model(incumbent, serving_path)
+    batches = [shifted(128, 100 + i, shift=True) for i in range(10)]
+    promoter = ModelPromoter(
+        incumbent, incumbent_raw=incumbent, serving_path=serving_path,
+        checkpoint_dir=ckpt, window=3, probation_batches=2,
+    )
+    mgr = LifecycleManager(promoter=promoter, partial_fit=True)
+    q = StreamingQuery(
+        incumbent, MemorySource(batches), MemorySink(), ckpt,
+        max_batch_offsets=1, lifecycle=mgr,
+    )
+    assert q.process_available() == 10
+    stats = q.pipeline_stats()["lifecycle"]
+    assert stats["partial_fit_batches"] == 10
+    assert stats["models_swapped"] >= 1
+    assert promoter.promotions >= 1 and promoter.rollbacks == 0
+    probe = shifted(400, 999, shift=True)
+    y = np.asarray(probe["label"], np.int64)
+    f1_inc = macro_f1(
+        y, np.asarray(incumbent.transform(probe)["prediction"])
+    )
+    f1_live = macro_f1(
+        y, np.asarray(q.predictor.model.transform(probe)["prediction"])
+    )
+    assert f1_inc < 0.2, f"incumbent unexpectedly survives: {f1_inc}"
+    assert f1_live > 0.9, f"refit candidate did not recover: {f1_live}"
+    q.stop()
+
+
+def test_incremental_estimator_for_unsupported_head_raises(mesh8):
+    from sntc_tpu.lifecycle import incremental_estimator_for
+    from sntc_tpu.models import LinearSVC
+
+    svc = LinearSVC(mesh=mesh8, maxIter=5).fit(_gauss(80, 0, k=2))
+    with pytest.raises(ValueError, match="no incremental estimator"):
+        incremental_estimator_for(svc)
+
+
+def test_lifecycle_hook_failure_degrades_not_kills(tmp_path, mesh8):
+    """A raising lifecycle hook must never kill the serving loop: the
+    engine emits ``lifecycle_error`` and keeps committing."""
+    from sntc_tpu.resilience import add_event_observer, remove_event_observer
+
+    incumbent = NaiveBayes(mesh=mesh8, modelType="gaussian").fit(
+        _gauss(200, 0)
+    )
+
+    class Exploding:
+        def on_batch(self, batch_id, frame, finalize):
+            raise RuntimeError("boom")
+
+    seen = []
+    obs = lambda rec: seen.append(rec) if (  # noqa: E731
+        rec.get("event") == "lifecycle_error"
+    ) else None
+    add_event_observer(obs)
+    try:
+        q = StreamingQuery(
+            incumbent,
+            MemorySource([_gauss(32, 1), _gauss(32, 2)]),
+            MemorySink(), str(tmp_path / "ckpt"),
+            max_batch_offsets=1, lifecycle=Exploding(),
+        )
+        assert q.process_available() == 2
+        assert q.last_committed() == 1
+        assert len(seen) == 2
+        q.stop()
+    finally:
+        remove_event_observer(obs)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle-flag drift check (the tier-1 wiring of
+# scripts/check_lifecycle_flags.py, mirroring check_perf_flags)
+# ---------------------------------------------------------------------------
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_lifecycle_flags_consistent():
+    checker = _load_script("check_lifecycle_flags")
+    assert checker.check() == []

@@ -751,3 +751,77 @@ def test_perf_flags_drift_check():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     assert mod.check() == []
+
+
+def test_hot_swap_never_lands_mid_delivery(tmp_path):
+    """r11 hot-swap safety under overlap_sink: ``swap_model`` settles
+    the in-air delivery FIRST (the head batch commits under the old
+    generation on this thread) and only then flips the predictor — a
+    swap can never land while a delivery is in the air."""
+    import threading
+
+    import numpy as np
+
+    from sntc_tpu.models.logistic_regression import (
+        LogisticRegressionModel,
+    )
+
+    def const_model(positive):
+        # zero coefficients + a pinned intercept: predicts ONE class
+        # everywhere, so the sink rows prove which model served them
+        return LogisticRegressionModel(
+            coefficient_matrix=np.zeros((2, 4), np.float32),
+            intercepts=np.asarray(
+                [0.0, 50.0 if positive else -50.0], np.float32
+            ),
+            is_binomial=True,
+        )
+
+    incumbent, candidate = const_model(False), const_model(True)
+    entered, release = threading.Event(), threading.Event()
+    holder = {}
+    events = []
+
+    class GatedSink(MemorySink):
+        def add_batch(self, batch_id, frame):
+            entered.set()
+            assert release.wait(timeout=10), "swap should release us"
+            # the engine predictor must still wrap the OLD model while
+            # this delivery is in the air — the swap waits for us
+            events.append(
+                ("sunk", batch_id,
+                 holder["q"].predictor.model is incumbent)
+            )
+            super().add_batch(batch_id, frame)
+
+    sink = GatedSink()
+    src = MemorySource([_batch(20, s) for s in range(2)])
+    q = StreamingQuery(
+        incumbent, src, sink, str(tmp_path / "ckpt"),
+        max_batch_offsets=1, pipeline_depth=2, overlap_sink=True,
+    )
+    holder["q"] = q
+    q._run_one_batch()  # batch 0's delivery is now in the air
+    assert entered.wait(timeout=10)
+    assert q._delivery is not None
+    # release the gated sink shortly AFTER swap_model starts waiting on
+    # the in-air head; the swap must join it, not overtake it
+    threading.Timer(0.2, release.set).start()
+    old = q.swap_model(candidate)
+    events.append(("swapped",))
+    assert old is incumbent
+    assert q._delivery is None  # the head settled before the flip
+    assert q.models_swapped == 1
+    # ordering evidence: the in-air delivery completed (under the old
+    # model) strictly before the swap was applied
+    assert events[0] == ("sunk", 0, True)
+    assert events[-1] == ("swapped",)
+    # drain the rest: batches dispatched after the swap serve class 1
+    src.add(_batch(15, 9))
+    q.process_available()
+    q.stop()
+    first = np.asarray(sink.frames[0]["prediction"])
+    last = np.asarray(sink.frames[-1]["prediction"])
+    np.testing.assert_array_equal(first, np.zeros_like(first))
+    np.testing.assert_array_equal(last, np.ones_like(last))
+    assert q.last_committed() == len(sink.frames) - 1

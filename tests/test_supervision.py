@@ -780,3 +780,40 @@ def test_chaos_sigterm_drains_pipelined(chaos, chaos_reference):
         )
     assert verdict["ok"], verdict
     assert verdict["rc"] == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill mid-promotion (r11) — the model-lifecycle publish/swap
+# protocol dies at each of its three boundaries in a REAL child
+# process; the restart must converge to the reference commits with the
+# CORRECT model (incumbent or promoted candidate) serving
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def promotion_reference(chaos, tmp_path_factory):
+    workdir = str(tmp_path_factory.mktemp("chaos_promote"))
+    return workdir, chaos.run_promotion_reference(workdir)
+
+
+def test_chaos_promotion_reference_shape(chaos, promotion_reference):
+    _, reference = promotion_reference
+    # 2 batches under the incumbent (class 0), promotion, 2 under the
+    # candidate (class 1) — and all four batches committed exactly once
+    assert sorted(reference["commits"]) == [0, 1, 2, 3]
+    assert reference["predictions"] == {
+        "batch_000000.csv": [0.0], "batch_000001.csv": [0.0],
+        "batch_000002.csv": [1.0], "batch_000003.csv": [1.0],
+    }
+
+
+def test_chaos_kill_mid_promotion_converges(chaos, promotion_reference):
+    workdir, reference = promotion_reference
+    for point in chaos.PROMOTE_KILL_POINTS:
+        verdict = chaos.run_promotion_kill_scenario(
+            workdir, point, reference
+        )
+        assert verdict["ok"], verdict
+        # pre-publish: the incumbent keeps serving; once the publish
+        # reached disk, the restart must serve the promoted candidate
+        assert verdict["candidate_serves"] is (point != "pre_publish")
