@@ -45,6 +45,19 @@ SCRIPT = os.path.abspath(__file__)
 KILL_SITES = ("stream.wal", "sink.write", "stream.commit")
 KILL_EXIT_CODE = 137  # mirrors sntc_tpu.resilience.KILL_EXIT_CODE
 
+# multi-tenant scenarios (r12): three tenants on one ServeDaemon.
+# The kill scenario arms ONE tenant's namespaced WAL boundary
+# (SNTC_FAULTS=tenant/t1/stream.wal:kill) — the process dies mid-batch
+# with three live tenants, and a restart on the same root must
+# converge EVERY tenant to its own uninterrupted reference commits and
+# sink rows (per-tenant WAL replay; t1's fault corrupted nobody
+# else's checkpoint).  The isolation scenario arms one tenant's sink
+# with a permanent io fault: that tenant's batches quarantine to its
+# own dead-letter and the tenant escalates to QUARANTINED, while the
+# other two tenants' sink output stays byte-for-byte the reference's
+# and the daemon exits 0.
+TENANT_IDS = ("t0", "t1", "t2")
+
 # kill-mid-promotion points (r11): where the model-lifecycle promotion
 # protocol dies.  pre_publish = before anything reached disk (the
 # promotion is simply lost; the incumbent keeps serving); pre_swap =
@@ -369,6 +382,145 @@ def run_promotion_kill_scenario(
     }
 
 
+def run_daemon_worker(
+    d: str, *, faults: str = "", timeout: float = 120.0,
+) -> subprocess.CompletedProcess:
+    """One drain-and-exit ServeDaemon pass over the three tenant
+    streams under ``<d>/in/<tid>`` in a child process."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS=faults)
+    env.pop("SNTC_RESILIENCE_LOG", None)
+    return subprocess.run(
+        [
+            sys.executable, SCRIPT, "--worker", "--daemon", "--watch",
+            os.path.join(d, "in"), "--out", os.path.join(d, "out"),
+            "--ckpt", os.path.join(d, "ckpt"),
+        ],
+        env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+def _write_daemon_inputs(d: str) -> None:
+    """Per-tenant input dirs with DISTINCT row values (tenant index in
+    the thousands digit block), so a cross-tenant mixup would show in
+    the sink rows, not only the counts."""
+    for k, tid in enumerate(TENANT_IDS):
+        tdir = os.path.join(d, "in", tid)
+        os.makedirs(tdir, exist_ok=True)
+        for i in range(4):
+            with open(
+                os.path.join(tdir, f"in_{i:03d}.csv"), "w", newline=""
+            ) as f:
+                w = csv.writer(f)
+                w.writerow(["x"])
+                for r in range(6):
+                    w.writerow([k * 100_000 + i * 1000 + r])
+
+
+def _daemon_state(d: str) -> dict:
+    """Per-tenant committed WAL ranges + sink rows."""
+    return {
+        tid: {
+            "commits": committed_state(
+                os.path.join(d, "ckpt", "tenant", tid, "ckpt")
+            ),
+            "rows": sink_rows(os.path.join(d, "out", tid)),
+        }
+        for tid in TENANT_IDS
+    }
+
+
+def run_multi_tenant_reference(workdir: str) -> dict:
+    """One uninterrupted 3-tenant daemon pass; every multi-tenant
+    scenario compares per-tenant against it."""
+    d = os.path.join(workdir, "mt_reference")
+    _write_daemon_inputs(d)
+    ref = run_daemon_worker(d)
+    if ref.returncode != 0:
+        raise RuntimeError(
+            f"multi-tenant reference rc={ref.returncode}: {ref.stderr}"
+        )
+    return _daemon_state(d)
+
+
+def run_multi_tenant_kill_scenario(workdir: str, reference: dict) -> dict:
+    """Kill the daemon at ONE tenant's namespaced WAL boundary with
+    three tenants live; restart and require every tenant to converge
+    to its own reference commits + sink rows."""
+    d = os.path.join(workdir, "mt_kill")
+    _write_daemon_inputs(d)
+    killed = run_daemon_worker(d, faults="tenant/t1/stream.wal:kill")
+    if killed.returncode != KILL_EXIT_CODE:
+        return {"site": "tenant/t1/stream.wal", "ok": False,
+                "error": f"kill run rc={killed.returncode} (expected "
+                f"{KILL_EXIT_CODE}): {killed.stderr}"}
+    restarted = run_daemon_worker(d)
+    if restarted.returncode != 0:
+        return {"site": "tenant/t1/stream.wal", "ok": False,
+                "error": f"restart rc={restarted.returncode}: "
+                f"{restarted.stderr}"}
+    got = _daemon_state(d)
+    ok = got == reference
+    return {
+        "site": "tenant/t1/stream.wal", "ok": ok,
+        "state": {t: {"commits": {str(k): v for k, v in s["commits"]
+                                  .items()},
+                      "rows": s["rows"]} for t, s in got.items()},
+        "expected": {t: {"commits": {str(k): v for k, v in s["commits"]
+                                     .items()},
+                         "rows": s["rows"]}
+                     for t, s in reference.items()},
+    }
+
+
+def run_tenant_isolation_scenario(workdir: str, reference: dict) -> dict:
+    """Arm ONE tenant's namespaced sink with a permanent io fault: its
+    batches must quarantine to its OWN dead-letter (namespaced dir)
+    and the tenant must escalate off the scheduler (QUARANTINED /
+    STOPPED), while the other tenants' sink rows stay exactly the
+    reference's and the daemon exits 0."""
+    d = os.path.join(workdir, "mt_isolation")
+    _write_daemon_inputs(d)
+    proc = run_daemon_worker(d, faults="tenant/t1/sink.write:io:1.0:0")
+    if proc.returncode != 0:
+        return {"site": "tenant/t1/sink.write", "ok": False,
+                "error": f"daemon rc={proc.returncode}: {proc.stderr}"}
+    try:
+        verdict = json.loads(
+            [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")][-1]
+        )
+    except (IndexError, ValueError):
+        return {"site": "tenant/t1/sink.write", "ok": False,
+                "error": f"no JSON verdict: {proc.stdout[-500:]}"}
+    got = _daemon_state(d)
+    dead_letter = os.path.join(
+        d, "ckpt", "tenant", "t1", "ckpt", "dead_letter",
+        "dead_letter.jsonl",
+    )
+    clean_ok = all(
+        got[tid]["rows"] == reference[tid]["rows"]
+        for tid in TENANT_IDS if tid != "t1"
+    )
+    ok = (
+        clean_ok
+        and got["t1"]["rows"] == {}  # every t1 delivery failed
+        and os.path.exists(dead_letter)
+        and verdict["tenants"]["t1"] in ("QUARANTINED", "STOPPED")
+        and all(
+            verdict["tenants"][tid] == "OK"
+            for tid in TENANT_IDS if tid != "t1"
+        )
+    )
+    return {
+        "site": "tenant/t1/sink.write", "ok": ok,
+        "tenant_states": verdict.get("tenants"),
+        "clean_sinks_match": clean_ok,
+        "t1_sink_rows": got["t1"]["rows"],
+        "t1_dead_letter": os.path.exists(dead_letter),
+    }
+
+
 def run_matrix(workdir: str, pipelined: bool = False) -> dict:
     """The full matrix: reference is ALWAYS the serial engine; kill and
     drain scenarios run serial or pipelined per ``pipelined`` and must
@@ -384,6 +536,9 @@ def run_matrix(workdir: str, pipelined: bool = False) -> dict:
         run_promotion_kill_scenario(workdir, p, promo_ref)
         for p in PROMOTE_KILL_POINTS
     )
+    mt_ref = run_multi_tenant_reference(workdir)
+    results.append(run_multi_tenant_kill_scenario(workdir, mt_ref))
+    results.append(run_tenant_isolation_scenario(workdir, mt_ref))
     return {"ok": all(r["ok"] for r in results), "scenarios": results}
 
 
@@ -473,6 +628,50 @@ def promote_worker_main(args) -> int:
     return 0
 
 
+def daemon_worker_main(args) -> int:
+    """Multi-tenant engine pass: three Identity-model tenants on one
+    ServeDaemon (tenant dirs ``<watch>/<tid>`` → ``<out>/<tid>``,
+    checkpoints under ``<ckpt>/tenant/<tid>/``), drain-and-exit.
+    Ladder thresholds are tight so the isolation scenario escalates
+    within one pass; the cooldown is effectively infinite so a
+    quarantined tenant stays visibly QUARANTINED in the verdict."""
+    sys.path.insert(0, REPO)
+    from sntc_tpu.core.base import Transformer
+    from sntc_tpu.serve import ServeDaemon, TenantSpec
+
+    class Identity(Transformer):
+        def transform(self, frame):
+            return frame
+
+    model = Identity()
+    specs = [
+        TenantSpec(
+            tenant_id=tid, model=model,
+            watch=os.path.join(args.watch, tid),
+            out=os.path.join(args.out, tid),
+            out_columns=["x"],
+            max_batch_offsets=1, max_batch_failures=2,
+            quarantine_after=2, stop_after=99,
+            quarantine_cooldown_s=1e9,
+        )
+        for tid in TENANT_IDS
+    ]
+    daemon = ServeDaemon(specs, args.ckpt)
+    try:
+        n = daemon.process_available()
+        daemon.drain()
+        status = daemon.status()
+    finally:
+        daemon.close()
+    print(json.dumps({
+        "batches": n,
+        "tenants": {
+            tid: row["state"] for tid, row in status["tenants"].items()
+        },
+    }))
+    return 0
+
+
 def worker_main(args) -> int:
     sys.path.insert(0, REPO)
     from sntc_tpu.core.base import Transformer
@@ -522,6 +721,9 @@ def main(argv=None) -> int:
     ap.add_argument("--worker", action="store_true")
     ap.add_argument("--serve", action="store_true",
                     help="worker: supervised loop instead of one pass")
+    ap.add_argument("--daemon", action="store_true",
+                    help="worker: three-tenant ServeDaemon pass "
+                    "(multi-tenant scenarios)")
     ap.add_argument("--pipelined", action="store_true",
                     help="run the engine in pipelined mode (prefetching "
                     "source + shape buckets + overlapped sink delivery); "
@@ -551,6 +753,8 @@ def main(argv=None) -> int:
     if args.worker:
         if args.setup_models:
             return setup_models_main(args)
+        if args.daemon:
+            return daemon_worker_main(args)
         if args.model_dir:
             return promote_worker_main(args)
         return worker_main(args)

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Static drift check: multi-tenant knobs across CLI ⇔ TenantSpec ⇔ docs.
+
+The multi-tenant serving surface is one feature spread over three
+layers — ``python -m sntc_tpu serve-daemon`` flags (daemon-level
+defaults), the :class:`sntc_tpu.serve.tenancy.TenantSpec` fields they
+fill (each overridable per tenant in the ``--tenants`` JSON file), and
+the documentation — and each knob must exist in all of them:
+
+======================== ==============================
+``--tenant-weight``      ``TenantSpec.weight``
+``--max-rows-per-sec``   ``TenantSpec.max_rows_per_sec``
+``--max-pending-batches````TenantSpec.max_pending_batches``
+``--shed-policy``        ``TenantSpec.shed_policy``
+``--quarantine-after``   ``TenantSpec.quarantine_after``
+``--quarantine-cooldown````TenantSpec.quarantine_cooldown_s``
+``--stop-after``         ``TenantSpec.stop_after``
+``--row-policy``         ``TenantSpec.row_policy``
+``--max-files-per-batch````TenantSpec.max_batch_offsets``
+``--max-batch-failures`` ``TenantSpec.max_batch_failures``
+======================== ==============================
+
+Every flag AND its spec field must appear in the marker-delimited
+tenant-flags table of ``docs/RESILIENCE.md``, and the serve-daemon
+quickstart must exist in the README.  Wired as a tier-1 test
+(``tests/test_tenancy.py``) so the three layers cannot drift silently
+— the ``check_lifecycle_flags.py`` discipline applied to the tenancy
+surface.
+
+Exit 0 when consistent; exit 1 with a per-knob report otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (serve-daemon CLI flag, TenantSpec field it defaults)
+FLAGS = (
+    ("--tenant-weight", "weight"),
+    ("--max-rows-per-sec", "max_rows_per_sec"),
+    ("--max-pending-batches", "max_pending_batches"),
+    ("--shed-policy", "shed_policy"),
+    ("--quarantine-after", "quarantine_after"),
+    ("--quarantine-cooldown", "quarantine_cooldown_s"),
+    ("--stop-after", "stop_after"),
+    ("--row-policy", "row_policy"),
+    ("--max-files-per-batch", "max_batch_offsets"),
+    ("--max-batch-failures", "max_batch_failures"),
+)
+DOC = "docs/RESILIENCE.md"
+TABLE_BEGIN = "<!-- tenant-flags:begin -->"
+TABLE_END = "<!-- tenant-flags:end -->"
+README_NEEDLE = "serve-daemon"
+
+
+def _read(rel: str) -> str:
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def _doc_table() -> str:
+    text = _read(DOC)
+    if TABLE_BEGIN not in text or TABLE_END not in text:
+        return ""
+    return text.split(TABLE_BEGIN, 1)[1].split(TABLE_END, 1)[0]
+
+
+def check() -> list:
+    """Returns a list of human-readable drift complaints (empty = ok)."""
+    problems = []
+    app_src = _read(os.path.join("sntc_tpu", "app.py"))
+    # flags must be declared inside the serve-daemon subparser block
+    daemon_src = app_src.split('sub.add_parser(\n        "serve-daemon"', 1)
+    daemon_src = daemon_src[1] if len(daemon_src) == 2 else ""
+    sys.path.insert(0, REPO)
+    from dataclasses import fields as dc_fields
+
+    from sntc_tpu.serve.tenancy import TenantSpec
+
+    spec_fields = {f.name for f in dc_fields(TenantSpec)}
+    table = _doc_table()
+    if not table:
+        problems.append(
+            f"{DOC} is missing the marker-delimited tenant-flags table "
+            f"({TABLE_BEGIN} ... {TABLE_END})"
+        )
+    for flag, fld in FLAGS:
+        if f'"{flag}"' not in daemon_src:
+            problems.append(
+                f"serve-daemon CLI flag {flag!r} missing from the "
+                "serve-daemon parser in sntc_tpu/app.py"
+            )
+        if fld not in spec_fields:
+            problems.append(
+                f"TenantSpec has no {fld!r} field for {flag!r} to "
+                "default"
+            )
+        if table and (flag not in table or f"`{fld}`" not in table):
+            problems.append(
+                f"{flag!r} / field {fld!r} missing from the {DOC} "
+                "tenant-flags table"
+            )
+    # the reverse direction: every table row must be a known flag
+    for row_flag in re.findall(r"`(--[a-z-]+)`", table):
+        if row_flag not in {f for f, _ in FLAGS}:
+            problems.append(
+                f"{DOC} tenant-flags table documents {row_flag!r} but "
+                "the checker's FLAGS mapping does not declare it"
+            )
+    if README_NEEDLE not in _read("README.md"):
+        problems.append("README.md has no serve-daemon quickstart")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("tenant-flag drift detected:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print(
+        f"ok: {len(FLAGS)} tenant flags consistent across the "
+        "serve-daemon CLI, TenantSpec fields, and docs"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

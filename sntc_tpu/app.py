@@ -237,8 +237,41 @@ def strip_label_indexer(model, label_index_col: str):
     return stages, labels
 
 
-def cmd_serve(args) -> int:
+def _serving_form(model, label_index_col: str, fuse: bool,
+                  fuse_heads: bool = True):
+    """One checkpoint → its servable form, shared by ``serve`` and
+    ``serve-daemon``: drop the LABEL indexer (live flows carry no
+    label; feature-column indexers are kept), map predictions back to
+    label strings with its vocabulary, and — with ``fuse`` — compile
+    through the whole-pipeline fusion compiler
+    (docs/PERFORMANCE.md "Whole-pipeline fusion"; ``fuse_heads=False``
+    keeps the head a plain swappable stage for lifecycle hot-swap).
+    Returns ``(model, labels, out_cols)``."""
     from sntc_tpu.core.base import PipelineModel
+
+    out_cols = ["prediction"]
+    labels = None
+    if isinstance(model, PipelineModel):
+        from sntc_tpu.feature import IndexToString
+        from sntc_tpu.serve import compile_serving
+
+        stages, labels = strip_label_indexer(model, label_index_col)
+        tail = (
+            [IndexToString(
+                inputCol="prediction", outputCol="predictedLabel",
+                labels=labels,
+            )]
+            if labels is not None else []
+        )
+        model = PipelineModel(stages=stages + tail)
+        if fuse:
+            model = compile_serving(model, fuse_heads=fuse_heads)
+        if tail:
+            out_cols = ["prediction", "predictedLabel"]
+    return model, labels, out_cols
+
+
+def cmd_serve(args) -> int:
     from sntc_tpu.mlio import load_model
     from sntc_tpu.resilience import (
         QuerySupervisor,
@@ -249,7 +282,6 @@ def cmd_serve(args) -> int:
         CsvDirSink,
         FileStreamSource,
         StreamingQuery,
-        compile_serving,
     )
 
     model = load_model(args.model)
@@ -262,40 +294,19 @@ def cmd_serve(args) -> int:
     # only a config that can SWAP models needs the head kept out of the
     # fused segments; drift-only monitoring keeps full head fusion
     swap_armed = bool(args.partial_fit or args.promote_from)
-    out_cols = ["prediction"]
-    labels = None
-    if isinstance(model, PipelineModel):
-        # no labels on live flows: drop the LABEL indexer (the one writing
-        # --label-index-col; indexers on feature columns are kept) and map
-        # predictions back to label STRINGS with its vocabulary — the
-        # reference app's output shape.  The scaler fuses into the model.
-        from sntc_tpu.feature import IndexToString
-
-        stages, labels = strip_label_indexer(model, args.label_index_col)
-        tail = (
-            [IndexToString(
-                inputCol="prediction", outputCol="predictedLabel",
-                labels=labels,
-            )]
-            if labels is not None else []
-        )
-        model = PipelineModel(stages=stages + tail)
-        # --fuse (default): the whole-pipeline fusion compiler — scaler
-        # weight folding + one jitted device program per fusible stage
-        # run, one upload/download per micro-batch (docs/PERFORMANCE.md
-        # "Whole-pipeline fusion"); --no-fuse serves the staged pipeline.
-        # With promotion or partial-fit armed the HEAD stays a plain
-        # stage (fuse_heads=False): a fused head's weights are
-        # constants of the segment's program, so hot-swapping it would
-        # recompile the whole prefix — plain heads swap with zero
-        # prefix recompiles while the feature prefix still fuses.
-        # Drift-only monitoring never swaps, so it keeps full fusion.
-        if args.fuse:
-            model = compile_serving(
-                model, fuse_heads=not swap_armed
-            )
-        if tail:
-            out_cols = ["prediction", "predictedLabel"]
+    # no labels on live flows: the label indexer comes off and
+    # predictions map back to label STRINGS — the reference app's
+    # output shape.  --fuse (default) compiles through the whole-
+    # pipeline fusion compiler; with promotion or partial-fit armed
+    # the HEAD stays a plain stage (fuse_heads=False): a fused head's
+    # weights are constants of the segment's program, so hot-swapping
+    # it would recompile the whole prefix — plain heads swap with zero
+    # prefix recompiles while the feature prefix still fuses.
+    # Drift-only monitoring never swaps, so it keeps full fusion.
+    model, labels, out_cols = _serving_form(
+        model, args.label_index_col, args.fuse,
+        fuse_heads=not swap_armed,
+    )
     # a SERVED query degrades instead of dying: transient read/sink
     # errors retry in place, a batch that keeps failing quarantines to
     # the dead-letter journal after --max-batch-failures rounds, and
@@ -421,6 +432,126 @@ def cmd_serve(args) -> int:
         sup.close()  # unsubscribe the health monitor from the event bus
     print(json.dumps({
         "batches": status["engine"]["batches_done"],
+        "drained": status["drained"],
+        "health": status["health"]["overall"],
+    }))
+    return 0
+
+
+def cmd_serve_daemon(args) -> int:
+    """Multi-tenant serving: N tenant streams (pipeline + source +
+    sink + checkpoint + row policy each) multiplexed over one shared
+    device program cache with fair scheduling and per-tenant fault
+    isolation — see docs/RESILIENCE.md "Multi-tenant serving".
+
+    The tenant file (``--tenants``) is JSON: ``{"tenants": [{"id":
+    ..., "model": <checkpoint>, "watch": <in dir>, "out": <out dir>,
+    ...}]}`` where every entry may override the daemon-level default
+    flags (``weight``, ``max_rows_per_sec``, ``max_pending_batches``,
+    ``shed_policy``, ``quarantine_after``, ``quarantine_cooldown_s``,
+    ``stop_after``, ``row_policy``, ...).  Tenants naming the SAME
+    model checkpoint share one predictor — and therefore one set of
+    compiled device programs."""
+    from sntc_tpu.mlio import load_model
+    from sntc_tpu.resilience import RetryPolicy
+    from sntc_tpu.serve import ServeDaemon, TenantSpec
+
+    with open(args.tenants) as f:
+        doc = json.load(f)
+    entries = doc["tenants"] if isinstance(doc, dict) else doc
+    if not entries:
+        raise SystemExit(f"{args.tenants}: no tenants declared")
+    retries = max(1, args.batch_retry_attempts)
+    defaults = {
+        "weight": args.tenant_weight,
+        "max_rows_per_sec": args.max_rows_per_sec,
+        "max_pending_batches": args.max_pending_batches,
+        "shed_policy": args.shed_policy,
+        "quarantine_after": args.quarantine_after,
+        "quarantine_cooldown_s": args.quarantine_cooldown,
+        "stop_after": args.stop_after,
+        "max_batch_offsets": args.max_files_per_batch,
+        "max_batch_failures": (
+            args.max_batch_failures if args.max_batch_failures > 0
+            else None
+        ),
+        "retry_policy": (
+            RetryPolicy(max_attempts=retries, base_delay_s=0.2,
+                        jitter=0.1)
+            if retries > 1 else None
+        ),
+    }
+    # each distinct checkpoint path loads and compiles ONCE; tenants
+    # sharing a path receive the SAME served-model object, which is
+    # what makes the daemon share their predictor + compiled programs
+    served_by_path = {}
+
+    def _served(path):
+        if path not in served_by_path:
+            model, _labels, out_cols = _serving_form(
+                load_model(path), args.label_index_col, args.fuse
+            )
+            served_by_path[path] = (model, out_cols)
+        return served_by_path[path]
+
+    specs = []
+    for entry in entries:
+        e = dict(entry)
+        path = e.get("model")
+        if not isinstance(path, str):
+            raise SystemExit(
+                f"tenant {e.get('id')!r}: 'model' must be a checkpoint "
+                "path"
+            )
+        model, out_cols = _served(path)
+        e["model"] = model
+        e.setdefault("out_columns", out_cols)
+        policy = e.get("row_policy", None if args.row_policy == "strict"
+                       else args.row_policy)
+        if policy is not None and policy != "strict":
+            from sntc_tpu.data import CICIDS2017_CONTRACT
+
+            e["row_policy"] = policy
+            e["schema_contract"] = CICIDS2017_CONTRACT.with_mode(policy)
+        else:
+            e.pop("row_policy", None)
+        specs.append(TenantSpec.from_dict(e, defaults))
+    daemon = ServeDaemon(
+        specs, args.root,
+        shape_buckets=args.shape_buckets,
+        pipeline_depth=args.pipeline_depth,
+        health_json=args.health_json,
+    )
+    try:
+        if args.once:
+            n = daemon.process_available()
+            # the --once pass IS the warmup; the drain that follows
+            # must not compile anything new on the shared cache
+            daemon.mark_warm()
+            daemon.drain()
+            status = daemon.status()
+        else:
+            daemon.install_signal_handlers()
+            print(
+                f"serve-daemon: {len(specs)} tenants -> {args.root}; "
+                "SIGTERM/Ctrl-C drains every tenant",
+                file=sys.stderr,
+            )
+            try:
+                status = daemon.run(poll_interval=args.poll_interval)
+            except KeyboardInterrupt:
+                daemon.request_drain("KeyboardInterrupt")
+                daemon.drain()
+                status = daemon.status()
+            n = status["aggregate"]["batches_done"]
+    finally:
+        daemon.close()
+    print(json.dumps({
+        "batches": n,
+        "tenants": {
+            tid: row["state"] for tid, row in status["tenants"].items()
+        },
+        "recompiles_after_warmup": status["recompiles_after_warmup"],
         "drained": status["drained"],
         "health": status["health"]["overall"],
     }))
@@ -573,6 +704,86 @@ def main(argv=None) -> int:
                    "kills the query (pre-r6 semantics)")
     add_platform_arg(p)
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "serve-daemon",
+        help="multi-tenant streaming inference: N tenant streams, one "
+        "shared device program cache, fair scheduling, per-tenant "
+        "isolation (docs/RESILIENCE.md)",
+    )
+    p.add_argument("--tenants", required=True, metavar="JSON",
+                   help="tenant spec file: {\"tenants\": [{\"id\", "
+                   "\"model\", \"watch\", \"out\", ...per-tenant "
+                   "overrides}]}")
+    p.add_argument("--root", required=True,
+                   help="daemon root: per-tenant checkpoints/WALs/"
+                   "dead-letters land under <root>/tenant/<id>/")
+    p.add_argument("--label-index-col", default="label")
+    p.add_argument("--max-files-per-batch", type=int, default=1,
+                   help="micro-batch size in source files, per tenant "
+                   "(TenantSpec max_batch_offsets)")
+    p.add_argument("--pipeline-depth", type=int, default=1,
+                   help="per-tenant in-flight micro-batches; > 1 arms "
+                   "each tenant's overlapped sink delivery")
+    p.add_argument("--shape-buckets", type=int, default=0,
+                   help="power-of-two row bucketing for the SHARED "
+                   "predictors (compile once per bucket across all "
+                   "tenants of a pipeline); 0 = off")
+    p.add_argument("--fuse", action="store_true", dest="fuse",
+                   default=True,
+                   help="compile each distinct tenant pipeline with the "
+                   "whole-pipeline fusion compiler (default)")
+    p.add_argument("--no-fuse", action="store_false", dest="fuse")
+    p.add_argument("--tenant-weight", type=float, default=1.0,
+                   help="default fair-share weight (TenantSpec weight): "
+                   "deficit round-robin credits per scheduling round")
+    p.add_argument("--max-rows-per-sec", type=float, default=None,
+                   help="default per-tenant admission rate quota "
+                   "(TenantSpec max_rows_per_sec): a token bucket "
+                   "charged at commit throttles a flooding tenant at "
+                   "its own edge; unset = unlimited")
+    p.add_argument("--max-pending-batches", type=int, default=None,
+                   help="default per-tenant backlog cap (TenantSpec "
+                   "max_pending_batches): surplus is shed through the "
+                   "tenant's own journaled shed path")
+    p.add_argument("--shed-policy", default="oldest",
+                   choices=["oldest", "sample"],
+                   help="default per-tenant shed policy (TenantSpec "
+                   "shed_policy)")
+    p.add_argument("--quarantine-after", type=int, default=3,
+                   help="unhealthy strikes (quarantine/retry_exhausted/"
+                   "breaker_open events tagged with the tenant) before "
+                   "the tenant is QUARANTINED (TenantSpec "
+                   "quarantine_after)")
+    p.add_argument("--quarantine-cooldown", type=float, default=30.0,
+                   metavar="S",
+                   help="seconds a QUARANTINED tenant holds before "
+                   "probation back to OK (TenantSpec "
+                   "quarantine_cooldown_s)")
+    p.add_argument("--stop-after", type=int, default=3,
+                   help="quarantine episodes before the tenant is "
+                   "STOPPED and its breakers evicted (TenantSpec "
+                   "stop_after)")
+    p.add_argument("--row-policy", default="strict",
+                   choices=["strict", "salvage", "permissive"],
+                   help="default per-tenant data-plane admission "
+                   "(TenantSpec row_policy) against the canonical "
+                   "CICIDS2017 contract")
+    p.add_argument("--batch-retry-attempts", type=int, default=2)
+    p.add_argument("--max-batch-failures", type=int, default=3,
+                   help="default per-tenant poison-batch threshold "
+                   "(TenantSpec max_batch_failures); 0 = first failure "
+                   "surfaces (and strikes the tenant)")
+    p.add_argument("--poll-interval", type=float, default=1.0)
+    p.add_argument("--once", action="store_true",
+                   help="drain available files across all tenants and "
+                   "exit")
+    p.add_argument("--health-json", default=None, metavar="PATH",
+                   help="atomically rewrite the daemon status dump "
+                   "(per-tenant states, compile ledger, health, "
+                   "breakers) here every scheduling round")
+    add_platform_arg(p)
+    p.set_defaults(fn=cmd_serve_daemon)
 
     p = sub.add_parser("synth", help="write schema-identical synthetic day CSVs")
     p.add_argument("--out", required=True)

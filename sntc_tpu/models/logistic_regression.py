@@ -1051,12 +1051,20 @@ class LogisticRegressionModel(_LrParams, ClassificationModel):
         self._dev_params = None  # lazy device-resident (coefT, intercepts)
 
     def _device_params(self):
-        if self._dev_params is None:
-            self._dev_params = (
+        params = self._dev_params
+        if params is None:
+            params = (
                 jnp.asarray(self.coefficientMatrix.T),
                 jnp.asarray(self.interceptVector),
             )
-        return self._dev_params
+            # never cache values created under an active trace: the
+            # fusion planner jits THROUGH transform, so inside its
+            # tracing these constants are tracers — caching one would
+            # poison every later trace with UnexpectedTracerError
+            # (bites exactly when two engines share one predictor)
+            if not isinstance(params[0], jax.core.Tracer):
+                self._dev_params = params
+        return params
 
     def evaluate(self, frame: Frame):
         """Metrics summary on ``frame`` (Spark ``model.evaluate(dataset)``)

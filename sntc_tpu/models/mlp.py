@@ -295,9 +295,15 @@ class MultilayerPerceptronClassificationModel(_MlpParams, ClassificationModel):
         self._dev_weights = None  # lazy device-resident flat weights
 
     def _device_weights(self):
-        if self._dev_weights is None:
-            self._dev_weights = jnp.asarray(self.weights)
-        return self._dev_weights
+        w = self._dev_weights
+        if w is None:
+            w = jnp.asarray(self.weights)
+            # never cache a value created under an active trace (the
+            # fusion planner jits THROUGH transform; a cached tracer
+            # poisons every later trace with UnexpectedTracerError)
+            if not isinstance(w, jax.core.Tracer):
+                self._dev_weights = w
+        return w
 
     def evaluate(self, frame: Frame):
         """Metrics summary on ``frame`` (Spark ``model.evaluate(dataset)``)."""

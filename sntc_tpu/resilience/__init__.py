@@ -34,6 +34,7 @@ from sntc_tpu.resilience.policy import (
     add_event_observer,
     clear_events,
     emit_event,
+    event_observer_count,
     events_dropped,
     recent_events,
     remove_event_observer,
@@ -48,6 +49,7 @@ __all__ = [
     "emit_event",
     "recent_events",
     "events_dropped",
+    "event_observer_count",
     "add_event_observer",
     "remove_event_observer",
     "clear_events",

@@ -195,6 +195,22 @@ class CircuitBreaker:
                     window=len(self._outcomes),
                 )
 
+    def reset(self) -> None:
+        """Administrative reset to a fresh CLOSED breaker: window
+        cleared, probes cleared, cooldown forgotten.  ``open_count``
+        survives as cumulative evidence.  The serve daemon calls this
+        when a quarantined tenant is released on probation — the tenant
+        gets a clean window to re-earn (or re-lose) trust; an OPEN
+        breaker left behind would refuse every call and starve the
+        ladder of fresh evidence."""
+        with self._lock:
+            self._outcomes.clear()
+            self._opened_at = None
+            self._probes_in_flight = 0
+            self._probe_successes = 0
+            if self._state != CLOSED:
+                self._transition(CLOSED, reset=True)
+
     def call(self, fn: Callable[[], Any]) -> Any:
         """Run ``fn()`` through the breaker: refuse when open, record
         the outcome otherwise.  KeyboardInterrupt/SystemExit pass
@@ -242,10 +258,18 @@ def breaker_for(site: str, **kwargs: Any) -> CircuitBreaker:
         return br
 
 
-def reset_breakers() -> None:
-    """Drop every registered breaker (test isolation)."""
+def reset_breakers(prefix: Optional[str] = None) -> None:
+    """Drop registered breakers: every one (test isolation), or — with
+    ``prefix`` — only the sites under one namespace (``prefix=
+    "tenant/<id>/"``: the serve daemon evicts a STOPPED tenant's
+    breakers so its failure history cannot outlive it and leak into
+    later tenants or tests reusing the id)."""
     with _registry_lock:
-        _registry.clear()
+        if prefix is None:
+            _registry.clear()
+            return
+        for site in [s for s in _registry if s.startswith(prefix)]:
+            del _registry[site]
 
 
 def breakers_snapshot() -> Dict[str, Dict[str, Any]]:

@@ -293,14 +293,26 @@ def _sync_env() -> None:
     _env_installed = raw
 
 
-def fault_point(site: str) -> None:
+def fault_point(site: str, tenant: Optional[str] = None) -> None:
     """The per-site hook real code calls; raises when armed + scheduled.
     A spec armed with a DATA kind is inert here — byte corruption only
-    makes sense where bytes flow (:func:`fault_data`)."""
+    makes sense where bytes flow (:func:`fault_data`).
+
+    ``tenant`` (r12) checks the tenant-NAMESPACED site first —
+    ``tenant/<id>/<site>`` — then falls back to the bare site, so
+    multi-tenant chaos can arm one tenant's boundary
+    (``SNTC_FAULTS=tenant/a/stream.wal:kill``) without touching its
+    neighbors, while a bare-site fault still hits every tenant (the
+    shared-environment failure mode)."""
     _sync_env()
-    spec = _registry.get(site)
+    spec = None
+    if tenant is not None:
+        spec = _registry.get(f"tenant/{tenant}/{site}")
+    if spec is None:
+        spec = _registry.get(site)
     if spec is None or spec.kind in DATA_KINDS:
         return
+    site = spec.site  # event/error name the ARMED site (namespaced)
     with _lock:
         fire = spec.decide()
         call = spec.calls

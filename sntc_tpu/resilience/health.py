@@ -141,6 +141,31 @@ class HealthMonitor:
                 return HealthState.OK
             return max(e["state"] for e in self._components.values())
 
+    def worst_under(self, prefix: str) -> HealthState:
+        """Worst state among components whose name starts with
+        ``prefix`` (OK when none match) — tenant-scoped health: the
+        serve daemon namespaces every tenant site ``tenant/<id>/...``,
+        so one tenant's aggregate is the worst of its own components
+        and NOTHING of its neighbors'."""
+        with self._lock:
+            states = [
+                e["state"]
+                for name, e in self._components.items()
+                if name.startswith(prefix)
+            ]
+            return max(states) if states else HealthState.OK
+
+    def reset_under(self, prefix: str, reason: str = "") -> None:
+        """Set every component under ``prefix`` back to OK (a tenant
+        leaving quarantine on probation: its past evidence is served;
+        fresh failures re-escalate on their own)."""
+        with self._lock:
+            names = [
+                n for n in self._components if n.startswith(prefix)
+            ]
+        for name in names:
+            self.report(name, HealthState.OK, reason=reason)
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -181,6 +206,15 @@ class HealthMonitor:
         if self._observer is not None:
             remove_event_observer(self._observer)
             self._observer = None
+
+    def close(self) -> None:
+        """Monitor teardown: unsubscribe from the process event stream.
+        Every component that ``attach()``es a monitor must call this
+        (supervisor/daemon teardown does) — the observer list is
+        process-global, so a leaked subscription outlives its monitor
+        and keeps folding events into dead state forever.  Idempotent;
+        a closed monitor still serves explicit :meth:`report` calls."""
+        self.detach()
 
     # -- heartbeat watchdog -------------------------------------------------
 

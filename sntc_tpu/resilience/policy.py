@@ -121,6 +121,12 @@ _RECENT_MAX = 512
 _recent: "deque[Dict[str, Any]]" = deque(maxlen=_RECENT_MAX)
 _events_lock = threading.Lock()
 _events_dropped = 0
+# per-tenant eviction breakdown (r12): records carrying a ``tenant``
+# field count against their tenant when the ring evicts them, so a
+# flooding tenant's event pressure is attributable — the fair-share
+# evidence the serve daemon journals.  Untagged records count under
+# the int total only (single-tenant emit paths stay unchanged).
+_events_dropped_by_tenant: Dict[str, int] = {}
 _logger: Optional[MetricsLogger] = None
 _observers: List[Callable[[Dict[str, Any]], None]] = []
 
@@ -161,6 +167,11 @@ def emit_event(**fields: Any) -> Dict[str, Any]:
                 f.write(json.dumps(record) + "\n")
         if len(_recent) == _recent.maxlen:
             _events_dropped += 1
+            evicted_tenant = _recent[0].get("tenant")
+            if evicted_tenant is not None:
+                _events_dropped_by_tenant[evicted_tenant] = (
+                    _events_dropped_by_tenant.get(evicted_tenant, 0) + 1
+                )
         _recent.append(record)
         observers = list(_observers)
     # observers run OUTSIDE the ring lock: an observer that emits (a
@@ -195,10 +206,15 @@ def recent_events(
     ]
 
 
-def events_dropped() -> int:
+def events_dropped(by_tenant: bool = False):
     """Events evicted from the ring since the last :func:`clear_events`
-    — nonzero means ``recent_events`` is a suffix, not the full story."""
+    — nonzero means ``recent_events`` is a suffix, not the full story.
+    ``by_tenant=True`` returns the per-tenant breakdown instead (a
+    dict of tenant → evictions, only tenant-tagged records counted) —
+    the serve daemon's noisy-neighbor evidence."""
     with _events_lock:
+        if by_tenant:
+            return dict(_events_dropped_by_tenant)
         return _events_dropped
 
 
@@ -216,11 +232,20 @@ def remove_event_observer(fn: Callable[[Dict[str, Any]], None]) -> None:
             _observers.remove(fn)
 
 
+def event_observer_count() -> int:
+    """Registered observers right now — the leak regression's probe: a
+    component that attaches an observer must detach it on teardown, so
+    the count stays flat across component lifecycles."""
+    with _events_lock:
+        return len(_observers)
+
+
 def clear_events() -> None:
     global _events_dropped
     with _events_lock:
         _recent.clear()
         _events_dropped = 0
+        _events_dropped_by_tenant.clear()
 
 
 # ---------------------------------------------------------------------------

@@ -116,7 +116,7 @@ class QuerySupervisor:
         event stream IF this supervisor created it (a caller-supplied
         monitor's subscription belongs to the caller)."""
         if self._owns_health:
-            self.health.detach()
+            self.health.close()
 
     # -- preemption ---------------------------------------------------------
 

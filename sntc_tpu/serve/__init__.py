@@ -13,6 +13,11 @@ from sntc_tpu.serve.streaming import (
     MemorySource,
     StreamingQuery,
 )
+from sntc_tpu.serve.tenancy import (
+    ServeDaemon,
+    TenantSpec,
+    TenantStream,
+)
 
 __all__ = [
     "BatchPredictor",
@@ -27,4 +32,7 @@ __all__ = [
     "NetFlowDirSource",
     "PcapDirSource",
     "capture_udp",
+    "ServeDaemon",
+    "TenantSpec",
+    "TenantStream",
 ]

@@ -444,6 +444,17 @@ class StreamingQuery:
     drain, and crash-replay semantics are exactly the serial engine's
     (the chaos matrix runs unchanged in pipelined mode).  See
     ``docs/PERFORMANCE.md``.
+
+    **Multi-tenant namespacing (opt-in, r12):** ``tenant="<id>"`` —
+    set by the :class:`~sntc_tpu.serve.tenancy.ServeDaemon` — prefixes
+    every site this engine touches with ``tenant/<id>/``: retry,
+    quarantine, shed, and reject events (which also carry a ``tenant``
+    field), health components derived from them, and ``fault_point``
+    lookups (a fault armed at ``tenant/<id>/stream.wal`` fires only
+    for this engine; a bare-site fault still hits every tenant).  The
+    checkpoint/WAL/dead-letter directories are whatever the caller
+    passes — the daemon namespaces those too.  Single-tenant engines
+    (the default) are byte-for-byte unchanged.
     """
 
     _PROGRESS_KEEP = 100  # Spark keeps the last 100 progress records
@@ -467,6 +478,7 @@ class StreamingQuery:
         row_policy: Optional[str] = None,
         row_dead_letter_dir: Optional[str] = None,
         lifecycle=None,
+        tenant: Optional[str] = None,
     ):
         # a pre-built BatchPredictor passes through unchanged (its own
         # bucket config wins — bench warmup shares one predictor across
@@ -537,6 +549,22 @@ class StreamingQuery:
         # BETWEEN micro-batches; see swap_model().
         self.lifecycle = lifecycle
         self.models_swapped = 0
+        # multi-tenant namespacing (r12): a ``tenant`` id prefixes every
+        # site this engine emits against — retry/quarantine/shed events,
+        # breaker-adjacent health components, and fault_point lookups
+        # all become ``tenant/<id>/<site>`` — so one tenant's failures,
+        # breakers, and health can never alias a neighbor's.  The map is
+        # precomputed once; the single-tenant path (tenant=None) keeps
+        # the bare site strings and adds no per-event work.
+        self.tenant = tenant
+        _known_sites = (
+            "stream.wal", "stream.read", "stream.commit",
+            "sink.write", "predict.dispatch", "source.parse",
+        )
+        self._sites = {
+            s: (s if tenant is None else f"tenant/{tenant}/{s}")
+            for s in _known_sites
+        }
         # per-site circuit breakers (sink.write / predict.dispatch): an
         # OPEN breaker defers the stage — the batch stays queued and the
         # loop stays alive — instead of hammering a dead dependency
@@ -628,6 +656,14 @@ class StreamingQuery:
     def last_committed(self) -> int:
         return self._last_committed
 
+    def _emit(self, **fields) -> None:
+        """Engine event emission: tenant-tagged when this engine serves
+        a tenant (the daemon's fair-share / shed evidence reads the tag
+        back out of the stream), a plain pass-through otherwise."""
+        if self.tenant is not None:
+            fields["tenant"] = self.tenant
+        emit_event(**fields)
+
     def _pending_intent(self, batch_id: int):
         if self._pending_intents is not None:  # append mode: in-memory
             return self._pending_intents.get(batch_id)
@@ -694,7 +730,7 @@ class StreamingQuery:
                 self._sample_next = None
             # kill point pre-WAL: a crash here leaves NO intent — the
             # restarted query plans the batch fresh (chaos matrix row 1)
-            fault_point("stream.wal")
+            fault_point("stream.wal", tenant=self.tenant)
             # intent WAL before any processing (OffsetSeqLog)
             self._wal_intent(batch_id, intent)
 
@@ -712,7 +748,7 @@ class StreamingQuery:
         t0 = time.perf_counter()
 
         def _read() -> tuple:
-            fault_point("stream.read")
+            fault_point("stream.read", tenant=self.tenant)
             frame = self.source.get_batch(intent["start"], intent["end"])
             stride = intent.get("sample_stride", 1)
             if stride > 1:
@@ -777,7 +813,8 @@ class StreamingQuery:
             return False
         try:
             frame, row_mask, rejects, coerced, batch_files = (
-                with_retries(_read, self.retry_policy, site="stream.read")
+                with_retries(_read, self.retry_policy,
+                             site=self._sites["stream.read"])
                 if self.retry_policy is not None
                 else _read()
             )
@@ -855,11 +892,12 @@ class StreamingQuery:
         try:
 
             def _deliver() -> None:
-                fault_point("sink.write")
+                fault_point("sink.write", tenant=self.tenant)
                 self.sink.add_batch(batch_id, finalize())
 
             if self.retry_policy is not None:
-                with_retries(_deliver, self.retry_policy, site="sink.write")
+                with_retries(_deliver, self.retry_policy,
+                             site=self._sites["sink.write"])
             else:
                 _deliver()
         finally:
@@ -911,7 +949,7 @@ class StreamingQuery:
                 )
                 self.lifecycle.on_batch(batch_id, lc_frame, finalize)
             except Exception as e:
-                emit_event(
+                self._emit(
                     event="lifecycle_error", component="model",
                     batch_id=batch_id, error=repr(e),
                 )
@@ -1082,7 +1120,7 @@ class StreamingQuery:
                 rearm = getattr(lc, "rearm_pending_swap", None)
                 if rearm is not None:
                     rearm(pending)
-            emit_event(
+            self._emit(
                 event="lifecycle_error", component="model",
                 error=repr(e),
             )
@@ -1128,7 +1166,7 @@ class StreamingQuery:
         # the commit never lands — the restarted query must REPLAY the
         # batch from its WAL'd intent and the sink must dedupe (chaos
         # matrix row 3)
-        fault_point("stream.commit")
+        fault_point("stream.commit", tenant=self.tenant)
         self._wal_commit(batch_id, intent)
         self._clear_failures(batch_id)
         # a committed batch never re-reads in this process — drop its
@@ -1233,8 +1271,8 @@ class StreamingQuery:
         reasons: dict = {}
         for rec in records:
             reasons[rec["reason"]] = reasons.get(rec["reason"], 0) + 1
-        emit_event(
-            event="rows_rejected", site="source.parse",
+        self._emit(
+            event="rows_rejected", site=self._sites["source.parse"],
             batch_id=batch_id, count=len(records), reasons=reasons,
         )
 
@@ -1289,9 +1327,9 @@ class StreamingQuery:
             os.path.join(self.dead_letter_dir, "dead_letter.jsonl"), "a"
         ) as f:
             f.write(json.dumps(record) + "\n")
-        emit_event(
-            event="quarantine", site=site, batch_id=batch_id,
-            error=repr(exc),
+        self._emit(
+            event="quarantine", site=self._sites.get(site, site),
+            batch_id=batch_id, error=repr(exc),
         )
 
     def _run_one_batch(self) -> bool:
@@ -1427,6 +1465,10 @@ class StreamingQuery:
             "backlog_offsets": pending,
             "max_pending_batches": max_pending_batches,
         }
+        if self.tenant is not None:
+            # shed.jsonl must say WHICH tenant paid for the decision —
+            # the daemon's fair-share evidence reads it back
+            record["tenant"] = self.tenant
         if policy == "oldest":
             shed_end = latest - keep
             record.update(
@@ -1446,8 +1488,9 @@ class StreamingQuery:
             os.path.join(self.checkpoint_dir, "shed.jsonl"), "a"
         ) as f:
             f.write(json.dumps(record) + "\n")
-        emit_event(
-            event="load_shed", site="stream.read", policy=policy,
+        self._emit(
+            event="load_shed", site=self._sites["stream.read"],
+            policy=policy,
             start=record["start"], end=record["end"],
             offsets_shed=record["offsets_shed"],
             sample_stride=record.get("sample_stride"),

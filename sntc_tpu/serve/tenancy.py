@@ -1,0 +1,899 @@
+"""Multi-tenant serve front door — many streams, one device (r12).
+
+One :class:`~sntc_tpu.serve.streaming.StreamingQuery` owns one model,
+one source, and one sink; "millions of users" as N independent
+processes means N engines fighting over the device with zero isolation.
+:class:`ServeDaemon` multiplexes N :class:`TenantStream`\\ s — each a
+pipeline + source + sink + checkpoint dir + row policy — over shared
+infrastructure, on ONE scheduling thread, with four contracts:
+
+* **Shared program cache** — tenants handing the daemon the SAME model
+  object (or checkpoint path) share one
+  :class:`~sntc_tpu.serve.transform.BatchPredictor`, so they share its
+  shape-bucketed / fused compiled programs: adding a tenant to an
+  already-warm signature costs ZERO compiles, proven by the existing
+  compile ledger (``recompiles_after_warmup()``; bench config 8
+  journals it across 10+ tenants).
+* **Fair scheduling** — a weighted deficit round-robin dispatches
+  micro-batches across tenant backlogs: each scheduling round credits
+  every runnable tenant ``weight`` batches of deficit and drains it in
+  a fixed rotation, so throughput under contention converges to the
+  weight ratio.  Per-tenant quotas bound what one tenant can take:
+  ``max_rows_per_sec`` (a token bucket charged at commit) throttles a
+  flooding source at its own admission edge, ``max_pending_batches`` +
+  ``shed_policy`` sheds its backlog through the engine's journaled
+  shed path — both leave every other tenant's latency alone.
+* **Per-tenant fault isolation** — every site a tenant's engine
+  touches is namespaced ``tenant/<id>/...``: breakers
+  (``breaker_for``), fault points, retry/quarantine/shed events (all
+  tenant-tagged), health components, and the on-disk layout
+  (``<root>/tenant/<id>/ckpt/`` with ``dead_letter`` /
+  ``dead_letter_rows`` under it, ``drain_marker.json`` beside it).  A
+  tenant escalates OK → THROTTLED → QUARANTINED → STOPPED on its OWN
+  evidence — UNHEALTHY-class events carrying its tag — and a STOPPED
+  tenant's breakers are evicted (``reset_breakers(prefix=...)``) so
+  its state cannot leak.  The daemon loop itself never dies for a
+  tenant: engine errors strike the tenant, not the process.
+* **Drain** — SIGTERM / :meth:`ServeDaemon.request_drain` settles
+  every tenant's in-flight work (commit or WAL-replay-on-restart,
+  exactly the single-query contract), writes one atomic drain marker
+  per tenant plus a daemon-level marker, and exits 0.
+
+Scheduling runs on one thread (the daemon's), so the device sees one
+dispatch stream and every engine keeps its single-WAL-writer contract;
+the only other threads are the ones the engines already own (overlap
+delivery, source prefetch).  The clock is injectable and :meth:`tick`
+is steppable — fairness, quotas, and the ladder are all unit-testable
+without sleeps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from dataclasses import dataclass, fields as dc_fields
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from sntc_tpu.resilience import (
+    HealthState,
+    breaker_for,
+    emit_event,
+    events_dropped,
+    reset_breakers,
+)
+from sntc_tpu.resilience.health import HealthMonitor
+from sntc_tpu.resilience.policy import RetryPolicy
+from sntc_tpu.serve.streaming import (
+    CsvDirSink,
+    FileStreamSource,
+    StreamingQuery,
+)
+from sntc_tpu.serve.transform import BatchPredictor
+
+#: the tenant escalation ladder, in order.  OK ↔ THROTTLED are the
+#: quota states (automatic both ways); QUARANTINED is entered on
+#: ``quarantine_after`` unhealthy strikes and left after
+#: ``quarantine_cooldown_s`` on probation; STOPPED (after
+#: ``stop_after`` quarantine episodes, or a fatal engine error) is
+#: terminal for the daemon's lifetime.
+TENANT_STATES = ("OK", "THROTTLED", "QUARANTINED", "STOPPED")
+
+#: events that count as an unhealthy STRIKE against the tenant that
+#: emitted them (the ladder's escalation evidence), attributed by
+#: their ``tenant`` field or their ``tenant/<id>/...`` site.
+#: ``retry`` / ``rows_rejected`` / ``load_shed`` deliberately do NOT
+#: strike — they are the degraded-but-working vocabulary, already
+#: absorbed by throttling and shedding.  ``watchdog_stall`` is not
+#: listed: it carries neither tenant nor site, and the daemon never
+#: arms the supervisor watchdog (engine wedges surface as
+#: ``tenant_error`` strikes from the scheduler instead).
+STRIKE_EVENTS = frozenset(
+    ("quarantine", "retry_exhausted", "breaker_open")
+)
+
+DAEMON_DRAIN_MARKER = "daemon_drain_marker.json"
+
+
+def _atomic_json(path: str, obj: Dict[str, Any]) -> str:
+    from sntc_tpu.resilience.supervisor import _atomic_json as _write
+
+    return _write(path, obj, indent=1)
+
+
+@dataclass
+class TenantSpec:
+    """One tenant's declaration: identity, pipeline, endpoints, quotas,
+    and ladder thresholds.  The serve-daemon CLI reads a JSON file of
+    these (``--tenants``); daemon-level flags supply defaults for any
+    field a tenant omits (``scripts/check_tenant_flags.py`` pins the
+    flag ⇔ field ⇔ docs mapping in tier-1).
+
+    ``model`` is a fitted Transformer, a ``BatchPredictor``, or a
+    checkpoint path — tenants passing the SAME object or path share
+    one predictor and therefore its compiled programs.
+    """
+
+    tenant_id: str
+    model: Any = None
+    watch: Optional[str] = None  # CSV directory source
+    out: Optional[str] = None  # CSV directory sink
+    source: Any = None  # explicit StreamSource (tests / bench)
+    sink: Any = None  # explicit StreamSink
+    weight: float = 1.0  # fair-share weight (deficit per round)
+    max_rows_per_sec: Optional[float] = None  # admission token bucket
+    max_pending_batches: Optional[int] = None  # backlog cap before shed
+    shed_policy: str = "oldest"  # 'oldest' | 'sample'
+    quarantine_after: int = 3  # unhealthy strikes → QUARANTINED
+    quarantine_cooldown_s: float = 30.0  # quarantine hold before probation
+    stop_after: int = 3  # quarantine episodes → STOPPED
+    row_policy: Optional[str] = None  # 'strict'|'salvage'|'permissive'
+    schema_contract: Any = None
+    max_batch_offsets: Optional[int] = 1
+    max_batch_failures: Optional[int] = 3
+    retry_policy: Optional[RetryPolicy] = None
+    out_columns: Optional[List[str]] = None
+
+    def __post_init__(self):
+        if not self.tenant_id or "/" in self.tenant_id:
+            raise ValueError(
+                f"tenant_id must be a non-empty path-safe string, got "
+                f"{self.tenant_id!r}"
+            )
+        if self.weight <= 0:
+            raise ValueError("weight must be > 0")
+        if self.shed_policy not in ("oldest", "sample"):
+            raise ValueError("shed_policy must be 'oldest' or 'sample'")
+        if self.quarantine_after < 1 or self.stop_after < 1:
+            raise ValueError(
+                "quarantine_after and stop_after must be >= 1"
+            )
+        if self.max_batch_failures == 0:
+            # the CLI documents 0 = quarantine unarmed; normalize here
+            # so a per-tenant {"max_batch_failures": 0} JSON override
+            # means the same thing as the daemon-level flag
+            self.max_batch_failures = None
+        if (
+            self.max_rows_per_sec is not None
+            and self.max_rows_per_sec <= 0
+        ):
+            raise ValueError("max_rows_per_sec must be > 0 (or None)")
+        if self.row_policy is not None and self.schema_contract is None:
+            # the canonical contract is the CLI's job; specs built in
+            # code must be explicit about what they enforce
+            raise ValueError(
+                "row_policy requires a schema_contract on the spec"
+            )
+
+    @classmethod
+    def from_dict(
+        cls, d: Dict[str, Any], defaults: Optional[Dict[str, Any]] = None
+    ) -> "TenantSpec":
+        """Build a spec from one tenant-file entry; ``defaults`` (the
+        daemon CLI's flag values) fill any field the entry omits.
+        Unknown keys are an error — a typo'd quota silently defaulting
+        is exactly the drift the tenant file must not allow."""
+        merged = dict(defaults or {})
+        merged.update({("tenant_id" if k == "id" else k): v
+                       for k, v in d.items()})
+        known = {f.name for f in dc_fields(cls)}
+        unknown = sorted(set(merged) - known)
+        if unknown:
+            raise ValueError(
+                f"unknown TenantSpec field(s) {unknown} for tenant "
+                f"{merged.get('tenant_id')!r}; known: {sorted(known)}"
+            )
+        return cls(**merged)
+
+
+class TenantStream:
+    """One tenant's engine plus the daemon-side accounting around it:
+    deficit (fair share), token-bucket allowance (rate quota), ladder
+    state, strike/episode counters, and latency samples.  Constructed
+    by :class:`ServeDaemon`; not for standalone use."""
+
+    _LATENCY_KEEP = 10_000
+
+    def __init__(self, spec: TenantSpec, query: StreamingQuery, clock):
+        self.spec = spec
+        self.query = query
+        self.prefix = f"tenant/{spec.tenant_id}/"
+        self.state = "OK"
+        self._clock = clock
+        self.deficit = 0.0
+        rate = spec.max_rows_per_sec
+        # burst = one second of quota: a tenant idle for an hour gets
+        # one second's rows instantly, not an hour's
+        self._burst = None if rate is None else max(rate, 1.0)
+        self.allowance = self._burst
+        self._last_refill = clock()
+        self.strikes = 0
+        self.quarantine_episodes = 0
+        self.quarantined_at: Optional[float] = None
+        self.probation_hold = False
+        self.batches_done = 0
+        self.rows_done = 0
+        self.shed_total_offsets = 0
+        self.latencies_ms: List[float] = []
+        self.stop_reason: Optional[str] = None
+
+    # -- quota --------------------------------------------------------------
+
+    def refill(self, now: float) -> None:
+        if self.allowance is None:
+            return
+        elapsed = max(0.0, now - self._last_refill)
+        self._last_refill = now
+        self.allowance = min(
+            self._burst,
+            self.allowance + elapsed * self.spec.max_rows_per_sec,
+        )
+
+    def throttled(self) -> bool:
+        return self.allowance is not None and self.allowance <= 0
+
+    def charge(self, rows: int) -> None:
+        if self.allowance is not None:
+            self.allowance -= rows
+
+    # -- work ---------------------------------------------------------------
+
+    def has_work(self, latest: Optional[int] = None) -> bool:
+        if self.query.in_flight_count() > 0:
+            return True
+        if latest is None:
+            latest = self.query.source.latest_offset()
+        return latest > self.query.planned_offset()
+
+    def record_commit(self, progress: Optional[dict]) -> int:
+        """Fold one committed batch's progress into tenant accounting;
+        returns the rows charged against the quota."""
+        self.batches_done += 1
+        if not progress:
+            return 0
+        rows = int(progress.get("numInputRows", 0))
+        self.rows_done += rows
+        self.latencies_ms.append(float(progress.get("durationMs", 0.0)))
+        if len(self.latencies_ms) > self._LATENCY_KEEP:
+            del self.latencies_ms[: -self._LATENCY_KEEP]
+        self.charge(rows)
+        return rows
+
+    # -- evidence -----------------------------------------------------------
+
+    def latency_percentiles(self) -> Dict[str, Optional[float]]:
+        if not self.latencies_ms:
+            return {"p50_ms": None, "p99_ms": None}
+        lat = np.asarray(self.latencies_ms, np.float64)
+        return {
+            "p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat, 99)), 3),
+        }
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "tenant": self.spec.tenant_id,
+            "state": self.state,
+            "weight": self.spec.weight,
+            "batches_done": self.batches_done,
+            "rows_done": self.rows_done,
+            "in_flight": self.query.in_flight_count(),
+            "last_committed": self.query.last_committed(),
+            "strikes": self.strikes,
+            "quarantine_episodes": self.quarantine_episodes,
+            "shed_total_offsets": self.shed_total_offsets,
+            "allowance_rows": (
+                None if self.allowance is None
+                else round(self.allowance, 1)
+            ),
+            "stop_reason": self.stop_reason,
+            **self.latency_percentiles(),
+        }
+
+
+class ServeDaemon:
+    """N tenant streams over one shared device program cache, fairly
+    scheduled, fault-isolated, drainable (module docstring has the
+    contracts).  Construct with specs, then :meth:`run` (the CLI
+    loop), :meth:`process_available` (drain what's there), or
+    :meth:`tick` (one deterministic scheduling round — the test
+    surface)."""
+
+    def __init__(
+        self,
+        specs: List[TenantSpec],
+        root_dir: str,
+        *,
+        shape_buckets: int = 0,
+        pipeline_depth: int = 1,
+        quantum: float = 1.0,
+        health: Optional[HealthMonitor] = None,
+        health_json: Optional[str] = None,
+        clock=time.monotonic,
+        breaker_kwargs: Optional[Dict[str, Any]] = None,
+    ):
+        if not specs:
+            raise ValueError("ServeDaemon needs at least one TenantSpec")
+        ids = [s.tenant_id for s in specs]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate tenant ids: {sorted(ids)}")
+        self.root_dir = root_dir
+        self.shape_buckets = int(shape_buckets)
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self.quantum = float(quantum)
+        self.health_json = health_json
+        self._clock = clock
+        self._breaker_kwargs = dict(breaker_kwargs or {})
+        self._owns_health = health is None
+        self.health = health or HealthMonitor(clock=clock).attach()
+        # shared program cache: one BatchPredictor per distinct model —
+        # keyed by checkpoint path (str specs) or object identity —
+        # handed to every tenant that declared it
+        self._predictors: Dict[Any, BatchPredictor] = {}
+        self._models_by_path: Dict[str, Any] = {}
+        self._warm_compiles: Optional[Dict[Any, int]] = None
+        self.tenants: List[TenantStream] = []
+        try:
+            for spec in specs:
+                self.tenants.append(self._build_tenant(spec))
+        except BaseException:
+            # a bad spec must not leak what __init__ already set up
+            # (close() can never run when __init__ raises): the health
+            # observer this daemon just attached, and every
+            # earlier-built tenant's registered breakers and source
+            if self._owns_health:
+                self.health.close()
+            for t in self.tenants:
+                close = getattr(t.query.source, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
+                reset_breakers(prefix=t.prefix)
+            raise
+        self._by_id = {t.spec.tenant_id: t for t in self.tenants}
+        # strike counting rides the event stream: engine-emitted
+        # UNHEALTHY-class events carry the tenant tag (overlap-mode
+        # delivery threads emit too, hence the lock)
+        self._strike_lock = threading.Lock()
+        self._observer = self._on_event
+        from sntc_tpu.resilience import add_event_observer
+
+        add_event_observer(self._observer)
+        self._drain = threading.Event()
+        self._drain_reason: Optional[str] = None
+        self.drained = False
+        self._closed = False
+
+    # -- construction -------------------------------------------------------
+
+    def _resolve_model(self, spec: TenantSpec):
+        if isinstance(spec.model, str):
+            if spec.model not in self._models_by_path:
+                from sntc_tpu.mlio import load_model
+
+                self._models_by_path[spec.model] = load_model(spec.model)
+            return spec.model, self._models_by_path[spec.model]
+        if spec.model is None:
+            raise ValueError(
+                f"tenant {spec.tenant_id!r} has no model"
+            )
+        return id(spec.model), spec.model
+
+    def predictor_for(self, spec: TenantSpec) -> BatchPredictor:
+        """The SHARED predictor for this spec's pipeline: same model
+        (object or path) → same predictor → same compiled bucketed /
+        fused programs.  A spec handing in a ``BatchPredictor``
+        directly shares by that object's identity (its own bucket
+        config wins)."""
+        if isinstance(spec.model, BatchPredictor):
+            self._predictors.setdefault(id(spec.model), spec.model)
+            return spec.model
+        key, model = self._resolve_model(spec)
+        pred = self._predictors.get(key)
+        if pred is None:
+            pred = BatchPredictor(model, bucket_rows=self.shape_buckets)
+            self._predictors[key] = pred
+        return pred
+
+    def tenant_dir(self, tenant_id: str) -> str:
+        return os.path.join(self.root_dir, "tenant", tenant_id)
+
+    def _build_tenant(self, spec: TenantSpec) -> TenantStream:
+        tdir = self.tenant_dir(spec.tenant_id)
+        source = spec.source
+        if source is None:
+            if spec.watch is None:
+                raise ValueError(
+                    f"tenant {spec.tenant_id!r} needs a source or a "
+                    "watch directory"
+                )
+            source = FileStreamSource(
+                spec.watch, parse_salvage=spec.schema_contract is not None
+            )
+        sink = spec.sink
+        if sink is None:
+            if spec.out is None:
+                raise ValueError(
+                    f"tenant {spec.tenant_id!r} needs a sink or an out "
+                    "directory"
+                )
+            sink = CsvDirSink(spec.out, columns=spec.out_columns)
+        prefix = f"tenant/{spec.tenant_id}/"
+        breakers = {
+            site: breaker_for(prefix + site, **self._breaker_kwargs)
+            for site in ("sink.write", "predict.dispatch")
+        }
+        query = StreamingQuery(
+            self.predictor_for(spec),
+            source,
+            sink,
+            os.path.join(tdir, "ckpt"),
+            max_batch_offsets=spec.max_batch_offsets,
+            pipeline_depth=self.pipeline_depth,
+            overlap_sink=self.pipeline_depth > 1,
+            breakers=breakers,
+            retry_policy=spec.retry_policy,
+            max_batch_failures=spec.max_batch_failures,
+            schema_contract=spec.schema_contract,
+            row_policy=spec.row_policy,
+            tenant=spec.tenant_id,
+        )
+        return TenantStream(spec, query, self._clock)
+
+    # -- compile-ledger evidence -------------------------------------------
+
+    def compile_ledger(self) -> Dict[str, Dict[str, int]]:
+        return {
+            str(key): {
+                "compile_events": p.compile_events,
+                "bucket_hits": p.bucket_hits,
+            }
+            for key, p in self._predictors.items()
+        }
+
+    def mark_warm(self) -> None:
+        """Snapshot every shared predictor's compile counter; later
+        :meth:`recompiles_after_warmup` is the delta — the
+        zero-cross-tenant-recompiles evidence bench config 8 journals."""
+        self._warm_compiles = {
+            key: p.compile_events for key, p in self._predictors.items()
+        }
+
+    def recompiles_after_warmup(self) -> Optional[int]:
+        if self._warm_compiles is None:
+            return None
+        return sum(
+            p.compile_events - self._warm_compiles.get(key, 0)
+            for key, p in self._predictors.items()
+        )
+
+    # -- escalation ladder --------------------------------------------------
+
+    def _on_event(self, record: Dict[str, Any]) -> None:
+        if record.get("event") not in STRIKE_EVENTS:
+            return
+        tenant = record.get("tenant")
+        if tenant is None:
+            # breaker / retry-executor events carry no tenant field but
+            # fire against the tenant's NAMESPACED site — attribute by
+            # prefix so an open breaker or exhausted retry strikes too
+            site = record.get("site")
+            if isinstance(site, str) and site.startswith("tenant/"):
+                parts = site.split("/", 2)
+                tenant = parts[1] if len(parts) == 3 else None
+        if tenant is None:
+            return
+        t = self._by_id.get(tenant)
+        if t is None or t.state == "STOPPED":
+            return
+        with self._strike_lock:
+            t.strikes += 1
+
+    def _escalate(self, now: float) -> None:
+        """Ladder transitions, once per tick: quarantine release after
+        cooldown (probation: health reset, fresh strikes), strike
+        threshold → QUARANTINED, episode threshold → STOPPED."""
+        for t in self.tenants:
+            if t.state == "STOPPED":
+                continue
+            if t.state == "QUARANTINED":
+                if now - t.quarantined_at >= t.spec.quarantine_cooldown_s:
+                    t.state = "OK"
+                    t.quarantined_at = None
+                    t.probation_hold = True  # release tick stays pure
+                    with self._strike_lock:
+                        t.strikes = 0
+                    self.health.reset_under(
+                        t.prefix, reason="quarantine released (probation)"
+                    )
+                    # probation means a real chance: an OPEN breaker
+                    # left from the episode would refuse every call and
+                    # starve the ladder of fresh evidence
+                    for br in t.query.breakers.values():
+                        br.reset()
+                    emit_event(
+                        event="tenant_released", tenant=t.spec.tenant_id,
+                        episodes=t.quarantine_episodes,
+                    )
+                continue
+            with self._strike_lock:
+                strikes = t.strikes
+            if strikes >= t.spec.quarantine_after:
+                t.quarantine_episodes += 1
+                if t.quarantine_episodes >= t.spec.stop_after:
+                    self._stop_tenant(
+                        t,
+                        reason=f"{t.quarantine_episodes} quarantine "
+                        "episodes",
+                    )
+                    continue
+                t.state = "QUARANTINED"
+                t.quarantined_at = now
+                with self._strike_lock:
+                    t.strikes = 0
+                emit_event(
+                    event="tenant_quarantined", tenant=t.spec.tenant_id,
+                    strikes=strikes, episode=t.quarantine_episodes,
+                    cooldown_s=t.spec.quarantine_cooldown_s,
+                )
+
+    def _stop_tenant(self, t: TenantStream, reason: str) -> None:
+        """Terminal eviction: the tenant's engine stops, its breakers
+        leave the process registry, its WAL keeps whatever a restart
+        would need.  The daemon — and every other tenant — keeps
+        serving."""
+        t.state = "STOPPED"
+        t.stop_reason = reason
+        try:
+            t.query.stop()
+        except Exception as e:  # a wedged engine must not stop the stop
+            emit_event(
+                event="tenant_error", tenant=t.spec.tenant_id,
+                error=repr(e), during="stop",
+            )
+        close = getattr(t.query.source, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
+        reset_breakers(prefix=t.prefix)
+        emit_event(
+            event="tenant_stopped", tenant=t.spec.tenant_id,
+            reason=reason,
+        )
+
+    def tenant_state(self, tenant_id: str) -> str:
+        return self._by_id[tenant_id].state
+
+    def tenant_health(self, tenant_id: str) -> HealthState:
+        """Worst health among the tenant's OWN namespaced components."""
+        return self.health.worst_under(self._by_id[tenant_id].prefix)
+
+    # -- the scheduler ------------------------------------------------------
+
+    def tick(self) -> int:
+        """One deficit-round-robin scheduling round; returns batches
+        committed across all tenants.  Order per round: ladder
+        transitions, quota refills, per-tenant shed decisions, then
+        credit every runnable tenant ``weight × quantum`` deficit and
+        drain the rotation — each committed micro-batch costs one
+        deficit and charges its rows to the tenant's bucket, so a
+        heavy tenant exhausts its credit (or allowance) and the
+        rotation moves on.  An engine error strikes the tenant and the
+        round continues; the daemon loop never dies for one tenant."""
+        now = self._clock()
+        self._escalate(now)
+        committed_total = 0
+        runnable: List[TenantStream] = []
+        for t in self.tenants:
+            if t.state in ("STOPPED", "QUARANTINED"):
+                continue
+            if t.probation_hold:
+                # the tick that released this tenant does not also
+                # serve it: release is observable (state OK, health
+                # reset) before the first probation batch can re-dirty
+                # either one
+                t.probation_hold = False
+                continue
+            t.refill(now)
+            try:
+                latest = t.query.source.latest_offset()
+            except Exception as e:
+                self._strike(t, e, during="latest_offset")
+                continue
+            if t.spec.max_pending_batches is not None:
+                try:
+                    shed = t.query.shed_backlog(
+                        t.spec.max_pending_batches,
+                        policy=t.spec.shed_policy,
+                        latest=latest,
+                    )
+                except Exception as e:
+                    self._strike(t, e, during="shed")
+                    shed = None
+                if shed is not None:
+                    t.shed_total_offsets += shed.get("offsets_shed", 0)
+            if not t.has_work(latest):
+                t.deficit = 0.0  # DRR: an idle queue keeps no credit
+                if t.state == "THROTTLED":
+                    t.state = "OK"
+                continue
+            if t.throttled():
+                t.state = "THROTTLED"
+                continue
+            if t.state == "THROTTLED":
+                t.state = "OK"
+            runnable.append(t)
+        for t in runnable:
+            t.deficit += t.spec.weight * self.quantum
+        for t in runnable:
+            committed_total += self._drain_deficit(t)
+        self._last_runnable = len(runnable)
+        if self.health_json:
+            _atomic_json(self.health_json, self.status())
+        return committed_total
+
+    def _drain_deficit(self, t: TenantStream) -> int:
+        """Run one tenant's engine while it has deficit, work, and
+        allowance; returns batches committed."""
+        committed = 0
+        while (
+            t.deficit >= 1.0
+            and t.state not in ("STOPPED", "QUARANTINED")
+        ):
+            before = t.query.last_committed()
+            try:
+                t.query._run_one_batch()
+            except Exception as e:
+                self._strike(t, e, during="run_one_batch")
+                t.deficit = min(
+                    t.deficit, t.spec.weight * self.quantum
+                )
+                break
+            delta = t.query.last_committed() - before
+            if delta == 0:
+                # deferred (breaker open / retry round) or idle: credit
+                # a queue could not spend does not bank — classic DRR.
+                # Without the cap, ~30 deferring ticks bank ~30 deficit
+                # and the recovery tick drains them back-to-back ahead
+                # of every neighbor in the rotation (a latency spike in
+                # exactly the noisy-neighbor scenario fairness is for).
+                t.deficit = min(
+                    t.deficit, t.spec.weight * self.quantum
+                )
+                break
+            t.deficit -= delta
+            committed += delta
+            # charge each committed batch's rows; recentProgress holds
+            # them newest-last in commit order
+            for progress in t.query.recentProgress[-delta:]:
+                t.record_commit(progress)
+            if t.throttled():
+                t.state = "THROTTLED"
+                break
+        return committed
+
+    def _strike(self, t: TenantStream, exc: Exception, during: str) -> None:
+        """An engine error that surfaced to the scheduler (quarantine
+        unarmed, or infrastructure failure): evidence against the
+        tenant, never against the daemon."""
+        with self._strike_lock:
+            t.strikes += 1
+        emit_event(
+            event="tenant_error", tenant=t.spec.tenant_id,
+            error=repr(exc), during=during,
+        )
+
+    # -- loop / drain -------------------------------------------------------
+
+    def has_work(self) -> bool:
+        return any(
+            t.state not in ("STOPPED", "QUARANTINED") and t.has_work()
+            for t in self.tenants
+        )
+
+    def process_available(self, max_rounds: int = 1_000_000) -> int:
+        """Deterministically drain what every schedulable tenant has
+        (the test/step API).  A zero-commit round with runnable work is
+        a RETRY round (a tenant deferring toward its quarantine
+        threshold), tolerated up to the bounded stall budget the
+        engine's own ``drain()`` uses; a round with nothing runnable
+        ends the call — a throttled tenant's backlog stays for later
+        (time, not rounds, refills its bucket), a quarantined tenant's
+        for its probation."""
+        total = 0
+        stalled = 0
+        max_stalled = max(
+            ((t.spec.max_batch_failures or 1) + 1) for t in self.tenants
+        ) * len(self.tenants)
+        for _ in range(max_rounds):
+            delta = self.tick()
+            total += delta
+            if delta:
+                stalled = 0
+                continue
+            if getattr(self, "_last_runnable", 0) == 0:
+                break
+            stalled += 1
+            if stalled >= max_stalled:
+                break
+        return total
+
+    def request_drain(self, reason: str = "request_drain") -> None:
+        if not self._drain.is_set():
+            self._drain_reason = reason
+            self._drain.set()
+
+    @property
+    def drain_requested(self) -> bool:
+        return self._drain.is_set()
+
+    def install_signal_handlers(self) -> bool:
+        try:
+            signal.signal(
+                signal.SIGTERM,
+                lambda signum, frame: self.request_drain("SIGTERM"),
+            )
+            return True
+        except ValueError:  # not the main thread
+            return False
+
+    def drain(self) -> int:
+        """Settle every live tenant: finish + commit its in-flight
+        batches (the engine's bounded drain — anything still deferring
+        stays in its WAL for a restart, the crash contract), write one
+        atomic marker per tenant and one for the daemon, stop the
+        engines.  Idempotent; returns batches committed during the
+        drain."""
+        if self.drained:
+            return 0
+        committed = 0
+        for t in self.tenants:
+            if t.state == "STOPPED":
+                continue
+            try:
+                done = t.query.drain()
+            except Exception as e:
+                emit_event(
+                    event="tenant_error", tenant=t.spec.tenant_id,
+                    error=repr(e), during="drain",
+                )
+                done = 0
+            committed += done
+            for progress in t.query.recentProgress[-done:] if done else []:
+                t.record_commit(progress)
+            _atomic_json(
+                os.path.join(
+                    self.tenant_dir(t.spec.tenant_id), "drain_marker.json"
+                ),
+                {
+                    "ts": time.time(),
+                    "tenant": t.spec.tenant_id,
+                    "reason": self._drain_reason,
+                    "last_committed": t.query.last_committed(),
+                    "end_offset": t.query.committed_end(),
+                    "in_flight_left": t.query.in_flight_count(),
+                },
+            )
+            try:
+                t.query.stop()
+            except Exception as e:
+                emit_event(
+                    event="tenant_error", tenant=t.spec.tenant_id,
+                    error=repr(e), during="stop",
+                )
+        self.drained = True
+        _atomic_json(
+            os.path.join(self.root_dir, DAEMON_DRAIN_MARKER),
+            {
+                "ts": time.time(),
+                "reason": self._drain_reason,
+                "pid": os.getpid(),
+                "tenants": {
+                    t.spec.tenant_id: t.state for t in self.tenants
+                },
+                "batches_committed_at_drain": committed,
+            },
+        )
+        emit_event(
+            event="daemon_drained", reason=self._drain_reason,
+            tenants=len(self.tenants), committed=committed,
+        )
+        return committed
+
+    def run(
+        self,
+        poll_interval: float = 1.0,
+        max_batches: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """The supervised foreground loop: tick until ``max_batches``
+        total commits or a drain request; idle ticks wait
+        ``poll_interval`` (interruptibly).  Always drains on the way
+        out and returns the final :meth:`status`."""
+        done = 0
+        try:
+            while not self._drain.is_set():
+                delta = self.tick()
+                done += delta
+                if max_batches is not None and done >= max_batches:
+                    break
+                if delta == 0:
+                    if self._warm_compiles is None:
+                        # first idle round = the initial backlog is
+                        # served and every live signature compiled:
+                        # everything after this is the measured cache
+                        self.mark_warm()
+                    self._drain.wait(poll_interval)
+        finally:
+            self.drain()
+            if self.health_json:
+                _atomic_json(self.health_json, self.status())
+        return self.status()
+
+    # -- status / teardown --------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        from sntc_tpu.resilience import breakers_snapshot
+
+        tenant_rows = {
+            t.spec.tenant_id: t.snapshot() for t in self.tenants
+        }
+        return {
+            "tenants": tenant_rows,
+            "aggregate": {
+                "batches_done": sum(
+                    t.batches_done for t in self.tenants
+                ),
+                "rows_done": sum(t.rows_done for t in self.tenants),
+                "states": {
+                    s: sum(1 for t in self.tenants if t.state == s)
+                    for s in TENANT_STATES
+                },
+            },
+            "compile_ledger": self.compile_ledger(),
+            "recompiles_after_warmup": self.recompiles_after_warmup(),
+            "health": self.health.snapshot(),
+            "breakers": {
+                site: snap
+                for site, snap in breakers_snapshot().items()
+                if site.startswith("tenant/")
+            },
+            "events_dropped": events_dropped(),
+            "events_dropped_by_tenant": events_dropped(by_tenant=True),
+            "drain_requested": self.drain_requested,
+            "drained": self.drained,
+        }
+
+    def close(self) -> None:
+        """Daemon teardown: detach the strike observer and the owned
+        health monitor from the process event stream, stop engines that
+        are still live, close sources, and evict every tenant's
+        breakers from the process registry.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        from sntc_tpu.resilience import remove_event_observer
+
+        remove_event_observer(self._observer)
+        if self._owns_health:
+            self.health.close()
+        for t in self.tenants:
+            if t.state != "STOPPED":
+                try:
+                    t.query.stop()
+                except Exception:
+                    pass
+                close = getattr(t.query.source, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
+            reset_breakers(prefix=t.prefix)

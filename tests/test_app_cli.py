@@ -102,3 +102,49 @@ def test_train_new_estimators(data_dir, capsys):
         assert rc == 0, est
         out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         assert 0.0 <= out["macroF1"] <= 1.0, est
+
+
+def test_serve_daemon_once_two_tenants_shared_checkpoint(
+    data_dir, tmp_path, capsys
+):
+    """serve-daemon end-to-end: two tenants naming the SAME checkpoint
+    share one served model (and so one predictor/program cache), each
+    drains its own watch dir into its own out dir, and --once exits 0
+    with per-tenant drain markers under the daemon root."""
+    model_dir = str(tmp_path / "model")
+    main(["train", "--data", data_dir, "--estimator", "lr", "--binary",
+          "--max-iter", "15", "--model-out", model_dir])
+    capsys.readouterr()
+    for tid in ("acme", "beta"):
+        in_dir = tmp_path / "in" / tid
+        in_dir.mkdir(parents=True)
+        for f in sorted(os.listdir(data_dir)):
+            os.link(os.path.join(data_dir, f), str(in_dir / f))
+    spec_path = tmp_path / "tenants.json"
+    spec_path.write_text(json.dumps({"tenants": [
+        {"id": "acme", "model": model_dir,
+         "watch": str(tmp_path / "in" / "acme"),
+         "out": str(tmp_path / "out" / "acme"), "weight": 2},
+        {"id": "beta", "model": model_dir,
+         "watch": str(tmp_path / "in" / "beta"),
+         "out": str(tmp_path / "out" / "beta")},
+    ]}))
+    root = str(tmp_path / "root")
+    rc = main([
+        "serve-daemon", "--tenants", str(spec_path), "--root", root,
+        "--max-files-per-batch", "1", "--once",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["batches"] == 6  # 3 day files per tenant
+    assert out["tenants"] == {"acme": "OK", "beta": "OK"}
+    assert out["drained"] is True
+    for tid in ("acme", "beta"):
+        outs = sorted(os.listdir(tmp_path / "out" / tid))
+        assert len(outs) == 3
+        with open(tmp_path / "out" / tid / outs[0]) as fh:
+            assert "predictedLabel" in fh.readline()
+        marker = os.path.join(root, "tenant", tid, "drain_marker.json")
+        with open(marker) as fh:
+            assert json.load(fh)["tenant"] == tid
+    assert os.path.exists(os.path.join(root, "daemon_drain_marker.json"))

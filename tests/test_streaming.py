@@ -825,3 +825,112 @@ def test_hot_swap_never_lands_mid_delivery(tmp_path):
     np.testing.assert_array_equal(first, np.zeros_like(first))
     np.testing.assert_array_equal(last, np.ones_like(last))
     assert q.last_committed() == len(sink.frames) - 1
+
+
+# ---------------------------------------------------------------------------
+# concurrent engines on ONE shared BatchPredictor (r12): the serve
+# daemon hands tenants sharing a pipeline one predictor, so two engines
+# dispatching through it from separate threads must (a) produce the
+# exact sink output a serial run produces and (b) never widen the
+# shared compile ledger past the union of their bucket shapes — the
+# thread-safety contract the daemon's shared program cache depends on
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_queries_shared_predictor_bitwise_vs_serial(
+    mesh8, tmp_path, monkeypatch
+):
+    import threading
+
+    from sntc_tpu.core.base import Pipeline
+    from sntc_tpu.feature import MinMaxScaler, VectorAssembler
+    from sntc_tpu.fuse import compile_pipeline, fused_segments
+
+    # fused serving always runs on device; pin the staged host-serve
+    # crossover off so serial and concurrent hit one numerical path
+    monkeypatch.setenv("SNTC_SERVE_HOST_ROWS", "0")
+
+    def scalar_frame(n, seed):
+        rng = np.random.default_rng(seed)
+        cols = {
+            f"c{i}": rng.normal(3.0, 2.0, size=n).astype(np.float32)
+            for i in range(4)
+        }
+        return Frame(cols)
+
+    train = scalar_frame(400, 99)
+    train = Frame(
+        {
+            **{c: train[c] for c in train.columns},
+            "label": (np.asarray(train["c0"]) > 3.0).astype(np.float64),
+        }
+    )
+    pm = Pipeline(stages=[
+        VectorAssembler(inputCols=[f"c{i}" for i in range(4)],
+                        outputCol="features"),
+        MinMaxScaler(inputCol="features", outputCol="scaled"),
+        LogisticRegression(mesh=mesh8, featuresCol="scaled", maxIter=20),
+    ]).fit(train)
+    fused = compile_pipeline(pm)
+    assert fused_segments(fused), "pipeline should fuse"
+
+    # per-tenant streams with DIFFERENT row counts that land in two
+    # buckets (5,7 -> 8; 11,13 -> 16): the shared ledger must hold
+    # exactly those two shapes however the threads interleave
+    frames = {
+        "a": [scalar_frame(5, 10 + i) for i in range(4)]
+        + [scalar_frame(11, 20 + i) for i in range(4)],
+        "b": [scalar_frame(7, 30 + i) for i in range(4)]
+        + [scalar_frame(13, 40 + i) for i in range(4)],
+    }
+
+    def run(pred, tid, ckpt_tag):
+        sink = MemorySink()
+        q = StreamingQuery(
+            pred, MemorySource(frames[tid]), sink,
+            str(tmp_path / f"{ckpt_tag}-{tid}"), max_batch_offsets=1,
+        )
+        q.process_available()
+        q.stop()
+        return sink
+
+    # serial reference: each tenant alone on its OWN predictor
+    serial = {
+        tid: run(BatchPredictor(fused, bucket_rows=8), tid, "serial")
+        for tid in ("a", "b")
+    }
+
+    shared = BatchPredictor(fused, bucket_rows=8)
+    results, errs = {}, []
+
+    def worker(tid):
+        try:
+            results[tid] = run(shared, tid, "conc")
+        except Exception as e:  # pragma: no cover - failure evidence
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(tid,)) for tid in ("a", "b")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+
+    # (a) bitwise: every tenant's concurrent sink == its serial sink
+    for tid in ("a", "b"):
+        assert len(results[tid].frames) == len(serial[tid].frames)
+        for got, want in zip(results[tid].frames, serial[tid].frames):
+            assert got.num_rows == want.num_rows
+            for col in ("rawPrediction", "probability", "prediction"):
+                if col in want:
+                    np.testing.assert_array_equal(
+                        np.asarray(got[col]), np.asarray(want[col]),
+                        err_msg=f"{tid}:{col}",
+                    )
+
+    # (b) flat shared ledger: exactly the two bucket shapes, however
+    # the threads raced; every later dispatch was a bucket hit
+    assert shared.compile_events == 2
+    assert shared.bucket_hits == sum(len(v) for v in frames.values()) - 2

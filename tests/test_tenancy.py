@@ -1,0 +1,641 @@
+"""Multi-tenant serve front door (r12): shared predictor program
+cache, weighted-deficit fair scheduling, rate quotas, the tenant
+escalation ladder (OK → THROTTLED → QUARANTINED → STOPPED), per-tenant
+namespacing of events/breakers/fault sites/journals, daemon drain, the
+observer-leak and breaker-eviction regressions, the tenant-flags drift
+check, and the multi-tenant chaos scenarios in a real child process.
+Scheduler/quota/ladder tests run on injectable clocks — deterministic,
+no sleeps."""
+
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import sntc_tpu.resilience as R
+from sntc_tpu.core.base import Transformer
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.resilience import HealthMonitor, HealthState, breaker_for
+from sntc_tpu.serve import (
+    MemorySink,
+    MemorySource,
+    ServeDaemon,
+    StreamingQuery,
+    TenantSpec,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    R.clear()
+    R.clear_events()
+    R.reset_breakers()
+    yield
+    R.clear()
+    R.clear_events()
+    R.reset_breakers()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class _Identity(Transformer):
+    def transform(self, frame):
+        return frame
+
+
+class _FailingSink(MemorySink):
+    def add_batch(self, batch_id, frame):
+        raise IOError("sink volume down")
+
+
+def _frames(n_batches, rows=8, base=0):
+    return [
+        Frame({"x": np.arange(rows, dtype=np.float64) + 100 * b + base})
+        for b in range(n_batches)
+    ]
+
+
+def _spec(tid, frames, sink=None, model=None, **kw):
+    return TenantSpec(
+        tenant_id=tid,
+        model=model if model is not None else _Identity(),
+        source=MemorySource(frames),
+        sink=sink if sink is not None else MemorySink(),
+        **kw,
+    )
+
+
+def _daemon(tmp_path, specs, **kw):
+    return ServeDaemon(specs, str(tmp_path / "root"), **kw)
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions: observer leak, breaker-registry eviction,
+# tenant-tagged event stream
+# ---------------------------------------------------------------------------
+
+
+def test_observer_count_flat_across_50_monitor_lifecycles():
+    base = R.event_observer_count()
+    for _ in range(50):
+        HealthMonitor().attach().close()
+    assert R.event_observer_count() == base
+    # attach is idempotent, close is too
+    m = HealthMonitor().attach().attach()
+    assert R.event_observer_count() == base + 1
+    m.close()
+    m.close()
+    assert R.event_observer_count() == base
+
+
+def test_daemon_close_detaches_monitor_and_strike_observer(tmp_path):
+    base = R.event_observer_count()
+    for _ in range(5):
+        d = _daemon(tmp_path, [_spec("a", _frames(1))])
+        assert R.event_observer_count() == base + 2  # health + strikes
+        d.close()
+    assert R.event_observer_count() == base
+
+
+def test_daemon_init_failure_detaches_observer_and_evicts(tmp_path):
+    """A bad spec raising out of __init__ must not leak the monitor
+    observer the daemon had already attached (close() never runs) —
+    nor the breakers an earlier-built GOOD tenant already registered."""
+    base = R.event_observer_count()
+    good = _spec("good", _frames(1))
+    bad = TenantSpec(tenant_id="bad", model=_Identity())  # no source
+    with pytest.raises(ValueError, match="source"):
+        _daemon(tmp_path, [good, bad])
+    assert R.event_observer_count() == base
+    assert not any(
+        site.startswith("tenant/good/")
+        for site in R.breakers_snapshot()
+    )
+
+
+def test_deferring_tenant_banks_no_deficit(tmp_path):
+    """DRR cap: credit a deferring tenant could not spend does not
+    bank — on recovery one tick commits at most ~2 rounds' worth, not
+    the whole deferral backlog ahead of its neighbors."""
+    class _HealableSink(MemorySink):
+        def __init__(self):
+            super().__init__()
+            self.broken = True
+
+        def add_batch(self, batch_id, frame):
+            if self.broken:
+                raise IOError("sink volume down")
+            super().add_batch(batch_id, frame)
+
+    heal = _HealableSink()
+    specs = [
+        _spec("flaky", _frames(30), sink=heal, max_batch_offsets=1,
+              max_batch_failures=None, quarantine_after=10_000),
+        _spec("ok", _frames(30), max_batch_offsets=1),
+    ]
+    d = _daemon(tmp_path, specs, clock=FakeClock())
+    try:
+        flaky = d._by_id["flaky"]
+        for _ in range(20):  # 20 deferring rounds
+            d.tick()
+        assert flaky.batches_done == 0
+        assert flaky.deficit <= flaky.spec.weight * d.quantum
+        heal.broken = False
+        d.tick()
+        # one recovery tick: bounded by last round's cap + this
+        # round's credit, NOT the 20 banked rounds
+        assert flaky.batches_done <= 2
+    finally:
+        d.close()
+
+
+def test_reset_breakers_prefix_evicts_only_namespace():
+    breaker_for("tenant/a/sink.write")
+    breaker_for("tenant/a/predict.dispatch")
+    keep_b = breaker_for("tenant/b/sink.write")
+    keep_g = breaker_for("collective.dispatch")
+    R.reset_breakers(prefix="tenant/a/")
+    snap = R.breakers_snapshot()
+    assert set(snap) == {"tenant/b/sink.write", "collective.dispatch"}
+    # survivors are the same instances; the evicted site rebuilds fresh
+    assert breaker_for("tenant/b/sink.write") is keep_b
+    assert breaker_for("collective.dispatch") is keep_g
+    fresh = breaker_for("tenant/a/sink.write")
+    assert fresh.snapshot()["window_calls"] == 0
+
+
+def test_engine_events_tenant_tagged_and_site_namespaced(tmp_path):
+    sink = _FailingSink()
+    q = StreamingQuery(
+        _Identity(), MemorySource(_frames(1)), sink,
+        str(tmp_path / "ckpt"), max_batch_offsets=1,
+        max_batch_failures=1, tenant="acme",
+    )
+    assert q.process_available() == 1  # quarantined, committed
+    events = R.recent_events(event="quarantine")
+    assert len(events) == 1
+    assert events[0]["site"] == "tenant/acme/sink.write"
+    assert events[0]["tenant"] == "acme"
+    # single-tenant engines stay untagged (allocation-free path)
+    q2 = StreamingQuery(
+        _Identity(), MemorySource(_frames(1)), _FailingSink(),
+        str(tmp_path / "ckpt2"), max_batch_offsets=1,
+        max_batch_failures=1,
+    )
+    q2.process_available()
+    plain = R.recent_events(site="sink.write", event="quarantine")
+    assert len(plain) == 1 and "tenant" not in plain[0]
+
+
+def test_shed_journal_records_tenant(tmp_path):
+    q = StreamingQuery(
+        _Identity(), MemorySource(_frames(10)), MemorySink(),
+        str(tmp_path / "ckpt"), max_batch_offsets=1, tenant="acme",
+    )
+    record = q.shed_backlog(2)
+    assert record["tenant"] == "acme"
+    with open(tmp_path / "ckpt" / "shed.jsonl") as f:
+        assert json.loads(f.readline())["tenant"] == "acme"
+    shed_events = R.recent_events(event="load_shed")
+    assert shed_events[0]["tenant"] == "acme"
+    assert shed_events[0]["site"] == "tenant/acme/stream.read"
+
+
+def test_events_dropped_per_tenant_breakdown():
+    for _ in range(600):
+        R.emit_event(event="retry", site="x", tenant="noisy")
+    for _ in range(30):
+        R.emit_event(event="retry", site="x")
+    by_tenant = R.events_dropped(by_tenant=True)
+    total = R.events_dropped()
+    assert total == 600 + 30 - 512
+    # the first 512-30=... evictions were all noisy's records; the
+    # untagged records count only against the int total
+    assert by_tenant["noisy"] >= 600 - 512
+    assert set(by_tenant) == {"noisy"}
+    R.clear_events()
+    assert R.events_dropped(by_tenant=True) == {}
+
+
+def test_fault_point_tenant_namespacing(tmp_path):
+    R.arm("tenant/a/stream.read", times=None)
+    qa = StreamingQuery(
+        _Identity(), MemorySource(_frames(1)), MemorySink(),
+        str(tmp_path / "a"), tenant="a",
+    )
+    qb = StreamingQuery(
+        _Identity(), MemorySource(_frames(1)), MemorySink(),
+        str(tmp_path / "b"), tenant="b",
+    )
+    with pytest.raises(R.InjectedFault):
+        qa.process_available()
+    assert qb.process_available() == 1  # b never sees a's fault
+    # a bare-site fault is the shared-environment failure: hits b too
+    R.clear()
+    R.arm("stream.read")
+    qb2 = StreamingQuery(
+        _Identity(), MemorySource(_frames(1)), MemorySink(),
+        str(tmp_path / "b2"), tenant="b",
+    )
+    with pytest.raises(R.InjectedFault):
+        qb2.process_available()
+
+
+# ---------------------------------------------------------------------------
+# shared program cache
+# ---------------------------------------------------------------------------
+
+
+def test_shared_predictor_and_flat_ledger_across_tenants(tmp_path):
+    model = _Identity()
+    sinks = {t: MemorySink() for t in ("a", "b", "c")}
+    # ragged per-tenant batch sizes that all fall into buckets {4, 8}
+    frames = {
+        "a": _frames(2, rows=3), "b": _frames(2, rows=5),
+        "c": _frames(2, rows=7),
+    }
+    specs = [
+        _spec(t, frames[t], sink=sinks[t], model=model,
+              max_batch_offsets=1)
+        for t in ("a", "b", "c")
+    ]
+    d = _daemon(tmp_path, specs, shape_buckets=4)
+    try:
+        # one model object -> ONE shared predictor for all three
+        preds = {
+            id(d.predictor_for(s.spec)) for s in d.tenants
+        }
+        assert len(preds) == 1
+        d.process_available()
+        d.mark_warm()
+        # more traffic in the SAME shapes: zero new compiles, shared
+        # bucket hits keep counting on the one ledger
+        for t in ("a", "b", "c"):
+            src = d._by_id[t].query.source
+            for f in frames[t]:
+                src.add(f)
+        d.process_available()
+        assert d.recompiles_after_warmup() == 0
+        ledger = list(d.compile_ledger().values())
+        assert len(ledger) == 1 and ledger[0]["compile_events"] == 2
+        # every tenant's rows came through intact
+        for t in ("a", "b", "c"):
+            got = np.concatenate(
+                [np.asarray(f["x"]) for f in sinks[t].frames]
+            )
+            want = np.concatenate(
+                [np.asarray(f["x"]) for f in frames[t] * 2]
+            )
+            np.testing.assert_array_equal(got, want)
+    finally:
+        d.close()
+
+
+# ---------------------------------------------------------------------------
+# fair scheduling & quotas (injectable clock, steppable ticks)
+# ---------------------------------------------------------------------------
+
+
+def test_deficit_round_robin_honors_weights(tmp_path):
+    sinks = {"heavy": MemorySink(), "light": MemorySink()}
+    specs = [
+        _spec("heavy", _frames(12), sink=sinks["heavy"], weight=3.0,
+              max_batch_offsets=1),
+        _spec("light", _frames(12), sink=sinks["light"], weight=1.0,
+              max_batch_offsets=1),
+    ]
+    d = _daemon(tmp_path, specs, clock=FakeClock())
+    try:
+        for _ in range(4):
+            d.tick()
+        heavy, light = d._by_id["heavy"], d._by_id["light"]
+        assert heavy.batches_done == 12  # 3 per round
+        assert light.batches_done == 4  # 1 per round
+    finally:
+        d.close()
+
+
+def test_rate_quota_throttles_then_time_refills(tmp_path):
+    clk = FakeClock()
+    sink = MemorySink()
+    specs = [
+        _spec("metered", _frames(6, rows=8), sink=sink,
+              max_rows_per_sec=8.0, max_batch_offsets=1),
+    ]
+    d = _daemon(tmp_path, specs, clock=clk)
+    try:
+        t = d._by_id["metered"]
+        assert d.tick() == 1  # burst = 1 s of quota = one 8-row batch
+        assert t.allowance <= 0
+        assert d.tick() == 0  # same instant: bucket empty
+        assert t.state == "THROTTLED"
+        assert d.process_available() == 0  # rounds don't refill, time does
+        clk.t = 1.0
+        assert d.tick() == 1
+        assert t.state in ("OK", "THROTTLED")
+        assert t.batches_done == 2
+    finally:
+        d.close()
+
+
+def test_backlog_shed_is_journaled_per_tenant(tmp_path):
+    sink = MemorySink()
+    specs = [
+        _spec("flood", _frames(10), sink=sink, max_pending_batches=2,
+              max_batch_offsets=1),
+    ]
+    d = _daemon(tmp_path, specs, clock=FakeClock())
+    try:
+        d.process_available()
+        t = d._by_id["flood"]
+        assert t.shed_total_offsets > 0
+        shed_path = os.path.join(
+            d.tenant_dir("flood"), "ckpt", "shed.jsonl"
+        )
+        with open(shed_path) as f:
+            rec = json.loads(f.readline())
+        assert rec["tenant"] == "flood"
+        # freshest data kept flowing: the sink got the post-shed tail
+        assert len(sink.batches) > 0
+    finally:
+        d.close()
+
+
+# ---------------------------------------------------------------------------
+# escalation ladder & isolation
+# ---------------------------------------------------------------------------
+
+
+def test_noisy_tenant_walks_the_ladder_good_tenant_unaffected(tmp_path):
+    clk = FakeClock()
+    good_sink = MemorySink()
+    specs = [
+        _spec("good", _frames(6), sink=good_sink, max_batch_offsets=1,
+              max_batch_failures=2),
+        _spec("bad", _frames(8), sink=_FailingSink(),
+              max_batch_offsets=1, max_batch_failures=2,
+              quarantine_after=2, quarantine_cooldown_s=10.0,
+              stop_after=2),
+    ]
+    d = _daemon(tmp_path, specs, clock=clk)
+    try:
+        bad = d._by_id["bad"]
+        d.process_available()
+        # every bad batch takes 2 failed rounds then quarantines (a
+        # strike); 2 strikes -> episode 1 -> QUARANTINED
+        assert bad.state == "QUARANTINED"
+        assert bad.quarantine_episodes == 1
+        assert R.recent_events(event="tenant_quarantined")
+        # the good tenant never noticed
+        good = d._by_id["good"]
+        assert good.state == "OK" and good.batches_done == 6
+        assert len(good_sink.batches) == 6
+        assert d.tenant_health("good") == HealthState.OK
+        # bad's evidence landed in bad's OWN namespace
+        dead = os.path.join(
+            d.tenant_dir("bad"), "ckpt", "dead_letter",
+            "dead_letter.jsonl",
+        )
+        assert os.path.exists(dead)
+        assert d.tenant_health("bad") == HealthState.UNHEALTHY
+        # cooldown elapses -> probation: health reset, serving resumes
+        clk.t = 10.0
+        d.tick()
+        assert bad.state != "QUARANTINED"
+        assert d.tenant_health("bad") == HealthState.OK
+        assert R.recent_events(event="tenant_released")
+        # still failing -> second episode >= stop_after -> STOPPED,
+        # breakers evicted from the process registry
+        d.process_available()
+        assert bad.state == "STOPPED"
+        assert R.recent_events(event="tenant_stopped")
+        assert not any(
+            site.startswith("tenant/bad/")
+            for site in R.breakers_snapshot()
+        )
+        # a stopped tenant's neighbors keep their breakers
+        assert any(
+            site.startswith("tenant/good/")
+            for site in R.breakers_snapshot()
+        )
+        # daemon keeps scheduling the survivors
+        d._by_id["good"].query.source.add(_frames(1)[0])
+        assert d.process_available() == 1
+    finally:
+        d.close()
+
+
+def test_strikes_attributed_by_namespaced_site_too(tmp_path):
+    """Breaker / retry-executor events carry no ``tenant`` field —
+    they fire against the tenant's namespaced site; the ladder must
+    count them anyway (an open breaker IS escalation evidence)."""
+    d = _daemon(tmp_path, [_spec("a", _frames(1)), _spec("b", [])],
+                clock=FakeClock())
+    try:
+        R.emit_event(event="breaker_open", site="tenant/a/sink.write")
+        R.emit_event(event="retry_exhausted",
+                     site="tenant/a/sink.write", attempts=3)
+        assert d._by_id["a"].strikes == 2
+        assert d._by_id["b"].strikes == 0
+        # untagged bare-site events attribute to nobody
+        R.emit_event(event="breaker_open", site="sink.write")
+        R.emit_event(event="breaker_open", site="tenant/unknown")
+        assert d._by_id["a"].strikes == 2
+    finally:
+        d.close()
+
+
+def test_engine_error_strikes_tenant_never_kills_daemon(tmp_path):
+    class _ExplodingSource(MemorySource):
+        def latest_offset(self):
+            raise RuntimeError("source backend down")
+
+    specs = [
+        TenantSpec(tenant_id="boom", model=_Identity(),
+                   source=_ExplodingSource(_frames(1)),
+                   sink=MemorySink(), quarantine_after=99),
+        _spec("ok", _frames(2), max_batch_offsets=1),
+    ]
+    d = _daemon(tmp_path, specs, clock=FakeClock())
+    try:
+        assert d.process_available() == 2  # the healthy tenant's batches
+        assert d._by_id["boom"].strikes > 0
+        assert R.recent_events(event="tenant_error")
+        assert d._by_id["ok"].batches_done == 2
+    finally:
+        d.close()
+
+
+# ---------------------------------------------------------------------------
+# drain
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_drain_settles_every_tenant_with_markers(tmp_path):
+    sinks = {"a": MemorySink(), "b": MemorySink()}
+    specs = [
+        _spec(t, _frames(3), sink=sinks[t], max_batch_offsets=1)
+        for t in ("a", "b")
+    ]
+    d = _daemon(tmp_path, specs, clock=FakeClock())
+    try:
+        d.request_drain("test")
+        status = d.run(poll_interval=0.0)
+        assert status["drained"] is True
+        for t in ("a", "b"):
+            marker = os.path.join(
+                d.tenant_dir(t), "drain_marker.json"
+            )
+            with open(marker) as f:
+                rec = json.load(f)
+            assert rec["tenant"] == t and rec["in_flight_left"] == 0
+        with open(
+            os.path.join(str(tmp_path / "root"),
+                         "daemon_drain_marker.json")
+        ) as f:
+            daemon_marker = json.load(f)
+        assert daemon_marker["reason"] == "test"
+        assert set(daemon_marker["tenants"]) == {"a", "b"}
+        assert R.recent_events(event="daemon_drained")
+    finally:
+        d.close()
+
+
+# ---------------------------------------------------------------------------
+# spec hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_spec_validation():
+    with pytest.raises(ValueError, match="path-safe"):
+        TenantSpec(tenant_id="a/b", model=_Identity())
+    with pytest.raises(ValueError, match="weight"):
+        TenantSpec(tenant_id="a", model=_Identity(), weight=0)
+    with pytest.raises(ValueError, match="schema_contract"):
+        TenantSpec(tenant_id="a", model=_Identity(),
+                   row_policy="salvage")
+    with pytest.raises(ValueError, match="unknown TenantSpec field"):
+        TenantSpec.from_dict({"id": "a", "max_rows_per_second": 5})
+    spec = TenantSpec.from_dict(
+        {"id": "a", "weight": 2.0},
+        defaults={"weight": 1.0, "max_rows_per_sec": 10.0,
+                  "model": _Identity()},
+    )
+    assert spec.weight == 2.0 and spec.max_rows_per_sec == 10.0
+    # the CLI's documented "0 = quarantine unarmed" normalizes to None
+    # (a raw 0 would be rejected by StreamingQuery)
+    zero = TenantSpec(tenant_id="z", model=_Identity(),
+                      max_batch_failures=0)
+    assert zero.max_batch_failures is None
+
+
+def test_daemon_rejects_duplicate_tenants(tmp_path):
+    with pytest.raises(ValueError, match="duplicate"):
+        _daemon(tmp_path, [_spec("a", _frames(1)),
+                           _spec("a", _frames(1))])
+
+
+# ---------------------------------------------------------------------------
+# tenant-flags drift check (the tier-1 wiring of check_tenant_flags)
+# ---------------------------------------------------------------------------
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tenant_flags_consistent_cli_spec_docs():
+    checker = _load_script("check_tenant_flags")
+    assert checker.check() == []
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant chaos: one tenant's kill/fault in a REAL daemon process
+# must not touch its neighbors (tier-1 wiring of the chaos matrix's
+# r12 scenarios)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def chaos():
+    return _load_script("chaos_crash_matrix")
+
+
+@pytest.fixture(scope="module")
+def mt_reference(chaos, tmp_path_factory):
+    workdir = str(tmp_path_factory.mktemp("mt_chaos"))
+    return workdir, chaos.run_multi_tenant_reference(workdir)
+
+
+def test_chaos_multi_tenant_kill_converges_every_tenant(
+    chaos, mt_reference
+):
+    workdir, reference = mt_reference
+    # reference sanity: 3 tenants x 4 single-file batches, 6 rows each
+    for tid in chaos.TENANT_IDS:
+        assert sorted(reference[tid]["commits"]) == [0, 1, 2, 3]
+        assert set(reference[tid]["rows"].values()) == {6}
+    verdict = chaos.run_multi_tenant_kill_scenario(workdir, reference)
+    assert verdict["ok"], verdict
+
+
+def test_chaos_tenant_fault_isolated_to_its_namespace(
+    chaos, mt_reference
+):
+    workdir, reference = mt_reference
+    verdict = chaos.run_tenant_isolation_scenario(workdir, reference)
+    assert verdict["ok"], verdict
+    assert verdict["tenant_states"]["t1"] in ("QUARANTINED", "STOPPED")
+
+
+# ---------------------------------------------------------------------------
+# concurrency smoke: the daemon schedules on one thread, but sharing a
+# predictor ACROSS daemon + external thread must keep the ledger sane
+# (the full bitwise two-engine contract lives in test_streaming.py)
+# ---------------------------------------------------------------------------
+
+
+def test_shared_predictor_ledger_thread_safe(tmp_path):
+    from sntc_tpu.serve import BatchPredictor
+
+    pred = BatchPredictor(_Identity(), bucket_rows=4)
+    frames = _frames(40, rows=5)
+    errs = []
+
+    def worker(tid):
+        try:
+            q = StreamingQuery(
+                pred, MemorySource(frames), MemorySink(),
+                str(tmp_path / tid), max_batch_offsets=1, tenant=tid,
+            )
+            q.process_available()
+        except Exception as e:  # pragma: no cover - failure evidence
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(f"t{i}",))
+        for i in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert pred.compile_events == 1  # one bucket shape, ever
+    assert pred.bucket_hits == 3 * 40 - 1
