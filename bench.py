@@ -135,6 +135,59 @@ def _resilience_summary():
     return out
 
 
+# watermark for the metrics-registry journal field: a --config all
+# sweep shares one process registry, and each record must report only
+# ITS OWN window's activity (the _resilience_mark discipline)
+_obs_mark: dict = {"flat": {}}
+
+
+def _obs_flatten() -> dict:
+    """The process metrics registry as flat ``name{k=v,...}`` → value
+    (histograms contribute ``:count``/``:sum``) — the journalable
+    form of a snapshot."""
+    from sntc_tpu.obs.metrics import registry
+
+    flat: dict = {}
+    for name, metric in registry().snapshot().items():
+        for s in metric["series"]:
+            labels = s["labels"]
+            key = name + (
+                "{" + ",".join(
+                    f"{k}={v}" for k, v in sorted(labels.items())
+                ) + "}"
+                if labels else ""
+            )
+            if metric["type"] == "histogram":
+                flat[key + ":count"] = s["count"]
+                flat[key + ":sum"] = round(s["sum"], 6)
+            else:
+                flat[key] = (
+                    round(s["value"], 6)
+                    if isinstance(s["value"], float)
+                    and not float(s["value"]).is_integer()
+                    else int(s["value"])
+                )
+    return flat
+
+
+def _obs_summary():
+    """Registry activity for the journal: nonzero deltas of every
+    metric series since the previous journal record.  None when the
+    window was quiet."""
+    try:
+        flat = _obs_flatten()
+    except Exception:
+        return None
+    prev = _obs_mark["flat"]
+    delta = {}
+    for k, v in flat.items():
+        d = v - prev.get(k, 0)
+        if d:
+            delta[k] = round(d, 6) if isinstance(d, float) else d
+    _obs_mark["flat"] = flat
+    return delta or None
+
+
 def _journal_run(cfg: str, line: dict) -> None:
     """Append the full machine-written record of this invocation to the
     COMMITTED ``bench_runs.jsonl`` — the auditable raw evidence behind
@@ -157,6 +210,13 @@ def _journal_run(cfg: str, line: dict) -> None:
         resilience = _resilience_summary()
         if resilience is not None:
             record["resilience"] = resilience
+    # the metrics-registry window delta rides every journal record: the
+    # same counters an operator would scrape from --metrics-out, scoped
+    # to this config's run (obs satellite of r13)
+    if "obs" not in record:
+        obs = _obs_summary()
+        if obs is not None:
+            record["obs"] = obs
     with open(RUNS_JOURNAL, "a") as f:
         f.write(json.dumps(record) + "\n")
 
@@ -1965,6 +2025,12 @@ def run_config_isolated(cfg: str, args, runner=None) -> dict:
     # the child must NOT inherit isolate mode, or it would recursively
     # re-spawn itself for its single config
     env.pop("BENCH_ISOLATE", None)
+    # each child exports its own trace at exit — on the shared parent
+    # path successive configs would overwrite each other, so fan the
+    # trace out to one file per config
+    if env.get("BENCH_TRACE_OUT"):
+        base, ext = os.path.splitext(env["BENCH_TRACE_OUT"])
+        env["BENCH_TRACE_OUT"] = f"{base}.config{cfg}{ext or '.json'}"
     retried = False
     proc = None
     for attempt in (1, 2):
@@ -2003,10 +2069,15 @@ def run_config_isolated(cfg: str, args, runner=None) -> dict:
 def run_config(cfg: str, rows, pair: bool = True):
     import jax
 
+    from sntc_tpu.obs.trace import span
     from sntc_tpu.parallel.context import get_default_mesh
 
     mesh = get_default_mesh()
-    result = BENCHES[cfg](rows or DEFAULT_ROWS[cfg], mesh)
+    # phase span (replaces the dormant utils.profiling.StepTimer): one
+    # span per config run on the process tracer when BENCH_TRACE_OUT
+    # armed it — nested engine/ingest spans land inside it
+    with span("bench.config", config=cfg):
+        result = BENCHES[cfg](rows or DEFAULT_ROWS[cfg], mesh)
     train, test = result.pop("_datasets", (None, None))
     line = {
         "metric": result["metric"],
@@ -2135,6 +2206,18 @@ def main():
 
     enable_persistent_cache()
 
+    # the metrics plane rides every bench run (each journal record
+    # carries its window's registry delta); BENCH_TRACE_OUT=<path>
+    # additionally arms the span tracer and exports the whole sweep's
+    # host-stage timeline as Chrome-trace JSON at exit
+    from sntc_tpu.obs import install_event_metrics
+
+    install_event_metrics()
+    if os.environ.get("BENCH_TRACE_OUT"):
+        from sntc_tpu.obs import enable_tracing
+
+        enable_tracing()
+
     if args.mfu:
         from sntc_tpu.parallel.context import get_default_mesh
 
@@ -2168,8 +2251,22 @@ def main():
             resilience = _resilience_summary()
             if resilience is not None:
                 line["resilience"] = resilience
+        # same discipline for the registry delta: fold it into the
+        # PRINTED line so an --isolate child ships its obs evidence
+        # through stdout (the parent's registry never saw its counters)
+        if "obs" not in line:
+            obs = _obs_summary()
+            if obs is not None:
+                line["obs"] = obs
         _journal_run(cfg, line)
         print(json.dumps(line), flush=True)
+
+    if os.environ.get("BENCH_TRACE_OUT"):
+        from sntc_tpu.obs import tracer
+
+        t = tracer()
+        if t is not None:
+            t.export_chrome_trace(os.environ["BENCH_TRACE_OUT"])
 
 
 if __name__ == "__main__":

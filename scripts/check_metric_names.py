@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Static drift check: metric names across code ⇔ CATALOG ⇔ docs.
+
+The telemetry substrate (``sntc_tpu.obs``) declares every metric the
+codebase may emit in ``obs.metrics.CATALOG`` — name, type, labels,
+help.  Three things must stay in lockstep or the plane silently rots:
+
+1. **code → CATALOG**: every ``"sntc_*"`` metric-name literal used in
+   the source must be declared (the registry enforces this at runtime
+   too, but a dynamic-only check fires after the regression shipped);
+2. **CATALOG → code**: every declared metric must be emitted somewhere
+   — an unemitted catalog row is dead telemetry documentation;
+3. **CATALOG ⇔ docs**: ``docs/OBSERVABILITY.md`` carries a
+   marker-delimited metric-catalog table; every cataloged name must
+   have a row and every row must name a cataloged metric, with the
+   documented type matching.
+
+Wired as a tier-1 test (``tests/test_obs.py``), the same discipline as
+``check_tenant_flags.py`` / ``check_fault_sites.py``.
+
+Exit 0 when consistent; exit 1 with a per-name report otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DOC = "docs/OBSERVABILITY.md"
+TABLE_BEGIN = "<!-- metric-catalog:begin -->"
+TABLE_END = "<!-- metric-catalog:end -->"
+README_NEEDLE = "--metrics-out"
+
+#: files/dirs scanned for metric-name literals (code emitters)
+CODE_ROOTS = ("sntc_tpu", "bench.py", "scripts")
+
+# metric names end in a unit/kind suffix by convention (the registry
+# enforces CATALOG membership at runtime; this narrows the static scan
+# past unrelated "sntc_*" literals like the package name itself)
+_NAME_RE = re.compile(
+    r'"(sntc_[a-z0-9_]+_(?:total|seconds|bytes|state|deficit|'
+    r'divergence))"'
+)
+
+
+def _read(rel: str) -> str:
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def _code_names() -> set:
+    """Every sntc_* string literal in the scanned sources, except the
+    CATALOG declaration file itself and this checker."""
+    names = set()
+    skip = {
+        os.path.join(REPO, "sntc_tpu", "obs", "metrics.py"),
+        os.path.abspath(__file__),
+    }
+    for root in CODE_ROOTS:
+        path = os.path.join(REPO, root)
+        files = []
+        if os.path.isfile(path):
+            files = [path]
+        else:
+            for dirpath, _dirs, fnames in os.walk(path):
+                if "__pycache__" in dirpath:
+                    continue
+                files.extend(
+                    os.path.join(dirpath, f)
+                    for f in fnames
+                    if f.endswith(".py")
+                )
+        for f in files:
+            if os.path.abspath(f) in skip:
+                continue
+            with open(f) as fh:
+                names.update(_NAME_RE.findall(fh.read()))
+    return names
+
+
+def _doc_rows() -> dict:
+    """name -> documented type, from the marker-delimited table."""
+    text = _read(DOC)
+    if TABLE_BEGIN not in text or TABLE_END not in text:
+        return {}
+    table = text.split(TABLE_BEGIN, 1)[1].split(TABLE_END, 1)[0]
+    rows = {}
+    for line in table.splitlines():
+        m = re.match(r"\s*\|\s*`(sntc_[a-z0-9_]+)`\s*\|\s*(\w+)", line)
+        if m:
+            rows[m.group(1)] = m.group(2)
+    return rows
+
+
+def check() -> list:
+    """Returns human-readable drift complaints (empty = consistent)."""
+    problems = []
+    sys.path.insert(0, REPO)
+    from sntc_tpu.obs.metrics import CATALOG
+
+    code = _code_names()
+    doc = _doc_rows()
+    if not doc:
+        problems.append(
+            f"{DOC} is missing the marker-delimited metric-catalog "
+            f"table ({TABLE_BEGIN} ... {TABLE_END})"
+        )
+    for name in sorted(code - set(CATALOG)):
+        problems.append(
+            f"code emits {name!r} but obs.metrics.CATALOG does not "
+            "declare it"
+        )
+    for name in sorted(set(CATALOG) - code):
+        problems.append(
+            f"CATALOG declares {name!r} but no code emits it — dead "
+            "telemetry declaration"
+        )
+    for name, spec in sorted(CATALOG.items()):
+        if doc and name not in doc:
+            problems.append(
+                f"CATALOG metric {name!r} missing from the {DOC} "
+                "catalog table"
+            )
+        elif doc and doc[name] != spec["type"]:
+            problems.append(
+                f"{name!r}: docs say type {doc[name]!r}, CATALOG says "
+                f"{spec['type']!r}"
+            )
+    for name in sorted(set(doc) - set(CATALOG)):
+        problems.append(
+            f"{DOC} documents {name!r} but CATALOG does not declare it"
+        )
+    if README_NEEDLE not in _read("README.md"):
+        problems.append(
+            "README.md has no --metrics-out observability quickstart"
+        )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("metric-name drift detected:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    from sntc_tpu.obs.metrics import CATALOG
+
+    print(
+        f"ok: {len(CATALOG)} metrics consistent across code, "
+        "obs.metrics.CATALOG, and docs/OBSERVABILITY.md"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -21,11 +21,66 @@ unchanged; ``synth`` writes schema-identical synthetic days.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import sys
 import time
 
 TRAIN_DEFAULT_LAYERS = "78,64,15"
+
+
+def _obs_start(args) -> None:
+    """Arm the telemetry surfaces a command requested (before any
+    work): ``--trace-out`` enables the span tracer for the process."""
+    if getattr(args, "trace_out", None):
+        from sntc_tpu.obs import enable_tracing
+
+        enable_tracing()
+
+
+def _obs_finish(args) -> None:
+    """Publish the telemetry a command requested: the Prometheus text
+    snapshot (``--metrics-out``, atomic) and the Chrome-trace/Perfetto
+    span export (``--trace-out``)."""
+    if getattr(args, "metrics_out", None):
+        from sntc_tpu.obs import registry
+
+        registry().write_prometheus(args.metrics_out)
+    if getattr(args, "trace_out", None):
+        from sntc_tpu.obs import tracer
+
+        t = tracer()
+        if t is not None:
+            t.export_chrome_trace(args.trace_out)
+
+
+def _device_trace_ctx(args):
+    """``--device-trace DIR``: a jax.profiler capture around the run
+    (XLA op timeline for Perfetto/TensorBoard) — device time next to
+    the host spans.  A no-op context when the flag is unset."""
+    if getattr(args, "device_trace", None):
+        from sntc_tpu.obs import device_trace
+
+        return device_trace(args.device_trace)
+    return contextlib.nullcontext()
+
+
+def _add_obs_flags(p, device: bool = True):
+    p.add_argument("--metrics-out", default=None, metavar="PATH",
+                   help="write the process metrics registry as a "
+                   "Prometheus text snapshot here (atomic; "
+                   "serve-daemon republishes it every scheduling "
+                   "round, other commands at exit) — see "
+                   "docs/OBSERVABILITY.md")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="arm the span tracer and export the host-stage "
+                   "timeline as Chrome-trace JSON here at exit "
+                   "(loadable in chrome://tracing / ui.perfetto.dev)")
+    if device:
+        p.add_argument("--device-trace", default=None, metavar="DIR",
+                       help="additionally capture a jax.profiler "
+                       "(XLA op-level) trace of the run into DIR "
+                       "for TensorBoard/Perfetto")
 
 
 def _build_estimator(name: str, mesh, args):
@@ -128,14 +183,28 @@ def _load_data(args):
 
 
 def cmd_train(args) -> int:
+    from sntc_tpu.parallel.context import get_default_mesh
+
+    _obs_start(args)
+    mesh = get_default_mesh()
+    # telemetry publishes in finally: a crashed fit is exactly the run
+    # whose partial metrics/spans the operator armed --metrics-out /
+    # --trace-out to see (same contract as the serve/daemon paths)
+    try:
+        return _cmd_train_body(args, mesh)
+    finally:
+        _obs_finish(args)
+
+
+def _cmd_train_body(args, mesh) -> int:
     from sntc_tpu.core.base import Pipeline
     from sntc_tpu.data import CICIDS2017_FEATURES
     from sntc_tpu.evaluation import MulticlassClassificationEvaluator
     from sntc_tpu.mlio import save_model
-    from sntc_tpu.parallel.context import get_default_mesh
+    from sntc_tpu.obs import span
 
-    mesh = get_default_mesh()
-    df = _load_data(args)
+    with span("train.load_data"):
+        df = _load_data(args)
     train, test = df.random_split(
         [1 - args.test_fraction, args.test_fraction], seed=args.seed
     )
@@ -169,11 +238,15 @@ def cmd_train(args) -> int:
         est.set("featuresCol", args.features_col)
     pipe = Pipeline(stages=_feature_stages(mesh, args, with_scaler) + [est])
     t0 = time.perf_counter()
-    model = pipe.fit(train)
+    with _device_trace_ctx(args), span(
+        "train.fit", estimator=args.estimator
+    ):
+        model = pipe.fit(train)
     fit_s = time.perf_counter() - t0
-    f1 = MulticlassClassificationEvaluator(
-        metricName=args.metric, mesh=mesh
-    ).evaluate(model.transform(test))
+    with span("train.evaluate"):
+        f1 = MulticlassClassificationEvaluator(
+            metricName=args.metric, mesh=mesh
+        ).evaluate(model.transform(test))
     if args.model_out:
         save_model(model, args.model_out)
     print(json.dumps({
@@ -284,6 +357,7 @@ def cmd_serve(args) -> int:
         StreamingQuery,
     )
 
+    _obs_start(args)
     model = load_model(args.model)
     raw_model = model  # persistable form: the lifecycle publish target
     # model lifecycle (r11): any of the drift / shadow-promotion /
@@ -407,7 +481,13 @@ def cmd_serve(args) -> int:
         lifecycle=lifecycle,
     )
     if args.once:
-        n = q.process_available()
+        try:
+            with _device_trace_ctx(args):
+                n = q.process_available()
+        finally:
+            # publish even when the drain crashed — the partial
+            # metrics/trace are the debugging evidence
+            _obs_finish(args)
         print(json.dumps({"batches": n}))
         return 0
     # supervised loop: SIGTERM (and Ctrl-C) drains — finish in-flight
@@ -425,11 +505,13 @@ def cmd_serve(args) -> int:
           f"(checkpoint {args.checkpoint}); SIGTERM/Ctrl-C drains",
           file=sys.stderr)
     try:
-        status = sup.run(poll_interval=args.poll_interval)
+        with _device_trace_ctx(args):
+            status = sup.run(poll_interval=args.poll_interval)
     except KeyboardInterrupt:
         status = sup.drain_now("KeyboardInterrupt")
     finally:
         sup.close()  # unsubscribe the health monitor from the event bus
+        _obs_finish(args)
     print(json.dumps({
         "batches": status["engine"]["batches_done"],
         "drained": status["drained"],
@@ -456,6 +538,7 @@ def cmd_serve_daemon(args) -> int:
     from sntc_tpu.resilience import RetryPolicy
     from sntc_tpu.serve import ServeDaemon, TenantSpec
 
+    _obs_start(args)
     with open(args.tenants) as f:
         doc = json.load(f)
     entries = doc["tenants"] if isinstance(doc, dict) else doc
@@ -521,10 +604,12 @@ def cmd_serve_daemon(args) -> int:
         shape_buckets=args.shape_buckets,
         pipeline_depth=args.pipeline_depth,
         health_json=args.health_json,
+        metrics_out=args.metrics_out,
     )
     try:
         if args.once:
-            n = daemon.process_available()
+            with _device_trace_ctx(args):
+                n = daemon.process_available()
             # the --once pass IS the warmup; the drain that follows
             # must not compile anything new on the shared cache
             daemon.mark_warm()
@@ -538,7 +623,10 @@ def cmd_serve_daemon(args) -> int:
                 file=sys.stderr,
             )
             try:
-                status = daemon.run(poll_interval=args.poll_interval)
+                with _device_trace_ctx(args):
+                    status = daemon.run(
+                        poll_interval=args.poll_interval
+                    )
             except KeyboardInterrupt:
                 daemon.request_drain("KeyboardInterrupt")
                 daemon.drain()
@@ -546,6 +634,7 @@ def cmd_serve_daemon(args) -> int:
             n = status["aggregate"]["batches_done"]
     finally:
         daemon.close()
+        _obs_finish(args)
     print(json.dumps({
         "batches": n,
         "tenants": {
@@ -603,6 +692,7 @@ def main(argv=None) -> int:
     p.add_argument("--chisq-top", type=int, default=0,
                    help="if > 0, use ChiSqSelector(k) instead of the scaler")
     p.add_argument("--features-col", default="features")
+    _add_obs_flags(p)
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("evaluate", help="evaluate a saved model on CSVs")
@@ -702,6 +792,7 @@ def main(argv=None) -> int:
                    help="failed rounds before a poison batch is "
                    "dead-lettered and committed; 0 = first failure "
                    "kills the query (pre-r6 semantics)")
+    _add_obs_flags(p)
     add_platform_arg(p)
     p.set_defaults(fn=cmd_serve)
 
@@ -782,6 +873,7 @@ def main(argv=None) -> int:
                    help="atomically rewrite the daemon status dump "
                    "(per-tenant states, compile ledger, health, "
                    "breakers) here every scheduling round")
+    _add_obs_flags(p)
     add_platform_arg(p)
     p.set_defaults(fn=cmd_serve_daemon)
 
@@ -811,6 +903,12 @@ def main(argv=None) -> int:
     from sntc_tpu.utils.compile_cache import enable_persistent_cache
 
     enable_persistent_cache()
+    # every CLI gets the metrics plane: the event→metrics bridge folds
+    # whatever the command emits (engines install it themselves, but
+    # train/evaluate emit too — CV retries, checkpoint fallbacks)
+    from sntc_tpu.obs import install_event_metrics
+
+    install_event_metrics()
     return args.fn(args)
 
 

@@ -26,6 +26,8 @@ from sntc_tpu.data.schema import (
     normalize_feature_name,
     normalize_label,
 )
+from sntc_tpu.obs.metrics import inc
+from sntc_tpu.obs.trace import span
 from sntc_tpu.resilience import data_fault_armed, fault_data
 
 
@@ -82,7 +84,8 @@ def load_csv(
 
     bad_rows: List[tuple] = []
     try:
-        table = _parse(single_thread=False, bad=bad_rows)
+        with span("ingest.parse", file=os.path.basename(path)):
+            table = _parse(single_thread=False, bad=bad_rows)
     except pa.ArrowInvalid as e:
         # rare path: re-parse single-threaded so the error can NAME the
         # line (the parallel reader cannot attribute row numbers)
@@ -116,6 +119,8 @@ def load_csv(
                     "detail": f"{actual} fields, expected {expected}",
                 }
             )
+    inc("sntc_ingest_files_parsed_total")
+    inc("sntc_ingest_rows_parsed_total", table.num_rows)
     names = [normalize_feature_name(c) for c in table.column_names]
     # Real MachineLearningCVE day files contain 'Fwd Header Length' TWICE;
     # pandas-style dedup (second copy -> '.1') matches the schema's

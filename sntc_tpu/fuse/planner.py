@@ -44,6 +44,7 @@ stage reads is kept automatically.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Iterable, List, Optional, Sequence, Tuple
 
@@ -61,7 +62,9 @@ from sntc_tpu.fuse.registry import (
 )
 from sntc_tpu.fuse.rules import fold_scalers
 from sntc_tpu.models.base import ClassificationModel
-from sntc_tpu.utils.profiling import transfer_ledger
+from sntc_tpu.obs.metrics import inc
+from sntc_tpu.obs.trace import span
+from sntc_tpu.utils.profiling import active_ledgers
 
 
 def _fusible_head(stage) -> bool:
@@ -102,6 +105,10 @@ class FusedSegment(Transformer):
         self.compile_events = 0  # distinct input signatures compiled
         self.invocations = 0  # fused dispatches
         self.fallbacks = 0  # eager fallbacks (empty/dtype-gated)
+        # SNTC_OBS_COST_ANALYSIS=1: XLA cost_analysis() per compiled
+        # signature (flops / bytes accessed), keyed by signature repr —
+        # the device-cost side of the obs span correlation
+        self.cost_analyses: dict = {}
         # per-SEGMENT transfer counters: fusion_stats() aggregates these
         # per model, so one engine's evidence is never polluted by other
         # fused models in the process (the global ledger stays the
@@ -245,10 +252,30 @@ class FusedSegment(Transformer):
             donate_argnums=tuple(range(len(names))) if donate else (),
         )
         with self._lock:
-            if sig not in self._programs:
+            fresh = sig not in self._programs
+            if fresh:
                 self._programs[sig] = prog
                 self.compile_events += 1
             prog = self._programs[sig]
+        if fresh:
+            inc("sntc_fuse_compile_events_total")
+            if os.environ.get("SNTC_OBS_COST_ANALYSIS"):
+                # device-cost hook (opt-in — it compiles the program
+                # eagerly): XLA's own FLOPs/bytes estimate for this
+                # signature, correlatable with the host fuse.* spans
+                try:
+                    cost = prog.lower(*args).compile().cost_analysis()
+                    if isinstance(cost, (list, tuple)):
+                        cost = cost[0] if cost else {}
+                    self.cost_analyses[repr(sig[0])] = {
+                        k: float(v)
+                        for k, v in dict(cost or {}).items()
+                        if isinstance(v, (int, float))
+                        and k in ("flops", "bytes accessed",
+                                  "transcendentals")
+                    }
+                except Exception:
+                    self.cost_analyses[repr(sig[0])] = None
         return prog
 
     def _transform_eager(self, frame: Frame) -> Frame:
@@ -266,22 +293,31 @@ class FusedSegment(Transformer):
         args = self._bind(frame) if frame.num_rows else None
         if args is None:
             self.fallbacks += 1
+            inc("sntc_fuse_fallbacks_total")
             out = self._transform_eager(frame)
             return lambda: out
         prog = self._program(args)
-        ledger = transfer_ledger()
-        ledger.record_uploads(len(args), sum(a.nbytes for a in args))
-        outs = prog(*args)  # async dispatch; finalize materializes
+        # snapshot the ledgers to record into AT DISPATCH TIME: the
+        # engine scopes its own (per-tenant) ledger on its thread, and
+        # the finalize closure below may run on the delivery thread —
+        # capturing here keeps attribution correct across threads
+        ledgers = active_ledgers()
+        up_bytes = sum(a.nbytes for a in args)
+        for led in ledgers:
+            led.record_uploads(len(args), up_bytes)
+        with span("fuse.dispatch", args=len(args)):
+            outs = prog(*args)  # async dispatch; finalize materializes
         with self._lock:
             self.invocations += 1
             self.uploads += len(args)
         head, live = self._head, self._live_writes
 
         def finalize() -> Frame:
-            host = [np.asarray(o) for o in outs]
-            ledger.record_downloads(
-                len(host), sum(h.nbytes for h in host)
-            )
+            with span("fuse.finalize"):
+                host = [np.asarray(o) for o in outs]
+            down_bytes = sum(h.nbytes for h in host)
+            for led in ledgers:
+                led.record_downloads(len(host), down_bytes)
             with self._lock:
                 self.downloads += len(host)
             out_frame = frame
@@ -414,7 +450,7 @@ def fusion_stats(model) -> Optional[dict]:
     segs = fused_segments(model)
     if not segs:
         return None
-    return {
+    out = {
         "segments": len(segs),
         "fused_stages": sum(len(s.fused_stages) for s in segs),
         "compile_events": sum(s.compile_events for s in segs),
@@ -423,3 +459,14 @@ def fusion_stats(model) -> Optional[dict]:
         "uploads": sum(s.uploads for s in segs),
         "downloads": sum(s.downloads for s in segs),
     }
+    # keyed per SEGMENT: two segments can compile identically-shaped
+    # signatures, and a flat sig-keyed merge would attribute one
+    # segment's device cost to the other
+    costs = {
+        f"segment{i}:{sig}": cost
+        for i, s in enumerate(segs)
+        for sig, cost in s.cost_analyses.items()
+    }
+    if costs:  # present only under SNTC_OBS_COST_ANALYSIS=1
+        out["cost_analysis"] = costs
+    return out

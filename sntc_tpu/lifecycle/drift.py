@@ -169,6 +169,14 @@ class DriftMonitor:
             js_divergence(ref_hist, cur_hist),
         )
         self.last_divergence = div
+        try:  # live divergence on the metrics plane (obs)
+            from sntc_tpu.obs.metrics import set_gauge
+
+            set_gauge(
+                "sntc_drift_divergence", div, component=self.component
+            )
+        except Exception:
+            pass
         if div > self.threshold and not self.detected:
             self.detected = True
             self.detected_batch = stats.get("batch_id")

@@ -1,0 +1,108 @@
+"""Event-stream → metrics bridge: the consolidation glue.
+
+Every resilience/lifecycle/tenancy subsystem already narrates itself
+through ``sntc_tpu.resilience.emit_event`` — retries, breaker
+transitions, quarantines, load sheds, rejected rows, drift episodes,
+health changes, fault injections, tenant ladder moves.  Instead of
+teaching each emitter about the registry, ONE observer folds the whole
+stream into named metrics:
+
+* every event counts into ``sntc_events_total{event, site, tenant}``
+  (tenant-namespaced sites are split: ``tenant/a/sink.write`` becomes
+  ``site="sink.write", tenant="a"`` so series stay low-cardinality and
+  tenant-aggregable);
+* events carrying quantities get dedicated counters — ``rows_rejected``
+  reasons, ``load_shed`` offsets, ``quarantine`` batches.
+
+The observer NEVER raises (``emit_event`` evicts raising observers, and
+losing the metrics plane to one malformed record would be worse than
+missing the record): internal failures are counted on the bridge and
+inspectable via :func:`bridge_errors`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict
+
+from sntc_tpu.obs.metrics import inc
+
+_installed = False
+_install_lock = threading.Lock()
+_errors = 0
+
+
+def split_tenant_site(record: Dict[str, Any]):
+    """(site, tenant) for one event record: the explicit ``tenant``
+    field wins; a ``tenant/<id>/<site>`` site is split so the bare site
+    name and the tenant label stay separately aggregable."""
+    site = record.get("site") or ""
+    tenant = record.get("tenant") or ""
+    if isinstance(site, str) and site.startswith("tenant/"):
+        parts = site.split("/", 2)
+        if len(parts) == 3:
+            tenant = tenant or parts[1]
+            site = parts[2]
+    return site, tenant
+
+
+def _observe(record: Dict[str, Any]) -> None:
+    global _errors
+    try:
+        event = record.get("event")
+        if not event:
+            return
+        site, tenant = split_tenant_site(record)
+        labels: Dict[str, str] = {"event": str(event)}
+        if site:
+            labels["site"] = str(site)
+        if tenant:
+            labels["tenant"] = str(tenant)
+        inc("sntc_events_total", 1, **labels)
+        tlabel = {"tenant": str(tenant)} if tenant else {}
+        if event == "rows_rejected":
+            reasons = record.get("reasons")
+            if isinstance(reasons, dict) and reasons:
+                for reason, n in reasons.items():
+                    inc(
+                        "sntc_rows_rejected_total", int(n),
+                        reason=str(reason), **tlabel,
+                    )
+            else:
+                inc(
+                    "sntc_rows_rejected_total",
+                    int(record.get("count") or 0),
+                    reason="unknown", **tlabel,
+                )
+        elif event == "load_shed":
+            inc(
+                "sntc_shed_offsets_total",
+                int(record.get("offsets_shed") or 0), **tlabel,
+            )
+        elif event == "quarantine":
+            inc("sntc_batches_quarantined_total", 1, **tlabel)
+    except Exception:
+        _errors += 1
+
+
+def bridge_errors() -> int:
+    """Records the bridge failed to fold (malformed payloads) — the
+    bridge swallows them so ``emit_event`` never evicts it."""
+    return _errors
+
+
+def install_event_metrics() -> bool:
+    """Subscribe the bridge to the process event stream (idempotent;
+    returns True when this call did the install).  Called by every
+    entry point that starts emitting — engine/daemon construction, the
+    CLIs, bench — so ad-hoc embedders get the metrics plane without
+    asking for it."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return False
+        from sntc_tpu.resilience.policy import add_event_observer
+
+        add_event_observer(_observe)
+        _installed = True
+        return True

@@ -1,0 +1,497 @@
+"""Process-wide metrics registry: counters, gauges, fixed-bucket
+histograms, labels, Prometheus/JSONL exposition.
+
+Design constraints, in priority order:
+
+1. **Hot-path writes are cheap** — one dict lookup + one small lock per
+   increment.  The streaming engine calls :func:`inc` per micro-batch
+   (not per row), so the registry never shows up in a profile; bench
+   config 5 pins the whole substrate's overhead at ≤ 5% rows/s
+   (docs/OBSERVABILITY.md has the measured numbers).
+2. **Snapshots never block writers** — :meth:`MetricsRegistry.snapshot`
+   reads live series values without taking the write locks (CPython
+   makes each individual read atomic); a snapshot taken mid-increment
+   may be one tick stale on one series, never torn across the registry.
+3. **Bounded cardinality** — every metric holds at most
+   ``max_label_sets`` distinct label sets; beyond the cap, writes to
+   any further label set collapse into a reserved ``overflow="true"``
+   series and each such write is counted (:meth:`label_overflows`),
+   never silent.  A misbehaving label (a batch id, a file path)
+   degrades the one metric, not the process.
+4. **Deterministic in tests** — wall/monotonic clocks are injectable
+   per registry, so JSONL exposition records are assertable exactly.
+
+Every metric this codebase emits is declared in :data:`CATALOG` (name →
+type/help/labels/buckets) — the single source of truth that
+``docs/OBSERVABILITY.md`` documents and ``scripts/check_metric_names
+.py`` drift-checks against the code in tier-1.  Undeclared names are
+rejected: an unregistered metric is exactly the ad-hoc-ledger drift
+this package exists to end.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from bisect import bisect_left
+from typing import Any, Dict, List, Optional, Tuple
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+# seconds; covers sub-ms device dispatches through multi-second batches
+LATENCY_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0,
+)
+
+#: THE metric catalog: every name the codebase may emit, with its type,
+#: allowed labels, and help text.  ``scripts/check_metric_names.py``
+#: pins code ⇔ CATALOG ⇔ docs/OBSERVABILITY.md in tier-1.
+CATALOG: Dict[str, Dict[str, Any]] = {
+    # -- the structured event stream (obs.bridge) -------------------------
+    "sntc_events_total": dict(
+        type=COUNTER, labels=("event", "site", "tenant"),
+        help="Structured resilience/lifecycle events by name, site, "
+        "and tenant (the _emit/emit_event stream, consolidated).",
+    ),
+    "sntc_events_dropped_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Event-ring evictions (legacy view: events_dropped()).",
+    ),
+    "sntc_rows_rejected_total": dict(
+        type=COUNTER, labels=("reason", "tenant"),
+        help="Rows excised by data-plane admission, by reason code.",
+    ),
+    "sntc_shed_offsets_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Source offsets dropped by load shedding (shed journal).",
+    ),
+    "sntc_batches_quarantined_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Poison batches journaled to the dead-letter sink.",
+    ),
+    "sntc_faults_injected_total": dict(
+        type=COUNTER, labels=("site", "kind"),
+        help="Deterministic fault injections fired (SNTC_FAULTS).",
+    ),
+    # -- the serving engine -----------------------------------------------
+    "sntc_batches_committed_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Micro-batches committed to the WAL (incl. quarantined).",
+    ),
+    "sntc_rows_committed_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Input rows across committed micro-batches.",
+    ),
+    "sntc_batch_duration_seconds": dict(
+        type=HISTOGRAM, labels=("tenant",), buckets=LATENCY_BUCKETS,
+        help="WAL-intent→commit latency per micro-batch (the "
+        "recentProgress durationMs distribution).",
+    ),
+    "sntc_source_prefetch_hits_total": dict(
+        type=COUNTER, labels=(),
+        help="get_batch calls served from a staged prefetch read.",
+    ),
+    "sntc_source_prefetch_misses_total": dict(
+        type=COUNTER, labels=(),
+        help="get_batch calls that fell through to a synchronous read "
+        "while prefetch was armed.",
+    ),
+    # -- ingest -------------------------------------------------------------
+    "sntc_ingest_files_parsed_total": dict(
+        type=COUNTER, labels=(),
+        help="Source files parsed by load_csv.",
+    ),
+    "sntc_ingest_rows_parsed_total": dict(
+        type=COUNTER, labels=(),
+        help="Rows parsed out of source files by load_csv.",
+    ),
+    # -- predict / compile ledgers ------------------------------------------
+    "sntc_predict_compile_events_total": dict(
+        type=COUNTER, labels=(),
+        help="Distinct dispatched row shapes across BatchPredictors "
+        "(each costs at most one XLA compile; legacy view: "
+        "BatchPredictor.compile_events).",
+    ),
+    "sntc_predict_bucket_hits_total": dict(
+        type=COUNTER, labels=(),
+        help="Dispatches that reused an already-seen row shape.",
+    ),
+    "sntc_predict_padded_rows_total": dict(
+        type=COUNTER, labels=(),
+        help="Wasted rows shape-bucket padding cost.",
+    ),
+    "sntc_fuse_compile_events_total": dict(
+        type=COUNTER, labels=(),
+        help="Distinct input signatures compiled across FusedSegments.",
+    ),
+    "sntc_fuse_fallbacks_total": dict(
+        type=COUNTER, labels=(),
+        help="FusedSegment eager fallbacks (empty frame / dtype gate).",
+    ),
+    # -- host↔device transfers (utils.profiling.TransferLedger mirror) ------
+    "sntc_transfer_dispatches_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Fused-program dispatches (unlabeled series = the "
+        "process-global TransferLedger; tenant series = the "
+        "per-engine ledgers).",
+    ),
+    "sntc_transfer_uploads_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Host→device array uploads by fused dispatches.",
+    ),
+    "sntc_transfer_downloads_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Device→host output materializations by fused finalizes.",
+    ),
+    "sntc_transfer_upload_bytes_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Bytes uploaded host→device by fused dispatches.",
+    ),
+    "sntc_transfer_download_bytes_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Bytes materialized device→host by fused finalizes.",
+    ),
+    # -- health / breakers / drift -------------------------------------------
+    "sntc_health_state": dict(
+        type=GAUGE, labels=("component",),
+        help="Component health (0=OK, 1=DEGRADED, 2=UNHEALTHY).",
+    ),
+    "sntc_breaker_state": dict(
+        type=GAUGE, labels=("site",),
+        help="Circuit-breaker state (0=closed, 1=half_open, 2=open).",
+    ),
+    "sntc_drift_divergence": dict(
+        type=GAUGE, labels=("component",),
+        help="Latest Jensen-Shannon divergence the drift monitor saw.",
+    ),
+    # -- multi-tenant scheduler ----------------------------------------------
+    "sntc_daemon_ticks_total": dict(
+        type=COUNTER, labels=(),
+        help="ServeDaemon scheduling rounds.",
+    ),
+    "sntc_tenant_state": dict(
+        type=GAUGE, labels=("tenant",),
+        help="Tenant ladder state (0=OK, 1=THROTTLED, 2=QUARANTINED, "
+        "3=STOPPED).",
+    ),
+    "sntc_tenant_deficit": dict(
+        type=GAUGE, labels=("tenant",),
+        help="DRR scheduler deficit after the last round.",
+    ),
+    "sntc_tenant_strikes_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Unhealthy strikes counted against the tenant ladder.",
+    ),
+    # -- the tracer's own accounting -----------------------------------------
+    "sntc_spans_dropped_total": dict(
+        type=COUNTER, labels=(),
+        help="Spans evicted from the trace ring buffer.",
+    ),
+}
+
+_OVERFLOW_KEY: Tuple[Tuple[str, str], ...] = (("overflow", "true"),)
+
+
+class _Series:
+    """One label set of one metric.  Counters/gauges keep ``value``;
+    histograms keep per-bucket counts plus sum/count."""
+
+    __slots__ = ("labels", "value", "bucket_counts", "sum", "count")
+
+    def __init__(self, labels: Tuple[Tuple[str, str], ...],
+                 n_buckets: int = 0):
+        self.labels = labels
+        self.value = 0.0
+        self.bucket_counts = [0] * n_buckets if n_buckets else None
+        self.sum = 0.0
+        self.count = 0
+
+
+class MetricsRegistry:
+    """Registry of cataloged metrics (module docstring has the design).
+
+    ``clock``/``mono`` are the wall/monotonic time sources used by the
+    JSONL exposition — inject constants for deterministic test output.
+    ``max_label_sets`` caps per-metric label cardinality.
+    """
+
+    def __init__(
+        self,
+        *,
+        clock=time.time,
+        mono=time.monotonic,
+        max_label_sets: int = 64,
+    ):
+        self._clock = clock
+        self._mono = mono
+        self.max_label_sets = int(max_label_sets)
+        self._lock = threading.Lock()  # series creation only
+        # name -> (spec, {labelkey: _Series}, write lock)
+        self._metrics: Dict[str, Tuple[dict, Dict, threading.Lock]] = {}
+        self._label_overflows = 0
+        self._jsonl_records = 0
+
+    # -- series resolution ---------------------------------------------------
+
+    def _series(self, name: str, labels: Dict[str, str]) -> _Series:
+        entry = self._metrics.get(name)
+        if entry is None:
+            spec = CATALOG.get(name)
+            if spec is None:
+                raise KeyError(
+                    f"metric {name!r} is not declared in obs.metrics."
+                    "CATALOG — add it there (and to docs/OBSERVABILITY"
+                    ".md; scripts/check_metric_names.py enforces both)"
+                )
+            with self._lock:
+                entry = self._metrics.get(name)
+                if entry is None:
+                    entry = (spec, {}, threading.Lock())
+                    self._metrics[name] = entry
+        spec, series, lock = entry
+        if labels:
+            allowed = spec["labels"]
+            for k in labels:
+                if k not in allowed:
+                    raise KeyError(
+                        f"label {k!r} not declared for metric {name!r} "
+                        f"(allowed: {allowed})"
+                    )
+            key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        else:
+            key = ()
+        s = series.get(key)
+        if s is None:
+            with lock:
+                s = series.get(key)
+                if s is None:
+                    if key and len(series) >= self.max_label_sets:
+                        # cardinality cap: collapse into the reserved
+                        # overflow series (created on first breach).
+                        # The counter is registry-wide, so guard it
+                        # with the registry lock — two metrics
+                        # overflowing concurrently hold DIFFERENT
+                        # series locks (lock order metric→registry is
+                        # safe: creation never takes them nested the
+                        # other way)
+                        with self._lock:
+                            self._label_overflows += 1
+                        s = series.get(_OVERFLOW_KEY)
+                        if s is None:
+                            s = series[_OVERFLOW_KEY] = _Series(
+                                _OVERFLOW_KEY,
+                                len(spec.get("buckets", ())) + 1
+                                if spec["type"] == HISTOGRAM else 0,
+                            )
+                        return s
+                    s = series[key] = _Series(
+                        key,
+                        len(spec.get("buckets", ())) + 1
+                        if spec["type"] == HISTOGRAM else 0,
+                    )
+        return s
+
+    # -- write surface -------------------------------------------------------
+
+    def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
+        s = self._series(name, labels)
+        with self._metrics[name][2]:
+            s.value += value
+
+    def set_gauge(self, name: str, value: float, **labels: str) -> None:
+        s = self._series(name, labels)
+        lock = self._metrics[name][2]
+        with lock:
+            s.value = float(value)
+
+    def observe(self, name: str, value: float, **labels: str) -> None:
+        spec = CATALOG.get(name)
+        if spec is None or spec["type"] != HISTOGRAM:
+            raise KeyError(f"{name!r} is not a cataloged histogram")
+        s = self._series(name, labels)
+        lock = self._metrics[name][2]
+        buckets = spec["buckets"]
+        # bisect_left = first bound >= value, i.e. Prometheus le
+        # semantics; index len(buckets) is the +Inf bucket
+        i = bisect_left(buckets, value)
+        with lock:
+            s.bucket_counts[i] += 1
+            s.sum += value
+            s.count += 1
+
+    # -- read surface (lock-free) --------------------------------------------
+
+    def get(self, name: str, **labels: str) -> Optional[float]:
+        """Current value of one counter/gauge series (None when the
+        series does not exist yet)."""
+        entry = self._metrics.get(name)
+        if entry is None:
+            return None
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        s = entry[1].get(key)
+        return s.value if s is not None else None
+
+    def label_overflows(self) -> int:
+        """WRITES that landed on an overflow series (not distinct
+        evicted label sets — telling those apart would require storing
+        exactly the keys the cap exists to bound).  Nonzero means some
+        metric's labels exceeded ``max_label_sets``; the rate says how
+        hot the overflowing series are, not how many there were."""
+        return self._label_overflows
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Point-in-time copy of every live series — readers never take
+        the write locks (see module docstring, constraint 2)."""
+        out: Dict[str, Any] = {}
+        for name, (spec, series, _lock) in list(self._metrics.items()):
+            rows = []
+            for s in list(series.values()):
+                row: Dict[str, Any] = {"labels": dict(s.labels)}
+                if spec["type"] == HISTOGRAM:
+                    row["buckets"] = list(s.bucket_counts)
+                    row["sum"] = s.sum
+                    row["count"] = s.count
+                else:
+                    row["value"] = s.value
+                rows.append(row)
+            out[name] = {
+                "type": spec["type"],
+                "help": spec["help"],
+                "series": rows,
+            }
+            if spec["type"] == HISTOGRAM:
+                out[name]["bucket_bounds"] = list(spec["buckets"])
+        return out
+
+    # -- exposition ----------------------------------------------------------
+
+    @staticmethod
+    def _fmt_labels(labels, extra: str = "") -> str:
+        parts = [
+            '%s="%s"' % (
+                k,
+                str(v).replace("\\", r"\\").replace('"', r"\"")
+                .replace("\n", r"\n"),
+            )
+            for k, v in labels
+        ]
+        if extra:
+            parts.append(extra)
+        return "{%s}" % ",".join(parts) if parts else ""
+
+    @staticmethod
+    def _fmt_value(v: float) -> str:
+        return repr(int(v)) if float(v).is_integer() else repr(v)
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of every live
+        series, metrics sorted by name for diffable output."""
+        lines: List[str] = []
+        for name in sorted(self._metrics):
+            spec, series, _lock = self._metrics[name]
+            lines.append(f"# HELP {name} {spec['help']}")
+            lines.append(f"# TYPE {name} {spec['type']}")
+            for s in sorted(
+                list(series.values()), key=lambda s: s.labels
+            ):
+                if spec["type"] == HISTOGRAM:
+                    # snapshot the counts once so the cumulative sums
+                    # below cannot tear against concurrent observes
+                    counts = list(s.bucket_counts)
+                    acc = 0
+                    for bound, n in zip(spec["buckets"], counts):
+                        acc += n
+                        lines.append(
+                            f"{name}_bucket"
+                            + self._fmt_labels(
+                                s.labels, f'le="{bound}"'
+                            )
+                            + f" {acc}"
+                        )
+                    acc += counts[-1]
+                    lines.append(
+                        f"{name}_bucket"
+                        + self._fmt_labels(s.labels, 'le="+Inf"')
+                        + f" {acc}"
+                    )
+                    lines.append(
+                        f"{name}_sum" + self._fmt_labels(s.labels)
+                        + f" {self._fmt_value(s.sum)}"
+                    )
+                    lines.append(
+                        f"{name}_count" + self._fmt_labels(s.labels)
+                        + f" {acc}"
+                    )
+                else:
+                    lines.append(
+                        name + self._fmt_labels(s.labels)
+                        + f" {self._fmt_value(s.value)}"
+                    )
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write_prometheus(self, path: str) -> str:
+        """Atomically (tmp + rename) publish the Prometheus text dump —
+        a scraper/tailer never reads a torn snapshot."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.to_prometheus())
+        os.replace(tmp, path)
+        return path
+
+    def write_jsonl(self, path: str) -> Dict[str, Any]:
+        """Append one snapshot record (wall + monotonic timestamps from
+        the injectable clocks) to a JSONL file and return it."""
+        record = {
+            "ts": self._clock(),
+            "mono": self._mono(),
+            "seq": self._jsonl_records,
+            "metrics": self.snapshot(),
+        }
+        self._jsonl_records += 1
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+        return record
+
+
+# ---------------------------------------------------------------------------
+# the process default registry + module-level write helpers (hot paths
+# call these; swap the default out with set_registry for test isolation)
+# ---------------------------------------------------------------------------
+
+_default = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _default
+
+
+def set_registry(r: MetricsRegistry) -> MetricsRegistry:
+    """Replace the process default registry; returns the previous one."""
+    global _default
+    prev, _default = _default, r
+    return prev
+
+
+def reset_registry() -> MetricsRegistry:
+    """Fresh default registry (test isolation); returns the new one."""
+    set_registry(MetricsRegistry())
+    return _default
+
+
+def inc(name: str, value: float = 1.0, **labels: str) -> None:
+    _default.inc(name, value, **labels)
+
+
+def set_gauge(name: str, value: float, **labels: str) -> None:
+    _default.set_gauge(name, value, **labels)
+
+
+def observe(name: str, value: float, **labels: str) -> None:
+    _default.observe(name, value, **labels)

@@ -1,0 +1,253 @@
+"""Span tracer — host-side stage timing on a ring buffer, exported as
+Chrome-trace/Perfetto JSON.
+
+``obs.span("stage.name", **attrs)`` wraps a hot-path stage; each closed
+span records (name, monotonic start, duration, wall start, thread id,
+attrs) onto a bounded ring.  Tracing is OFF by default and the disabled
+path is one attribute read + one dict build — the engine's hot paths
+carry the calls permanently without measurable cost (bench config 5
+pins the ≤ 5% overhead budget, docs/OBSERVABILITY.md has the numbers).
+
+:meth:`SpanTracer.export_chrome_trace` writes the ring as Chrome
+``traceEvents`` JSON, loadable in ``chrome://tracing`` and
+https://ui.perfetto.dev — every span a complete ("X") event on its
+thread's track.  Ring overflow drops the OLDEST spans and counts them
+(``sntc_spans_dropped_total``), never silently.
+
+Device-side correlation hooks (both opt-in — they cost real time):
+
+* :func:`device_trace` — a ``jax.profiler`` trace context (XLA op-level
+  timeline for TensorBoard/Perfetto) around any region; the serve CLIs
+  expose it as ``--device-trace DIR``.
+* ``SNTC_OBS_COST_ANALYSIS=1`` — the fusion planner additionally runs
+  XLA's compiled-program ``cost_analysis()`` per compiled signature and
+  keeps the FLOPs/bytes estimates on the segment
+  (``fusion_stats()["cost_analysis"]``), so host spans can be compared
+  against what the program *should* cost on the device.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from sntc_tpu.obs.metrics import inc
+
+
+class _NullSpan:
+    """Shared no-op context manager for the tracing-disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span: records itself on exit (exceptions included —
+    a failing stage's time is exactly the time worth seeing)."""
+
+    __slots__ = ("_tracer", "name", "attrs", "_t0", "_wall0")
+
+    def __init__(self, tracer: "SpanTracer", name: str, attrs):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._wall0 = self._tracer._wall()
+        self._t0 = self._tracer._clock()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._tracer._record(
+            self.name,
+            self._t0,
+            self._tracer._clock() - self._t0,
+            self._wall0,
+            threading.get_ident(),
+            self.attrs,
+        )
+        return False
+
+
+class SpanTracer:
+    """Bounded ring of closed spans (thread-safe; injectable clocks).
+
+    ``capacity`` bounds memory for the life of the process; overflow
+    evicts oldest and counts ``dropped``.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 65_536,
+        *,
+        clock=time.perf_counter,
+        wall=time.time,
+    ):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self._clock = clock
+        self._wall = wall
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity)
+        self.dropped = 0
+
+    def span(self, name: str, **attrs: Any) -> _Span:
+        return _Span(self, name, attrs or None)
+
+    def _record(self, name, t0, dur, wall0, tid, attrs) -> None:
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+                try:
+                    inc("sntc_spans_dropped_total")
+                except Exception:
+                    pass
+            self._ring.append((name, t0, dur, wall0, tid, attrs))
+
+    def spans(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            ring = list(self._ring)
+        return [
+            {
+                "name": name, "t0": t0, "dur_s": dur, "wall": wall0,
+                "tid": tid, "attrs": attrs or {},
+            }
+            for name, t0, dur, wall0, tid, attrs in ring
+        ]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "spans": len(self._ring),
+                "capacity": self.capacity,
+                "dropped": self.dropped,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+    def export_chrome_trace(self, path: str) -> str:
+        """Write the ring as Chrome trace-event JSON (``ph: "X"``
+        complete events, µs timestamps) — loadable in chrome://tracing
+        and ui.perfetto.dev.  Atomic publish (tmp + rename)."""
+        with self._lock:
+            ring = list(self._ring)
+        pid = os.getpid()
+        thread_names = {
+            t.ident: t.name for t in threading.enumerate()
+            if t.ident is not None
+        }
+        events: List[Dict[str, Any]] = []
+        for tid, tname in sorted(thread_names.items()):
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid,
+                "tid": tid, "args": {"name": tname},
+            })
+        for name, t0, dur, wall0, tid, attrs in ring:
+            ev: Dict[str, Any] = {
+                "name": name, "cat": "host", "ph": "X",
+                "ts": round(t0 * 1e6, 3),
+                "dur": round(dur * 1e6, 3),
+                "pid": pid, "tid": tid,
+            }
+            args = dict(attrs) if attrs else {}
+            args["wall_ts"] = wall0
+            ev["args"] = args
+            events.append(ev)
+        doc = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "tool": "sntc_tpu.obs",
+                "dropped_spans": self.dropped,
+            },
+        }
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# the process tracer: disabled (None) by default; span() is the
+# permanent hot-path call site
+# ---------------------------------------------------------------------------
+
+_tracer: Optional[SpanTracer] = None
+
+
+def span(name: str, **attrs: Any):
+    """``with obs.span("stream.read", batch=3): ...`` — records onto
+    the process tracer when enabled, a shared no-op otherwise."""
+    t = _tracer
+    if t is None:
+        return _NULL_SPAN
+    return t.span(name, **attrs)
+
+
+def tracer() -> Optional[SpanTracer]:
+    return _tracer
+
+
+def tracing_enabled() -> bool:
+    return _tracer is not None
+
+
+def enable_tracing(capacity: int = 65_536, **kwargs: Any) -> SpanTracer:
+    """Arm the process tracer (idempotent: an already-armed tracer is
+    returned unchanged unless a new capacity is requested)."""
+    global _tracer
+    if _tracer is None or _tracer.capacity != capacity:
+        _tracer = SpanTracer(capacity, **kwargs)
+    return _tracer
+
+
+def disable_tracing() -> Optional[SpanTracer]:
+    """Disarm and return the tracer (its ring stays readable)."""
+    global _tracer
+    t, _tracer = _tracer, None
+    return t
+
+
+# ---------------------------------------------------------------------------
+# device-side correlation (opt-in)
+# ---------------------------------------------------------------------------
+
+
+class device_trace:
+    """``with device_trace(log_dir):`` — a ``jax.profiler`` capture
+    (XLA op-level Perfetto/TensorBoard timeline) around the block, so
+    device time lines up with the host spans recorded inside it.
+    Expensive; the serve CLIs gate it behind ``--device-trace DIR``."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+
+    def __enter__(self):
+        import jax
+
+        jax.profiler.start_trace(self.log_dir)
+        return self
+
+    def __exit__(self, *exc):
+        import jax
+
+        jax.profiler.stop_trace()
+        return False

@@ -107,8 +107,19 @@ class CircuitBreaker:
 
     # -- state machine ------------------------------------------------------
 
+    _STATE_GAUGE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
     def _transition(self, new_state: str, **fields: Any) -> None:
         old, self._state = self._state, new_state
+        try:  # live state on the metrics plane (obs), never fatally
+            from sntc_tpu.obs.metrics import set_gauge
+
+            set_gauge(
+                "sntc_breaker_state", self._STATE_GAUGE[new_state],
+                site=self.site,
+            )
+        except Exception:
+            pass
         emit_event(
             event=f"breaker_{new_state}", site=self.site, from_state=old,
             **fields,

@@ -293,6 +293,18 @@ def _sync_env() -> None:
     _env_installed = raw
 
 
+def _count_injection(site: str, kind: str) -> None:
+    """Mirror one fired injection into the metrics plane (obs) — chaos
+    evidence next to the production counters it perturbs.  Never fatal:
+    the injection itself is the point, not its accounting."""
+    try:
+        from sntc_tpu.obs.metrics import inc
+
+        inc("sntc_faults_injected_total", site=site, kind=kind)
+    except Exception:
+        pass
+
+
 def fault_point(site: str, tenant: Optional[str] = None) -> None:
     """The per-site hook real code calls; raises when armed + scheduled.
     A spec armed with a DATA kind is inert here — byte corruption only
@@ -317,6 +329,7 @@ def fault_point(site: str, tenant: Optional[str] = None) -> None:
         fire = spec.decide()
         call = spec.calls
     if fire:
+        _count_injection(site, spec.kind)
         emit_event(
             event="fault_injected", site=site, kind=spec.kind, call=call
         )
@@ -407,6 +420,7 @@ def fault_data(site: str, data: bytes) -> bytes:
         spec.raised += 1
     draws = rng.uniform(size=2 * max(1, len(data) // 64))
     mutated = _mutate(spec.kind, data, draws)
+    _count_injection(site, spec.kind)
     emit_event(
         event="fault_injected", site=site, kind=spec.kind, call=call,
         bytes_in=len(data), bytes_out=len(mutated),

@@ -112,7 +112,11 @@ class HealthMonitor:
     def report(
         self, component: str, state: HealthState, reason: str = ""
     ) -> None:
-        """Set ``component``'s state; emits ``health_changed`` on change."""
+        """Set ``component``'s state; emits ``health_changed`` on
+        change.  Every entry carries BOTH clocks: ``since`` on the
+        monitor's (injectable, monotonic) clock for interval math, and
+        ``since_wall`` on the wall clock so reports from different
+        tenants/processes order on replay analysis."""
         state = HealthState(state)
         with self._lock:
             prev = self._components.get(component)
@@ -121,8 +125,19 @@ class HealthMonitor:
                 "state": state,
                 "reason": reason,
                 "since": self._clock() if changed else prev["since"],
+                "since_wall": (
+                    time.time() if changed else prev["since_wall"]
+                ),
             }
         if changed:
+            try:  # the metrics plane tracks the live state per component
+                from sntc_tpu.obs.metrics import set_gauge
+
+                set_gauge(
+                    "sntc_health_state", int(state), component=component
+                )
+            except Exception:
+                pass
             emit_event(
                 event="health_changed", component=component,
                 state=state.name,
@@ -174,6 +189,8 @@ class HealthMonitor:
                     name: {
                         "state": e["state"].name,
                         "reason": e["reason"],
+                        "since": e["since"],
+                        "since_wall": e["since_wall"],
                     }
                     for name, e in sorted(self._components.items())
                 },

@@ -33,6 +33,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Type
 
 import numpy as np
 
+# top-level on purpose: the ring-eviction mirror below runs under
+# _events_lock, and a lazy import THERE could re-enter this module's
+# machinery mid-import; obs.metrics imports only the stdlib
+from sntc_tpu.obs.metrics import inc as _metrics_inc
 from sntc_tpu.utils.logging import MetricsLogger
 
 
@@ -161,6 +165,14 @@ def emit_event(**fields: Any) -> Dict[str, Any]:
         # thread emit concurrently, and a torn step sequence would break
         # the step-watermark windows bench journaling relies on
         record = _events_logger().log(**fields)
+        # wall AND monotonic timestamps on EVERY event record: replay
+        # analysis across tenants (or processes) orders by ``ts``;
+        # intra-process interval math uses ``mono``, which never jumps
+        # with the system clock.  Emitter-supplied values win.
+        if "ts" not in record:
+            record["ts"] = time.time()
+        if "mono" not in record:
+            record["mono"] = time.monotonic()
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             with open(path, "a") as f:
@@ -172,6 +184,16 @@ def emit_event(**fields: Any) -> Dict[str, Any]:
                 _events_dropped_by_tenant[evicted_tenant] = (
                     _events_dropped_by_tenant.get(evicted_tenant, 0) + 1
                 )
+            try:  # mirror into the metrics plane (obs), never fatally
+                _metrics_inc(
+                    "sntc_events_dropped_total",
+                    **(
+                        {"tenant": evicted_tenant}
+                        if evicted_tenant is not None else {}
+                    ),
+                )
+            except Exception:
+                pass
         _recent.append(record)
         observers = list(_observers)
     # observers run OUTSIDE the ring lock: an observer that emits (a

@@ -28,6 +28,9 @@ import numpy as np
 from sntc_tpu.core.base import Transformer
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.data.ingest import load_csv
+from sntc_tpu.obs import install_event_metrics
+from sntc_tpu.obs.metrics import inc, observe
+from sntc_tpu.obs.trace import span
 from sntc_tpu.resilience import (
     RetryPolicy,
     emit_event,
@@ -35,6 +38,12 @@ from sntc_tpu.resilience import (
     with_retries,
 )
 from sntc_tpu.serve.transform import BatchPredictor
+from sntc_tpu.utils.profiling import TransferLedger, ledger_scope
+
+# the event→metrics bridge rides every process that can serve: at
+# MODULE import (not engine construction) so event-observer counts are
+# deterministic for tests and ad-hoc emitters are covered too
+install_event_metrics()
 
 
 # ---------------------------------------------------------------------------
@@ -247,12 +256,14 @@ class DirStreamSource(StreamSource):
         fut = self._staged.pop((start, end), None)
         if fut is not None:
             self.prefetch_hits += 1
+            inc("sntc_source_prefetch_hits_total")
             # a failed staged read re-raises HERE, inside the engine's
             # stream.read retry/fault scope; the entry was consumed, so
             # a retry falls through to a fresh synchronous read
             return fut.result()
         if self.prefetch_batches > 0:
             self.prefetch_misses += 1
+            inc("sntc_source_prefetch_misses_total")
         listing = self._listing
         if listing is not None and len(listing) < end:
             listing = None  # stale: _read_range re-scans exactly once
@@ -565,6 +576,14 @@ class StreamingQuery:
             s: (s if tenant is None else f"tenant/{tenant}/{s}")
             for s in _known_sites
         }
+        # observability (r13): the engine's own transfer ledger —
+        # scoped around every predict dispatch so fused-segment
+        # uploads/downloads attribute to THIS engine (and, when
+        # tenanted, to its sntc_transfer_*{tenant=...} series) instead
+        # of conflating in the process-global view; the engine-emitted
+        # metrics (batches/rows/duration) carry the same tenant label.
+        self.transfer = TransferLedger(tenant=tenant)
+        self._mlabels = {} if tenant is None else {"tenant": tenant}
         # per-site circuit breakers (sink.write / predict.dispatch): an
         # OPEN breaker defers the stage — the batch stays queued and the
         # loop stays alive — instead of hammering a dead dependency
@@ -732,7 +751,8 @@ class StreamingQuery:
             # restarted query plans the batch fresh (chaos matrix row 1)
             fault_point("stream.wal", tenant=self.tenant)
             # intent WAL before any processing (OffsetSeqLog)
-            self._wal_intent(batch_id, intent)
+            with span("stream.wal", batch=batch_id):
+                self._wal_intent(batch_id, intent)
 
         # stage the FOLLOWING range before this batch's read blocks: the
         # prefetch thread parses batch N+1 while this round waits on
@@ -749,7 +769,10 @@ class StreamingQuery:
 
         def _read() -> tuple:
             fault_point("stream.read", tenant=self.tenant)
-            frame = self.source.get_batch(intent["start"], intent["end"])
+            with span("stream.read", batch=batch_id):
+                frame = self.source.get_batch(
+                    intent["start"], intent["end"]
+                )
             stride = intent.get("sample_stride", 1)
             if stride > 1:
                 frame = frame.take(np.arange(0, frame.num_rows, stride))
@@ -775,9 +798,10 @@ class StreamingQuery:
                 # strict mode raises SchemaViolation here — the batch
                 # fails exactly like any other stream.read poison and
                 # the retry/quarantine machinery owns it
-                res = self.schema_contract.admit(
-                    frame, mode=self.row_policy
-                )
+                with span("stream.admit", batch=batch_id):
+                    res = self.schema_contract.admit(
+                        frame, mode=self.row_policy
+                    )
                 frame = res.frame
                 if not res.valid.all():
                     mask = res.valid
@@ -839,9 +863,16 @@ class StreamingQuery:
                     self._batches_salvaged += 1
                 self._rows_coerced_total += coerced
             try:
-                finalize = self.predictor.predict_frame_async(
-                    frame, row_valid=row_mask
-                )
+                # the engine's ledger is scoped around the dispatch:
+                # fused-segment transfers attribute to this engine even
+                # though their finalize may run on the delivery thread
+                # (the segment captures the scope at dispatch)
+                with ledger_scope(self.transfer), span(
+                    "predict.dispatch", batch=batch_id
+                ):
+                    finalize = self.predictor.predict_frame_async(
+                        frame, row_valid=row_mask
+                    )
             except Exception:
                 if br_predict is not None:
                     br_predict.record_failure()
@@ -895,11 +926,12 @@ class StreamingQuery:
                 fault_point("sink.write", tenant=self.tenant)
                 self.sink.add_batch(batch_id, finalize())
 
-            if self.retry_policy is not None:
-                with_retries(_deliver, self.retry_policy,
-                             site=self._sites["sink.write"])
-            else:
-                _deliver()
+            with span("sink.deliver", batch=batch_id):
+                if self.retry_policy is not None:
+                    with_retries(_deliver, self.retry_policy,
+                                 site=self._sites["sink.write"])
+                else:
+                    _deliver()
         finally:
             self._delivery_busy_s += time.perf_counter() - t0
 
@@ -1140,6 +1172,7 @@ class StreamingQuery:
             "bucket_hits": self.predictor.bucket_hits,
             "padded_rows_total": self.predictor.padded_rows_total,
         }
+        stats["transfers"] = self.transfer.snapshot()
         src_stats = getattr(self.source, "prefetch_stats", None)
         if src_stats is not None:
             stats["prefetch"] = src_stats()
@@ -1167,7 +1200,8 @@ class StreamingQuery:
         # batch from its WAL'd intent and the sink must dedupe (chaos
         # matrix row 3)
         fault_point("stream.commit", tenant=self.tenant)
-        self._wal_commit(batch_id, intent)
+        with span("stream.commit", batch=batch_id):
+            self._wal_commit(batch_id, intent)
         self._clear_failures(batch_id)
         # a committed batch never re-reads in this process — drop its
         # admission-idempotence bookkeeping so the sets stay bounded by
@@ -1177,6 +1211,12 @@ class StreamingQuery:
         self._last_committed = batch_id
         self._end_offset = intent["end"]
         dur = time.perf_counter() - t0
+        # per-batch engine metrics (tenant-labeled when serving one):
+        # the commit is the ONE place every batch passes exactly once
+        inc("sntc_batches_committed_total", **self._mlabels)
+        if n_rows:
+            inc("sntc_rows_committed_total", n_rows, **self._mlabels)
+        observe("sntc_batch_duration_seconds", dur, **self._mlabels)
         progress = {
             "batchId": batch_id,
             "numInputRows": int(n_rows),

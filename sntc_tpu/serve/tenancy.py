@@ -59,6 +59,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from sntc_tpu.obs.metrics import inc, registry, set_gauge
+from sntc_tpu.obs.trace import span
 from sntc_tpu.resilience import (
     HealthState,
     breaker_for,
@@ -313,6 +315,7 @@ class ServeDaemon:
         quantum: float = 1.0,
         health: Optional[HealthMonitor] = None,
         health_json: Optional[str] = None,
+        metrics_out: Optional[str] = None,
         clock=time.monotonic,
         breaker_kwargs: Optional[Dict[str, Any]] = None,
     ):
@@ -326,6 +329,11 @@ class ServeDaemon:
         self.pipeline_depth = max(1, int(pipeline_depth))
         self.quantum = float(quantum)
         self.health_json = health_json
+        # observability (r13): when set, every scheduling round also
+        # atomically republishes the registry's Prometheus text here —
+        # per-tenant series (rows/batches/deficit/state/transfers) are
+        # already namespaced by their ``tenant`` label
+        self.metrics_out = metrics_out
         self._clock = clock
         self._breaker_kwargs = dict(breaker_kwargs or {})
         self._owns_health = health is None
@@ -494,6 +502,7 @@ class ServeDaemon:
             return
         with self._strike_lock:
             t.strikes += 1
+        inc("sntc_tenant_strikes_total", tenant=t.spec.tenant_id)
 
     def _escalate(self, now: float) -> None:
         """Ladder transitions, once per tick: quarantine release after
@@ -589,55 +598,72 @@ class ServeDaemon:
         rotation moves on.  An engine error strikes the tenant and the
         round continues; the daemon loop never dies for one tenant."""
         now = self._clock()
-        self._escalate(now)
+        inc("sntc_daemon_ticks_total")
         committed_total = 0
-        runnable: List[TenantStream] = []
-        for t in self.tenants:
-            if t.state in ("STOPPED", "QUARANTINED"):
-                continue
-            if t.probation_hold:
-                # the tick that released this tenant does not also
-                # serve it: release is observable (state OK, health
-                # reset) before the first probation batch can re-dirty
-                # either one
-                t.probation_hold = False
-                continue
-            t.refill(now)
-            try:
-                latest = t.query.source.latest_offset()
-            except Exception as e:
-                self._strike(t, e, during="latest_offset")
-                continue
-            if t.spec.max_pending_batches is not None:
+        with span("daemon.tick"):
+            self._escalate(now)
+            runnable: List[TenantStream] = []
+            for t in self.tenants:
+                if t.state in ("STOPPED", "QUARANTINED"):
+                    continue
+                if t.probation_hold:
+                    # the tick that released this tenant does not also
+                    # serve it: release is observable (state OK, health
+                    # reset) before the first probation batch can
+                    # re-dirty either one
+                    t.probation_hold = False
+                    continue
+                t.refill(now)
                 try:
-                    shed = t.query.shed_backlog(
-                        t.spec.max_pending_batches,
-                        policy=t.spec.shed_policy,
-                        latest=latest,
-                    )
+                    latest = t.query.source.latest_offset()
                 except Exception as e:
-                    self._strike(t, e, during="shed")
-                    shed = None
-                if shed is not None:
-                    t.shed_total_offsets += shed.get("offsets_shed", 0)
-            if not t.has_work(latest):
-                t.deficit = 0.0  # DRR: an idle queue keeps no credit
+                    self._strike(t, e, during="latest_offset")
+                    continue
+                if t.spec.max_pending_batches is not None:
+                    try:
+                        shed = t.query.shed_backlog(
+                            t.spec.max_pending_batches,
+                            policy=t.spec.shed_policy,
+                            latest=latest,
+                        )
+                    except Exception as e:
+                        self._strike(t, e, during="shed")
+                        shed = None
+                    if shed is not None:
+                        t.shed_total_offsets += shed.get(
+                            "offsets_shed", 0
+                        )
+                if not t.has_work(latest):
+                    t.deficit = 0.0  # DRR: idle queues keep no credit
+                    if t.state == "THROTTLED":
+                        t.state = "OK"
+                    continue
+                if t.throttled():
+                    t.state = "THROTTLED"
+                    continue
                 if t.state == "THROTTLED":
                     t.state = "OK"
-                continue
-            if t.throttled():
-                t.state = "THROTTLED"
-                continue
-            if t.state == "THROTTLED":
-                t.state = "OK"
-            runnable.append(t)
-        for t in runnable:
-            t.deficit += t.spec.weight * self.quantum
-        for t in runnable:
-            committed_total += self._drain_deficit(t)
-        self._last_runnable = len(runnable)
+                runnable.append(t)
+            for t in runnable:
+                t.deficit += t.spec.weight * self.quantum
+            for t in runnable:
+                committed_total += self._drain_deficit(t)
+            self._last_runnable = len(runnable)
+            # scheduler state on the metrics plane, once per round: the
+            # DRR deficits and ladder states every tenant ended with
+            for t in self.tenants:
+                set_gauge(
+                    "sntc_tenant_deficit", t.deficit,
+                    tenant=t.spec.tenant_id,
+                )
+                set_gauge(
+                    "sntc_tenant_state", TENANT_STATES.index(t.state),
+                    tenant=t.spec.tenant_id,
+                )
         if self.health_json:
             _atomic_json(self.health_json, self.status())
+        if self.metrics_out:
+            registry().write_prometheus(self.metrics_out)
         return committed_total
 
     def _drain_deficit(self, t: TenantStream) -> int:
@@ -686,6 +712,7 @@ class ServeDaemon:
         tenant, never against the daemon."""
         with self._strike_lock:
             t.strikes += 1
+        inc("sntc_tenant_strikes_total", tenant=t.spec.tenant_id)
         emit_event(
             event="tenant_error", tenant=t.spec.tenant_id,
             error=repr(exc), during=during,

@@ -29,6 +29,8 @@ import pyarrow as pa
 
 from sntc_tpu.core.base import Transformer
 from sntc_tpu.core.frame import Frame
+from sntc_tpu.obs.metrics import inc
+from sntc_tpu.obs.trace import span
 
 # row-validity mask column threaded through bucketed transforms: True for
 # real rows, False for bucket-padding rows.  Row-DROPPING stages
@@ -98,10 +100,21 @@ class BatchPredictor:
         with self._ledger_lock:
             if n_rows in self._shapes_seen:
                 self.bucket_hits += 1
+                fresh = False
             else:
                 self._shapes_seen.add(n_rows)
                 self.compile_events += 1
+                fresh = True
             self.padded_rows_total += padded
+        # mirror into the metrics plane (sntc_predict_* series): the
+        # per-predictor attributes stay the legacy views the bench and
+        # the daemon's recompiles_after_warmup() already read
+        inc(
+            "sntc_predict_compile_events_total"
+            if fresh else "sntc_predict_bucket_hits_total"
+        )
+        if padded:
+            inc("sntc_predict_padded_rows_total", padded)
 
     def _dispatch_one(
         self,
@@ -129,9 +142,10 @@ class BatchPredictor:
             self._record_shape(n)
             return model.transform_async(frame)
         self._record_shape(target, padded=target - n)
-        valid = np.zeros(target, dtype=bool)
-        valid[:n] = True if row_valid is None else row_valid
-        padded = frame.pad_rows(target).with_column(VALID_COL, valid)
+        with span("predict.bucket", rows=n, bucket=target):
+            valid = np.zeros(target, dtype=bool)
+            valid[:n] = True if row_valid is None else row_valid
+            padded = frame.pad_rows(target).with_column(VALID_COL, valid)
         fin = model.transform_async(padded)
 
         def finalize() -> Frame:

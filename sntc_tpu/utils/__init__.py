@@ -1,5 +1,17 @@
 from sntc_tpu.utils.compile_cache import enable_persistent_cache
 from sntc_tpu.utils.logging import MetricsLogger
-from sntc_tpu.utils.profiling import profile_trace, StepTimer
+from sntc_tpu.utils.profiling import (
+    TransferLedger,
+    ledger_scope,
+    profile_trace,
+    transfer_ledger,
+)
 
-__all__ = ["MetricsLogger", "profile_trace", "StepTimer"]
+__all__ = [
+    "MetricsLogger",
+    "profile_trace",
+    "TransferLedger",
+    "transfer_ledger",
+    "ledger_scope",
+    "enable_persistent_cache",
+]

@@ -3,15 +3,16 @@
 Behavioral spec: SURVEY.md §5.1: Spark's per-stage timelines come from the
 listener bus; the TPU-native equivalents are (a) ``jax.profiler`` traces
 viewable in TensorBoard/Perfetto (XLA op-level — far deeper than Spark's
-stage view) and (b) a lightweight wall-clock step timer for the
-host-visible phases (ingest, fit, transform).
+stage view; see also ``sntc_tpu.obs.trace.device_trace``), (b) the host
+span tracer (``sntc_tpu.obs.span``) for the engine's stage timeline, and
+(c) the transfer ledger below, whose counters also mirror into the
+``sntc_tpu.obs`` metrics registry (``sntc_transfer_*`` series).
 """
 
 from __future__ import annotations
 
 import contextlib
 import threading
-import time
 from typing import Dict, Optional
 
 
@@ -42,26 +43,75 @@ class TransferLedger:
     of hiding behind a per-dispatch ratio that is ~1 by construction.
     Thread-safe: the pipelined engine dispatches on the engine thread
     and finalizes on the delivery thread.
+
+    **Attachment (r13):** the process-global instance
+    (:func:`transfer_ledger`) used to be the ONLY ledger, which
+    conflated every engine's counts — two tenant streams on one device
+    were indistinguishable.  Engines now construct their OWN ledger and
+    scope it around dispatch (:func:`ledger_scope`); the fused segment
+    captures :func:`active_ledgers` at dispatch time and records into
+    all of them, so the closure attributes correctly even though its
+    finalize may run on the delivery thread.  The global stays the
+    default process-wide view.
+
+    ``tenant`` names the engine's tenant: the ledger then also mirrors
+    into the ``sntc_transfer_*{tenant=...}`` metrics series.  The
+    global ledger mirrors into the unlabeled series; anonymous
+    per-engine ledgers (``tenant=None``) keep their own counts but do
+    not mirror — the unlabeled series stays exactly the global view.
     """
 
-    def __init__(self):
+    def __init__(self, tenant: Optional[str] = None, *,
+                 _mirror_unlabeled: bool = False):
         self._lock = threading.Lock()
+        self.tenant = tenant
+        if tenant is not None:
+            self._mirror_labels: Optional[Dict[str, str]] = {
+                "tenant": tenant
+            }
+        elif _mirror_unlabeled:
+            self._mirror_labels = {}
+        else:
+            self._mirror_labels = None
         self.dispatches = 0
         self.uploads = 0
         self.downloads = 0
         self.upload_bytes = 0
         self.download_bytes = 0
 
+    def _mirror(self, uploads=0, upload_bytes=0, downloads=0,
+                download_bytes=0, dispatches=0) -> None:
+        labels = self._mirror_labels
+        if labels is None:
+            return
+        from sntc_tpu.obs.metrics import inc
+
+        if dispatches:
+            inc("sntc_transfer_dispatches_total", dispatches, **labels)
+        if uploads:
+            inc("sntc_transfer_uploads_total", uploads, **labels)
+        if upload_bytes:
+            inc("sntc_transfer_upload_bytes_total", upload_bytes,
+                **labels)
+        if downloads:
+            inc("sntc_transfer_downloads_total", downloads, **labels)
+        if download_bytes:
+            inc("sntc_transfer_download_bytes_total", download_bytes,
+                **labels)
+
     def record_uploads(self, count: int, nbytes: int = 0) -> None:
         with self._lock:
             self.dispatches += 1
             self.uploads += int(count)
             self.upload_bytes += int(nbytes)
+        self._mirror(uploads=int(count), upload_bytes=int(nbytes),
+                     dispatches=1)
 
     def record_downloads(self, count: int, nbytes: int = 0) -> None:
         with self._lock:
             self.downloads += int(count)
             self.download_bytes += int(nbytes)
+        self._mirror(downloads=int(count), download_bytes=int(nbytes))
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
@@ -79,31 +129,44 @@ class TransferLedger:
             self.upload_bytes = self.download_bytes = 0
 
 
-# process-global instance the fused segments write to; bench/tests diff
-# snapshots around a measured window (see sntc_tpu.fuse.planner)
-_TRANSFER_LEDGER = TransferLedger()
+# process-global instance: the default process-wide view every fused
+# dispatch records into; bench/tests diff snapshots around a measured
+# window (see sntc_tpu.fuse.planner).  Scoped per-engine ledgers record
+# ALONGSIDE it, never instead of it.
+_TRANSFER_LEDGER = TransferLedger(_mirror_unlabeled=True)
+
+# per-thread stack of additionally-scoped ledgers.  Thread-local (not a
+# contextvar) on purpose: the scope is pushed on the ENGINE thread
+# around dispatch, and the fused segment snapshots active_ledgers()
+# into its finalize closure — cross-thread finalize needs no
+# propagation because attribution is captured at dispatch time.
+_scoped = threading.local()
 
 
 def transfer_ledger() -> TransferLedger:
     return _TRANSFER_LEDGER
 
 
-class StepTimer:
-    """Named wall-clock phases: ``with timer.phase("fit"): ...``."""
+@contextlib.contextmanager
+def ledger_scope(ledger: TransferLedger):
+    """Attribute fused-segment transfers dispatched inside the block to
+    ``ledger`` (in addition to the process-global view)."""
+    stack = getattr(_scoped, "stack", None)
+    if stack is None:
+        stack = _scoped.stack = []
+    stack.append(ledger)
+    try:
+        yield ledger
+    finally:
+        stack.pop()
 
-    def __init__(self):
-        self.totals: Dict[str, float] = {}
-        self.counts: Dict[str, int] = {}
 
-    @contextlib.contextmanager
-    def phase(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            self.totals[name] = self.totals.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
-
-    def summary(self) -> Dict[str, float]:
-        return dict(sorted(self.totals.items(), key=lambda kv: -kv[1]))
+def active_ledgers() -> tuple:
+    """The ledgers a dispatch happening NOW should record into: the
+    process-global one plus any :func:`ledger_scope` stack on this
+    thread.  Callers snapshot this at dispatch time and carry it into
+    their finalize closures (see ``fuse.planner``)."""
+    stack = getattr(_scoped, "stack", None)
+    if not stack:
+        return (_TRANSFER_LEDGER,)
+    return (_TRANSFER_LEDGER, *stack)

@@ -1,0 +1,588 @@
+"""Unified telemetry substrate (r13, ``sntc_tpu.obs``): metrics
+registry semantics (labels, cardinality cap, histogram bucket edges,
+snapshot under concurrent writes, exposition), span-tracer ring
+behavior and Chrome-trace export, the event→metrics bridge, the
+per-engine transfer-ledger attribution, the end-to-end agreement of
+one serve run's Prometheus snapshot with the legacy ledger views, and
+the metric-name drift check (tier-1 wiring of check_metric_names)."""
+
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import sntc_tpu.resilience as R
+from sntc_tpu.core.base import Pipeline, Transformer
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.feature import MinMaxScaler, VectorAssembler
+from sntc_tpu.models import LogisticRegression
+from sntc_tpu.obs import SpanTracer, disable_tracing, enable_tracing
+from sntc_tpu.obs import span as obs_span
+from sntc_tpu.obs import tracer as obs_tracer
+from sntc_tpu.obs.bridge import split_tenant_site
+from sntc_tpu.obs.metrics import CATALOG, MetricsRegistry, registry
+from sntc_tpu.serve import (
+    MemorySink,
+    MemorySource,
+    ServeDaemon,
+    TenantSpec,
+)
+from sntc_tpu.utils.profiling import (
+    TransferLedger,
+    active_ledgers,
+    ledger_scope,
+    transfer_ledger,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    R.clear()
+    R.clear_events()
+    R.reset_breakers()
+    yield
+    R.clear()
+    R.clear_events()
+    R.reset_breakers()
+    disable_tracing()
+
+
+def _get(name, **labels):
+    return registry().get(name, **labels) or 0
+
+
+# ---------------------------------------------------------------------------
+# MetricsRegistry unit semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_and_labels():
+    r = MetricsRegistry()
+    r.inc("sntc_rows_committed_total", 5)
+    r.inc("sntc_rows_committed_total", 2)
+    r.inc("sntc_rows_committed_total", 3, tenant="a")
+    r.set_gauge("sntc_health_state", 2, component="engine")
+    r.set_gauge("sntc_health_state", 1, component="engine")
+    assert r.get("sntc_rows_committed_total") == 7
+    assert r.get("sntc_rows_committed_total", tenant="a") == 3
+    assert r.get("sntc_health_state", component="engine") == 1
+    assert r.get("sntc_rows_committed_total", tenant="nope") is None
+
+
+def test_registry_rejects_undeclared_names_and_labels():
+    r = MetricsRegistry()
+    with pytest.raises(KeyError, match="CATALOG"):
+        r.inc("sntc_made_up_total")
+    with pytest.raises(KeyError, match="label"):
+        r.inc("sntc_rows_committed_total", 1, flavor="x")
+    with pytest.raises(KeyError, match="histogram"):
+        r.observe("sntc_rows_committed_total", 1.0)
+
+
+def test_label_cardinality_cap_collapses_to_overflow():
+    r = MetricsRegistry(max_label_sets=4)
+    for i in range(9):
+        r.inc("sntc_rows_committed_total", 1, tenant=f"t{i}")
+    # first 4 label sets kept; the 5 surplus collapse into overflow
+    assert r.label_overflows() == 5
+    assert r.get("sntc_rows_committed_total", overflow="true") == 5
+    for i in range(4):
+        assert r.get("sntc_rows_committed_total", tenant=f"t{i}") == 1
+    snap = r.snapshot()["sntc_rows_committed_total"]
+    assert len(snap["series"]) == 5  # 4 kept + overflow
+
+
+def test_histogram_bucket_edges():
+    spec = CATALOG["sntc_batch_duration_seconds"]
+    bounds = spec["buckets"]
+    r = MetricsRegistry()
+    # exactly ON a bound counts into that bound's bucket (le semantics)
+    r.observe("sntc_batch_duration_seconds", bounds[0])
+    r.observe("sntc_batch_duration_seconds", bounds[0] * 1.0001)
+    r.observe("sntc_batch_duration_seconds", 1e9)  # +Inf bucket
+    s = r.snapshot()["sntc_batch_duration_seconds"]["series"][0]
+    assert s["buckets"][0] == 1
+    assert s["buckets"][1] == 1
+    assert s["buckets"][-1] == 1
+    assert s["count"] == 3
+    text = r.to_prometheus()
+    assert f'sntc_batch_duration_seconds_bucket{{le="{bounds[0]}"}} 1' in text
+    # cumulative: the second bucket line includes the first's count
+    assert f'sntc_batch_duration_seconds_bucket{{le="{bounds[1]}"}} 2' in text
+    assert 'sntc_batch_duration_seconds_bucket{le="+Inf"} 3' in text
+    assert "sntc_batch_duration_seconds_count 3" in text
+
+
+def test_snapshot_under_concurrent_writes():
+    r = MetricsRegistry()
+    N_THREADS, N_INC = 8, 2_000
+    stop = threading.Event()
+    snaps = []
+
+    def writer(i):
+        for _ in range(N_INC):
+            r.inc("sntc_rows_committed_total", 1, tenant=f"w{i % 3}")
+            r.observe("sntc_batch_duration_seconds", 0.01)
+
+    def reader():
+        while not stop.is_set():
+            snaps.append(r.snapshot())
+            r.to_prometheus()
+
+    threads = [
+        threading.Thread(target=writer, args=(i,))
+        for i in range(N_THREADS)
+    ]
+    rt = threading.Thread(target=reader)
+    rt.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    rt.join()
+    total = sum(
+        r.get("sntc_rows_committed_total", tenant=f"w{k}")
+        for k in range(3)
+    )
+    assert total == N_THREADS * N_INC  # no lost increments
+    s = r.snapshot()["sntc_batch_duration_seconds"]["series"][0]
+    assert s["count"] == N_THREADS * N_INC
+    assert snaps, "reader never snapshotted"
+    # monotone non-decreasing totals across the reader's snapshots
+    last = -1
+    for snap in snaps:
+        rows = snap.get("sntc_rows_committed_total")
+        tot = sum(x["value"] for x in rows["series"]) if rows else 0
+        assert tot >= last
+        last = tot
+
+
+def test_jsonl_exposition_deterministic_clock(tmp_path):
+    r = MetricsRegistry(clock=lambda: 123.5, mono=lambda: 7.25)
+    r.inc("sntc_daemon_ticks_total", 3)
+    path = str(tmp_path / "m.jsonl")
+    rec = r.write_jsonl(path)
+    assert (rec["ts"], rec["mono"], rec["seq"]) == (123.5, 7.25, 0)
+    r.write_jsonl(path)
+    with open(path) as f:
+        lines = [json.loads(line) for line in f]
+    assert [r_["seq"] for r_ in lines] == [0, 1]
+    assert (
+        lines[0]["metrics"]["sntc_daemon_ticks_total"]["series"][0][
+            "value"
+        ]
+        == 3
+    )
+
+
+def test_write_prometheus_atomic(tmp_path):
+    r = MetricsRegistry()
+    r.inc("sntc_daemon_ticks_total")
+    path = str(tmp_path / "m.prom")
+    r.write_prometheus(path)
+    with open(path) as f:
+        text = f.read()
+    assert "# TYPE sntc_daemon_ticks_total counter" in text
+    assert "sntc_daemon_ticks_total 1" in text
+    assert not os.path.exists(path + ".tmp")
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+
+def test_span_ring_overflow_drops_oldest_and_counts():
+    t = SpanTracer(capacity=4)
+    for i in range(7):
+        with t.span("s", i=i):
+            pass
+    spans = t.spans()
+    assert len(spans) == 4
+    assert [s["attrs"]["i"] for s in spans] == [3, 4, 5, 6]
+    assert t.dropped == 3
+    assert t.stats() == {"spans": 4, "capacity": 4, "dropped": 3}
+
+
+def test_span_records_on_exception_and_clocks():
+    base = {"t": 0.0}
+    t = SpanTracer(clock=lambda: base["t"], wall=lambda: 1000 + base["t"])
+    with pytest.raises(ValueError):
+        with t.span("boom"):
+            base["t"] = 2.5
+            raise ValueError("x")
+    (s,) = t.spans()
+    assert s["name"] == "boom"
+    assert s["dur_s"] == 2.5
+    assert s["wall"] == 1000.0
+
+
+def test_module_span_noop_when_disabled_records_when_enabled():
+    assert obs_tracer() is None
+    with obs_span("ignored", k=1):
+        pass  # no tracer: shared null context
+    t = enable_tracing(capacity=16)
+    assert obs_tracer() is t
+    with obs_span("live", k=2):
+        pass
+    assert [s["name"] for s in t.spans()] == ["live"]
+    assert disable_tracing() is t
+    with obs_span("ignored-again"):
+        pass
+    assert [s["name"] for s in t.spans()] == ["live"]
+
+
+def test_chrome_trace_export_loadable(tmp_path):
+    t = SpanTracer(capacity=16)
+    with t.span("outer", batch=1):
+        with t.span("inner"):
+            pass
+    path = t.export_chrome_trace(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in events} == {"outer", "inner"}
+    for e in events:
+        assert e["dur"] >= 0 and "ts" in e and "tid" in e
+        assert "wall_ts" in e["args"]
+    assert events[1]["args"]["batch"] == 1  # ring order: inner first
+    assert any(e["ph"] == "M" for e in doc["traceEvents"])  # thread names
+
+
+# ---------------------------------------------------------------------------
+# the event→metrics bridge + event timestamps
+# ---------------------------------------------------------------------------
+
+
+def test_events_carry_wall_and_monotonic_timestamps():
+    rec = R.emit_event(event="retry", site="stream.read", attempt=1)
+    assert rec["ts"] > 0 and rec["mono"] > 0
+    (tail,) = R.recent_events(event="retry")[-1:]
+    assert tail["ts"] == rec["ts"] and tail["mono"] == rec["mono"]
+
+
+def test_bridge_counts_events_and_splits_tenant_sites():
+    assert split_tenant_site(
+        {"site": "tenant/a/sink.write"}
+    ) == ("sink.write", "a")
+    assert split_tenant_site(
+        {"site": "stream.read", "tenant": "b"}
+    ) == ("stream.read", "b")
+    before = _get(
+        "sntc_events_total", event="retry", site="sink.write", tenant="z"
+    )
+    R.emit_event(event="retry", site="tenant/z/sink.write", attempt=1)
+    assert (
+        _get("sntc_events_total", event="retry", site="sink.write",
+             tenant="z")
+        == before + 1
+    )
+
+
+def test_bridge_rows_rejected_reasons_and_shed_offsets():
+    before_nf = _get(
+        "sntc_rows_rejected_total", reason="non_finite", tenant="q"
+    )
+    before_shed = _get("sntc_shed_offsets_total", tenant="q")
+    R.emit_event(
+        event="rows_rejected", site="tenant/q/source.parse", count=3,
+        reasons={"non_finite": 2, "ragged_row": 1}, tenant="q",
+    )
+    R.emit_event(
+        event="load_shed", site="tenant/q/stream.read", tenant="q",
+        policy="oldest", offsets_shed=7, start=0, end=7,
+    )
+    assert (
+        _get("sntc_rows_rejected_total", reason="non_finite", tenant="q")
+        == before_nf + 2
+    )
+    assert (
+        _get("sntc_shed_offsets_total", tenant="q") == before_shed + 7
+    )
+
+
+def test_health_report_mirrors_gauge_and_snapshot_has_both_clocks():
+    h = R.HealthMonitor(clock=lambda: 42.0)
+    h.report("mycomp", R.HealthState.DEGRADED, "testing")
+    assert _get("sntc_health_state", component="mycomp") == 1
+    entry = h.snapshot()["components"]["mycomp"]
+    assert entry["since"] == 42.0
+    assert entry["since_wall"] > 0
+    h.report("mycomp", R.HealthState.OK)
+    assert _get("sntc_health_state", component="mycomp") == 0
+
+
+def test_breaker_transitions_set_state_gauge():
+    br = R.CircuitBreaker(
+        "obs.test.site", window=4, min_calls=2, failure_threshold=0.5,
+        cooldown_s=0.0, clock=lambda: 0.0,
+    )
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "half_open"  # cooldown 0: open → half_open
+    # the OPEN transition wrote 2, the half_open probe window wrote 1
+    assert _get("sntc_breaker_state", site="obs.test.site") == 1
+
+
+# ---------------------------------------------------------------------------
+# per-engine transfer-ledger attribution (satellite: the bare
+# process-global conflated tenants)
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_scope_attribution_and_metrics_mirror():
+    glob = transfer_ledger()
+    g0 = glob.snapshot()
+    a = TransferLedger(tenant="ledger-a")
+    b = TransferLedger(tenant="ledger-b")
+    assert active_ledgers() == (glob,)
+    with ledger_scope(a):
+        assert active_ledgers() == (glob, a)
+        for led in active_ledgers():
+            led.record_uploads(2, 100)
+    with ledger_scope(b):
+        for led in active_ledgers():
+            led.record_uploads(1, 50)
+            led.record_downloads(1, 10)
+    assert active_ledgers() == (glob,)
+    # per-engine ledgers saw only their own scope's transfers
+    assert (a.uploads, a.downloads) == (2, 0)
+    assert (b.uploads, b.downloads) == (1, 1)
+    # the global saw everything (the default process-wide view)
+    g1 = glob.snapshot()
+    assert g1["uploads"] - g0["uploads"] == 3
+    assert g1["download_bytes"] - g0["download_bytes"] == 10
+    # tenant-labeled metric series mirror the per-engine ledgers exactly
+    assert _get("sntc_transfer_uploads_total", tenant="ledger-a") == 2
+    assert _get("sntc_transfer_uploads_total", tenant="ledger-b") == 1
+    assert (
+        _get("sntc_transfer_download_bytes_total", tenant="ledger-b")
+        == 10
+    )
+    # anonymous engine ledgers do NOT mirror (the unlabeled series must
+    # stay exactly the global ledger)
+    anon = TransferLedger()
+    unlabeled0 = _get("sntc_transfer_uploads_total")
+    anon.record_uploads(5, 5)
+    assert _get("sntc_transfer_uploads_total") == unlabeled0
+
+
+def test_nested_scopes_record_to_both():
+    a = TransferLedger()
+    b = TransferLedger()
+    with ledger_scope(a), ledger_scope(b):
+        for led in active_ledgers()[1:]:
+            led.record_downloads(1)
+    assert a.downloads == 1 and b.downloads == 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: one serve run's Prometheus snapshot agrees with the
+# legacy ledger views (compile / transfer / shed / tenant series)
+# ---------------------------------------------------------------------------
+
+
+class _Identity(Transformer):
+    def transform(self, frame):
+        return frame
+
+
+def _fused_served_model(mesh):
+    """A tiny fitted pipeline with a real fused segment (assembler runs
+    eagerly per the single-upload rule; scaler+LR fuse)."""
+    rng = np.random.default_rng(0)
+    cols = {
+        f"c{i}": np.abs(rng.normal(3, 2, 240)).astype(np.float32)
+        for i in range(4)
+    }
+    cols["label"] = (cols["c0"] > 3.0).astype(np.float64)
+    f = Frame(cols)
+    pm = Pipeline(stages=[
+        VectorAssembler(inputCols=[f"c{i}" for i in range(4)],
+                        outputCol="features"),
+        MinMaxScaler(inputCol="features", outputCol="scaled"),
+        LogisticRegression(mesh=mesh, featuresCol="scaled", maxIter=15),
+    ]).fit(f)
+    from sntc_tpu.fuse import compile_pipeline, fused_segments
+
+    fused = compile_pipeline(pm)
+    assert fused_segments(fused)
+    serve_frames = [
+        Frame({
+            f"c{i}": np.abs(rng.normal(3, 2, 16)).astype(np.float32)
+            for i in range(4)
+        })
+        for _ in range(4)
+    ]
+    return fused, serve_frames
+
+
+def test_e2e_prometheus_snapshot_agrees_with_legacy_ledgers(
+    mesh8, tmp_path
+):
+    fused, frames_a = _fused_served_model(mesh8)
+    _, frames_b = _fused_served_model(mesh8)
+    from sntc_tpu.serve.transform import BatchPredictor
+
+    pred = BatchPredictor(fused, bucket_rows=8)
+    spec_a = TenantSpec(
+        tenant_id="obs-a", model=pred,
+        source=MemorySource(frames_a), sink=MemorySink(),
+    )
+    # tenant b sheds: backlog of 6 one-offset batches over a cap of 2
+    spec_b = TenantSpec(
+        tenant_id="obs-b", model=pred,
+        source=MemorySource(frames_b + frames_b[:2]),
+        sink=MemorySink(),
+        max_pending_batches=2, shed_policy="oldest",
+    )
+    before = {
+        "compile": _get("sntc_predict_compile_events_total"),
+        "fuse_compile": _get("sntc_fuse_compile_events_total"),
+        "up_global": _get("sntc_transfer_uploads_total"),
+        "down_global": _get("sntc_transfer_downloads_total"),
+        "shed_b": _get("sntc_shed_offsets_total", tenant="obs-b"),
+        "rows_a": _get("sntc_rows_committed_total", tenant="obs-a"),
+        "rows_b": _get("sntc_rows_committed_total", tenant="obs-b"),
+        "ticks": _get("sntc_daemon_ticks_total"),
+    }
+    glob0 = transfer_ledger().snapshot()
+    compile0 = pred.compile_events
+    daemon = ServeDaemon(
+        [spec_a, spec_b], str(tmp_path / "root"), shape_buckets=8
+    )
+    try:
+        daemon.process_available()
+        status = daemon.status()
+        ta = daemon._by_id["obs-a"]
+        tb = daemon._by_id["obs-b"]
+        # tenant rows: registry series == the daemon's own accounting
+        assert (
+            _get("sntc_rows_committed_total", tenant="obs-a")
+            - before["rows_a"]
+            == ta.rows_done
+        )
+        assert (
+            _get("sntc_rows_committed_total", tenant="obs-b")
+            - before["rows_b"]
+            == tb.rows_done
+        )
+        assert (
+            _get("sntc_batches_committed_total", tenant="obs-a")
+            == ta.batches_done
+        )
+        # shed: registry series == the tenant's journaled shed ledger
+        assert tb.shed_total_offsets > 0
+        assert (
+            _get("sntc_shed_offsets_total", tenant="obs-b")
+            - before["shed_b"]
+            == tb.shed_total_offsets
+        )
+        # compile ledger: registry delta == the shared predictor's delta
+        assert (
+            _get("sntc_predict_compile_events_total") - before["compile"]
+            == pred.compile_events - compile0
+        )
+        assert status["recompiles_after_warmup"] is None  # not marked
+        # transfers: the unlabeled series delta == the global ledger
+        # delta, and the per-tenant series sum to it (every dispatch in
+        # this window came from the two scoped engines)
+        glob1 = transfer_ledger().snapshot()
+        up_delta = _get("sntc_transfer_uploads_total") - before[
+            "up_global"
+        ]
+        assert up_delta == glob1["uploads"] - glob0["uploads"]
+        assert up_delta > 0
+        assert (
+            _get("sntc_transfer_uploads_total", tenant="obs-a")
+            + _get("sntc_transfer_uploads_total", tenant="obs-b")
+            >= up_delta
+        )
+        # per-engine ledgers ride pipeline_stats as the legacy-style view
+        ledger_a = ta.query.pipeline_stats()["transfers"]
+        assert ledger_a["uploads"] == _get(
+            "sntc_transfer_uploads_total", tenant="obs-a"
+        )
+        assert _get("sntc_daemon_ticks_total") > before["ticks"]
+        # the exposition carries all of it
+        prom = registry().to_prometheus()
+        assert 'sntc_rows_committed_total{tenant="obs-a"}' in prom
+        assert 'sntc_shed_offsets_total{tenant="obs-b"}' in prom
+        assert "sntc_predict_compile_events_total" in prom
+        assert 'sntc_tenant_state{tenant="obs-a"} 0' in prom
+    finally:
+        daemon.close()
+
+
+def test_engine_transfer_ledger_not_conflated_across_tenants(
+    mesh8, tmp_path
+):
+    """THE satellite regression: two tenant streams on one shared fused
+    predictor used to conflate upload/download counts in the one
+    process-global ledger; per-engine ledgers attribute them."""
+    fused, frames = _fused_served_model(mesh8)
+    from sntc_tpu.serve.transform import BatchPredictor
+
+    pred = BatchPredictor(fused)
+    specs = [
+        TenantSpec(tenant_id=tid, model=pred,
+                   source=MemorySource(list(frames[:n])),
+                   sink=MemorySink())
+        for tid, n in (("conf-a", 3), ("conf-b", 1))
+    ]
+    daemon = ServeDaemon(specs, str(tmp_path / "root"))
+    try:
+        daemon.process_available()
+        la = daemon._by_id["conf-a"].query.transfer
+        lb = daemon._by_id["conf-b"].query.transfer
+        assert la.dispatches == 3 and lb.dispatches == 1
+        assert la.uploads > lb.uploads  # 3 batches vs 1, attributed
+        assert la.tenant == "conf-a" and lb.tenant == "conf-b"
+    finally:
+        daemon.close()
+
+
+# ---------------------------------------------------------------------------
+# single-tenant engine: per-batch metrics without labels
+# ---------------------------------------------------------------------------
+
+
+def test_single_tenant_engine_emits_unlabeled_series(tmp_path):
+    from sntc_tpu.serve import StreamingQuery
+
+    frames = [Frame({"x": np.arange(6.0)}) for _ in range(2)]
+    b0 = _get("sntc_batches_committed_total")
+    r0 = _get("sntc_rows_committed_total")
+    q = StreamingQuery(
+        _Identity(), MemorySource(frames), MemorySink(),
+        str(tmp_path / "ckpt"), max_batch_offsets=1,
+    )
+    assert q.process_available() == 2
+    assert _get("sntc_batches_committed_total") - b0 == 2
+    assert _get("sntc_rows_committed_total") - r0 == 12
+    assert q.pipeline_stats()["transfers"]["dispatches"] == 0  # unfused
+
+
+# ---------------------------------------------------------------------------
+# metric-name drift check (tier-1 wiring of check_metric_names)
+# ---------------------------------------------------------------------------
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metric_names_consistent_code_catalog_docs():
+    checker = _load_script("check_metric_names")
+    assert checker.check() == []

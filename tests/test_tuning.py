@@ -89,7 +89,8 @@ def test_train_validation_split(mesh8, tmp_path):
 
 
 def test_utils_metrics_logger(tmp_path):
-    from sntc_tpu.utils import MetricsLogger, StepTimer
+    from sntc_tpu.obs import SpanTracer
+    from sntc_tpu.utils import MetricsLogger
 
     log = MetricsLogger(str(tmp_path / "m.jsonl"))
     log.log(event="fit_start", model="lr")
@@ -98,12 +99,14 @@ def test_utils_metrics_logger(tmp_path):
     assert [r["step"] for r in records] == [0, 1]
     assert records[1]["loss"] == 0.5
 
-    t = StepTimer()
-    with t.phase("a"):
+    # phase timing lives on the obs span tracer now (the old StepTimer
+    # was dormant telemetry and is gone)
+    t = SpanTracer(capacity=8)
+    with t.span("a"):
         pass
-    with t.phase("a"):
+    with t.span("a"):
         pass
-    assert t.counts["a"] == 2 and "a" in t.summary()
+    assert [s["name"] for s in t.spans()] == ["a", "a"]
 
 
 def test_cross_validator_fold_col(mesh8):
