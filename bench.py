@@ -9,10 +9,13 @@ wall-clock at macro-F1 parity, over the five reference configs [B:6-12]:
   4  GBTClassifier one-vs-rest, all days (15-class)
   5  Structured-streaming inference micro-batches (rows/s)
 
-plus the post-paper configs: 6 (fused vs staged serving, r9) and 7
+plus the post-paper configs: 6 (fused vs staged serving, r9), 7
 (the r11 live-model lifecycle arc on a drifting stream — incumbent
 degrades, drift detected, candidate refit online and promoted,
-macro-F1 recovers; detection latency and swap downtime journaled).
+macro-F1 recovers; detection latency and swap downtime journaled),
+8 (the r12 multi-tenant ServeDaemon at 10+ tenants), and 9 (the r14
+raw-capture flow engine: replayed capture → keyed windows → features
+→ classify vs the precomputed-CSV path on the same rows).
 
 No Spark and no real CICIDS2017 exist in-image (SURVEY.md §6), so the
 workload is the schema-locked synthetic generator (real day CSVs drop in
@@ -245,6 +248,7 @@ DEFAULT_ROWS = {
     "6": int(os.environ.get("BENCH_ROWS", 500_000)) // 4,
     "7": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
     "8": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
+    "9": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
 }
 
 
@@ -474,7 +478,11 @@ def _read_sink_dir(out_dir):
         pacsv.read_csv(p)
         for p in sorted(glob.glob(os.path.join(out_dir, "batch_*.csv")))
     ]
-    return pa.concat_tables(parts)
+    # header-only batch CSVs (a capture micro-batch that completed no
+    # windows, config 9) infer null-typed columns that poison the
+    # concat; they carry no rows, so drop them when any rows exist
+    nonempty = [t for t in parts if t.num_rows]
+    return pa.concat_tables(nonempty if nonempty else parts[:1])
 
 
 def _sinks_match(a, b):
@@ -1335,6 +1343,192 @@ def bench_config8(n_rows, mesh):
     }
 
 
+# config 9: the stateful flow-feature engine (r14).  A synthetic raw
+# pcap capture stream (deterministic flows spanning file boundaries +
+# an out-of-order tail) is served end-to-end — parse → keyed session
+# windows → CICIDS2017 feature rows → classify — and compared against
+# the precomputed-CSV path serving the SAME feature rows through the
+# same predictor: the cost of computing the features live, measured.
+# The CSV stream is written from the capture path's own reference
+# emissions, so row parity is by construction and the two sinks'
+# prediction sequences must match row-for-row.
+BENCH9_PACKETS_PER_FLOW = 6
+BENCH9_FLOWS_PER_FILE = 256
+BENCH9_SHAPE_BUCKETS = 256
+BENCH9_REPS = 3
+BENCH9_FLOW_TIMEOUT = 5.0
+# lateness > the inter-file gap: the deferred (out-of-order) tail is
+# ACCEPTED and reordered into its windows rather than dropped late —
+# the representative ISP-capture shape; the late-drop path is pinned
+# by tests, not the bench
+BENCH9_LATENESS = 35.0
+BENCH9_FILE_GAP_S = 30.0
+
+
+def bench_config9(n_rows, mesh):
+    """Raw-capture flow serving throughput: replayed capture →
+    windowed features → classify rows/s vs the precomputed-CSV path on
+    the same rows (docs/RESILIENCE.md "Stateful flow windows").  The
+    journal record's ``obs`` delta carries the ``sntc_flow_*``
+    state/eviction series as the operator evidence."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.csv as pacsv
+
+    from sntc_tpu.core.base import Pipeline, PipelineModel
+    from sntc_tpu.core.frame import Frame
+    from sntc_tpu.data import CICIDS2017_FEATURES
+    from sntc_tpu.data.synth import write_capture_stream
+    from sntc_tpu.flow import FlowCaptureSource
+    from sntc_tpu.models import LogisticRegression
+    from sntc_tpu.serve import (
+        BatchPredictor,
+        CsvDirSink,
+        FileStreamSource,
+        StreamingQuery,
+        compile_serving,
+    )
+
+    train, test = _dataset(n_rows, binary=True)
+    pipe = Pipeline(stages=_feature_stages(mesh) + [
+        LogisticRegression(mesh=mesh, maxIter=20)
+    ]).fit(train)
+    serve_model = compile_serving(
+        PipelineModel(stages=pipe.getStages()[1:])
+    )
+    # ONE predictor across both paths and every rep: the compile
+    # ledger is shared, so the ratio isolates feature computation
+    predictor = BatchPredictor(
+        serve_model, bucket_rows=BENCH9_SHAPE_BUCKETS
+    )
+    n_flows = max(64, n_rows // 4)
+    n_files = max(2, n_flows // BENCH9_FLOWS_PER_FILE)
+
+    def flow_source(tmp, rep, state=True):
+        # the commit-less reference pass runs store-less: with no
+        # commits to prune them, staged snapshots would only pile up
+        return FlowCaptureSource(
+            os.path.join(tmp, "in_cap"), format="pcap",
+            flow_timeout=BENCH9_FLOW_TIMEOUT,
+            allowed_lateness=BENCH9_LATENESS,
+            state_dir=(
+                os.path.join(tmp, f"ckpt_cap_{rep}", "flow_state")
+                if state else None
+            ),
+        )
+
+    def timed_pass(tmp, name, rep, source):
+        out_dir = os.path.join(tmp, f"out_{name}_{rep}")
+        q = StreamingQuery(
+            predictor, source,
+            CsvDirSink(out_dir, columns=["prediction"], durable=False),
+            os.path.join(tmp, f"ckpt_{name}_{rep}"),
+            # SAME WAL mode on both sides: the ratio must isolate
+            # feature computation, not a WAL-format delta
+            max_batch_offsets=1, wal_mode="append",
+        )
+        t0 = time.perf_counter()
+        q.process_available()
+        dt = time.perf_counter() - t0
+        q.stop()
+        close = getattr(source, "close", None)
+        if close is not None:
+            close()
+        return dt, out_dir, source
+
+    tmp = tempfile.mkdtemp()
+    arrow_cpus = pa.cpu_count()
+    pa.set_cpu_count(1)  # config-5 intra-op pinning discipline
+    try:
+        cap_info = write_capture_stream(
+            os.path.join(tmp, "in_cap"),
+            n_files=n_files,
+            flows_per_file=max(1, n_flows // n_files),
+            packets_per_flow=BENCH9_PACKETS_PER_FLOW,
+            seed=SEED, file_gap_s=BENCH9_FILE_GAP_S,
+            defer_fraction=0.1, flush=True,
+        )
+        n_packets = int(cap_info["packets"].shape[0])
+        # reference pass: drive the source directly to (a) capture the
+        # emitted feature frames the CSV path will serve and (b) warm
+        # every bucket shape through the shared predictor — untimed
+        ref_src = flow_source(tmp, "ref", state=False)
+        emitted = []
+        for i in range(ref_src.latest_offset()):
+            f = ref_src.get_batch(i, i + 1)
+            if f.num_rows:
+                emitted.append(f)
+                predictor.predict_frame(f)
+        feature_rows = sum(f.num_rows for f in emitted)
+        csv_dir = os.path.join(tmp, "in_csv")
+        os.makedirs(csv_dir, exist_ok=True)
+        for k, f in enumerate(emitted):
+            pacsv.write_csv(
+                f.select(CICIDS2017_FEATURES).to_arrow(),
+                os.path.join(csv_dir, f"part_{k:05d}.csv"),
+            )
+        ref_stats = ref_src.flow_stats()
+        ref_src.close()
+        # one untimed CSV warmup pass (pyarrow pools, WAL/sink paths)
+        timed_pass(tmp, "csvwarm", 0,
+                   FileStreamSource(csv_dir))
+        reps = {"cap": [], "csv": []}
+        flow_stats = None
+        for rep in range(BENCH9_REPS):
+            # interleave the two paths (config-5 host-drift hygiene)
+            dt, out_cap, src = timed_pass(
+                tmp, "cap", rep, flow_source(tmp, rep)
+            )
+            reps["cap"].append((dt, out_cap))
+            flow_stats = src.flow_stats()
+            dt, out_csv, _ = timed_pass(
+                tmp, "csv", rep, FileStreamSource(csv_dir)
+            )
+            reps["csv"].append((dt, out_csv))
+        med = {
+            k: sorted(v)[len(v) // 2] for k, v in reps.items()
+        }
+        # the config-5/6 sink-parity check: full row-for-row equality
+        # of the two paths' concatenated sink output
+        sink_match = _sinks_match(
+            _read_sink_dir(med["cap"][1]),
+            _read_sink_dir(med["csv"][1]),
+        )
+    finally:
+        pa.set_cpu_count(arrow_cpus)
+        shutil.rmtree(tmp, ignore_errors=True)
+    cap_rows_per_s = feature_rows / med["cap"][0]
+    csv_rows_per_s = feature_rows / med["csv"][0]
+    evidence = {
+        "capture_files": n_files + 1,  # + the flush sentinel file
+        "packets": n_packets,
+        "flows": cap_info["n_flows"],
+        "feature_rows": feature_rows,
+        "packets_per_s": round(n_packets / med["cap"][0], 1),
+        "csv_rows_per_s": round(csv_rows_per_s, 1),
+        "capture_vs_csv": _round_ratio(cap_rows_per_s / csv_rows_per_s),
+        "sink_match": sink_match,
+        "shape_buckets": BENCH9_SHAPE_BUCKETS,
+        "reps": BENCH9_REPS,
+        "windows_emitted": ref_stats["windows_emitted"],
+        "out_of_order": ref_stats["out_of_order"],
+        "late_records": ref_stats["late_records"],
+        "evictions": ref_stats["evictions"],
+        "snapshots_published": flow_stats["snapshots_published"],
+        "state_packets_final": flow_stats["packets"],
+    }
+    return {
+        "metric": "cicids2017_capture_flow_serving_rows_per_s",
+        "_datasets": (train, test),
+        "value": cap_rows_per_s,
+        "unit": "rows/s",
+        "quality": {"flow": evidence},
+        "n_rows": feature_rows,
+    }
+
+
 BENCHES = {
     "1": bench_config1,
     "2": bench_config2,
@@ -1344,6 +1538,7 @@ BENCHES = {
     "6": bench_config6,
     "7": bench_config7,
     "8": bench_config8,
+    "9": bench_config9,
 }
 
 
@@ -1925,6 +2120,9 @@ PROXIES = {
     # single-process comparison point is the config-5 proxy's CSV ->
     # predict -> CSV rows/s
     "8": proxy_config5,
+    # config 9 computes the features live before the same CSV-out job;
+    # the proxy stays the precomputed CSV -> predict -> CSV baseline
+    "9": proxy_config5,
 }
 
 
@@ -1939,12 +2137,12 @@ def measure_baseline(configs, rows):
 
     for cfg in configs:
         n = rows or DEFAULT_ROWS[cfg]
-        train, test = _dataset(n, binary=cfg in ("1", "5", "6"))
+        train, test = _dataset(n, binary=cfg in ("1", "5", "6", "9"))
         p = PROXIES[cfg](train, test)
         entry = {
             "baseline": f"sklearn CPU proxy: {p['desc']}",
             "n_rows": (
-                int(test.num_rows) if cfg in ("5", "6", "7") else int(train.num_rows)
+                int(test.num_rows) if cfg in ("5", "6", "7", "9") else int(train.num_rows)
             ),
             "host_cpus": os.cpu_count(),
         }
@@ -1980,7 +2178,7 @@ def _load_baseline(cfg: str) -> dict:
 def _vs_baseline(cfg: str, result: dict, base: dict):
     if not base:
         return None
-    if cfg in ("5", "6", "7"):
+    if cfg in ("5", "6", "7", "9"):
         return result["value"] / base["rows_per_s"]  # throughput ratio
     scale = result["n_rows"] / max(base["n_rows"], 1)
     return (base["train_s"] * scale) / result["value"]
@@ -2089,7 +2287,7 @@ def run_config(cfg: str, rows, pair: bool = True):
         # invocation, on the same train/test split — both sides of the
         # ratio see the same host state (VERDICT r4 item 2)
         proxy = PROXIES[cfg](train, test)
-        if cfg in ("5", "6", "7", "8"):
+        if cfg in ("5", "6", "7", "8", "9"):
             line["vs_baseline"] = _round_ratio(
                 result["value"] / proxy["rows_per_s"]
             )

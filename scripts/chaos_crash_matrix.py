@@ -5,11 +5,16 @@ Forks a real engine process over a directory of CSV micro-batches and
 KILLS it (``SNTC_FAULTS=<site>:kill`` → ``os._exit``, no cleanup) at
 each armed protocol boundary:
 
-=================  ====================================================
-``stream.wal``     pre-WAL: the batch was planned but no intent exists
-``sink.write``     post-WAL / pre-sink: intent logged, no output
-``stream.commit``  post-sink / pre-commit: output written, no commit
-=================  ====================================================
+======================  ===============================================
+``stream.wal``          pre-WAL: the batch was planned but no intent exists
+``sink.write``          post-WAL / pre-sink: intent logged, no output
+``stream.commit``       post-sink / pre-commit: output written, no commit
+``flow.emit``           raw-capture engine: window state mutated in
+                        memory, nothing durable (r14 flow scenarios)
+``flow.evict``          raw-capture engine: mid-eviction pass
+``flow.state_snapshot`` raw-capture engine: batch sunk, state snapshot
+                        serialized but not yet on disk
+======================  ===============================================
 
 After each kill the engine is restarted on the same checkpoint dir and
 must converge to EXACTLY the committed offsets and sink row counts of
@@ -44,6 +49,22 @@ SCRIPT = os.path.abspath(__file__)
 
 KILL_SITES = ("stream.wal", "sink.write", "stream.commit")
 KILL_EXIT_CODE = 137  # mirrors sntc_tpu.resilience.KILL_EXIT_CODE
+
+# stateful flow-window scenarios (r14): an engine serving RAW pcap
+# captures through the keyed-window operator (sntc_tpu/flow) is killed
+# MID-WINDOW — flows genuinely span the micro-batch boundary at death —
+# at each state-protocol boundary, then restarted on the same
+# checkpoint.  Restart must converge BITWISE to the uninterrupted
+# reference's commits and sink bytes: zero duplicated, zero lost
+# windows.  The kill is armed programmatically (arm(after=N)) because
+# these sites fire once per batch/commit and the kill must land with
+# windows open, not on the first call.
+FLOW_KILL_SITES = ("flow.emit", "flow.evict", "flow.state_snapshot")
+FLOW_KILL_AFTER = {
+    "flow.emit": 2,  # 3rd get_batch: spanning flows open in state
+    "flow.evict": 1,  # 2nd eviction pass (the 1st batch evicts nothing)
+    "flow.state_snapshot": 2,  # 3rd commit's snapshot publish
+}
 
 # multi-tenant scenarios (r12): three tenants on one ServeDaemon.
 # The kill scenario arms ONE tenant's namespaced WAL boundary
@@ -264,6 +285,106 @@ def sink_predictions(out_dir: str) -> dict:
             {float(r["prediction"]) for r in rows}
         )
     return out
+
+
+def sink_contents(out_dir: str) -> dict:
+    """Per-batch-CSV raw bytes — the BITWISE convergence evidence the
+    flow scenarios require (row counts alone would hide a feature
+    value computed from replayed state diverging)."""
+    out = {}
+    for p in sorted(glob.glob(os.path.join(out_dir, "batch_*.csv"))):
+        with open(p, "rb") as f:
+            out[os.path.basename(p)] = f.read()
+    return out
+
+
+def run_flow_worker(
+    d: str, *, kill_site: str = "", timeout: float = 120.0,
+) -> subprocess.CompletedProcess:
+    """One drain-and-exit pass of the raw-capture flow engine over
+    ``<d>/in`` in a child process (``--setup-flow-inputs`` must have
+    run first)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS="")
+    env.pop("SNTC_RESILIENCE_LOG", None)
+    cmd = [
+        sys.executable, SCRIPT, "--worker", "--flow", "--watch",
+        os.path.join(d, "in"), "--out", os.path.join(d, "out"),
+        "--ckpt", os.path.join(d, "ckpt"),
+    ]
+    if kill_site:
+        cmd += ["--kill-site", kill_site, "--kill-after",
+                str(FLOW_KILL_AFTER[kill_site])]
+    return subprocess.run(
+        cmd, env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+def _setup_flow_inputs(d: str) -> None:
+    """Capture files with flows SPANNING file boundaries plus a
+    deterministic out-of-order tail (written by a child process — the
+    parent side of the matrix never imports sntc_tpu)."""
+    setup = subprocess.run(
+        [
+            sys.executable, SCRIPT, "--worker", "--setup-flow-inputs",
+            "--watch", os.path.join(d, "in"),
+        ],
+        env=dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS=""),
+        cwd=REPO, capture_output=True, text=True, timeout=120.0,
+    )
+    if setup.returncode != 0:
+        raise RuntimeError(f"flow input setup failed: {setup.stderr}")
+
+
+def run_flow_reference(workdir: str) -> dict:
+    """One uninterrupted raw-capture flow run; every flow kill
+    scenario compares commits AND sink bytes against it."""
+    d = os.path.join(workdir, "flow_reference")
+    _setup_flow_inputs(d)
+    ref = run_flow_worker(d)
+    if ref.returncode != 0:
+        raise RuntimeError(
+            f"flow reference rc={ref.returncode}: {ref.stderr}"
+        )
+    return {
+        "commits": committed_state(os.path.join(d, "ckpt")),
+        "sink": sink_contents(os.path.join(d, "out")),
+    }
+
+
+def run_flow_kill_scenario(
+    workdir: str, site: str, reference: dict,
+) -> dict:
+    """Kill the flow engine mid-window at ``site``, restart on the
+    same checkpoint (operator state restored from the last commit's
+    snapshot, WAL intents replayed), and require commits and sink
+    bytes BITWISE identical to the uninterrupted reference — zero
+    duplicated or lost windows."""
+    d = os.path.join(workdir, "flow_" + site.replace(".", "_"))
+    _setup_flow_inputs(d)
+    killed = run_flow_worker(d, kill_site=site)
+    if killed.returncode != KILL_EXIT_CODE:
+        return {"site": site, "ok": False,
+                "error": f"kill run rc={killed.returncode} (expected "
+                f"{KILL_EXIT_CODE}): {killed.stderr}"}
+    restarted = run_flow_worker(d)
+    if restarted.returncode != 0:
+        return {"site": site, "ok": False,
+                "error": f"restart rc={restarted.returncode}: "
+                f"{restarted.stderr}"}
+    got_commits = committed_state(os.path.join(d, "ckpt"))
+    got_sink = sink_contents(os.path.join(d, "out"))
+    bitwise = got_sink == reference["sink"]
+    ok = got_commits == reference["commits"] and bitwise
+    return {
+        "site": site, "ok": ok, "sink_bitwise": bitwise,
+        "commits": {str(k): v for k, v in got_commits.items()},
+        "expected_commits": {
+            str(k): v for k, v in reference["commits"].items()
+        },
+        "sink_batches": len(got_sink),
+        "expected_sink_batches": len(reference["sink"]),
+    }
 
 
 def run_promote_worker(
@@ -531,6 +652,11 @@ def run_matrix(workdir: str, pipelined: bool = False) -> dict:
         for s in KILL_SITES
     ]
     results.append(run_drain_scenario(workdir, pipelined=pipelined))
+    flow_ref = run_flow_reference(workdir)
+    results.extend(
+        run_flow_kill_scenario(workdir, s, flow_ref)
+        for s in FLOW_KILL_SITES
+    )
     promo_ref = run_promotion_reference(workdir)
     results.extend(
         run_promotion_kill_scenario(workdir, p, promo_ref)
@@ -672,6 +798,67 @@ def daemon_worker_main(args) -> int:
     return 0
 
 
+#: sink columns the flow scenarios journal (a float-heavy subset of
+#: the 78 emitted features: the bitwise comparison must cover derived
+#: statistics, not just counts)
+FLOW_SINK_COLS = [
+    "Destination Port", "Flow Duration", "Total Fwd Packets",
+    "Total Backward Packets", "Fwd Packet Length Mean",
+    "Bwd Packet Length Std", "Flow IAT Mean", "Flow Bytes/s",
+]
+
+
+def setup_flow_inputs_main(args) -> int:
+    """Write the flow scenarios' capture stream: flows spanning file
+    boundaries, a deterministic out-of-order tail, and a terminal
+    flush file so the reference emits every window."""
+    sys.path.insert(0, REPO)
+    from sntc_tpu.data.synth import write_capture_stream
+
+    info = write_capture_stream(
+        args.watch, n_files=5, flows_per_file=3, packets_per_flow=6,
+        seed=11, defer_fraction=0.2, flush=True,
+    )
+    print(json.dumps({"files": len(info["files"]),
+                      "n_flows": info["n_flows"]}))
+    return 0
+
+
+def flow_worker_main(args) -> int:
+    """One raw-capture flow engine pass: pcap files → keyed windows →
+    feature rows → CSV sink, with snapshot-at-commit state under
+    ``<ckpt>/flow_state``.  ``--kill-site``/``--kill-after`` arm the
+    Nth-call kill programmatically (these sites fire once per
+    batch/commit; the kill must land mid-stream, which the env
+    grammar's first-call semantics cannot express)."""
+    sys.path.insert(0, REPO)
+    from sntc_tpu.core.base import Transformer
+    from sntc_tpu.flow import FlowCaptureSource
+    from sntc_tpu.resilience import arm
+    from sntc_tpu.serve import CsvDirSink, StreamingQuery
+
+    class Identity(Transformer):
+        def transform(self, frame):
+            return frame
+
+    if args.kill_site:
+        arm(args.kill_site, kind="kill", after=args.kill_after, times=1)
+    src = FlowCaptureSource(
+        args.watch, format="pcap",
+        flow_timeout=0.5, activity_timeout=0.2, allowed_lateness=1.2,
+        state_dir=os.path.join(args.ckpt, "flow_state"),
+    )
+    q = StreamingQuery(
+        Identity(), src,
+        CsvDirSink(args.out, columns=FLOW_SINK_COLS),
+        args.ckpt, max_batch_offsets=1,
+    )
+    n = q.process_available()
+    print(json.dumps({"batches": n,
+                      "flow": src.flow_stats()}))
+    return 0
+
+
 def worker_main(args) -> int:
     sys.path.insert(0, REPO)
     from sntc_tpu.core.base import Transformer
@@ -737,6 +924,18 @@ def main(argv=None) -> int:
     ap.add_argument("--setup-models", action="store_true",
                     help="worker: write the promotion scenario's "
                     "incumbent/candidate checkpoints and exit")
+    ap.add_argument("--flow", action="store_true",
+                    help="worker: raw-capture flow-window engine pass "
+                    "(stateful-operator scenarios)")
+    ap.add_argument("--setup-flow-inputs", action="store_true",
+                    help="worker: write the flow scenarios' capture "
+                    "stream and exit")
+    ap.add_argument("--kill-site", default="",
+                    help="worker: arm this site with an Nth-call kill "
+                    "(--kill-after) before serving")
+    ap.add_argument("--kill-after", type=int, default=0,
+                    help="worker: calls to let through before the "
+                    "armed --kill-site kill fires")
     ap.add_argument("--model-dir", default=None,
                     help="worker: serving-model checkpoint (doubles as "
                     "the promotion publish target)")
@@ -753,6 +952,10 @@ def main(argv=None) -> int:
     if args.worker:
         if args.setup_models:
             return setup_models_main(args)
+        if args.setup_flow_inputs:
+            return setup_flow_inputs_main(args)
+        if args.flow:
+            return flow_worker_main(args)
         if args.daemon:
             return daemon_worker_main(args)
         if args.model_dir:

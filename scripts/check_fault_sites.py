@@ -106,6 +106,42 @@ def check_kinds() -> list:
     return problems
 
 
+_CHAOS = "scripts/chaos_crash_matrix.py"
+# the kill-site tuples the crash matrix drives; every stream.*/sink.*
+# and every flow.* site must appear in one of them
+_CHAOS_TUPLE_RE = re.compile(
+    r"^(?:KILL_SITES|FLOW_KILL_SITES)\s*=\s*\(([^)]*)\)", re.MULTILINE
+)
+
+
+def chaos_kill_sites() -> set:
+    """Sites the chaos crash matrix kills at (KILL_SITES +
+    FLOW_KILL_SITES literals in the script)."""
+    with open(os.path.join(REPO, _CHAOS)) as f:
+        text = f.read()
+    sites = set()
+    for body in _CHAOS_TUPLE_RE.findall(text):
+        sites.update(re.findall(r"""["']([A-Za-z0-9_.]+)["']""", body))
+    return sites
+
+
+def check_chaos_coverage() -> list:
+    """Every engine-protocol fault site (stream.*/sink.*/flow.*) must
+    have a kill-and-restart scenario in the crash matrix — a declared
+    site nobody ever kills at is untested crash surface."""
+    covered = chaos_kill_sites()
+    must_cover = {
+        s for s in declared_sites()
+        if s.split(".")[0] in ("stream", "sink", "flow")
+        and s != "stream.read"  # read kills pre-WAL == stream.wal row
+    }
+    return [
+        f"fault site {site!r} has no kill scenario in {_CHAOS} "
+        "(KILL_SITES/FLOW_KILL_SITES)"
+        for site in sorted(must_cover - covered)
+    ]
+
+
 def check() -> list:
     """Returns a list of human-readable drift complaints (empty = ok)."""
     in_code = code_sites()
@@ -133,6 +169,7 @@ def check() -> list:
             f"fault_point({site!r}) call site exists in sntc_tpu/"
         )
     problems.extend(check_kinds())
+    problems.extend(check_chaos_coverage())
     return problems
 
 

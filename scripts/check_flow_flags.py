@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Static drift check: raw-capture flow knobs across CLI ⇔ flow ⇔ docs.
+
+The stateful flow-window surface is one feature spread over three
+layers — ``python -m sntc_tpu serve`` flags, the ``sntc_tpu.flow``
+constructor kwargs they map to, and the documentation — and each knob
+must exist in all of them:
+
+==========================  =========================================
+``--from-capture``          ``FlowCaptureSource(format=...)``
+``--flow-timeout``          ``PcapFlowMeter(flow_timeout=...)``
+``--flow-activity-timeout`` ``PcapFlowMeter(activity_timeout=...)``
+``--flow-lateness``         ``FlowFeatureEngine(allowed_lateness=...)``
+``--flow-max-packets``      ``FlowFeatureEngine(max_state_packets=...)``
+==========================  =========================================
+
+Every flag must appear in the marker-delimited flow-flags table of
+``docs/RESILIENCE.md`` AND in the README raw-capture quickstart, and
+the serve-daemon parser must carry the ``--from-capture`` default for
+the matching ``TenantSpec.from_capture`` field.  Wired as a tier-1
+test (``tests/test_flow.py``) so the layers cannot drift silently —
+the ``check_lifecycle_flags.py`` discipline applied to the flow
+surface.
+
+Exit 0 when consistent; exit 1 with a per-knob report otherwise.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (serve CLI flag, owner class name, kwarg it maps to)
+FLAGS = (
+    ("--from-capture", "FlowCaptureSource", "format"),
+    ("--flow-timeout", "PcapFlowMeter", "flow_timeout"),
+    ("--flow-activity-timeout", "PcapFlowMeter", "activity_timeout"),
+    ("--flow-lateness", "FlowFeatureEngine", "allowed_lateness"),
+    ("--flow-max-packets", "FlowFeatureEngine", "max_state_packets"),
+)
+DOC = "docs/RESILIENCE.md"
+TABLE_BEGIN = "<!-- flow-flags:begin -->"
+TABLE_END = "<!-- flow-flags:end -->"
+README_NEEDLE = "--from-capture"
+
+
+def _read(rel: str) -> str:
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def _owner(name: str):
+    sys.path.insert(0, REPO)
+    from sntc_tpu.flow import (
+        FlowCaptureSource,
+        FlowFeatureEngine,
+        PcapFlowMeter,
+    )
+
+    return {
+        "FlowCaptureSource": FlowCaptureSource,
+        "FlowFeatureEngine": FlowFeatureEngine,
+        "PcapFlowMeter": PcapFlowMeter,
+    }[name]
+
+
+def _doc_table() -> str:
+    text = _read(DOC)
+    if TABLE_BEGIN not in text or TABLE_END not in text:
+        return ""
+    return text.split(TABLE_BEGIN, 1)[1].split(TABLE_END, 1)[0]
+
+
+def check() -> list:
+    """Returns a list of human-readable drift complaints (empty = ok)."""
+    problems = []
+    app_src = _read(os.path.join("sntc_tpu", "app.py"))
+    table = _doc_table()
+    if not table:
+        problems.append(
+            f"{DOC} is missing the marker-delimited flow-flags table "
+            f"({TABLE_BEGIN} ... {TABLE_END})"
+        )
+    if README_NEEDLE not in _read("README.md"):
+        problems.append(
+            "README.md has no raw-capture quickstart "
+            f"({README_NEEDLE!r} not found)"
+        )
+    for flag, owner_name, target in FLAGS:
+        if f'"{flag}"' not in app_src:
+            problems.append(
+                f"serve CLI flag {flag!r} missing from sntc_tpu/app.py"
+            )
+        owner = _owner(owner_name)
+        params = inspect.signature(owner.__init__).parameters
+        if target not in params:
+            problems.append(
+                f"{owner_name} has no {target!r} kwarg for {flag!r} "
+                "to map to"
+            )
+        if table and flag not in table:
+            problems.append(
+                f"{flag!r} missing from the {DOC} flow-flags table"
+            )
+    # reverse direction: every table row must be a declared flag
+    for row_flag in re.findall(r"`(--[a-z-]+)`", table):
+        if row_flag not in {f for f, _o, _t in FLAGS}:
+            problems.append(
+                f"{DOC} flow-flags table documents {row_flag!r} but "
+                "the checker's FLAGS mapping does not declare it"
+            )
+    # the daemon side: the per-tenant default flag and its spec field
+    daemon_src = app_src.split('sub.add_parser(\n        "serve-daemon"', 1)
+    daemon_src = daemon_src[1] if len(daemon_src) == 2 else ""
+    if '"--from-capture"' not in daemon_src:
+        problems.append(
+            "serve-daemon parser is missing the '--from-capture' "
+            "per-tenant default flag"
+        )
+    from dataclasses import fields as dc_fields
+
+    sys.path.insert(0, REPO)
+    from sntc_tpu.serve.tenancy import TenantSpec
+
+    spec_fields = {f.name for f in dc_fields(TenantSpec)}
+    for fld in ("from_capture", "flow_options"):
+        if fld not in spec_fields:
+            problems.append(
+                f"TenantSpec has no {fld!r} field for the daemon "
+                "raw-capture surface"
+            )
+    return sorted(set(problems))
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("flow-flag drift detected:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print(
+        f"ok: {len(FLAGS)} flow flags consistent across CLI, flow "
+        "kwargs, TenantSpec, and docs"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

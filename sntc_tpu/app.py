@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import contextlib
 import json
+import os
 import sys
 import time
 
@@ -455,13 +456,35 @@ def cmd_serve(args) -> int:
             partial_fit=args.partial_fit,
             n_classes=len(labels) if labels is not None else None,
         )
-    q = StreamingQuery(
-        model,
-        FileStreamSource(
+    # --from-capture (flow subsystem): the watch directory holds RAW
+    # pcap/NetFlow capture files; a stateful keyed-window operator
+    # computes the CICIDS2017 flow features live (watermark-driven
+    # windows, crash-safe snapshot-at-commit state under
+    # <checkpoint>/flow_state) and the emitted feature rows ride the
+    # SAME admission → predict → sink path the CSV mode serves.  See
+    # docs/RESILIENCE.md "Stateful flow windows".
+    if args.from_capture:
+        from sntc_tpu.flow import FlowCaptureSource
+
+        source = FlowCaptureSource(
+            args.watch,
+            format=args.from_capture,
+            flow_timeout=args.flow_timeout,
+            activity_timeout=args.flow_activity_timeout,
+            allowed_lateness=args.flow_lateness,
+            max_state_packets=args.flow_max_packets,
+            state_dir=os.path.join(args.checkpoint, "flow_state"),
+            prefetch_batches=(args.prefetch_batches if pipelined else 0),
+        )
+    else:
+        source = FileStreamSource(
             args.watch,
             prefetch_batches=(args.prefetch_batches if pipelined else 0),
             parse_salvage=contract is not None,
-        ),
+        )
+    q = StreamingQuery(
+        model,
+        source,
         CsvDirSink(args.out, columns=out_cols),
         args.checkpoint,
         max_batch_offsets=args.max_files_per_batch,
@@ -553,6 +576,7 @@ def cmd_serve_daemon(args) -> int:
         "quarantine_after": args.quarantine_after,
         "quarantine_cooldown_s": args.quarantine_cooldown,
         "stop_after": args.stop_after,
+        "from_capture": args.from_capture,
         "max_batch_offsets": args.max_files_per_batch,
         "max_batch_failures": (
             args.max_batch_failures if args.max_batch_failures > 0
@@ -792,6 +816,34 @@ def main(argv=None) -> int:
                    help="failed rounds before a poison batch is "
                    "dead-lettered and committed; 0 = first failure "
                    "kills the query (pre-r6 semantics)")
+    p.add_argument("--from-capture", default=None,
+                   choices=["pcap", "netflow"],
+                   help="serve RAW captures: --watch holds pcap/.nf5 "
+                   "capture files and a stateful keyed-window operator "
+                   "computes the CICIDS2017 flow features live "
+                   "(crash-safe state under <checkpoint>/flow_state); "
+                   "unset = the default precomputed-CSV mode")
+    p.add_argument("--flow-timeout", type=float, default=120.0,
+                   metavar="S",
+                   help="session-window quiet gap: a flow idle longer "
+                   "than this (behind the watermark) is COMPLETE and "
+                   "its feature row emits (CICFlowMeter's flow "
+                   "timeout)")
+    p.add_argument("--flow-activity-timeout", type=float, default=5.0,
+                   metavar="S",
+                   help="Active/Idle split gap inside a flow window "
+                   "(CICFlowMeter's activity timeout; pcap only)")
+    p.add_argument("--flow-lateness", type=float, default=5.0,
+                   metavar="S",
+                   help="allowed event-time lateness: the watermark "
+                   "trails the max seen timestamp by this much; "
+                   "records behind the watermark drop with reason "
+                   "late_record (journaled, counted)")
+    p.add_argument("--flow-max-packets", type=int, default=500_000,
+                   help="hard cap on buffered records across all open "
+                   "windows: beyond it the oldest flows force-evict "
+                   "early (reason state_cap) so operator state stays "
+                   "bounded under any replay")
     _add_obs_flags(p)
     add_platform_arg(p)
     p.set_defaults(fn=cmd_serve)
@@ -860,6 +912,15 @@ def main(argv=None) -> int:
                    help="default per-tenant data-plane admission "
                    "(TenantSpec row_policy) against the canonical "
                    "CICIDS2017 contract")
+    p.add_argument("--from-capture", default=None,
+                   choices=["pcap", "netflow"],
+                   help="default per-tenant raw-capture mode "
+                   "(TenantSpec from_capture): tenants' watch dirs "
+                   "hold capture files and each tenant runs its own "
+                   "stateful flow-window operator (state under "
+                   "tenant/<id>/ckpt/flow_state); per-tenant "
+                   "'flow_options' in the tenants JSON tunes the "
+                   "window knobs")
     p.add_argument("--batch-retry-attempts", type=int, default=2)
     p.add_argument("--max-batch-failures", type=int, default=3,
                    help="default per-tenant poison-batch threshold "

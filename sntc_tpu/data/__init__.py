@@ -11,6 +11,7 @@ from sntc_tpu.data.schema import (
 from sntc_tpu.data.synth import (
     generate_drift_frames,
     generate_frame,
+    write_capture_stream,
     write_day_csvs,
     write_drift_stream,
 )
@@ -27,6 +28,7 @@ __all__ = [
     "SchemaViolation",
     "generate_frame",
     "generate_drift_frames",
+    "write_capture_stream",
     "write_day_csvs",
     "write_drift_stream",
     "clean_flows",

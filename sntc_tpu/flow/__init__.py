@@ -1,0 +1,40 @@
+"""Stateful flow-feature engine: crash-safe keyed session windows from
+raw captures to CICIDS2017 feature rows (ROADMAP item 4, [B:11]).
+
+- :class:`FlowFeatureEngine` — the keyed window operator (watermarks,
+  late/out-of-order policy, bounded state, snapshot/restore);
+- :class:`PcapFlowMeter` / :class:`NetFlowMeter` — keying + emission
+  over the native parsers' record matrices (emission defers to the
+  hardened batch meters, so windowed and whole-capture features can
+  never drift);
+- :class:`FlowCaptureSource` — the ``StreamSource`` adapter opening
+  end-to-end raw-capture → features → classify serving
+  (``python -m sntc_tpu serve --from-capture pcap ...``);
+- :class:`FlowStateStore` — snapshot-at-commit persistence under the
+  PR-1 atomic-publish + sha256 discipline.
+
+See docs/RESILIENCE.md "Stateful flow windows".
+"""
+
+from sntc_tpu.flow.engine import (
+    FlowFeatureEngine,
+    NetFlowMeter,
+    PcapFlowMeter,
+)
+from sntc_tpu.flow.source import FORMATS, FlowCaptureSource
+from sntc_tpu.flow.state import (
+    FlowStateCorruptError,
+    FlowStateError,
+    FlowStateStore,
+)
+
+__all__ = [
+    "FlowFeatureEngine",
+    "PcapFlowMeter",
+    "NetFlowMeter",
+    "FlowCaptureSource",
+    "FORMATS",
+    "FlowStateStore",
+    "FlowStateError",
+    "FlowStateCorruptError",
+]

@@ -187,6 +187,48 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         type=COUNTER, labels=("tenant",),
         help="Unhealthy strikes counted against the tenant ladder.",
     ),
+    # -- the stateful flow-feature engine (sntc_tpu/flow) --------------------
+    "sntc_flow_records_consumed_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Parser records (packets/datagram rows) accepted into "
+        "keyed window state.",
+    ),
+    "sntc_flow_late_records_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Records dropped behind the watermark (reason code "
+        "late_record).",
+    ),
+    "sntc_flow_out_of_order_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Accepted records that arrived behind the stream head "
+        "but inside the lateness bound.",
+    ),
+    "sntc_flow_windows_emitted_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Completed flow windows emitted as feature rows.",
+    ),
+    "sntc_flow_evictions_total": dict(
+        type=COUNTER, labels=("reason", "tenant"),
+        help="Flows evicted from keyed state, by reason (watermark / "
+        "state_cap / flush).",
+    ),
+    "sntc_flow_snapshots_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Operator-state snapshots published at commit.",
+    ),
+    "sntc_flow_active_flows": dict(
+        type=GAUGE, labels=("tenant",),
+        help="Open (uncompleted) flow windows held in keyed state.",
+    ),
+    "sntc_flow_state_packets": dict(
+        type=GAUGE, labels=("tenant",),
+        help="Buffered parser records across all open windows (the "
+        "watermark-bounded state size).",
+    ),
+    "sntc_flow_state_bytes": dict(
+        type=GAUGE, labels=("tenant",),
+        help="Size of the last published operator-state snapshot.",
+    ),
     # -- the tracer's own accounting -----------------------------------------
     "sntc_spans_dropped_total": dict(
         type=COUNTER, labels=(),

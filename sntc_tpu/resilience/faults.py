@@ -26,6 +26,12 @@ Wired sites:
                         checkpoint publish
 ``model.swap``          ``lifecycle`` promotion: post-publish/pre-swap
                         (first call) and post-swap (second call)
+``flow.emit``           ``flow.FlowCaptureSource`` after window state
+                        mutated, before the emitted batch is returned
+``flow.evict``          ``flow.FlowFeatureEngine`` eviction pass, before
+                        completed windows leave the keyed state
+``flow.state_snapshot`` ``flow.FlowStateStore`` before a state snapshot
+                        reaches disk
 ======================  =====================================================
 
 Env grammar (comma-separated specs)::
@@ -114,6 +120,9 @@ SITES = (
     "cv.fit",
     "model.publish",
     "model.swap",
+    "flow.emit",
+    "flow.evict",
+    "flow.state_snapshot",
 )
 
 

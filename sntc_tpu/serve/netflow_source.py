@@ -46,6 +46,37 @@ class _CaptureDirSource(DirStreamSource):
             return self._decode_file(fault_data("source.parse", f.read()))
 
 
+def decode_pcap_packets(data: bytes):
+    """``parse_pcap`` with THE capture-file serving policy, shared by
+    every pcap-serving source (:class:`PcapDirSource`, the flow
+    engine's ``FlowCaptureSource``): a short header is a
+    partially-written capture (external writer race) — FAILING the
+    batch is the lossless choice, the intent stays uncommitted in the
+    WAL and the engine replays it next poll when the file is complete
+    (writers should rename into place atomically, as ``capture_udp``
+    does); ≥24 bytes with a bad magic or unsupported linktype will
+    never become readable — retrying would wedge the stream forever,
+    so skip it (0 packets) and warn, like Spark's badRecordsPath.
+    Returns the ``[n, PCAP_FIELDS]`` packet matrix."""
+    import numpy as np
+
+    from sntc_tpu.native import PCAP_FIELDS, parse_pcap
+
+    pkts = parse_pcap(data)
+    if pkts is None:
+        if len(data) < 24:
+            raise ValueError(
+                "truncated pcap capture (partial write? writers must "
+                "rename into place atomically); batch will be retried"
+            )
+        warnings.warn(
+            "skipping unreadable capture file (bad magic or "
+            "unsupported linktype; only Ethernet/raw-IP are decoded)"
+        )
+        return np.zeros((0, PCAP_FIELDS), np.float64)
+    return pkts
+
+
 class NetFlowDirSource(_CaptureDirSource):
     """Directory of NetFlow v5 capture files (``*.nf5``)."""
 
@@ -122,38 +153,10 @@ class PcapDirSource(_CaptureDirSource):
         self.activity_timeout = activity_timeout
 
     def _decode_file(self, data: bytes) -> Frame:
-        import numpy as np
+        from sntc_tpu.native import packets_to_flow_frame
 
-        from sntc_tpu.data.schema import CICIDS2017_FEATURES
-        from sntc_tpu.native import packets_to_flow_frame, parse_pcap
-
-        pkts = parse_pcap(data)
-        if pkts is None:
-            if len(data) < 24:
-                # A short header is a partially-written capture (external
-                # writer race).  FAILING the batch is the lossless choice:
-                # the intent stays uncommitted in the WAL and the engine
-                # replays it next poll, when the file is complete — an
-                # empty-frame fallback would commit past the file and drop
-                # its flows forever.  Writers should create capture files
-                # atomically (write to .tmp, then rename) as capture_udp
-                # does.
-                raise ValueError(
-                    "truncated pcap capture (partial write? writers must "
-                    "rename into place atomically); batch will be retried"
-                )
-            # ≥24 bytes with a bad magic or unsupported linktype will never
-            # become readable — retrying would wedge the stream forever.
-            # Skip it (0 rows) and warn, like Spark's badRecordsPath.
-            warnings.warn(
-                "skipping unreadable capture file (bad magic or "
-                "unsupported linktype; only Ethernet/raw-IP are decoded)"
-            )
-            return Frame(
-                {n: np.zeros(0, np.float32) for n in CICIDS2017_FEATURES}
-            )
         return packets_to_flow_frame(
-            pkts,
+            decode_pcap_packets(data),
             flow_timeout=self.flow_timeout,
             activity_timeout=self.activity_timeout,
         )

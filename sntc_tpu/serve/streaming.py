@@ -615,6 +615,12 @@ class StreamingQuery:
             self._last_committed = self._scan_last_committed()
             self._end_offset = self._read_committed_end(self._last_committed)
         self._next_start = self._end_offset
+        # stateful sources (sntc_tpu/flow): rewind operator state to
+        # the snapshot matching the recovered committed offset BEFORE
+        # any WAL replay dispatches — replay then reconverges bitwise
+        restore = getattr(source, "on_restore", None)
+        if restore is not None:
+            restore(self._end_offset)
 
     def _init_append_wal(self, checkpoint_dir: str) -> None:
         """``wal_mode='append'``: one JSONL log per side (intents /
@@ -1195,6 +1201,14 @@ class StreamingQuery:
         """The ONE commit protocol (WAL commit + bookkeeping + progress
         record), shared by normal retirement and both quarantine paths
         so restart-recovery state can never diverge between them."""
+        # stateful sources publish their operator-state snapshot BEFORE
+        # the commit record is written: the two retained snapshots then
+        # always bracket the committed offset, so a crash anywhere in
+        # between restores the exact-offset snapshot and the replayed
+        # batch reconsumes from it (sntc_tpu/flow/source.py)
+        committed_hook = getattr(self.source, "on_batch_committed", None)
+        if committed_hook is not None:
+            committed_hook(batch_id, intent)
         # kill point post-sink/pre-commit: results reached the sink but
         # the commit never lands — the restarted query must REPLAY the
         # batch from its WAL'd intent and the sink must dedupe (chaos

@@ -139,6 +139,12 @@ class TenantSpec:
     max_batch_failures: Optional[int] = 3
     retry_policy: Optional[RetryPolicy] = None
     out_columns: Optional[List[str]] = None
+    # raw-capture serving (sntc_tpu/flow): 'pcap'|'netflow' arms a
+    # stateful FlowCaptureSource over the watch dir (state snapshots
+    # under tenant/<id>/ckpt/flow_state); flow_options passes window
+    # knobs (flow_timeout, allowed_lateness, ...) through to it
+    from_capture: Optional[str] = None
+    flow_options: Optional[Dict[str, Any]] = None
 
     def __post_init__(self):
         if not self.tenant_id or "/" in self.tenant_id:
@@ -421,9 +427,21 @@ class ServeDaemon:
                     f"tenant {spec.tenant_id!r} needs a source or a "
                     "watch directory"
                 )
-            source = FileStreamSource(
-                spec.watch, parse_salvage=spec.schema_contract is not None
-            )
+            if spec.from_capture:
+                from sntc_tpu.flow import FlowCaptureSource
+
+                source = FlowCaptureSource(
+                    spec.watch,
+                    format=spec.from_capture,
+                    state_dir=os.path.join(tdir, "ckpt", "flow_state"),
+                    tenant=spec.tenant_id,
+                    **(spec.flow_options or {}),
+                )
+            else:
+                source = FileStreamSource(
+                    spec.watch,
+                    parse_salvage=spec.schema_contract is not None,
+                )
         sink = spec.sink
         if sink is None:
             if spec.out is None:

@@ -1,0 +1,753 @@
+"""Stateful flow-feature engine tests (sntc_tpu/flow, r14).
+
+Golden-value window correctness against hand-computed references,
+bitwise equality of windowed output vs the whole-capture oracle
+(including out-of-order and session-gap cases), the late-record /
+watermark-eviction state bounds, snapshot/restore and
+snapshot-at-commit crash safety (in-process and via the real
+process-kill chaos matrix), the `--from-capture` CLI path, and the
+tier-1 wiring of scripts/check_flow_flags.py.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.data.synth import write_capture_stream
+from sntc_tpu.flow import (
+    FlowCaptureSource,
+    FlowFeatureEngine,
+    FlowStateCorruptError,
+    FlowStateError,
+    FlowStateStore,
+    NetFlowMeter,
+    PcapFlowMeter,
+)
+from sntc_tpu.native import (
+    make_datagram,
+    make_packet,
+    make_pcap,
+    netflow_to_flow_frame,
+    packets_to_flow_frame,
+    parse_pcap,
+    parse_stream,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+A, B = 0x0A000001, 0x0A000002  # the golden flow's endpoints
+
+
+def _pkts(spec):
+    """[(ts, src, dst, sport, dport, payload)] -> parsed packet matrix
+    (through the real pcap encode/decode round trip)."""
+    cap = make_pcap([
+        (ts, make_packet(s, d, sp, dp, proto=6, payload=pay))
+        for ts, s, d, sp, dp, pay in spec
+    ])
+    out = parse_pcap(cap)
+    assert out is not None and out.shape[0] == len(spec)
+    return out
+
+
+def _sentinel(ts):
+    """One far-future packet on a reserved key: advances the watermark
+    without joining any flow under test."""
+    return _pkts([(ts, 0x01010101, 0x02020202, 9, 9, 8)])
+
+
+def _engine(**kw):
+    kw.setdefault("allowed_lateness", 0.5)
+    meter = PcapFlowMeter(
+        flow_timeout=kw.pop("flow_timeout", 2.0),
+        activity_timeout=kw.pop("activity_timeout", 1.0),
+    )
+    return FlowFeatureEngine(meter, **kw)
+
+
+def _rows(frame):
+    """Canonical row matrix for order-free bitwise comparison."""
+    arr = np.stack(
+        [np.asarray(frame[c], np.float64) for c in frame.columns], 1
+    )
+    return arr[np.lexsort(arr.T[::-1])]
+
+
+# ---------------------------------------------------------------------------
+# golden-value window correctness
+# ---------------------------------------------------------------------------
+
+
+def test_golden_single_flow_hand_computed():
+    eng = _engine()
+    eng.consume(_pkts([
+        (100.0, A, B, 1024, 80, 100),   # fwd
+        (100.1, B, A, 80, 1024, 50),    # bwd
+        (100.3, A, B, 1024, 80, 200),   # fwd
+    ]))
+    eng.consume(_sentinel(200.0))  # watermark far past the flow
+    out = eng.poll()
+    assert out.num_rows == 1
+    got = {c: float(out[c][0]) for c in out.columns}
+    assert got["Destination Port"] == 80.0
+    assert got["Flow Duration"] == pytest.approx(300_000.0, rel=1e-6)
+    assert got["Total Fwd Packets"] == 2.0
+    assert got["Total Backward Packets"] == 1.0
+    assert got["Total Length of Fwd Packets"] == 300.0
+    assert got["Total Length of Bwd Packets"] == 50.0
+    assert got["Fwd Packet Length Mean"] == 150.0
+    assert got["Fwd Packet Length Max"] == 200.0
+    assert got["Fwd Packet Length Min"] == 100.0
+    # sample std of [100, 200]
+    assert got["Fwd Packet Length Std"] == pytest.approx(
+        70.7106781, rel=1e-6
+    )
+    assert got["Flow IAT Mean"] == pytest.approx(150_000.0, rel=1e-6)
+    assert got["Flow IAT Max"] == pytest.approx(200_000.0, rel=1e-6)
+    assert got["Flow IAT Min"] == pytest.approx(100_000.0, rel=1e-6)
+    assert got["Flow Bytes/s"] == pytest.approx(350 / 0.3, rel=1e-5)
+    assert got["Flow Packets/s"] == pytest.approx(3 / 0.3, rel=1e-5)
+    assert got["Down/Up Ratio"] == 0.0
+    assert eng.windows_emitted == 1
+    assert eng.evictions == {"watermark": 1}
+
+
+def test_session_gap_splits_into_two_windows():
+    eng = _engine(flow_timeout=2.0)
+    spec = [
+        (10.0, A, B, 1024, 80, 100), (10.5, A, B, 1024, 80, 100),
+        # quiet gap of 20s >> flow_timeout: a NEW session window
+        (30.0, A, B, 1024, 80, 40), (30.2, A, B, 1024, 80, 40),
+    ]
+    eng.consume(_pkts(spec))
+    eng.consume(_sentinel(100.0))
+    out = eng.poll()
+    assert out.num_rows == 2
+    durs = sorted(np.asarray(out["Flow Duration"], np.float64))
+    assert durs == pytest.approx([200_000.0, 500_000.0], rel=1e-6)
+
+
+def test_out_of_order_within_lateness_is_bitwise_order_free():
+    spec = [
+        (10.0, A, B, 1024, 80, 100), (10.1, B, A, 80, 1024, 60),
+        (10.2, A, B, 1024, 80, 80), (10.3, B, A, 80, 1024, 30),
+    ]
+    pkts = _pkts(spec)
+    e1 = _engine(allowed_lateness=1.0)
+    e1.consume(pkts)
+    e1.consume(_sentinel(100.0))
+    ref = e1.poll()
+    # scrambled arrival over several consume calls, inside lateness
+    e2 = _engine(allowed_lateness=1.0)
+    e2.consume(pkts[[2]])
+    e2.consume(pkts[[0, 3]])
+    e2.consume(pkts[[1]])
+    assert e2.out_of_order >= 2 and e2.late_records == 0
+    e2.consume(_sentinel(100.0))
+    out = e2.poll()
+    assert np.array_equal(_rows(ref), _rows(out))  # bitwise
+
+
+def test_late_record_drops_with_reason_code():
+    from sntc_tpu.resilience import recent_events
+
+    eng = _engine(allowed_lateness=0.5)
+    eng.consume(_pkts([(50.0, A, B, 1024, 80, 100)]))
+    # 40.0 < watermark 49.5: dropped, never joins any window
+    eng.consume(_pkts([(40.0, A, B, 1024, 80, 999)]))
+    assert eng.late_records == 1
+    evs = [e for e in recent_events()
+           if e.get("event") == "flow_late_records"]
+    assert evs and evs[-1]["reason"] == "late_record"
+    eng.consume(_sentinel(100.0))
+    out = eng.poll()
+    assert out.num_rows == 1
+    assert float(out["Total Fwd Packets"][0]) == 1.0  # late pkt excluded
+
+
+def test_watermark_eviction_bounds_state_on_out_of_order_replay():
+    """The acceptance-criteria bound: on a long out-of-order replayed
+    capture, buffered state stays a small constant (the watermark
+    window) while total consumption grows without bound."""
+    d = str(pytest.importorskip("tempfile").mkdtemp())
+    info = write_capture_stream(
+        d, n_files=20, flows_per_file=4, packets_per_flow=6,
+        seed=5, defer_fraction=0.25, flush=False, file_gap_s=1.0,
+    )
+    src = FlowCaptureSource(
+        d, format="pcap", flow_timeout=0.5, allowed_lateness=1.5,
+    )
+    peaks = []
+    for i in range(src.latest_offset()):
+        src.get_batch(i, i + 1)
+        peaks.append(src.engine.state_size()["packets"])
+    consumed = src.engine.records_consumed
+    assert consumed >= 20 * 4 * 6 - info["n_flows"]  # ~everything
+    # watermark window spans lateness (1.5) + timeout (0.5) + one file
+    # of arrival skew: at most ~4 files' packets ever buffered
+    per_file = 4 * 6
+    assert max(peaks) <= 4 * per_file
+    assert max(peaks) < consumed / 3  # state ≪ stream length
+    assert src.engine.out_of_order > 0
+
+
+def test_state_cap_force_evicts_oldest():
+    eng = _engine(flow_timeout=1000.0, max_state_packets=8)
+    # long-lived flows the watermark can never complete
+    for i in range(6):
+        s = 0x0B000000 + i
+        eng.consume(_pkts([
+            (10.0 + i, s, B, 2000 + i, 80, 10),
+            (10.5 + i, s, B, 2000 + i, 80, 10),
+        ]))
+        eng.poll()
+        assert eng.state_size()["packets"] <= 8
+    assert eng.evictions.get("state_cap", 0) >= 1
+    assert eng.windows_emitted >= 1
+
+
+def test_snapshot_restore_replays_bitwise():
+    d = str(pytest.importorskip("tempfile").mkdtemp())
+    write_capture_stream(
+        d, n_files=6, flows_per_file=3, packets_per_flow=6, seed=7,
+        defer_fraction=0.2,
+    )
+    src1 = FlowCaptureSource(
+        d, format="pcap", flow_timeout=0.5, allowed_lateness=1.2,
+    )
+    frames, snap = [], None
+    for i in range(src1.latest_offset()):
+        if i == 3:
+            snap = src1.engine.snapshot()
+        frames.append(src1.get_batch(i, i + 1))
+    src2 = FlowCaptureSource(
+        d, format="pcap", flow_timeout=0.5, allowed_lateness=1.2,
+    )
+    src2.engine.restore(snap)
+    src2._consumed_end = 3
+    for i in range(3, src2.latest_offset()):
+        a, b = frames[i], src2.get_batch(i, i + 1)
+        assert a.columns == b.columns
+        for c in a.columns:
+            assert np.array_equal(a[c], b[c]), c  # bitwise
+
+
+def test_windowed_equals_whole_capture_oracle():
+    d = str(pytest.importorskip("tempfile").mkdtemp())
+    info = write_capture_stream(
+        d, n_files=6, flows_per_file=3, packets_per_flow=6, seed=9,
+        defer_fraction=0.2,
+    )
+    oracle = packets_to_flow_frame(
+        info["packets"], flow_timeout=0.5, activity_timeout=0.2
+    )
+    src = FlowCaptureSource(
+        d, format="pcap", flow_timeout=0.5, activity_timeout=0.2,
+        allowed_lateness=5.0,
+    )
+    frames = [
+        src.get_batch(i, i + 1) for i in range(src.latest_offset())
+    ]
+    emitted = Frame.concat_all(frames)
+    # every real window emitted (the sentinel stays open in state)
+    assert src.engine.state_size() == {"flows": 1, "packets": 1}
+    assert np.array_equal(_rows(emitted), _rows(oracle))  # bitwise
+
+
+# ---------------------------------------------------------------------------
+# NetFlow windows
+# ---------------------------------------------------------------------------
+
+
+def test_netflow_merge_golden():
+    # two exporter records of ONE flow, 100ms apart -> one window
+    recs = [
+        (A, B, 1024, 80, 6, 0x02, 0, 3, 300, 1000, 1040, 1, 2, 0, 0),
+        (A, B, 1024, 80, 6, 0x18, 0, 2, 200, 1100, 1150, 1, 2, 0, 0),
+        # a different flow
+        (B, A, 443, 9999, 6, 0x10, 0, 1, 99, 1000, 1001, 1, 2, 0, 0),
+    ]
+    records = parse_stream(make_datagram(recs))
+    out = NetFlowMeter(flow_timeout=10.0).emit(records)
+    assert out.num_rows == 2
+    i = int(np.asarray(out["Total Fwd Packets"]).argmax())
+    assert float(out["Total Fwd Packets"][i]) == 5.0   # 3 + 2
+    assert float(out["Total Length of Fwd Packets"][i]) == 500.0
+    # duration: min(first)=1000 .. max(last)=1150 -> 150ms = 150000us
+    assert float(out["Flow Duration"][i]) == pytest.approx(150_000.0)
+    assert float(out["SYN Flag Count"][i]) == 1.0  # OR'd flags has 0x02
+    assert float(out["PSH Flag Count"][i]) == 1.0  # ...and 0x08
+
+
+def test_netflow_capture_source_end_to_end():
+    d = str(pytest.importorskip("tempfile").mkdtemp())
+    info = write_capture_stream(
+        d, n_files=4, flows_per_file=3, packets_per_flow=4, seed=3,
+        format="netflow", file_gap_s=1.0,
+    )
+    assert info["records"].shape[1] == 16
+    src = FlowCaptureSource(
+        d, format="netflow", flow_timeout=0.5, allowed_lateness=0.2,
+    )
+    frames = [
+        src.get_batch(i, i + 1) for i in range(src.latest_offset())
+    ]
+    frames.append(src.flush_windows())
+    total = sum(f.num_rows for f in frames)
+    oracle = NetFlowMeter(flow_timeout=0.5).emit(info["records"])
+    # +1: the flush sentinel record emits as its own window here
+    assert total == oracle.num_rows + 1
+
+
+# ---------------------------------------------------------------------------
+# state store + source protocol
+# ---------------------------------------------------------------------------
+
+
+def test_state_store_roundtrip_retention_and_corruption(tmp_path):
+    store = FlowStateStore(str(tmp_path / "st"))
+    for end, payload in ((1, b"one"), (2, b"two"), (3, b"three")):
+        store.publish(end, payload)
+    assert store.ends() == [2, 3]  # keep=2 pruned offset 1
+    assert store.load(3) == b"three"
+    assert store.load(1) is None
+    # torn payload -> loud integrity failure naming the file
+    path = store._file(2)
+    with open(path, "rb") as f:
+        blob = f.read()
+    with open(path, "wb") as f:
+        f.write(blob[:-1])
+    with pytest.raises(FlowStateCorruptError):
+        store.load(2)
+
+
+def test_source_ordered_consumption_and_memoized_retry(tmp_path):
+    d = str(tmp_path / "cap")
+    write_capture_stream(d, n_files=3, flows_per_file=2,
+                         packets_per_flow=4, seed=2)
+    src = FlowCaptureSource(d, format="pcap", flow_timeout=0.5,
+                            allowed_lateness=0.2)
+    f0 = src.get_batch(0, 1)
+    consumed = src.engine.records_consumed
+    # engine read-retry of the SAME range: memoized, no double-consume
+    again = src.get_batch(0, 1)
+    assert again is f0 and src.engine.records_consumed == consumed
+    # rewinding below the consumed offset is a contract violation
+    src.get_batch(1, 2)
+    with pytest.raises(ValueError, match="snapshot-at-commit"):
+        src.get_batch(0, 1)
+
+
+def test_on_restore_requires_matching_snapshot(tmp_path):
+    d = str(tmp_path / "cap")
+    write_capture_stream(d, n_files=3, flows_per_file=2,
+                         packets_per_flow=4, seed=2)
+    src = FlowCaptureSource(
+        d, format="pcap", state_dir=str(tmp_path / "st")
+    )
+    src.on_restore(0)  # fresh state: fine
+    with pytest.raises(FlowStateError, match="diverged"):
+        src.on_restore(2)  # nonzero offset, no snapshot
+    # and with NO store at all, a nonzero offset is unrecoverable
+    src2 = FlowCaptureSource(d, format="pcap")
+    with pytest.raises(FlowStateError, match="state_dir"):
+        src2.on_restore(1)
+
+
+def test_streaming_query_restart_converges_bitwise(tmp_path, mesh8):
+    """In-process crash analog: a fresh StreamingQuery on the same
+    checkpoint (uncommitted WAL intent pending) must replay to the
+    uninterrupted reference's sink bytes."""
+    import glob as _glob
+
+    from sntc_tpu.core.base import Transformer
+    from sntc_tpu.resilience import arm, clear
+    from sntc_tpu.serve.streaming import CsvDirSink, StreamingQuery
+
+    class Identity(Transformer):
+        def transform(self, frame):
+            return frame
+
+    cap = str(tmp_path / "cap")
+    write_capture_stream(cap, n_files=5, flows_per_file=3,
+                         packets_per_flow=6, seed=4, defer_fraction=0.2)
+    cols = ["Destination Port", "Flow Duration", "Total Fwd Packets",
+            "Flow IAT Mean", "Flow Bytes/s"]
+
+    def engine(d):
+        src = FlowCaptureSource(
+            cap, format="pcap", flow_timeout=0.5,
+            allowed_lateness=1.2,
+            state_dir=os.path.join(d, "ckpt", "flow_state"),
+        )
+        return src, StreamingQuery(
+            Identity(), src,
+            CsvDirSink(os.path.join(d, "out"), columns=cols),
+            os.path.join(d, "ckpt"), max_batch_offsets=1,
+        )
+
+    def sink_bytes(d):
+        return {
+            os.path.basename(p): open(p, "rb").read()
+            for p in sorted(_glob.glob(
+                os.path.join(d, "out", "batch_*.csv")
+            ))
+        }
+
+    ref = str(tmp_path / "ref")
+    _, q = engine(ref)
+    n_ref = q.process_available()
+    assert n_ref == 6
+
+    crash = str(tmp_path / "crash")
+    src, q = engine(crash)
+    for _ in range(2):
+        q._run_one_batch()
+    arm("sink.write", kind="io", times=100)
+    with pytest.raises(Exception):
+        q._run_one_batch()
+    clear()
+    assert q.in_flight_count() > 0  # a WAL intent is pending, unsunk
+    del q, src
+    src2, q2 = engine(crash)  # restart: on_restore + WAL replay
+    q2.process_available()
+    assert sink_bytes(crash) == sink_bytes(ref)  # bitwise
+
+
+@pytest.mark.parametrize("fault_site,fault_after", [
+    ("flow.emit", 2),   # raises after the memo landed -> memo path
+    ("flow.evict", 1),  # raises inside poll(), consume already folded
+                        # the records -> the _pending resume path
+])
+def test_raising_flow_fault_retries_without_double_consume(
+    tmp_path, mesh8, fault_site, fault_after,
+):
+    """A RAISING fault anywhere after the consume re-enters get_batch
+    through the engine's retry — the records must never fold into
+    keyed state twice, and the run must converge bitwise to a
+    no-fault reference."""
+    import glob as _glob
+
+    from sntc_tpu.core.base import Transformer
+    from sntc_tpu.resilience import RetryPolicy, arm, clear
+    from sntc_tpu.serve.streaming import CsvDirSink, StreamingQuery
+
+    class Identity(Transformer):
+        def transform(self, frame):
+            return frame
+
+    cap = str(tmp_path / "cap")
+    write_capture_stream(cap, n_files=4, flows_per_file=3,
+                         packets_per_flow=6, seed=17)
+    cols = ["Destination Port", "Flow Duration", "Total Fwd Packets"]
+
+    def run(name, faulted):
+        d = str(tmp_path / name)
+        src = FlowCaptureSource(
+            cap, format="pcap", flow_timeout=0.5,
+            allowed_lateness=0.2,
+            state_dir=os.path.join(d, "ckpt", "flow_state"),
+        )
+        q = StreamingQuery(
+            Identity(), src,
+            CsvDirSink(os.path.join(d, "out"), columns=cols),
+            os.path.join(d, "ckpt"), max_batch_offsets=1,
+            retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.0),
+        )
+        if faulted:
+            # fire mid-stream: state mutated, then the fault raises
+            # before the batch returns
+            arm(fault_site, kind="exc", after=fault_after, times=1)
+        try:
+            q.process_available()
+        finally:
+            clear()
+        consumed = src.engine.records_consumed
+        return {
+            os.path.basename(p): open(p, "rb").read()
+            for p in sorted(_glob.glob(
+                os.path.join(d, "out", "batch_*.csv")
+            ))
+        }, consumed
+
+    ref_sink, ref_consumed = run("ref", faulted=False)
+    got_sink, got_consumed = run("faulted", faulted=True)
+    assert got_consumed == ref_consumed  # exactly-once consumption
+    assert got_sink == ref_sink  # bitwise
+
+
+def test_persistent_poll_failure_quarantines_without_poisoning_state(
+    tmp_path, mesh8,
+):
+    """A poll that fails EVERY round exhausts the quarantine threshold;
+    the quarantined range's records must be excised from keyed state
+    (no cascade of the same failing eviction set into later batches)
+    and the stream must keep emitting windows afterwards."""
+    from sntc_tpu.core.base import Transformer
+    from sntc_tpu.resilience import RetryPolicy, arm, clear
+    from sntc_tpu.serve.streaming import CsvDirSink, StreamingQuery
+
+    class Identity(Transformer):
+        def transform(self, frame):
+            return frame
+
+    cap = str(tmp_path / "cap")
+    write_capture_stream(cap, n_files=5, flows_per_file=3,
+                         packets_per_flow=6, seed=21)
+    src = FlowCaptureSource(
+        cap, format="pcap", flow_timeout=0.5, allowed_lateness=0.2,
+        state_dir=str(tmp_path / "ckpt" / "flow_state"),
+    )
+    q = StreamingQuery(
+        Identity(), src,
+        CsvDirSink(str(tmp_path / "out"),
+                   columns=["Destination Port", "Flow Duration"]),
+        str(tmp_path / "ckpt"), max_batch_offsets=1,
+        retry_policy=RetryPolicy(max_attempts=2, base_delay_s=0.0),
+        max_batch_failures=2,
+    )
+    # 2 attempts/round x 2 rounds = 4 raising polls exhaust ONE batch's
+    # quarantine threshold; afterwards the site is spent and the
+    # stream must recover
+    arm("flow.evict", kind="exc", after=1, times=4)
+    try:
+        for _ in range(10):
+            q.process_available()
+    finally:
+        clear()
+    quarantined = [p for p in q.recentProgress if p.get("quarantined")]
+    assert len(quarantined) == 1, q.recentProgress
+    assert q.last_committed() == 5  # every batch committed regardless
+    # the cascade check: batches AFTER the quarantined one still
+    # emitted windows (their polls did not inherit the poisoned set)
+    after = [p for p in q.recentProgress
+             if p["batchId"] > quarantined[0]["batchId"]]
+    assert sum(p["numInputRows"] for p in after) > 0
+    # and the quarantined range's packets are NOT in keyed state: the
+    # residue is just the flush sentinel plus flows the watermark has
+    # not completed yet — far fewer than a whole un-excised file
+    assert src.engine.state_size()["packets"] < 18  # one file = 18+
+
+
+def test_pipelined_engine_matches_serial_bitwise(tmp_path, mesh8):
+    """The overlapped engine (prefetching source + delivery thread)
+    over the stateful flow source: reads stay ordered on the engine
+    thread, parse staging is stateless, and the sink output must be
+    byte-identical to the serial engine's."""
+    import glob as _glob
+
+    from sntc_tpu.core.base import Transformer
+    from sntc_tpu.serve.streaming import CsvDirSink, StreamingQuery
+
+    class Identity(Transformer):
+        def transform(self, frame):
+            return frame
+
+    cap = str(tmp_path / "cap")
+    write_capture_stream(cap, n_files=6, flows_per_file=3,
+                         packets_per_flow=6, seed=13,
+                         defer_fraction=0.2)
+    cols = ["Destination Port", "Flow Duration", "Total Fwd Packets",
+            "Flow IAT Mean"]
+
+    def run(name, pipelined):
+        d = str(tmp_path / name)
+        src = FlowCaptureSource(
+            cap, format="pcap", flow_timeout=0.5,
+            allowed_lateness=1.2,
+            state_dir=os.path.join(d, "ckpt", "flow_state"),
+            prefetch_batches=2 if pipelined else 0,
+        )
+        q = StreamingQuery(
+            Identity(), src,
+            CsvDirSink(os.path.join(d, "out"), columns=cols),
+            os.path.join(d, "ckpt"), max_batch_offsets=1,
+            pipeline_depth=3 if pipelined else 1,
+            overlap_sink=pipelined,
+        )
+        q.process_available()
+        q.stop()
+        src.close()
+        return {
+            os.path.basename(p): open(p, "rb").read()
+            for p in sorted(_glob.glob(
+                os.path.join(d, "out", "batch_*.csv")
+            ))
+        }
+
+    assert run("serial", False) == run("pipe", True)
+
+
+def test_serve_daemon_capture_tenants(tmp_path, mesh8):
+    """Two raw-capture tenants on one ServeDaemon: each runs its own
+    namespaced flow operator (state under tenant/<id>/ckpt/flow_state)
+    and emits exactly its own capture's windows."""
+    from sntc_tpu.core.base import Transformer
+    from sntc_tpu.serve import ServeDaemon, TenantSpec
+
+    class Identity(Transformer):
+        def transform(self, frame):
+            return frame
+
+    cols = ["Destination Port", "Flow Duration", "Total Fwd Packets"]
+    expected = {}
+    specs = []
+    for k, tid in enumerate(("t0", "t1")):
+        cap = str(tmp_path / "in" / tid)
+        write_capture_stream(cap, n_files=3, flows_per_file=2,
+                             packets_per_flow=4, seed=20 + k)
+        ref = FlowCaptureSource(cap, format="pcap", flow_timeout=0.5,
+                                allowed_lateness=0.2)
+        expected[tid] = sum(
+            ref.get_batch(i, i + 1).num_rows
+            for i in range(ref.latest_offset())
+        )
+        specs.append(TenantSpec(
+            tenant_id=tid, model=Identity(), watch=cap,
+            out=str(tmp_path / "out" / tid), out_columns=cols,
+            from_capture="pcap",
+            flow_options={"flow_timeout": 0.5,
+                          "allowed_lateness": 0.2},
+        ))
+    daemon = ServeDaemon(specs, str(tmp_path / "root"))
+    try:
+        daemon.process_available()
+        snap = {t.spec.tenant_id: t.rows_done for t in daemon.tenants}
+    finally:
+        daemon.close()
+    assert snap == expected and all(v > 0 for v in snap.values())
+    for tid in ("t0", "t1"):
+        assert os.path.isdir(
+            str(tmp_path / "root" / "tenant" / tid / "ckpt"
+                / "flow_state")
+        )
+
+
+# ---------------------------------------------------------------------------
+# capture writer
+# ---------------------------------------------------------------------------
+
+
+def test_write_capture_stream_parses_and_reports_truth(tmp_path):
+    d = str(tmp_path / "cap")
+    info = write_capture_stream(
+        d, n_files=4, flows_per_file=2, packets_per_flow=4, seed=0
+    )
+    assert len(info["files"]) >= 4 and info["flush_file"] is not None
+    total = 0
+    for p in info["files"]:
+        with open(p, "rb") as f:
+            pkts = parse_pcap(f.read())
+        assert pkts is not None
+        total += pkts.shape[0]
+    # every ground-truth packet present exactly once, plus the sentinel
+    assert total == info["packets"].shape[0] + 1
+    assert info["n_flows"] == 8
+
+
+# ---------------------------------------------------------------------------
+# CLI: --from-capture end-to-end
+# ---------------------------------------------------------------------------
+
+
+def test_serve_from_capture_cli(tmp_path, mesh8, capsys):
+    from sntc_tpu.app import main
+
+    data = str(tmp_path / "days")
+    assert main(["synth", "--out", data, "--rows", "4000",
+                 "--days", "2"]) == 0
+    model = str(tmp_path / "model")
+    main(["train", "--data", data, "--estimator", "lr", "--binary",
+          "--max-iter", "10", "--model-out", model])
+    capsys.readouterr()
+    cap = str(tmp_path / "caps")
+    write_capture_stream(cap, n_files=4, flows_per_file=3,
+                         packets_per_flow=6, seed=6)
+    out_dir = str(tmp_path / "preds")
+    ckpt = str(tmp_path / "ckpt")
+    rc = main([
+        "serve", "--model", model, "--watch", cap, "--out", out_dir,
+        "--checkpoint", ckpt, "--from-capture", "pcap",
+        "--flow-timeout", "0.5", "--flow-activity-timeout", "0.2",
+        "--flow-lateness", "0.1", "--max-files-per-batch", "1",
+        "--once",
+    ])
+    assert rc == 0
+    served = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert served["batches"] == 5
+    # crash-safe state landed under the checkpoint
+    assert os.path.isdir(os.path.join(ckpt, "flow_state"))
+    outs = sorted(os.listdir(out_dir))
+    assert len(outs) == 5
+    rows = 0
+    for name in outs:
+        with open(os.path.join(out_dir, name)) as fh:
+            header = fh.readline()
+            body = fh.read().strip()
+        assert "predictedLabel" in header
+        rows += len(body.splitlines()) if body else 0
+    assert rows > 0  # live windows were classified to label strings
+    # resume on the same checkpoint: nothing new -> zero batches
+    rc = main([
+        "serve", "--model", model, "--watch", cap, "--out", out_dir,
+        "--checkpoint", ckpt, "--from-capture", "pcap",
+        "--flow-timeout", "0.5", "--flow-lateness", "0.1", "--once",
+    ])
+    served = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert served["batches"] == 0
+
+
+# ---------------------------------------------------------------------------
+# drift checks (tier-1 wiring) + process-kill chaos
+# ---------------------------------------------------------------------------
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_flow_flags_consistent():
+    problems = _load_script("check_flow_flags").check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_chaos_matrix_covers_flow_sites():
+    checker = _load_script("check_fault_sites")
+    assert checker.check_chaos_coverage() == []
+    covered = checker.chaos_kill_sites()
+    assert {"flow.emit", "flow.evict",
+            "flow.state_snapshot"} <= covered
+
+
+@pytest.fixture(scope="module")
+def flow_chaos():
+    return _load_script("chaos_crash_matrix")
+
+
+@pytest.fixture(scope="module")
+def flow_chaos_reference(flow_chaos, tmp_path_factory):
+    workdir = str(tmp_path_factory.mktemp("flow_chaos"))
+    return workdir, flow_chaos.run_flow_reference(workdir)
+
+
+def test_flow_kill_matrix_bitwise(flow_chaos, flow_chaos_reference):
+    """Real process kills mid-window at every flow.* fault site:
+    restart must converge BITWISE to the reference sink bytes (the
+    acceptance criterion: zero duplicated or lost windows)."""
+    workdir, reference = flow_chaos_reference
+    assert len(reference["sink"]) == 6
+    for site in flow_chaos.FLOW_KILL_SITES:
+        verdict = flow_chaos.run_flow_kill_scenario(
+            workdir, site, reference
+        )
+        assert verdict["ok"], verdict
+        assert verdict["sink_bitwise"], verdict
