@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Static drift check: ingest-autotuner surface across CLI ⇔ knobs ⇔
+metric catalog ⇔ docs.
+
+The autotuned ingest engine is one feature spread over four layers —
+``python -m sntc_tpu serve`` flags, the source graph's knob registry
+(``data.pipeline.KNOB_NAMES`` resolving to live setters on
+``DirStreamSource``/``StreamingQuery``), the ``sntc_ingest_*`` metric
+catalog that journals its behavior, and the tuning documentation — and
+they must stay in lockstep:
+
+1. **CLI → knobs**: every knob has a cold-start flag (``--read-workers``,
+   ``--prefetch-batches``, ``--pipeline-depth``) plus the arming pair
+   ``--autotune``/``--no-autotune`` on serve AND serve-daemon;
+2. **knobs → code**: every ``KNOB_NAMES`` entry resolves on a live
+   engine — the owner exposes the attribute AND its live setter
+   (``set_read_workers``/``set_prefetch_batches``; ``pipeline_depth``
+   is a plain engine attribute);
+3. **knobs/metrics → catalog**: the ``sntc_ingest_*`` autotune series
+   are declared in ``obs.metrics.CATALOG`` (``check_metric_names.py``
+   owns catalog ⇔ docs; this check pins the ingest set exists at all);
+4. **knobs → docs**: ``docs/PERFORMANCE.md`` carries a marker-delimited
+   ingest-knob table (``<!-- ingest-knobs:begin/end -->``) with one row
+   per knob naming its flag — stale/extra rows are drift.
+
+Wired as a tier-1 test (``tests/test_ingest_pipeline.py``), the same
+discipline as ``check_perf_flags.py`` / ``check_metric_names.py``.
+
+Exit 0 when consistent; exit 1 with a per-item report otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DOC = "docs/PERFORMANCE.md"
+TABLE_BEGIN = "<!-- ingest-knobs:begin -->"
+TABLE_END = "<!-- ingest-knobs:end -->"
+
+#: knob name -> its cold-start CLI flag
+KNOB_FLAGS = {
+    "read_workers": "--read-workers",
+    "prefetch_batches": "--prefetch-batches",
+    "pipeline_depth": "--pipeline-depth",
+}
+ARM_FLAGS = ("--autotune", "--no-autotune")
+
+#: the catalog rows the autotuned ingest plane emits
+INGEST_METRICS = (
+    "sntc_ingest_stage_seconds",
+    "sntc_ingest_queue_depth",
+    "sntc_ingest_autotune_decisions_total",
+    "sntc_ingest_knob_value",
+    "sntc_ingest_bytes_read_total",
+)
+
+
+def _read(rel: str) -> str:
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def _doc_rows() -> dict:
+    """knob -> documented flag, from the marker-delimited table."""
+    text = _read(DOC)
+    if TABLE_BEGIN not in text or TABLE_END not in text:
+        return {}
+    table = text.split(TABLE_BEGIN, 1)[1].split(TABLE_END, 1)[0]
+    rows = {}
+    for line in table.splitlines():
+        m = re.match(r"\s*\|\s*`([a-z_]+)`\s*\|\s*`(--[a-z-]+)`", line)
+        if m:
+            rows[m.group(1)] = m.group(2)
+    return rows
+
+
+def check() -> list:
+    """Returns human-readable drift complaints (empty = consistent)."""
+    problems = []
+    sys.path.insert(0, REPO)
+    from sntc_tpu.data.pipeline import KNOB_NAMES
+    from sntc_tpu.obs.metrics import CATALOG
+    from sntc_tpu.serve.streaming import DirStreamSource, StreamingQuery
+
+    app_src = _read(os.path.join("sntc_tpu", "app.py"))
+
+    # 1. CLI surface
+    for knob, flag in KNOB_FLAGS.items():
+        if f'"{flag}"' not in app_src:
+            problems.append(
+                f"knob {knob!r} has no {flag!r} flag in sntc_tpu/app.py"
+            )
+    for flag in ARM_FLAGS:
+        if app_src.count(f'"{flag}"') < 2:
+            problems.append(
+                f"{flag!r} must exist on BOTH serve and serve-daemon "
+                "CLIs (found fewer than 2 declarations)"
+            )
+
+    # 2. knob registry resolves on the live owners
+    if set(KNOB_NAMES) != set(KNOB_FLAGS):
+        problems.append(
+            f"data.pipeline.KNOB_NAMES {sorted(KNOB_NAMES)} != the "
+            f"checker's flag map {sorted(KNOB_FLAGS)} — update both"
+        )
+    for attr, setter in (
+        ("read_workers", "set_read_workers"),
+        ("prefetch_batches", "set_prefetch_batches"),
+    ):
+        if not hasattr(DirStreamSource, setter):
+            problems.append(
+                f"DirStreamSource lacks the live setter {setter!r} "
+                f"the autotuner needs for knob {attr!r}"
+            )
+    import inspect
+
+    if "pipeline_depth" not in inspect.signature(
+        StreamingQuery.__init__
+    ).parameters:
+        problems.append(
+            "StreamingQuery.__init__ lacks the pipeline_depth kwarg"
+        )
+
+    # 3. catalog
+    for name in INGEST_METRICS:
+        if name not in CATALOG:
+            problems.append(
+                f"ingest metric {name!r} missing from "
+                "obs.metrics.CATALOG"
+            )
+
+    # 4. docs
+    doc = _doc_rows()
+    if not doc:
+        problems.append(
+            f"{DOC} is missing the marker-delimited ingest-knob table "
+            f"({TABLE_BEGIN} ... {TABLE_END})"
+        )
+    else:
+        for knob, flag in KNOB_FLAGS.items():
+            if knob not in doc:
+                problems.append(
+                    f"knob {knob!r} missing from the {DOC} knob table"
+                )
+            elif doc[knob] != flag:
+                problems.append(
+                    f"{knob!r}: docs say flag {doc[knob]!r}, CLI has "
+                    f"{flag!r}"
+                )
+        for knob in sorted(set(doc) - set(KNOB_FLAGS)):
+            problems.append(
+                f"{DOC} knob table documents unknown knob {knob!r}"
+            )
+        if "--autotune" not in _read(DOC):
+            problems.append(f"--autotune undocumented in {DOC}")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("ingest-flag drift detected:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print(
+        f"ok: {len(KNOB_FLAGS)} ingest knobs + {len(INGEST_METRICS)} "
+        "metrics consistent across CLI, knob registry, catalog, and "
+        "docs"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -475,13 +475,25 @@ def cmd_serve(args) -> int:
             max_state_packets=args.flow_max_packets,
             state_dir=os.path.join(args.checkpoint, "flow_state"),
             prefetch_batches=(args.prefetch_batches if pipelined else 0),
+            read_workers=args.read_workers,
         )
     else:
         source = FileStreamSource(
             args.watch,
             prefetch_batches=(args.prefetch_batches if pipelined else 0),
+            read_workers=args.read_workers,
             parse_salvage=contract is not None,
         )
+    # --autotune: the ingest source graph tunes its own pools/queues
+    # (read_workers, prefetch width, pipeline depth) from observed
+    # stage latencies, with hysteresis and journaled decisions —
+    # tf.data AUTOTUNE for this serve path (docs/PERFORMANCE.md
+    # "Autotuned ingest"); the flags above become the cold-start values
+    autotuner = None
+    if args.autotune:
+        from sntc_tpu.data.autotune import IngestAutotuner
+
+        autotuner = IngestAutotuner()
     q = StreamingQuery(
         model,
         source,
@@ -502,6 +514,7 @@ def cmd_serve(args) -> int:
         schema_contract=contract,
         row_dead_letter_dir=args.row_dead_letter,
         lifecycle=lifecycle,
+        autotuner=autotuner,
     )
     if args.once:
         try:
@@ -629,6 +642,7 @@ def cmd_serve_daemon(args) -> int:
         pipeline_depth=args.pipeline_depth,
         health_json=args.health_json,
         metrics_out=args.metrics_out,
+        autotune=args.autotune,
     )
     try:
         if args.once:
@@ -743,6 +757,20 @@ def main(argv=None) -> int:
                    "buckets with this floor so the jitted predict "
                    "compiles once per bucket, not once per batch "
                    "shape; 0 = off")
+    p.add_argument("--read-workers", type=int, default=4,
+                   help="per-file read/parse pool width for multi-file "
+                   "micro-batches (the ingest graph's parse-stage "
+                   "workers; --autotune resizes it live)")
+    p.add_argument("--autotune", action="store_true", dest="autotune",
+                   default=False,
+                   help="arm the ingest autotuner: resize "
+                   "--read-workers / --prefetch-batches / "
+                   "--pipeline-depth live from observed stage "
+                   "latencies (hysteresis-guarded; every decision "
+                   "journaled as autotune_decision events and "
+                   "sntc_ingest_* metrics)")
+    p.add_argument("--no-autotune", action="store_false", dest="autotune",
+                   help="keep the ingest pools at their flag values")
     p.add_argument("--prefetch-batches", type=int, default=2,
                    help="background source reads staged ahead of the "
                    "engine (pipelined mode only); 0 = off")
@@ -877,6 +905,14 @@ def main(argv=None) -> int:
                    help="compile each distinct tenant pipeline with the "
                    "whole-pipeline fusion compiler (default)")
     p.add_argument("--no-fuse", action="store_false", dest="fuse")
+    p.add_argument("--autotune", action="store_true", dest="autotune",
+                   default=False,
+                   help="arm per-tenant ingest autotuners drawing from "
+                   "ONE shared tuning budget (total extra parse "
+                   "threads / staged ranges / pipeline slots capped "
+                   "across the fleet)")
+    p.add_argument("--no-autotune", action="store_false",
+                   dest="autotune")
     p.add_argument("--tenant-weight", type=float, default=1.0,
                    help="default fair-share weight (TenantSpec weight): "
                    "deficit round-robin credits per scheduling round")

@@ -37,7 +37,10 @@ def load_csv(
     salvage: bool = False,
     rejects: Optional[List[dict]] = None,
 ) -> Frame:
-    """Read one flow CSV with pyarrow, normalizing column names.
+    """Read one flow CSV with pyarrow, normalizing column names
+    (:func:`load_csv_table` materialized into a Frame; the zero-copy
+    columnar loader in :mod:`sntc_tpu.data.pipeline` shares the table
+    layer so the two paths cannot drift in parse behavior).
 
     Parse errors always NAME the offending file (and, for ragged rows,
     the 1-based line number plus the raw text) — never a bare
@@ -51,6 +54,21 @@ def load_csv(
     (``SNTC_FAULTS=source.parse:ragged:...``), so corrupt-input chaos
     can mutate real ingest payloads deterministically.
     """
+    return Frame.from_arrow(
+        load_csv_table(path, salvage=salvage, rejects=rejects)
+    )
+
+
+def load_csv_table(
+    path: str,
+    *,
+    salvage: bool = False,
+    rejects: Optional[List[dict]] = None,
+) -> pa.Table:
+    """:func:`load_csv`'s parse layer: the normalized/deduped Arrow
+    table, before any numpy materialization — the shared substrate of
+    the legacy Frame path and the zero-copy columnar plane
+    (:func:`sntc_tpu.data.pipeline.read_flows_columnar`)."""
     if data_fault_armed("source.parse"):
         # chaos path only: buffer the payload so the armed DATA fault
         # can mutate it.  Unarmed (production), pyarrow streams from
@@ -121,6 +139,11 @@ def load_csv(
             )
     inc("sntc_ingest_files_parsed_total")
     inc("sntc_ingest_rows_parsed_total", table.num_rows)
+    try:
+        inc("sntc_ingest_bytes_read_total",
+            len(data) if data is not None else os.path.getsize(path))
+    except OSError:
+        pass  # best-effort byte accounting (path may be a buffer name)
     names = [normalize_feature_name(c) for c in table.column_names]
     # Real MachineLearningCVE day files contain 'Fwd Header Length' TWICE;
     # pandas-style dedup (second copy -> '.1') matches the schema's
@@ -134,8 +157,7 @@ def load_csv(
         else:
             seen[n] = 0
             deduped.append(n)
-    table = table.rename_columns(deduped)
-    return Frame.from_arrow(table)
+    return table.rename_columns(deduped)
 
 
 def load_csv_dir(
@@ -199,13 +221,34 @@ def clean_flows(
         raise ValueError("handle_invalid must be 'drop' or 'zero'")
     feature_cols = [c for c in frame.columns if c != label_col]
     cleaned = {}
-    bad_mask = np.zeros(frame.num_rows, dtype=bool)
-    for name in feature_cols:
+    scalar_cols = [c for c in feature_cols if frame[c].ndim == 1]
+    # ONE float32 block for every scalar feature column (one row per
+    # feature, so each block[i] is a contiguous f32 column view): a
+    # single cast-on-copy per column INTO the block replaces the old
+    # astype(float32, copy=True)-then-mask double materialization, and
+    # the finite mask is one vectorized pass over the whole block
+    block = np.empty((len(scalar_cols), frame.num_rows), dtype=np.float32)
+    for i, name in enumerate(scalar_cols):
+        np.copyto(block[i], frame[name], casting="unsafe")
+    finite = np.isfinite(block)
+    if handle_invalid == "zero":
+        block[~finite] = 0.0
+        bad_mask = np.zeros(frame.num_rows, dtype=bool)
+    else:
+        bad_mask = ~finite.all(axis=0)
+    scalar_index = {name: i for i, name in enumerate(scalar_cols)}
+    for name in feature_cols:  # original column order preserved
+        i = scalar_index.get(name)
+        if i is not None:
+            cleaned[name] = block[i]
+            continue
+        # rare non-scalar feature column (already-assembled vectors):
+        # legacy per-column treatment
         col = frame[name].astype(np.float32, copy=True)
         invalid = ~np.isfinite(col)
         if invalid.any():
             if handle_invalid == "drop":
-                bad_mask |= invalid
+                bad_mask = bad_mask | invalid.any(axis=1)
             else:
                 col[invalid] = 0.0
         cleaned[name] = col
@@ -227,5 +270,9 @@ def cache_parquet(frame: Frame, path: str) -> str:
     return path
 
 
-def load_parquet(path: str) -> Frame:
-    return Frame.from_arrow(pq.read_table(path))
+def load_parquet(path: str, memory_map: bool = True) -> Frame:
+    """Reload a cached Frame.  ``memory_map=True`` (default) maps the
+    file instead of buffering it — uncompressed column pages then land
+    as views over the page cache, the zero-copy reload path the
+    columnar plane (``data/pipeline.py``) expects."""
+    return Frame.from_arrow(pq.read_table(path, memory_map=memory_map))

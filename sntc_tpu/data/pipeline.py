@@ -1,0 +1,367 @@
+"""Declarative ingest source graph + the zero-copy columnar plane.
+
+Host-side ingest — every path into the device — is modeled as ONE
+operator graph, **read → parse → admit → bucket → stage** (the tf.data
+structure, arxiv 2101.12127), instead of ad-hoc thread pools sized by
+static flags:
+
+========  ==============================================================
+stage     what it is in this codebase
+========  ==============================================================
+read      the engine-observed ``get_batch`` wait (staged hit ≈ 0; a
+          miss pays the synchronous parse inline)
+parse     one source file decoded to a Frame (CSV via pyarrow, pcap /
+          NetFlow via the native parsers) — runs on the source's
+          ``read_workers`` pool for multi-file batches
+admit     schema-contract row admission on the read batch
+bucket    shape-bucket padding + device dispatch of the admitted batch
+stage     a background prefetch of an upcoming range (the bounded
+          staging queue ``prefetch_batches`` deep — queue AND pool)
+========  ==============================================================
+
+Each stage carries a :class:`StageMeter` (EWMA latency, busy time,
+counts → the ``sntc_ingest_stage_seconds`` histogram), and the graph's
+three pool/queue knobs are first-class :class:`Knob` objects —
+``read_workers``, ``prefetch_batches``, ``pipeline_depth`` — resolvable
+live on a running engine (:func:`graph_knobs`) so the feedback
+autotuner (:mod:`sntc_tpu.data.autotune`) can resize them from the
+observed latency/backpressure profile instead of a human guessing
+``--prefetch-batches``.  :func:`describe_graph` renders the declarative
+structure (stages, queues, pools, meters) for status dumps and the
+bench journal.
+
+The second half is the **zero-copy columnar plane**:
+:func:`read_flows_columnar` / :func:`load_flows_columnar` cast every
+feature column to float32 ONCE inside Arrow at parse time (pyarrow
+compute kernels, no per-column numpy ``astype(copy=True)``), apply the
+NaN/Inf validity policy as ONE Arrow mask pass, and hand the engine
+numpy VIEWS over the Arrow buffers — already in exactly the dtype the
+fusion planner's ``f32cast`` upload policy wants, so nothing copies on
+the host between parse and the single ``device_put``.  Bitwise equal
+to the legacy ``load_csv`` → ``clean_flows`` path (pinned in
+``tests/test_ingest_pipeline.py``).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.data.schema import LABEL_COLUMN, normalize_label
+from sntc_tpu.obs.metrics import observe
+
+#: the operator graph, in data-flow order (module docstring has the
+#: mapping onto the live source/engine machinery)
+STAGES = ("read", "parse", "admit", "bucket", "stage")
+
+#: the graph's tunable pool/queue knobs — the autotuner's action space,
+#: the serve CLI's flag surface, and the ``sntc_ingest_knob_value``
+#: gauge's ``knob`` label values (scripts/check_ingest_flags.py pins
+#: all three in tier-1)
+KNOB_NAMES = ("read_workers", "prefetch_batches", "pipeline_depth")
+
+
+class StageMeter:
+    """Latency/occupancy accounting for one named ingest stage.
+
+    ``record`` is the hot-path write: one EWMA update + one cataloged
+    histogram observe per ITEM (a file parse, a batch read) — never per
+    row.  ``tenant`` labels the emitted series when the owning source /
+    engine serves a tenant (set post-construction by the engine for
+    sources built without one)."""
+
+    __slots__ = ("stage", "tenant", "count", "busy_s", "last_s",
+                 "ewma_s", "_lock")
+
+    #: EWMA smoothing: ~10-item memory, fast enough to follow a phase
+    #: change within one autotune window, slow enough to ignore one
+    #: outlier file
+    ALPHA = 0.2
+
+    def __init__(self, stage: str, tenant: Optional[str] = None):
+        self.stage = stage
+        self.tenant = tenant
+        self.count = 0
+        self.busy_s = 0.0
+        self.last_s = 0.0
+        self.ewma_s = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, elapsed_s: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.busy_s += elapsed_s
+            self.last_s = elapsed_s
+            self.ewma_s = (
+                elapsed_s if self.count == 1
+                else self.ALPHA * elapsed_s + (1 - self.ALPHA) * self.ewma_s
+            )
+        labels = {} if self.tenant is None else {"tenant": self.tenant}
+        observe(
+            "sntc_ingest_stage_seconds", elapsed_s,
+            stage=self.stage, **labels,
+        )
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "busy_s": round(self.busy_s, 6),
+            "last_s": round(self.last_s, 6),
+            "ewma_s": round(self.ewma_s, 6),
+        }
+
+
+def source_meters(tenant: Optional[str] = None) -> Dict[str, StageMeter]:
+    """The source-side meters (read/parse/stage) every
+    ``DirStreamSource`` carries."""
+    return {s: StageMeter(s, tenant) for s in ("read", "parse", "stage")}
+
+
+def engine_meters(tenant: Optional[str] = None) -> Dict[str, StageMeter]:
+    """The engine-side meters (admit/bucket) every ``StreamingQuery``
+    carries."""
+    return {s: StageMeter(s, tenant) for s in ("admit", "bucket")}
+
+
+@dataclass
+class Knob:
+    """One live pool/queue size: current value via ``get``, resized via
+    ``set`` (thread-safe on the owner's side), bounded to ``[lo, hi]``.
+    The autotuner only ever moves a knob by ``step`` per decision."""
+
+    name: str
+    get: Callable[[], int]
+    set: Callable[[int], None]
+    lo: int
+    hi: int
+    step: int = 1
+
+    def clamp(self, value: int) -> int:
+        return max(self.lo, min(self.hi, int(value)))
+
+
+#: default knob bounds — floors keep every pool alive, ceilings keep a
+#: runaway signal from allocating unbounded threads/queues; the
+#: autotuner (or a daemon budget) can narrow but never widen these
+DEFAULT_BOUNDS = {
+    "read_workers": (1, max(4, (os.cpu_count() or 4))),
+    "prefetch_batches": (1, 8),
+    "pipeline_depth": (1, 4),
+}
+
+
+def graph_knobs(engine, bounds: Optional[dict] = None) -> Dict[str, Knob]:
+    """Resolve the graph's knobs on a LIVE engine + source: only knobs
+    the owner actually exposes (``set_read_workers`` /
+    ``set_prefetch_batches`` on the source, ``pipeline_depth`` on the
+    engine) are returned, so a MemorySource-backed engine simply has a
+    smaller action space."""
+    bounds = dict(DEFAULT_BOUNDS, **(bounds or {}))
+    knobs: Dict[str, Knob] = {}
+    source = engine.source
+    if hasattr(source, "set_read_workers"):
+        lo, hi = bounds["read_workers"]
+        knobs["read_workers"] = Knob(
+            "read_workers",
+            lambda: source.read_workers,
+            source.set_read_workers, lo, hi,
+        )
+    if hasattr(source, "set_prefetch_batches"):
+        lo, hi = bounds["prefetch_batches"]
+        knobs["prefetch_batches"] = Knob(
+            "prefetch_batches",
+            lambda: source.prefetch_batches,
+            source.set_prefetch_batches, lo, hi,
+        )
+    if hasattr(engine, "pipeline_depth"):
+        lo, hi = bounds["pipeline_depth"]
+
+        def _set_depth(n: int, _e=engine) -> None:
+            _e.pipeline_depth = max(1, int(n))
+
+        knobs["pipeline_depth"] = Knob(
+            "pipeline_depth",
+            lambda: engine.pipeline_depth,
+            _set_depth, lo, hi,
+        )
+    return knobs
+
+
+def describe_graph(engine) -> Dict[str, dict]:
+    """The declarative structure of a live engine's source graph:
+    stage → {queue bound, pool width, meter snapshot}.  Pure read —
+    status dumps and the bench journal call this per snapshot."""
+    source = engine.source
+    src_meters = getattr(source, "meters", {})
+    eng_meters = getattr(engine, "ingest_meters", {})
+    staged = len(getattr(source, "_staged", ()) or ())
+    desc: Dict[str, dict] = {}
+    for stage in STAGES:
+        meter = src_meters.get(stage) or eng_meters.get(stage)
+        row: Dict[str, object] = {
+            "meter": meter.snapshot() if meter is not None else None,
+        }
+        if stage == "parse":
+            row["workers"] = getattr(source, "read_workers", None)
+        elif stage == "stage":
+            row["queue_bound"] = getattr(source, "prefetch_batches", None)
+            row["queue_depth"] = staged
+        elif stage == "read":
+            stats = getattr(source, "prefetch_stats", None)
+            row["prefetch"] = stats() if stats is not None else None
+        elif stage == "bucket":
+            row["queue_bound"] = getattr(engine, "pipeline_depth", None)
+            in_flight = getattr(engine, "in_flight_count", None)
+            row["queue_depth"] = (
+                in_flight() if in_flight is not None else None
+            )
+        desc[stage] = row
+    return desc
+
+
+# ---------------------------------------------------------------------------
+# the zero-copy columnar plane
+# ---------------------------------------------------------------------------
+
+
+def _columnar_table(
+    table: pa.Table, label_col: str, handle_invalid: Optional[str]
+):
+    """One in-Arrow pass over a parsed flow table: cast every feature
+    column to float32 (pyarrow compute — no numpy intermediates), build
+    the combined finite-AND-valid row mask, and apply the NaN/Inf
+    policy (``drop`` filters once, ``zero`` fills per cell, ``None``
+    keeps every row for a downstream admission layer).  Returns
+    ``(feature_arrays, feature_names, label_array_or_None)``."""
+    feature_names = [c for c in table.column_names if c != label_col]
+    f32 = pa.float32()
+    arrays: List[pa.Array] = []
+    finite_masks: List[pa.Array] = []
+    for name in feature_names:
+        col = table[name]
+        if isinstance(col, pa.ChunkedArray):
+            col = col.combine_chunks()
+        # THE cast: one Arrow kernel per column at parse time, in place
+        # of the legacy per-column astype(float32, copy=True) host pass
+        col = pc.cast(col, f32, safe=False)
+        arrays.append(col)
+        if handle_invalid is not None:
+            # a parse-time null (empty / "NaN" cell) is as non-finite
+            # as an Infinity — coalesce folds both into one mask
+            finite_masks.append(
+                pc.coalesce(pc.is_finite(col), pa.scalar(False))
+            )
+    label = table[label_col] if label_col in table.column_names else None
+    if handle_invalid == "zero":
+        zero = pa.scalar(0.0, f32)
+        arrays = [
+            pc.if_else(mask, col, zero)
+            for col, mask in zip(arrays, finite_masks)
+        ]
+    elif handle_invalid == "drop" and finite_masks:
+        valid = finite_masks[0]
+        for mask in finite_masks[1:]:
+            valid = pc.and_(valid, mask)
+        if not pc.all(valid).as_py():
+            arrays = [col.filter(valid) for col in arrays]
+            if label is not None:
+                label = label.filter(valid)
+    return arrays, feature_names, label
+
+
+def _columnar_frame(arrays, feature_names, label, label_col) -> Frame:
+    cols: Dict[str, np.ndarray] = {}
+    for name, col in zip(feature_names, arrays):
+        # zero-copy when the buffer allows it (float32, no nulls — the
+        # drop/zero policies guarantee none; the serve face keeps NaN
+        # VALUES, not Arrow nulls-from-parse, which fall back to one
+        # materializing copy for that column only)
+        try:
+            cols[name] = col.to_numpy(zero_copy_only=True)
+        except pa.ArrowInvalid:
+            cols[name] = col.to_numpy(zero_copy_only=False)
+    if label is not None:
+        if isinstance(label, pa.ChunkedArray):
+            label = label.combine_chunks()
+        cols[label_col] = np.array(
+            [normalize_label(str(v)) for v in label.to_pylist()],
+            dtype=object,
+        )
+    return Frame(cols)
+
+
+def read_flows_columnar(
+    path: str,
+    label_col: str = LABEL_COLUMN,
+    handle_invalid: Optional[str] = "drop",
+    *,
+    salvage: bool = False,
+    rejects: Optional[List[dict]] = None,
+) -> Frame:
+    """One flow CSV → a float32 columnar Frame with zero host copies
+    after the in-Arrow cast (module docstring).  ``handle_invalid``:
+    ``"drop"`` / ``"zero"`` replicate :func:`~sntc_tpu.data.ingest
+    .clean_flows` bitwise; ``None`` keeps every row (non-finite values
+    survive as float32 NaN/Inf) for the serve-time admission layer to
+    police.  ``salvage``/``rejects`` forward to the parser exactly as
+    in :func:`~sntc_tpu.data.ingest.load_csv`."""
+    from sntc_tpu.data.ingest import load_csv_table
+
+    if handle_invalid not in (None, "drop", "zero"):
+        raise ValueError("handle_invalid must be 'drop', 'zero', or None")
+    table = load_csv_table(path, salvage=salvage, rejects=rejects)
+    arrays, names, label = _columnar_table(
+        table, label_col, handle_invalid
+    )
+    return _columnar_frame(arrays, names, label, label_col)
+
+
+def load_flows_columnar(
+    path: str,
+    pattern: str = "*.csv",
+    label_col: str = LABEL_COLUMN,
+    handle_invalid: Optional[str] = "drop",
+    max_workers: int = 8,
+) -> Frame:
+    """Directory variant of :func:`read_flows_columnar` — the batch
+    train-ingest face (the ``load_csv_dir`` + ``clean_flows`` pair in
+    one parse).  Files parse in the same small thread pool
+    ``load_csv_dir`` uses and concatenate in sorted-filename order."""
+    paths = sorted(glob.glob(os.path.join(path, pattern)))
+    if not paths:
+        raise FileNotFoundError(f"no {pattern} files under {path}")
+
+    def _load(p: str) -> Frame:
+        return read_flows_columnar(
+            p, label_col=label_col, handle_invalid=handle_invalid
+        )
+
+    if len(paths) == 1 or max_workers <= 1:
+        return Frame.concat_all([_load(p) for p in paths])
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(
+        max_workers=min(max_workers, len(paths))
+    ) as pool:
+        return Frame.concat_all(list(pool.map(_load, paths)))
+
+
+def timed(meter: Optional[StageMeter], fn, *args, **kwargs):
+    """Run ``fn`` recording its wall time into ``meter`` (None = run
+    bare) — the one helper every instrumented stage call site shares."""
+    if meter is None:
+        return fn(*args, **kwargs)
+    t0 = time.perf_counter()
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        meter.record(time.perf_counter() - t0)

@@ -88,6 +88,10 @@ class FlowCaptureSource(_CaptureDirSource):
                 f"unknown capture format {format!r}; expected one of "
                 f"{sorted(FORMATS)}"
             )
+        # tenant forwards into DirStreamSource so the source-graph
+        # meters (read/parse/stage) and the autotuner's knob gauges
+        # carry this tenant's label from construction
+        kwargs.setdefault("tenant", tenant)
         super().__init__(path, pattern or FORMATS[format], **kwargs)
         self.format = format
         meter = (

@@ -110,6 +110,34 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         type=COUNTER, labels=(),
         help="Rows parsed out of source files by load_csv.",
     ),
+    "sntc_ingest_bytes_read_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Raw source bytes read by ingest (CSV parse, capture "
+        "decode).",
+    ),
+    # -- the ingest source graph + autotuner (data/pipeline, data/autotune) --
+    "sntc_ingest_stage_seconds": dict(
+        type=HISTOGRAM, labels=("stage", "tenant"),
+        buckets=LATENCY_BUCKETS,
+        help="Per-item latency of each ingest source-graph stage "
+        "(read/parse/admit/bucket/stage) — the autotuner's feedback "
+        "signal.",
+    ),
+    "sntc_ingest_queue_depth": dict(
+        type=GAUGE, labels=("stage", "tenant"),
+        help="Current occupancy of a source-graph stage queue (the "
+        "prefetch staging queue).",
+    ),
+    "sntc_ingest_autotune_decisions_total": dict(
+        type=COUNTER, labels=("knob", "direction", "tenant"),
+        help="Applied ingest-autotuner knob changes, by knob and "
+        "direction.",
+    ),
+    "sntc_ingest_knob_value": dict(
+        type=GAUGE, labels=("knob", "tenant"),
+        help="Current value of each autotuned ingest knob "
+        "(read_workers / prefetch_batches / pipeline_depth).",
+    ),
     # -- predict / compile ledgers ------------------------------------------
     "sntc_predict_compile_events_total": dict(
         type=COUNTER, labels=(),

@@ -19,6 +19,7 @@ from typing import List, Optional
 
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.native import netflow_to_flow_frame, parse_stream
+from sntc_tpu.obs.metrics import inc
 from sntc_tpu.resilience import fault_data
 from sntc_tpu.serve.streaming import DirStreamSource
 
@@ -29,9 +30,11 @@ class _CaptureDirSource(DirStreamSource):
 
     Inherits the full :class:`DirStreamSource` pipeline surface —
     per-tick listing cache, parallel per-file decodes
-    (``read_workers``), and background staging (``prefetch_batches``)
-    for the pipelined engine; decode is CPU-bound Python for pcap, so
-    prefetch (one staging thread) is the lever that matters there.
+    (``read_workers``), background staging (``prefetch_batches``), the
+    source-graph stage meters, and the live ``set_read_workers`` /
+    ``set_prefetch_batches`` resize surface the ingest autotuner
+    drives; decode is CPU-bound Python for pcap, so staging width is
+    the lever that matters there.
 
     Raw capture bytes pass through the ``source.parse`` fault site
     (``fault_data``) before decode, so the corrupt-input chaos kinds
@@ -43,7 +46,10 @@ class _CaptureDirSource(DirStreamSource):
 
     def _load_file(self, path: str) -> Frame:
         with open(path, "rb") as f:
-            return self._decode_file(fault_data("source.parse", f.read()))
+            data = f.read()
+        labels = {} if self.tenant is None else {"tenant": self.tenant}
+        inc("sntc_ingest_bytes_read_total", len(data), **labels)
+        return self._decode_file(fault_data("source.parse", data))
 
 
 def decode_pcap_packets(data: bytes):

@@ -28,8 +28,9 @@ import numpy as np
 from sntc_tpu.core.base import Transformer
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.data.ingest import load_csv
+from sntc_tpu.data.pipeline import engine_meters, source_meters, timed
 from sntc_tpu.obs import install_event_metrics
-from sntc_tpu.obs.metrics import inc, observe
+from sntc_tpu.obs.metrics import inc, observe, set_gauge
 from sntc_tpu.obs.trace import span
 from sntc_tpu.resilience import (
     RetryPolicy,
@@ -90,11 +91,19 @@ class DirStreamSource(StreamSource):
         prefetch_batches: int = 0,
         read_workers: int = 4,
         parse_salvage: bool = False,
+        tenant: Optional[str] = None,
     ):
         self.path = path
         self.pattern = pattern
         self.prefetch_batches = int(prefetch_batches)
         self.read_workers = max(1, int(read_workers))
+        # the ingest source graph's source-side meters (read/parse/
+        # stage; docs/PERFORMANCE.md "Autotuned ingest") — the
+        # feedback signal the IngestAutotuner reads; ``tenant`` labels
+        # their emitted series (the engine back-fills it for sources
+        # built without one)
+        self.tenant = tenant
+        self.meters = source_meters(tenant)
         # parse_salvage=True arms per-line salvage in the file loaders
         # that support it (CSV): unparsable lines are excised at parse
         # time and collected as reject records — the engine drains them
@@ -109,6 +118,7 @@ class DirStreamSource(StreamSource):
         # miss) AND from prefetch threads (staged _read_range) — the
         # lazy create must not race two executors into existence
         self._pool_lock = threading.Lock()
+        self._retired_pools: List = []  # resized-out executors (close())
         self._rejects_lock = threading.Lock()
         self._parse_rejects: List[dict] = []
         self._staged: dict = {}  # (start, end) -> Future[Frame]
@@ -178,10 +188,49 @@ class DirStreamSource(StreamSource):
                 )
             return self._read_pool
 
+    # -- live pool/queue resizing (the autotuner's action surface) -----------
+
+    def set_read_workers(self, n: int) -> None:
+        """Resize the per-file read pool live.  The old executor is
+        RETIRED, not shut down: a prefetch thread may sit between
+        ``_pool()`` returning it and ``.map()`` submitting to it, and
+        an immediate shutdown would turn that knob resize into a
+        spurious batch-read failure.  Retired pools drain their work
+        and are closed at :meth:`close`; their count is bounded by the
+        autotuner's no-oscillation change bound, so idle threads never
+        accumulate past it."""
+        n = max(1, int(n))
+        with self._pool_lock:
+            if n == self.read_workers:
+                return
+            self.read_workers = n
+            old, self._read_pool = self._read_pool, None
+            if old is not None:
+                self._retired_pools.append(old)
+
+    def set_prefetch_batches(self, n: int) -> None:
+        """Resize the staging queue bound (and therefore the staging
+        pool width) live.  Already-staged ranges stay staged — the
+        bound applies to NEW prefetch calls; the old pool is retired
+        exactly like :meth:`set_read_workers`'s."""
+        n = max(0, int(n))
+        with self._pool_lock:
+            if n == self.prefetch_batches:
+                return
+            self.prefetch_batches = n
+            old, self._prefetch_pool = self._prefetch_pool, None
+            if old is not None:
+                self._retired_pools.append(old)
+
+    def _timed_load(self, path: str) -> Frame:
+        return timed(self.meters["parse"], self._load_file, path)
+
     def _read_files(self, files: List[str]) -> Frame:
         if len(files) == 1:  # common micro-batch case: skip the concat copy
-            return self._load_file(files[0])
-        return Frame.concat_all(list(self._pool().map(self._load_file, files)))
+            return self._timed_load(files[0])
+        return Frame.concat_all(
+            list(self._pool().map(self._timed_load, files))
+        )
 
     def _read_range(
         self, start: int, end: int, listing: Optional[List[str]]
@@ -230,10 +279,26 @@ class DirStreamSource(StreamSource):
             else None
         )
         self._staged[(start, end)] = self._prefetch_pool.submit(
-            self._read_range, start, end, listing
+            self._staged_read, start, end, listing
         )
         self.prefetch_hwm = max(self.prefetch_hwm, len(self._staged))
+        self._queue_gauge()
         return True
+
+    def _staged_read(self, start: int, end: int, listing) -> Frame:
+        # the 'stage' operator: one background prefetch of a future
+        # range, timed into its own meter (the parse meter still sees
+        # the per-file decodes it fans out)
+        return timed(
+            self.meters["stage"], self._read_range, start, end, listing
+        )
+
+    def _queue_gauge(self) -> None:
+        labels = {} if self.tenant is None else {"tenant": self.tenant}
+        set_gauge(
+            "sntc_ingest_queue_depth", len(self._staged),
+            stage="stage", **labels,
+        )
 
     def prefetch_stats(self) -> dict:
         return {
@@ -247,42 +312,84 @@ class DirStreamSource(StreamSource):
         """Shut down the reader pools (idempotent; a closed source can
         still serve synchronous reads)."""
         self._staged.clear()
-        for pool in (self._read_pool, self._prefetch_pool):
+        with self._pool_lock:
+            pools = [self._read_pool, self._prefetch_pool]
+            pools.extend(self._retired_pools)
+            self._retired_pools = []
+            self._read_pool = self._prefetch_pool = None
+        for pool in pools:
             if pool is not None:
                 pool.shutdown(wait=True)
-        self._read_pool = self._prefetch_pool = None
 
     def get_batch(self, start: int, end: int) -> Frame:
-        fut = self._staged.pop((start, end), None)
-        if fut is not None:
-            self.prefetch_hits += 1
-            inc("sntc_source_prefetch_hits_total")
-            # a failed staged read re-raises HERE, inside the engine's
-            # stream.read retry/fault scope; the entry was consumed, so
-            # a retry falls through to a fresh synchronous read
-            return fut.result()
-        if self.prefetch_batches > 0:
-            self.prefetch_misses += 1
-            inc("sntc_source_prefetch_misses_total")
-        listing = self._listing
-        if listing is not None and len(listing) < end:
-            listing = None  # stale: _read_range re-scans exactly once
-        return self._read_range(start, end, listing)
+        t0 = time.perf_counter()
+        try:
+            fut = self._staged.pop((start, end), None)
+            if fut is not None:
+                self.prefetch_hits += 1
+                inc("sntc_source_prefetch_hits_total")
+                self._queue_gauge()
+                # a failed staged read re-raises HERE, inside the
+                # engine's stream.read retry/fault scope; the entry was
+                # consumed, so a retry falls through to a fresh
+                # synchronous read
+                return fut.result()
+            if self.prefetch_batches > 0:
+                self.prefetch_misses += 1
+                inc("sntc_source_prefetch_misses_total")
+            listing = self._listing
+            if listing is not None and len(listing) < end:
+                listing = None  # stale: _read_range re-scans exactly once
+            return self._read_range(start, end, listing)
+        finally:
+            # the 'read' operator: what the ENGINE waited for this
+            # range — near-zero on a staged hit, the full inline parse
+            # on a miss (the read-vs-parse gap is the autotuner's
+            # staging signal)
+            self.meters["read"].record(time.perf_counter() - t0)
 
 
 class FileStreamSource(DirStreamSource):
     """Directory of flow CSVs.  With ``parse_salvage=True`` ragged
     lines are excised per-line (file + line number journaled) instead
     of failing the whole batch — see :func:`sntc_tpu.data.ingest
-    .load_csv`."""
+    .load_csv`.
 
-    def __init__(self, path: str, pattern: str = "*.csv", **kwargs):
+    ``columnar=True`` parses through the zero-copy columnar plane
+    (:func:`sntc_tpu.data.pipeline.read_flows_columnar` with
+    ``handle_invalid=None``): every feature column is cast to float32
+    ONCE inside Arrow at parse time and handed over as a zero-copy
+    numpy view — exactly the dtype the fused predict programs' upload
+    policy wants, so no host copy remains between parse and the single
+    device upload.  Non-finite VALUES survive (as float32 NaN/Inf) for
+    the admission layer to police; row policy stays admission's job."""
+
+    def __init__(
+        self,
+        path: str,
+        pattern: str = "*.csv",
+        columnar: bool = False,
+        **kwargs,
+    ):
         super().__init__(path, pattern, **kwargs)
+        self.columnar = bool(columnar)
 
     def _load_file(self, path: str) -> Frame:
+        if self.columnar:
+            from sntc_tpu.data.pipeline import read_flows_columnar
+
+            recs: List[dict] = []
+            frame = read_flows_columnar(
+                path, handle_invalid=None,
+                salvage=self.parse_salvage,
+                rejects=recs if self.parse_salvage else None,
+            )
+            if recs:
+                self._note_rejects(recs)
+            return frame
         if not self.parse_salvage:
             return load_csv(path)
-        recs: List[dict] = []
+        recs = []
         frame = load_csv(path, salvage=True, rejects=recs)
         if recs:
             self._note_rejects(recs)
@@ -490,6 +597,7 @@ class StreamingQuery:
         row_dead_letter_dir: Optional[str] = None,
         lifecycle=None,
         tenant: Optional[str] = None,
+        autotuner=None,
     ):
         # a pre-built BatchPredictor passes through unchanged (its own
         # bucket config wins — bench warmup shares one predictor across
@@ -584,6 +692,23 @@ class StreamingQuery:
         # metrics (batches/rows/duration) carry the same tenant label.
         self.transfer = TransferLedger(tenant=tenant)
         self._mlabels = {} if tenant is None else {"tenant": tenant}
+        # the ingest source graph (r15): engine-side stage meters
+        # (admit/bucket) complete the source's read/parse/stage set;
+        # a tenant-less source built outside the daemon inherits this
+        # engine's tenant label so its series attribute correctly
+        self.ingest_meters = engine_meters(tenant)
+        src_meters = getattr(source, "meters", None)
+        if tenant is not None and src_meters is not None:
+            if getattr(source, "tenant", None) is None:
+                source.tenant = tenant
+                for m in src_meters.values():
+                    m.tenant = tenant
+        # optional feedback autotuner (sntc_tpu.data.autotune): ticked
+        # once per engine round — poll-tick cadence — to resize
+        # read_workers / prefetch width / pipeline depth from the
+        # observed stage-latency and backpressure profile.  Failures
+        # degrade (autotune_error event), never kill the loop.
+        self.autotuner = autotuner
         # per-site circuit breakers (sink.write / predict.dispatch): an
         # OPEN breaker defers the stage — the batch stays queued and the
         # loop stays alive — instead of hammering a dead dependency
@@ -805,8 +930,10 @@ class StreamingQuery:
                 # fails exactly like any other stream.read poison and
                 # the retry/quarantine machinery owns it
                 with span("stream.admit", batch=batch_id):
-                    res = self.schema_contract.admit(
-                        frame, mode=self.row_policy
+                    res = timed(
+                        self.ingest_meters["admit"],
+                        self.schema_contract.admit,
+                        frame, mode=self.row_policy,
                     )
                 frame = res.frame
                 if not res.valid.all():
@@ -876,8 +1003,10 @@ class StreamingQuery:
                 with ledger_scope(self.transfer), span(
                     "predict.dispatch", batch=batch_id
                 ):
-                    finalize = self.predictor.predict_frame_async(
-                        frame, row_valid=row_mask
+                    finalize = timed(
+                        self.ingest_meters["bucket"],
+                        self.predictor.predict_frame_async,
+                        frame, row_valid=row_mask,
                     )
             except Exception:
                 if br_predict is not None:
@@ -1182,6 +1311,20 @@ class StreamingQuery:
         src_stats = getattr(self.source, "prefetch_stats", None)
         if src_stats is not None:
             stats["prefetch"] = src_stats()
+        # the source graph's per-stage meters (read/parse/stage from
+        # the source, admit/bucket from this engine) + any autotuner
+        # evidence — the config-10 bench journal reads these
+        ingest = {
+            name: m.snapshot()
+            for name, m in getattr(self.source, "meters", {}).items()
+        }
+        ingest.update(
+            (name, m.snapshot())
+            for name, m in self.ingest_meters.items()
+        )
+        stats["ingest"] = ingest
+        if self.autotuner is not None:
+            stats["autotune"] = self.autotuner.stats()
         fusion = self.predictor.fusion_stats()
         if fusion is not None:
             stats["fusion"] = fusion
@@ -1398,6 +1541,14 @@ class StreamingQuery:
         that finished during the dispatch window commits now)."""
         before = self._last_committed
         self._lifecycle_tick()
+        if self.autotuner is not None:
+            # poll-tick cadence; same degrade-never-kill contract as
+            # the lifecycle tick — a controller bug must not stop
+            # serving (and knob changes land only between batches)
+            try:
+                self.autotuner.on_tick(self)
+            except Exception as e:
+                self._emit(event="autotune_error", error=repr(e))
         if self.overlap_sink:
             self._pump_delivery()
             if self._tick_latest is None:

@@ -324,6 +324,8 @@ class ServeDaemon:
         metrics_out: Optional[str] = None,
         clock=time.monotonic,
         breaker_kwargs: Optional[Dict[str, Any]] = None,
+        autotune: bool = False,
+        tuning_budget=None,
     ):
         if not specs:
             raise ValueError("ServeDaemon needs at least one TenantSpec")
@@ -342,6 +344,19 @@ class ServeDaemon:
         self.metrics_out = metrics_out
         self._clock = clock
         self._breaker_kwargs = dict(breaker_kwargs or {})
+        # ingest autotuning (r15): one IngestAutotuner per tenant
+        # engine, all drawing from ONE TuningBudget — the shared cap on
+        # extra parse threads / staged ranges / pipeline slots the
+        # fleet may grow, so N tenants tuning on one box cannot each
+        # claim the whole host (docs/PERFORMANCE.md "Autotuned
+        # ingest").  Tuners tick at the engines' own round cadence
+        # inside the daemon's scheduling rounds.
+        self.autotune = bool(autotune)
+        self.tuning_budget = tuning_budget
+        if self.autotune and self.tuning_budget is None:
+            from sntc_tpu.data.autotune import TuningBudget
+
+            self.tuning_budget = TuningBudget.default_for(len(specs))
         self._owns_health = health is None
         self.health = health or HealthMonitor(clock=clock).attach()
         # shared program cache: one BatchPredictor per distinct model —
@@ -455,6 +470,13 @@ class ServeDaemon:
             site: breaker_for(prefix + site, **self._breaker_kwargs)
             for site in ("sink.write", "predict.dispatch")
         }
+        autotuner = None
+        if self.autotune:
+            from sntc_tpu.data.autotune import IngestAutotuner
+
+            autotuner = IngestAutotuner(
+                budget=self.tuning_budget, tenant=spec.tenant_id
+            )
         query = StreamingQuery(
             self.predictor_for(spec),
             source,
@@ -469,8 +491,25 @@ class ServeDaemon:
             schema_contract=spec.schema_contract,
             row_policy=spec.row_policy,
             tenant=spec.tenant_id,
+            autotuner=autotuner,
         )
         return TenantStream(spec, query, self._clock)
+
+    def autotune_stats(self) -> Optional[Dict[str, Any]]:
+        """Per-tenant autotuner evidence + the shared budget (None when
+        autotuning is unarmed) — the bench/status surface."""
+        if not self.autotune:
+            return None
+        out: Dict[str, Any] = {
+            "tenants": {
+                t.spec.tenant_id: t.query.autotuner.stats()
+                for t in self.tenants
+                if t.query.autotuner is not None
+            }
+        }
+        if self.tuning_budget is not None:
+            out["budget"] = self.tuning_budget.snapshot()
+        return out
 
     # -- compile-ledger evidence -------------------------------------------
 
@@ -904,6 +943,7 @@ class ServeDaemon:
             },
             "compile_ledger": self.compile_ledger(),
             "recompiles_after_warmup": self.recompiles_after_warmup(),
+            "autotune": self.autotune_stats(),
             "health": self.health.snapshot(),
             "breakers": {
                 site: snap

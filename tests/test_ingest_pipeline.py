@@ -1,0 +1,646 @@
+"""Autotuned zero-copy ingest engine (r15): the declarative source
+graph, the feedback autotuner (convergence + the no-oscillation
+guarantee), the zero-copy columnar loader's bitwise contract against
+the legacy ``load_csv``/``clean_flows`` path, and the
+CLI ⇔ knobs ⇔ catalog ⇔ docs drift check."""
+
+import os
+import sys
+
+import numpy as np
+import pyarrow.csv as pacsv
+import pytest
+
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.data import (
+    CICIDS2017_FEATURES,
+    clean_flows,
+    generate_frame,
+    load_csv,
+    load_csv_dir,
+    write_day_csvs,
+)
+from sntc_tpu.data.autotune import (
+    AutotunePolicy,
+    IngestAutotuner,
+    Signal,
+    TuningBudget,
+)
+from sntc_tpu.data.ingest import cache_parquet, load_parquet
+from sntc_tpu.data.pipeline import (
+    DEFAULT_BOUNDS,
+    KNOB_NAMES,
+    STAGES,
+    Knob,
+    describe_graph,
+    graph_knobs,
+    load_flows_columnar,
+    read_flows_columnar,
+)
+from sntc_tpu.data.schema import LABEL_COLUMN, normalize_label
+from sntc_tpu.serve import (
+    CsvDirSink,
+    FileStreamSource,
+    MemorySink,
+    MemorySource,
+    StreamingQuery,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# the one-pass clean_flows + zero-copy loader bitwise contracts
+# ---------------------------------------------------------------------------
+
+
+def _legacy_clean(frame, label_col=LABEL_COLUMN, handle_invalid="drop"):
+    """The pre-r15 clean_flows, verbatim — the bitwise reference."""
+    feature_cols = [c for c in frame.columns if c != label_col]
+    cleaned = {}
+    bad = np.zeros(frame.num_rows, dtype=bool)
+    for name in feature_cols:
+        col = frame[name].astype(np.float32, copy=True)
+        invalid = ~np.isfinite(col)
+        if invalid.any():
+            if handle_invalid == "drop":
+                bad |= invalid
+            else:
+                col[invalid] = 0.0
+        cleaned[name] = col
+    if label_col in frame:
+        cleaned[label_col] = np.array(
+            [normalize_label(str(v)) for v in frame[label_col]],
+            dtype=object,
+        )
+    out = Frame(cleaned)
+    if handle_invalid == "drop" and bad.any():
+        out = out.filter(~bad)
+    return out
+
+
+def _frames_bitwise(a, b):
+    assert a.columns == b.columns
+    assert a.num_rows == b.num_rows
+    for c in a.columns:
+        assert a[c].dtype == b[c].dtype, c
+        assert np.array_equal(a[c], b[c]), c
+
+
+@pytest.mark.parametrize("mode", ["drop", "zero"])
+def test_clean_flows_one_pass_bitwise(mode):
+    frame = generate_frame(4000, seed=11, dirty=True)
+    _frames_bitwise(
+        clean_flows(frame, handle_invalid=mode),
+        _legacy_clean(frame, handle_invalid=mode),
+    )
+
+
+def test_clean_flows_single_contiguous_block():
+    """The r15 layout claim: every scalar feature column is a view into
+    ONE contiguous float32 block (no per-column materializations)."""
+    frame = generate_frame(1000, seed=3, dirty=False)
+    out = clean_flows(frame, handle_invalid="zero")
+    feats = [c for c in out.columns if c != LABEL_COLUMN]
+    assert len({id(out[c].base) for c in feats}) == 1
+    first = out[feats[0]]
+    assert first.base is not None and first.base.dtype == np.float32
+    assert all(out[c].base is first.base for c in feats)
+    assert all(out[c].flags.c_contiguous for c in feats)
+
+
+@pytest.fixture(scope="module")
+def day_csvs(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("days"))
+    return out, write_day_csvs(out, n_rows_per_day=1500, n_days=2, seed=5)
+
+
+@pytest.mark.parametrize("mode", ["drop", "zero"])
+def test_columnar_loader_bitwise_vs_legacy(day_csvs, mode):
+    _dir, paths = day_csvs
+    _frames_bitwise(
+        read_flows_columnar(paths[0], handle_invalid=mode),
+        clean_flows(load_csv(paths[0]), handle_invalid=mode),
+    )
+
+
+def test_columnar_dir_loader_bitwise(day_csvs):
+    csv_dir, _paths = day_csvs
+    _frames_bitwise(
+        load_flows_columnar(csv_dir),
+        clean_flows(load_csv_dir(csv_dir)),
+    )
+
+
+def test_columnar_loader_zero_copy_views(day_csvs):
+    """Feature columns come out as float32 views over Arrow buffers —
+    no post-parse host materialization."""
+    _dir, paths = day_csvs
+    frame = read_flows_columnar(paths[0], handle_invalid=None)
+    feats = [c for c in frame.columns if c != LABEL_COLUMN]
+    assert feats  # sanity
+    for c in feats:
+        assert frame[c].dtype == np.float32
+        assert not frame[c].flags.owndata  # a view, not a copy
+    # serve face: row count untouched (admission owns row policy)
+    assert frame.num_rows == load_csv(paths[0]).num_rows
+
+
+def test_columnar_invalid_mode_rejected(day_csvs):
+    _dir, paths = day_csvs
+    with pytest.raises(ValueError, match="handle_invalid"):
+        read_flows_columnar(paths[0], handle_invalid="impute")
+
+
+def test_load_parquet_memory_map_roundtrip(tmp_path):
+    frame = clean_flows(generate_frame(800, seed=9))
+    path = cache_parquet(frame, str(tmp_path / "cache.parquet"))
+    _frames_bitwise(load_parquet(path), frame)
+    _frames_bitwise(load_parquet(path, memory_map=False), frame)
+
+
+def test_columnar_frame_predicts_bitwise_with_legacy(
+    day_csvs, mesh8, tmp_path
+):
+    """The upload-dtype claim: a fused program fed the columnar f32
+    frame produces BITWISE the predictions of the legacy f64 frame
+    (the upload-cast policy applies the same f64→f32 conversion the
+    parse-time cast did)."""
+    from sntc_tpu.core.base import PipelineModel
+    from sntc_tpu.feature import VectorAssembler
+    from sntc_tpu.models import LogisticRegression
+    from sntc_tpu.serve import BatchPredictor, compile_serving
+
+    frame = generate_frame(1200, seed=21, dirty=False)
+    csv = str(tmp_path / "clean.csv")
+    pacsv.write_csv(
+        frame.select(CICIDS2017_FEATURES).to_arrow(), csv
+    )
+    cleaned = clean_flows(frame)
+    assembler = VectorAssembler(
+        inputCols=CICIDS2017_FEATURES, outputCol="features"
+    )
+    fit_frame = assembler.transform(cleaned).with_column(
+        "label",
+        (cleaned[LABEL_COLUMN].astype(str) == "BENIGN").astype(
+            np.float64
+        ),
+    )
+    lr = LogisticRegression(mesh=mesh8, maxIter=10).fit(fit_frame)
+    served = compile_serving(PipelineModel(stages=[assembler, lr]))
+    legacy64 = load_csv(csv)
+    columnar32 = read_flows_columnar(csv, handle_invalid=None)
+    # legacy keeps the parse dtypes (int64/float64); columnar is f32
+    assert any(
+        legacy64[c].dtype == np.float64 for c in CICIDS2017_FEATURES
+    )
+    assert all(
+        columnar32[c].dtype == np.float32 for c in CICIDS2017_FEATURES
+    )
+    p64 = BatchPredictor(served).predict_frame(legacy64)
+    p32 = BatchPredictor(served).predict_frame(columnar32)
+    np.testing.assert_array_equal(
+        p64["prediction"], p32["prediction"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# the source graph: meters + live knob resizing
+# ---------------------------------------------------------------------------
+
+
+def _stream_dir(tmp_path, n_files=8, rows=40, seed=0):
+    rng = np.random.default_rng(seed)
+    in_dir = str(tmp_path / "in")
+    os.makedirs(in_dir, exist_ok=True)
+    for i in range(n_files):
+        chunk = Frame({
+            k: rng.normal(size=rows) for k in ("a", "b", "c", "d")
+        })
+        pacsv.write_csv(
+            chunk.to_arrow(), os.path.join(in_dir, f"p_{i:03d}.csv")
+        )
+    return in_dir
+
+
+class _ColsModel:
+    """Tiny duck-typed served model over the 4-column stream frames."""
+
+    def transform(self, f):
+        return f.with_column("prediction", f["a"] + f["b"])
+
+    def transform_async(self, f):
+        out = self.transform(f)
+        return lambda: out
+
+    def input_columns(self):
+        return ["a", "b", "c", "d"]
+
+
+def test_source_meters_and_graph_description(tmp_path):
+    in_dir = _stream_dir(tmp_path)
+    src = FileStreamSource(in_dir, prefetch_batches=2, read_workers=2)
+    q = StreamingQuery(
+        _ColsModel(), src, MemorySink(), str(tmp_path / "ckpt"),
+        max_batch_offsets=2,
+    )
+    assert q.process_available() == 4
+    stats = q.pipeline_stats()
+    assert set(stats["ingest"]) == set(STAGES)
+    assert stats["ingest"]["read"]["count"] == 4
+    assert stats["ingest"]["parse"]["count"] == 8  # one per file
+    assert stats["ingest"]["bucket"]["count"] == 4
+    assert stats["ingest"]["parse"]["ewma_s"] > 0
+    desc = describe_graph(q)
+    assert list(desc) == list(STAGES)
+    assert desc["parse"]["workers"] == 2
+    assert desc["stage"]["queue_bound"] == 2
+    src.close()
+
+
+def test_live_knob_resize_mid_stream(tmp_path):
+    in_dir = _stream_dir(tmp_path, n_files=10)
+    src = FileStreamSource(in_dir, prefetch_batches=1, read_workers=1)
+    sink = MemorySink()
+    q = StreamingQuery(
+        _ColsModel(), src, sink, str(tmp_path / "ckpt"),
+        max_batch_offsets=1,
+    )
+    q.process_available()
+    knobs = graph_knobs(q)
+    assert set(knobs) == set(KNOB_NAMES)
+    knobs["read_workers"].set(3)
+    knobs["prefetch_batches"].set(4)
+    assert src.read_workers == 3 and src.prefetch_batches == 4
+    # the resized-out staging pool is RETIRED (still usable by any
+    # prefetch thread mid-submit), and close() drains the retirees
+    assert src._retired_pools
+    # resizing to the same value is a no-op (no pool churn)
+    pool_before = src._read_pool
+    src.set_read_workers(3)
+    assert src._read_pool is pool_before
+    # more files arrive; the resized source serves them correctly
+    rng = np.random.default_rng(99)
+    for i in range(10, 16):
+        chunk = Frame({
+            k: rng.normal(size=40) for k in ("a", "b", "c", "d")
+        })
+        pacsv.write_csv(
+            chunk.to_arrow(), os.path.join(in_dir, f"p_{i:03d}.csv")
+        )
+    assert q.process_available() == 6
+    assert len(sink.frames) == 16
+    src.close()
+    assert not src._retired_pools
+
+
+def test_default_bounds_cover_every_knob():
+    assert set(DEFAULT_BOUNDS) == set(KNOB_NAMES)
+    for lo, hi in DEFAULT_BOUNDS.values():
+        assert 1 <= lo <= hi
+
+
+# ---------------------------------------------------------------------------
+# the autotuner: convergence, hysteresis, no-oscillation, budget
+# ---------------------------------------------------------------------------
+
+
+def _fake_knobs(**spec):
+    """name -> (initial, lo, hi) into live Knob objects over dicts."""
+    knobs = {}
+    for name, (val, lo, hi) in spec.items():
+        box = {"v": val}
+        knobs[name] = Knob(
+            name,
+            (lambda b=box: b["v"]),
+            (lambda n, b=box: b.__setitem__("v", int(n))),
+            lo, hi,
+        )
+    return knobs
+
+
+SLOW_READ = Signal(backlog=6, miss_rate=0.9, queue_occupancy=0.3,
+                   read_wait_s=0.4, parse_s=0.01, files_per_batch=1)
+SLOW_PARSE = Signal(backlog=6, miss_rate=0.3, queue_occupancy=0.3,
+                    read_wait_s=0.5, parse_s=0.45, files_per_batch=4)
+SATURATED = Signal(backlog=9, miss_rate=0.1, queue_occupancy=1.0,
+                   read_wait_s=0.05, parse_s=0.01, files_per_batch=2)
+IDLE = Signal(backlog=0, miss_rate=0.0, queue_occupancy=0.0,
+              read_wait_s=0.001, parse_s=0.001, files_per_batch=1)
+
+
+def _drive(tuner, knobs, sig, windows):
+    for _ in range(windows):
+        tuner.observe(sig, knobs)
+
+
+def test_autotuner_slow_read_widens_staging():
+    """Skewed workload: engine waits on cold reads (single-file
+    batches, high miss rate) → the tuner converges prefetch_batches to
+    its ceiling and touches nothing else."""
+    knobs = _fake_knobs(
+        read_workers=(1, 1, 4), prefetch_batches=(1, 1, 6),
+        pipeline_depth=(2, 1, 4),
+    )
+    tuner = IngestAutotuner(policy=AutotunePolicy(confirm=2, cooldown=1))
+    _drive(tuner, knobs, SLOW_READ, 40)
+    assert knobs["prefetch_batches"].get() == 6  # converged to hi
+    assert knobs["read_workers"].get() == 1
+    assert knobs["pipeline_depth"].get() == 2
+    assert all(d["knob"] == "prefetch_batches" for d in tuner.applied())
+    # converged: the last windows apply nothing further
+    n = len(tuner.applied())
+    _drive(tuner, knobs, SLOW_READ, 20)
+    assert len(tuner.applied()) == n
+
+
+def test_autotuner_slow_parse_adds_workers():
+    """Skewed the other way: multi-file batches whose parse dominates
+    the read wait → read_workers grows first."""
+    knobs = _fake_knobs(
+        read_workers=(1, 1, 4), prefetch_batches=(2, 1, 6),
+        pipeline_depth=(2, 1, 4),
+    )
+    tuner = IngestAutotuner(policy=AutotunePolicy(confirm=2, cooldown=1))
+    _drive(tuner, knobs, SLOW_PARSE, 12)
+    assert knobs["read_workers"].get() > 1
+    assert tuner.applied()[0]["knob"] == "read_workers"
+
+
+def test_autotuner_saturated_staging_deepens_pipeline():
+    knobs = _fake_knobs(
+        read_workers=(4, 1, 4), prefetch_batches=(6, 1, 6),
+        pipeline_depth=(2, 1, 4),
+    )
+    tuner = IngestAutotuner(policy=AutotunePolicy(confirm=2, cooldown=1))
+    _drive(tuner, knobs, SATURATED, 12)
+    assert knobs["pipeline_depth"].get() > 2
+    assert tuner.applied()[0]["knob"] == "pipeline_depth"
+
+
+def test_autotuner_idle_shrinks():
+    knobs = _fake_knobs(
+        read_workers=(4, 1, 4), prefetch_batches=(6, 1, 6),
+        pipeline_depth=(2, 1, 4),
+    )
+    tuner = IngestAutotuner(policy=AutotunePolicy(confirm=2, cooldown=1))
+    _drive(tuner, knobs, IDLE, 12)
+    applied = tuner.applied()
+    assert applied and applied[0]["direction"] == "down"
+    assert knobs["prefetch_batches"].get() < 6
+
+
+def test_autotuner_hysteresis_requires_confirmation():
+    """A one-window blip never moves a knob: confirm=3 means two
+    agreeing windows are not enough."""
+    knobs = _fake_knobs(prefetch_batches=(1, 1, 6))
+    tuner = IngestAutotuner(policy=AutotunePolicy(confirm=3, cooldown=0))
+    tuner.observe(SLOW_READ, knobs)
+    tuner.observe(IDLE, knobs)      # breaks the streak
+    tuner.observe(SLOW_READ, knobs)
+    tuner.observe(IDLE, knobs)
+    assert knobs["prefetch_batches"].get() == 1
+    assert not tuner.applied()
+
+
+def test_no_oscillation_under_flapping_source():
+    """THE guarantee: a source flapping between starved and idle (the
+    chaos profile) produces a BOUNDED number of knob changes — the
+    reversal limit freezes the contested knob and the tuner goes
+    quiescent forever after."""
+    policy = AutotunePolicy(confirm=2, cooldown=1, max_reversals=2)
+    knobs = _fake_knobs(
+        read_workers=(1, 1, 4), prefetch_batches=(2, 1, 8),
+        pipeline_depth=(2, 1, 4),
+    )
+    tuner = IngestAutotuner(policy=policy)
+    changes_at = []
+    # flap with a period long enough to defeat pure confirm-hysteresis
+    for w in range(600):
+        sig = SLOW_READ if (w // 6) % 2 == 0 else IDLE
+        rec = tuner.observe(sig, knobs)
+        if rec is not None and rec["action"] == "applied":
+            changes_at.append(w)
+    # the analytic bound: Σ_knobs (max_reversals + 1) × (hi − lo)
+    bound = sum(
+        (policy.max_reversals + 1) * (k.hi - k.lo)
+        for k in knobs.values()
+    )
+    assert len(changes_at) <= bound
+    # and empirically FAR tighter: the contested knob froze
+    assert "prefetch_batches" in tuner.frozen
+    # quiescent: no change in the last 400 windows
+    assert not changes_at or changes_at[-1] < 200
+    frozen_decisions = [
+        d for d in tuner.decisions if d["action"] == "frozen"
+    ]
+    assert frozen_decisions  # the freeze itself is journaled
+
+
+def test_tuning_budget_shared_across_tenants():
+    """Two tenants' tuners draw on ONE budget: total extra staged
+    ranges across both never exceeds the cap, and the denied decision
+    is journaled (not silently dropped)."""
+    budget = TuningBudget(prefetch_batches=2)
+    tuners = [
+        IngestAutotuner(
+            policy=AutotunePolicy(confirm=1, cooldown=0),
+            budget=budget, tenant=t,
+        )
+        for t in ("a", "b")
+    ]
+    knobs = {
+        t: _fake_knobs(prefetch_batches=(1, 1, 8)) for t in ("a", "b")
+    }
+    for _ in range(10):
+        for t, tuner in zip(("a", "b"), tuners):
+            tuner.observe(SLOW_READ, knobs[t])
+    grown = sum(
+        knobs[t]["prefetch_batches"].get() - 1 for t in ("a", "b")
+    )
+    assert grown == 2  # exactly the budget, split across tenants
+    assert budget.snapshot()["prefetch_batches"]["used"] == 2
+    denied = [
+        d
+        for tuner in tuners
+        for d in tuner.decisions
+        if d["action"] == "budget_denied"
+    ]
+    assert denied
+    # a shrink refunds the budget
+    idle_knobs = knobs["a"]
+    t_a = tuners[0]
+    for _ in range(6):
+        t_a.observe(IDLE, idle_knobs)
+    assert budget.snapshot()["prefetch_batches"]["used"] < 2
+
+
+def test_budget_charges_only_above_cold_default():
+    """Review regression: the budget charges EXTRA capacity above a
+    knob's cold-start value.  Shrinking below the default refunds
+    nothing (nothing was charged), and regrowing back to it costs
+    nothing — an idle fleet that dipped under its defaults can always
+    recover them even on an exhausted budget."""
+    budget = TuningBudget(prefetch_batches=1)
+    policy = AutotunePolicy(confirm=1, cooldown=0, max_reversals=50)
+    tuner = IngestAutotuner(policy=policy, budget=budget)
+    knobs = _fake_knobs(prefetch_batches=(4, 1, 8))
+    # idle: shrink 4 -> 1; nothing was ever charged, nothing refunds
+    for _ in range(8):
+        tuner.observe(IDLE, knobs)
+    assert knobs["prefetch_batches"].get() == 1
+    assert budget.snapshot()["prefetch_batches"]["used"] == 0
+    # starved again: regrowth back to the cold default of 4 is FREE
+    for _ in range(8):
+        tuner.observe(SLOW_READ, knobs)
+    assert knobs["prefetch_batches"].get() >= 4
+    used_at_4 = budget.snapshot()["prefetch_batches"]["used"]
+    assert used_at_4 <= 1  # only growth PAST 4 charged
+    # and growth beyond default+cap is denied, not silently applied
+    for _ in range(12):
+        tuner.observe(SLOW_READ, knobs)
+    assert knobs["prefetch_batches"].get() == 5  # default 4 + cap 1
+    assert budget.snapshot()["prefetch_batches"]["used"] == 1
+    assert any(
+        d["action"] == "budget_denied" for d in tuner.decisions
+    )
+
+
+def test_signal_full_miss_rate_when_staging_disabled(tmp_path):
+    """Review regression: with prefetch disabled every read IS a cold
+    read, but the source's miss counters are gated on prefetch being
+    armed — the signal must report the honest 100% miss rate so the
+    tuner can ARM staging instead of one-way ratcheting down."""
+    in_dir = _stream_dir(tmp_path, n_files=4)
+    src = FileStreamSource(in_dir, prefetch_batches=0)
+    q = StreamingQuery(
+        _ColsModel(), src, MemorySink(), str(tmp_path / "ckpt"),
+        max_batch_offsets=1,
+    )
+    tuner = IngestAutotuner()
+    q._tick_latest = src.latest_offset()  # what an engine round sets
+    sig = tuner._signal(q)
+    assert sig.backlog == 4 and sig.miss_rate == 1.0
+    knobs = graph_knobs(q)
+    assert tuner.propose(sig, knobs) == ("prefetch_batches", +1)
+    # drained and idle: the synthetic miss rate must NOT block shrink
+    assert q.process_available() == 4
+    q._tick_latest = src.latest_offset()
+    idle_sig = tuner._signal(q)
+    assert idle_sig.backlog == 0 and idle_sig.miss_rate == 0.0
+    src.close()
+
+
+# ---------------------------------------------------------------------------
+# live-engine integration
+# ---------------------------------------------------------------------------
+
+
+def test_engine_autotune_end_to_end(tmp_path):
+    """Aggressive tuner on a real CSV stream: the engine serves
+    correctly, knob changes land between batches, decisions ride the
+    stats/metrics plane."""
+    from sntc_tpu.obs.metrics import registry
+
+    in_dir = _stream_dir(tmp_path, n_files=14)
+    src = FileStreamSource(in_dir, prefetch_batches=1, read_workers=1)
+    tuner = IngestAutotuner(
+        policy=AutotunePolicy(interval_ticks=1, confirm=1, cooldown=0)
+    )
+    sink = MemorySink()
+    q = StreamingQuery(
+        _ColsModel(), src, sink, str(tmp_path / "ckpt"),
+        max_batch_offsets=1, autotuner=tuner,
+    )
+    assert q.process_available() == 14
+    assert sum(f.num_rows for f in sink.frames) == 14 * 40
+    stats = q.pipeline_stats()
+    assert stats["autotune"]["windows"] > 0
+    assert stats["autotune"]["knobs"]["prefetch_batches"] >= 1
+    if tuner.applied():  # knob gauge mirrors the last applied value
+        d = tuner.applied()[-1]
+        assert registry().get(
+            "sntc_ingest_knob_value", knob=d["knob"]
+        ) == d["to"]
+    src.close()
+
+
+def test_engine_autotune_failure_degrades_not_kills(tmp_path):
+    """The degrade-never-kill contract: an exploding tuner emits
+    autotune_error and the stream keeps serving."""
+    from sntc_tpu.resilience import add_event_observer, remove_event_observer
+
+    class Exploding:
+        def on_tick(self, engine):
+            raise RuntimeError("controller bug")
+
+    seen = []
+
+    def _obs(rec):
+        if rec.get("event") == "autotune_error":
+            seen.append(rec)
+
+    add_event_observer(_obs)
+    try:
+        src = MemorySource([
+            Frame({k: np.ones(5) for k in ("a", "b", "c", "d")})
+        ])
+        sink = MemorySink()
+        q = StreamingQuery(
+            _ColsModel(), src, sink, str(tmp_path / "ckpt"),
+            autotuner=Exploding(),
+        )
+        assert q.process_available() == 1
+        assert len(sink.frames) == 1
+    finally:
+        remove_event_observer(_obs)
+    assert seen and "controller bug" in seen[0]["error"]
+
+
+def test_daemon_shared_budget_autotune(tmp_path, mesh8):
+    """serve-daemon wiring: per-tenant tuners share one TuningBudget,
+    autotune evidence lands in status()."""
+    from sntc_tpu.models import LogisticRegression
+    from sntc_tpu.serve import ServeDaemon, TenantSpec
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    model = LogisticRegression(mesh=mesh8, maxIter=5).fit(
+        Frame({"features": X, "label": y})
+    )
+    specs = [
+        TenantSpec(
+            tenant_id=t, model=model,
+            source=MemorySource([
+                Frame({"features": rng.normal(size=(16, 4)).astype(
+                    np.float32)})
+            ]),
+            sink=MemorySink(),
+        )
+        for t in ("a", "b")
+    ]
+    daemon = ServeDaemon(specs, str(tmp_path / "root"), autotune=True)
+    try:
+        daemon.process_available()
+        stats = daemon.autotune_stats()
+        assert set(stats["tenants"]) == {"a", "b"}
+        assert "budget" in stats
+        # both tuners share the SAME budget object
+        tuners = [t.query.autotuner for t in daemon.tenants]
+        assert tuners[0].budget is tuners[1].budget
+        assert daemon.status()["autotune"] is not None
+    finally:
+        daemon.close()
+
+
+# ---------------------------------------------------------------------------
+# drift check
+# ---------------------------------------------------------------------------
+
+
+def test_check_ingest_flags_consistent():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import check_ingest_flags
+
+    assert check_ingest_flags.check() == []
