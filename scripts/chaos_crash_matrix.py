@@ -79,6 +79,26 @@ FLOW_KILL_AFTER = {
 # and the daemon exits 0.
 TENANT_IDS = ("t0", "t1", "t2")
 
+# closed-loop SLO controller scenarios (r16).  The kill scenario arms
+# the controller (confirm=1, ingest delegation off so the guarded
+# serving knobs are the ones that move), declares an unreachable
+# throughput SLO on t0 so the controller provably applies knob steps,
+# and kills the process at the SECOND ``ctl.apply`` — after one
+# decision reached controller.jsonl, mid-way through the next apply.
+# The restart (controller armed again) must (a) converge every tenant
+# to the controller-OFF reference commits and sink rows — the
+# controller steers throughput knobs, never correctness — and (b)
+# write a ``restart`` reconciliation record logging the journal-tail
+# knob state against the fresh process's cold defaults.  The noisy
+# scenario floods t1 (3x files, every 3rd poisoned) under a declared
+# shed-rate SLO: the controller must degrade t1 down the journaled
+# ladder (throttle first) while t0/t2's knobs stay untouched and
+# their sink bytes stay identical to the controller-off reference,
+# then go quiescent (no decisions for 30 consecutive windows).
+CTL_KILL_SITES = ("ctl.apply",)
+CTL_NOISY_FILES = 12
+CTL_NOISY_POISON_EVERY = 3
+
 # kill-mid-promotion points (r11): where the model-lifecycle promotion
 # protocol dies.  pre_publish = before anything reached disk (the
 # promotion is simply lost; the incumbent keeps serving); pre_swap =
@@ -504,10 +524,12 @@ def run_promotion_kill_scenario(
 
 
 def run_daemon_worker(
-    d: str, *, faults: str = "", timeout: float = 120.0,
+    d: str, *, faults: str = "", timeout: float = 120.0, extra=(),
 ) -> subprocess.CompletedProcess:
     """One drain-and-exit ServeDaemon pass over the three tenant
-    streams under ``<d>/in/<tid>`` in a child process."""
+    streams under ``<d>/in/<tid>`` in a child process.  ``extra``
+    appends worker flags (``--controller``, ``--noisy``,
+    ``--kill-site``...)."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS=faults)
     env.pop("SNTC_RESILIENCE_LOG", None)
     return subprocess.run(
@@ -515,7 +537,7 @@ def run_daemon_worker(
             sys.executable, SCRIPT, "--worker", "--daemon", "--watch",
             os.path.join(d, "in"), "--out", os.path.join(d, "out"),
             "--ckpt", os.path.join(d, "ckpt"),
-        ],
+        ] + list(extra),
         env=env, cwd=REPO, capture_output=True, text=True,
         timeout=timeout,
     )
@@ -642,6 +664,151 @@ def run_tenant_isolation_scenario(workdir: str, reference: dict) -> dict:
     }
 
 
+def _write_ctl_noisy_inputs(d: str) -> None:
+    """The controller noisy-neighbor stream: the standard 3-tenant
+    inputs plus a t1 flood (3x its files), every
+    ``CTL_NOISY_POISON_EVERY``-th extra file poisoned with a ragged
+    line so the strict parser fails the batch (quarantine strikes —
+    the flooding evidence alongside the shed burst)."""
+    _write_daemon_inputs(d)
+    tdir = os.path.join(d, "in", "t1")
+    for i in range(4, CTL_NOISY_FILES):
+        path = os.path.join(tdir, f"in_{i:03d}.csv")
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["x"])
+            for r in range(6):
+                w.writerow([100_000 + i * 1000 + r])
+            if i % CTL_NOISY_POISON_EVERY == 0:
+                f.write("garbage,not,a,row\n")
+
+
+def _read_ctl_journal(d: str) -> tuple:
+    """Parse ``<ckpt>/controller.jsonl``; returns (records,
+    torn_line_count)."""
+    path = os.path.join(d, "ckpt", "controller.jsonl")
+    if not os.path.exists(path):
+        return [], 0
+    records, torn = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                torn += 1
+    return records, torn
+
+
+def run_controller_kill_scenario(workdir: str, reference: dict) -> dict:
+    """Kill the controller-armed daemon mid-knob-apply (the SECOND
+    ``ctl.apply`` — one decision already journaled) and restart it,
+    controller still armed.  Requires: the kill landed (rc 137), the
+    pre-kill journal holds >= 1 applied decision, the restart wrote a
+    ``restart`` reconciliation record (journal-tail knobs vs cold
+    defaults), the journal parses cleanly end to end, and every
+    tenant converged to the controller-OFF reference commits + sink
+    rows — the controller steers throughput knobs, never
+    correctness."""
+    d = os.path.join(workdir, "ctl_kill")
+    _write_daemon_inputs(d)
+    killed = run_daemon_worker(
+        d, extra=["--controller", "--kill-site", "ctl.apply",
+                  "--kill-after", "1"],
+    )
+    if killed.returncode != KILL_EXIT_CODE:
+        return {"site": "ctl.apply", "ok": False,
+                "error": f"kill run rc={killed.returncode} (expected "
+                f"{KILL_EXIT_CODE}): {killed.stderr}"}
+    pre_records, pre_torn = _read_ctl_journal(d)
+    pre_applied = [r for r in pre_records if r.get("action") == "applied"]
+    restarted = run_daemon_worker(d, extra=["--controller"])
+    if restarted.returncode != 0:
+        return {"site": "ctl.apply", "ok": False,
+                "error": f"restart rc={restarted.returncode}: "
+                f"{restarted.stderr}"}
+    records, torn = _read_ctl_journal(d)
+    restarts = [r for r in records if r.get("action") == "restart"]
+    got = _daemon_state(d)
+    ok = bool(
+        got == reference
+        and pre_torn == 0 and torn == 0
+        and len(pre_applied) >= 1
+        and len(restarts) >= 1
+        and restarts[0].get("journal_knobs") is not None
+        and restarts[0].get("delta")  # cold defaults != journal tail
+    )
+    return {
+        "site": "ctl.apply", "ok": ok,
+        "converged": got == reference,
+        "pre_kill_applied": len(pre_applied),
+        "journal_torn_lines": torn,
+        "restart_records": len(restarts),
+        "restart_delta": restarts[0].get("delta") if restarts else None,
+    }
+
+
+def run_controller_noisy_scenario(workdir: str) -> dict:
+    """The noisy-neighbor arc with the controller armed, against a
+    controller-OFF reference over IDENTICAL inputs.  Requires: both
+    daemons exit 0; the well-behaved tenants' sink BYTES match the
+    reference exactly (their knobs were never touched — also asserted
+    from the journal); the violator was degraded down the journaled
+    ladder starting with its quota; and the controller went quiescent
+    (30 consecutive decision-free windows, reported by the worker)."""
+    d_ref = os.path.join(workdir, "ctl_noisy_ref")
+    d_ctl = os.path.join(workdir, "ctl_noisy")
+    _write_ctl_noisy_inputs(d_ref)
+    _write_ctl_noisy_inputs(d_ctl)
+    ref = run_daemon_worker(d_ref, extra=["--noisy"])
+    if ref.returncode != 0:
+        return {"site": "controller_noisy", "ok": False,
+                "error": f"reference rc={ref.returncode}: {ref.stderr}"}
+    proc = run_daemon_worker(d_ctl, extra=["--noisy", "--controller"])
+    if proc.returncode != 0:
+        return {"site": "controller_noisy", "ok": False,
+                "error": f"controller run rc={proc.returncode}: "
+                f"{proc.stderr}"}
+    try:
+        verdict = json.loads(
+            [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")][-1]
+        )
+    except (IndexError, ValueError):
+        return {"site": "controller_noisy", "ok": False,
+                "error": f"no JSON verdict: {proc.stdout[-500:]}"}
+    clean_ok = all(
+        sink_contents(os.path.join(d_ctl, "out", tid))
+        == sink_contents(os.path.join(d_ref, "out", tid))
+        for tid in TENANT_IDS if tid != "t1"
+    )
+    records, torn = _read_ctl_journal(d_ctl)
+    applied = [r for r in records if r.get("action") == "applied"]
+    t1_knobs = {r["knob"] for r in applied
+                if r.get("tenant") == "t1"}
+    clean_touched = [r for r in applied
+                     if r.get("tenant") in ("t0", "t2")]
+    ok = (
+        clean_ok
+        and torn == 0
+        and any(k.endswith("quota") for k in t1_knobs)  # throttle rung
+        and not clean_touched  # compliant neighbors never touched
+        and verdict.get("ctl", {}).get("quiesced") is True
+        and verdict.get("ctl", {}).get("well_behaved_compliant") is True
+    )
+    return {
+        "site": "controller_noisy", "ok": ok,
+        "clean_sinks_match": clean_ok,
+        "t1_ladder_knobs": sorted(t1_knobs),
+        "clean_tenant_decisions": len(clean_touched),
+        "applied_total": len(applied),
+        "quiesced": verdict.get("ctl", {}).get("quiesced"),
+        "tenant_states": verdict.get("tenants"),
+    }
+
+
 def run_matrix(workdir: str, pipelined: bool = False) -> dict:
     """The full matrix: reference is ALWAYS the serial engine; kill and
     drain scenarios run serial or pipelined per ``pipelined`` and must
@@ -665,6 +832,8 @@ def run_matrix(workdir: str, pipelined: bool = False) -> dict:
     mt_ref = run_multi_tenant_reference(workdir)
     results.append(run_multi_tenant_kill_scenario(workdir, mt_ref))
     results.append(run_tenant_isolation_scenario(workdir, mt_ref))
+    results.append(run_controller_kill_scenario(workdir, mt_ref))
+    results.append(run_controller_noisy_scenario(workdir))
     return {"ok": all(r["ok"] for r in results), "scenarios": results}
 
 
@@ -760,18 +929,34 @@ def daemon_worker_main(args) -> int:
     checkpoints under ``<ckpt>/tenant/<tid>/``), drain-and-exit.
     Ladder thresholds are tight so the isolation scenario escalates
     within one pass; the cooldown is effectively infinite so a
-    quarantined tenant stays visibly QUARANTINED in the verdict."""
+    quarantined tenant stays visibly QUARANTINED in the verdict.
+
+    ``--controller`` attaches a ServeController (confirm=1 for fast
+    windows; ingest delegation OFF so the guarded serving knobs are
+    the ones that move) with scenario-specific SLOs: the kill
+    scenario declares an unreachable throughput floor on t0 (knob
+    steps provably apply → the armed ``ctl.apply`` kill lands); the
+    ``--noisy`` scenario declares a shed-rate SLO on the flooded t1
+    (the degradation ladder engages).  ``--kill-site``/``--kill-after``
+    arm the Nth-apply kill programmatically.  With ``--noisy
+    --controller`` the worker also runs a quiescence loop (up to 600
+    extra rounds) and reports whether 30 consecutive decision-free
+    windows were reached before draining."""
     sys.path.insert(0, REPO)
     from sntc_tpu.core.base import Transformer
+    from sntc_tpu.resilience import arm
     from sntc_tpu.serve import ServeDaemon, TenantSpec
 
     class Identity(Transformer):
         def transform(self, frame):
             return frame
 
+    if args.kill_site:
+        arm(args.kill_site, kind="kill", after=args.kill_after, times=1)
     model = Identity()
-    specs = [
-        TenantSpec(
+
+    def _spec(tid, **kw):
+        base = dict(
             tenant_id=tid, model=model,
             watch=os.path.join(args.watch, tid),
             out=os.path.join(args.out, tid),
@@ -780,11 +965,67 @@ def daemon_worker_main(args) -> int:
             quarantine_after=2, stop_after=99,
             quarantine_cooldown_s=1e9,
         )
-        for tid in TENANT_IDS
-    ]
+        base.update(kw)
+        return TenantSpec(**base)
+
+    if args.noisy:
+        # the flooded tenant sheds (cap 2) under a declared shed-rate
+        # SLO; its OWN ladder is loose so the CONTROLLER's ladder is
+        # the thing being tested, not the event-strike escalation
+        specs = [
+            _spec("t0"),
+            _spec("t1", max_pending_batches=2, shed_policy="oldest",
+                  quarantine_after=10,
+                  slo_max_shed_rate=0.05 if args.controller else None),
+            _spec("t2"),
+        ]
+    elif args.controller:
+        specs = [
+            _spec("t0", slo_min_rows_per_sec=1e9),
+            _spec("t1"),
+            _spec("t2"),
+        ]
+    else:
+        specs = [_spec(tid) for tid in TENANT_IDS]
     daemon = ServeDaemon(specs, args.ckpt)
+    if args.controller:
+        from sntc_tpu.resilience.control import ControlPolicy
+        from sntc_tpu.serve.controller import ServeController
+
+        daemon.controller = ServeController.for_daemon(
+            daemon,
+            policy=ControlPolicy(confirm=1, cooldown=0),
+            ingest=False,
+        )
+    ctl_report = None
     try:
         n = daemon.process_available()
+        if args.controller and args.noisy:
+            # quiescence: keep scheduling rounds coming until the
+            # controller has been silent 30 consecutive windows
+            quiesced = False
+            idle = 0
+            for _ in range(600):
+                before = daemon.controller.guard.decisions_total
+                daemon.tick()
+                if daemon.controller.guard.decisions_total == before:
+                    idle += 1
+                else:
+                    idle = 0
+                if idle >= 30:
+                    quiesced = True
+                    break
+            slo = daemon.controller.slo_status()
+            ctl_report = {
+                "quiesced": quiesced,
+                "applied": len(daemon.controller.guard.applied()),
+                "escalations": daemon.controller.escalations_total,
+                "well_behaved_compliant": all(
+                    slo[tid]["compliant"] in (None, True)
+                    for tid in ("t0", "t2")
+                ),
+                "knobs": daemon.controller.knob_values(),
+            }
         daemon.drain()
         status = daemon.status()
     finally:
@@ -794,6 +1035,7 @@ def daemon_worker_main(args) -> int:
         "tenants": {
             tid: row["state"] for tid, row in status["tenants"].items()
         },
+        "ctl": ctl_report,
     }))
     return 0
 
@@ -911,6 +1153,12 @@ def main(argv=None) -> int:
     ap.add_argument("--daemon", action="store_true",
                     help="worker: three-tenant ServeDaemon pass "
                     "(multi-tenant scenarios)")
+    ap.add_argument("--controller", action="store_true",
+                    help="worker: arm the closed-loop SLO controller "
+                    "over the daemon pass (controller scenarios)")
+    ap.add_argument("--noisy", action="store_true",
+                    help="worker: t1 runs the flooded+poisoned noisy "
+                    "stream under a declared shed-rate SLO")
     ap.add_argument("--pipelined", action="store_true",
                     help="run the engine in pipelined mode (prefetching "
                     "source + shape buckets + overlapped sink delivery); "

@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Static drift check: the closed-loop SLO controller's surface across
+CLI ⇔ TenantSpec SLO fields ⇔ controller knob names ⇔ metric catalog
+⇔ docs.
+
+The self-driving serve plane is one feature spread over five layers —
+the ``--slo-*`` / ``--controller`` flags on serve AND serve-daemon,
+the ``TenantSpec`` SLO fields the daemon reads as setpoints, the
+``ServeController`` knob registry (``SERVE_KNOB_NAMES``), the
+``sntc_ctl_*`` metric catalog, and the knob table in
+``docs/RESILIENCE.md`` — and they must stay in lockstep:
+
+1. **CLI → SLO fields**: every ``TenantSpec`` SLO field has its flag
+   on BOTH serve and serve-daemon, plus the arming pair
+   ``--controller``/``--no-controller`` on both;
+2. **SLO fields → spec/controller**: ``TenantSpec`` declares every
+   field in ``controller.SLO_FIELDS`` and vice versa;
+3. **knobs → docs**: ``docs/RESILIENCE.md`` carries a marker-delimited
+   controller-knob table (``<!-- controller-knobs:begin/end -->``)
+   with one row per ``SERVE_KNOB_NAMES`` entry — stale/extra rows are
+   drift;
+4. **metrics → catalog**: the ``sntc_ctl_*`` series are declared in
+   ``obs.metrics.CATALOG`` (``check_metric_names.py`` owns catalog ⇔
+   docs; this check pins the controller set exists at all).
+
+Wired as a tier-1 test (``tests/test_controller.py``), the same
+discipline as ``check_ingest_flags.py`` / ``check_tenant_flags.py``.
+
+Exit 0 when consistent; exit 1 with a per-item report otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DOC = "docs/RESILIENCE.md"
+TABLE_BEGIN = "<!-- controller-knobs:begin -->"
+TABLE_END = "<!-- controller-knobs:end -->"
+
+#: TenantSpec SLO field -> its CLI flag (on serve AND serve-daemon)
+SLO_FLAGS = {
+    "slo_p99_ms": "--slo-p99-ms",
+    "slo_min_rows_per_sec": "--slo-min-rows-per-sec",
+    "slo_max_shed_rate": "--slo-max-shed-rate",
+}
+ARM_FLAGS = ("--controller", "--no-controller")
+
+#: the catalog rows the controller emits
+CTL_METRICS = (
+    "sntc_ctl_windows_total",
+    "sntc_ctl_decisions_total",
+    "sntc_ctl_knob_value",
+    "sntc_ctl_slo_compliant",
+    "sntc_ctl_window_p99_seconds",
+)
+
+
+def _read(rel: str) -> str:
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def _doc_rows() -> set:
+    """Documented knob names from the marker-delimited table."""
+    text = _read(DOC)
+    if TABLE_BEGIN not in text or TABLE_END not in text:
+        return None
+    table = text.split(TABLE_BEGIN, 1)[1].split(TABLE_END, 1)[0]
+    rows = set()
+    for line in table.splitlines():
+        m = re.match(r"\s*\|\s*`([a-z_]+)`\s*\|", line)
+        if m and m.group(1) != "knob":
+            rows.add(m.group(1))
+    return rows
+
+
+def check() -> list:
+    """Returns human-readable drift complaints (empty = consistent)."""
+    problems = []
+    sys.path.insert(0, REPO)
+    from dataclasses import fields as dc_fields
+
+    from sntc_tpu.obs.metrics import CATALOG
+    from sntc_tpu.serve.controller import SERVE_KNOB_NAMES, SLO_FIELDS
+    from sntc_tpu.serve.tenancy import TenantSpec
+
+    app_src = _read(os.path.join("sntc_tpu", "app.py"))
+
+    # 1. CLI surface: every SLO flag + the arming pair, on BOTH CLIs
+    for field, flag in SLO_FLAGS.items():
+        if app_src.count(f'"{flag}"') < 2:
+            problems.append(
+                f"SLO field {field!r} needs its {flag!r} flag on BOTH "
+                "serve and serve-daemon (found fewer than 2 "
+                "declarations in sntc_tpu/app.py)"
+            )
+    for flag in ARM_FLAGS:
+        if app_src.count(f'"{flag}"') < 2:
+            problems.append(
+                f"{flag!r} must exist on BOTH serve and serve-daemon "
+                "CLIs (found fewer than 2 declarations)"
+            )
+
+    # 2. SLO fields: checker map ⇔ controller.SLO_FIELDS ⇔ TenantSpec
+    if set(SLO_FIELDS) != set(SLO_FLAGS):
+        problems.append(
+            f"controller.SLO_FIELDS {sorted(SLO_FIELDS)} != the "
+            f"checker's flag map {sorted(SLO_FLAGS)} — update both"
+        )
+    spec_fields = {f.name for f in dc_fields(TenantSpec)}
+    for field in SLO_FIELDS:
+        if field not in spec_fields:
+            problems.append(
+                f"controller.SLO_FIELDS names {field!r} but TenantSpec "
+                "has no such field"
+            )
+
+    # 3. docs: the marker-delimited knob table mirrors SERVE_KNOB_NAMES
+    doc = _doc_rows()
+    if doc is None:
+        problems.append(
+            f"{DOC} is missing the marker-delimited controller-knob "
+            f"table ({TABLE_BEGIN} ... {TABLE_END})"
+        )
+    else:
+        for knob in SERVE_KNOB_NAMES:
+            if knob not in doc:
+                problems.append(
+                    f"knob {knob!r} missing from the {DOC} "
+                    "controller-knob table"
+                )
+        for knob in sorted(doc - set(SERVE_KNOB_NAMES)):
+            problems.append(
+                f"{DOC} controller-knob table documents unknown knob "
+                f"{knob!r}"
+            )
+        for flag in list(SLO_FLAGS.values()) + ["--controller"]:
+            if flag not in _read(DOC):
+                problems.append(f"{flag} undocumented in {DOC}")
+
+    # 4. catalog
+    for name in CTL_METRICS:
+        if name not in CATALOG:
+            problems.append(
+                f"controller metric {name!r} missing from "
+                "obs.metrics.CATALOG"
+            )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("controller-flag drift detected:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print(
+        f"ok: {len(SLO_FLAGS)} SLO flags + {len(CTL_METRICS)} metrics "
+        "consistent across CLI, TenantSpec, knob registry, catalog, "
+        "and docs"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

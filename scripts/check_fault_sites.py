@@ -107,10 +107,11 @@ def check_kinds() -> list:
 
 
 _CHAOS = "scripts/chaos_crash_matrix.py"
-# the kill-site tuples the crash matrix drives; every stream.*/sink.*
-# and every flow.* site must appear in one of them
+# the kill-site tuples the crash matrix drives; every stream.*/sink.*,
+# every flow.*, and every ctl.* site must appear in one of them
 _CHAOS_TUPLE_RE = re.compile(
-    r"^(?:KILL_SITES|FLOW_KILL_SITES)\s*=\s*\(([^)]*)\)", re.MULTILINE
+    r"^(?:KILL_SITES|FLOW_KILL_SITES|CTL_KILL_SITES)\s*=\s*\(([^)]*)\)",
+    re.MULTILINE,
 )
 
 
@@ -132,7 +133,7 @@ def check_chaos_coverage() -> list:
     covered = chaos_kill_sites()
     must_cover = {
         s for s in declared_sites()
-        if s.split(".")[0] in ("stream", "sink", "flow")
+        if s.split(".")[0] in ("stream", "sink", "flow", "ctl")
         and s != "stream.read"  # read kills pre-WAL == stream.wal row
     }
     return [

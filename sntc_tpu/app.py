@@ -484,13 +484,34 @@ def cmd_serve(args) -> int:
             read_workers=args.read_workers,
             parse_salvage=contract is not None,
         )
+    # closed-loop SLO control (r16): any --slo-* flag declares a
+    # setpoint and arms the ServeController over this engine via the
+    # supervisor below (--no-controller keeps the knobs at their flag
+    # values).  Resolved HERE because the controller OWNS the ingest
+    # tuner — one owner per knob, exactly the daemon rule.
+    slo = None
+    if args.controller and (
+        args.slo_p99_ms or args.slo_min_rows_per_sec
+        or args.slo_max_shed_rate
+    ):
+        from sntc_tpu.serve import SloPolicy
+
+        slo = SloPolicy(
+            slo_p99_ms=args.slo_p99_ms,
+            slo_min_rows_per_sec=args.slo_min_rows_per_sec,
+            slo_max_shed_rate=args.slo_max_shed_rate,
+        )
     # --autotune: the ingest source graph tunes its own pools/queues
     # (read_workers, prefetch width, pipeline depth) from observed
     # stage latencies, with hysteresis and journaled decisions —
     # tf.data AUTOTUNE for this serve path (docs/PERFORMANCE.md
-    # "Autotuned ingest"); the flags above become the cold-start values
+    # "Autotuned ingest"); the flags above become the cold-start
+    # values.  With SLOs declared the CONTROLLER owns the tuner (and
+    # pipeline_depth) — an engine-owned tuner alongside it would
+    # double-steer the same knobs with two direction histories and
+    # defeat the no-oscillation bound.
     autotuner = None
-    if args.autotune:
+    if args.autotune and slo is None:
         from sntc_tpu.data.autotune import IngestAutotuner
 
         autotuner = IngestAutotuner()
@@ -529,12 +550,17 @@ def cmd_serve(args) -> int:
     # supervised loop: SIGTERM (and Ctrl-C) drains — finish in-flight
     # batches, commit, write drain_marker.json — and exits 0; a restart
     # on the same checkpoint resumes exactly-once from the offset log
+    # the controller (slo resolved above, before the autotuner) steers
+    # --pipeline-depth / --shape-buckets / the shed knob live and
+    # journals every decision to <checkpoint>/controller.jsonl — see
+    # docs/RESILIENCE.md "Closed-loop SLO control"
     sup = QuerySupervisor(
         q,
         max_pending_batches=args.max_pending_batches,
         shed_policy=args.shed_policy,
         max_batch_wall_time=args.max_batch_wall_time,
         health_json=args.health_json,
+        slo=slo,
     )
     sup.install_signal_handlers()
     print(f"serving: watching {args.watch} -> {args.out} "
@@ -590,6 +616,9 @@ def cmd_serve_daemon(args) -> int:
         "quarantine_cooldown_s": args.quarantine_cooldown,
         "stop_after": args.stop_after,
         "from_capture": args.from_capture,
+        "slo_p99_ms": args.slo_p99_ms,
+        "slo_min_rows_per_sec": args.slo_min_rows_per_sec,
+        "slo_max_shed_rate": args.slo_max_shed_rate,
         "max_batch_offsets": args.max_files_per_batch,
         "max_batch_failures": (
             args.max_batch_failures if args.max_batch_failures > 0
@@ -643,6 +672,7 @@ def cmd_serve_daemon(args) -> int:
         health_json=args.health_json,
         metrics_out=args.metrics_out,
         autotune=args.autotune,
+        controller=args.controller,
     )
     try:
         if args.once:
@@ -798,6 +828,29 @@ def main(argv=None) -> int:
     p.add_argument("--max-batch-wall-time", type=float, default=None,
                    metavar="S", help="watchdog: flag a batch running "
                    "longer than this as UNHEALTHY (watchdog_stall event)")
+    p.add_argument("--slo-p99-ms", type=float, default=None,
+                   help="declared p99 batch-latency SLO: arms the "
+                   "closed-loop controller, which steers the serving "
+                   "knobs (pipeline depth, shape-bucket floor, shed, "
+                   "ingest pools) toward it with hysteresis-guarded "
+                   "journaled decisions; 0/unset = undeclared")
+    p.add_argument("--slo-min-rows-per-sec", type=float, default=None,
+                   help="declared throughput-floor SLO (binds while "
+                   "the source has backlog); arms the controller "
+                   "like --slo-p99-ms; 0/unset = undeclared")
+    p.add_argument("--slo-max-shed-rate", type=float, default=None,
+                   help="declared bound on the per-window fraction of "
+                   "offsets load shedding may drop; arms the "
+                   "controller; 0/unset = undeclared")
+    p.add_argument("--controller", action="store_true",
+                   dest="controller", default=True,
+                   help="allow the closed-loop SLO controller (armed "
+                   "by any --slo-* flag; decisions journaled to "
+                   "<checkpoint>/controller.jsonl) — default")
+    p.add_argument("--no-controller", action="store_false",
+                   dest="controller",
+                   help="keep every serving knob at its flag value "
+                   "even when SLOs are declared")
     p.add_argument("--row-policy", default="strict",
                    choices=["strict", "salvage", "permissive"],
                    help="data-plane admission against the canonical "
@@ -957,6 +1010,30 @@ def main(argv=None) -> int:
                    "tenant/<id>/ckpt/flow_state); per-tenant "
                    "'flow_options' in the tenants JSON tunes the "
                    "window knobs")
+    p.add_argument("--slo-p99-ms", type=float, default=None,
+                   help="default per-tenant p99 latency SLO "
+                   "(TenantSpec slo_p99_ms; per-tenant JSON "
+                   "overrides); the --controller setpoint; "
+                   "0/unset = undeclared")
+    p.add_argument("--slo-min-rows-per-sec", type=float, default=None,
+                   help="default per-tenant throughput-floor SLO "
+                   "(TenantSpec slo_min_rows_per_sec); 0/unset = "
+                   "undeclared")
+    p.add_argument("--slo-max-shed-rate", type=float, default=None,
+                   help="default per-tenant shed-rate SLO bound "
+                   "(TenantSpec slo_max_shed_rate, a fraction in "
+                   "(0, 1]); 0/unset = undeclared")
+    p.add_argument("--controller", action="store_true",
+                   dest="controller", default=False,
+                   help="arm the closed-loop SLO controller: one "
+                   "guarded knob step per window toward the declared "
+                   "per-tenant SLOs (protect compliant tenants, "
+                   "degrade the violator throttle->shed->escalate), "
+                   "owning the per-tenant ingest tuners; decisions "
+                   "journaled to <root>/controller.jsonl")
+    p.add_argument("--no-controller", action="store_false",
+                   dest="controller",
+                   help="keep every serving knob at its flag value")
     p.add_argument("--batch-retry-attempts", type=int, default=2)
     p.add_argument("--max-batch-failures", type=int, default=3,
                    help="default per-tenant poison-batch threshold "

@@ -38,13 +38,20 @@ tenants autotuning on one box cannot each claim the whole host.
 
 from __future__ import annotations
 
-import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
-from sntc_tpu.data.pipeline import KNOB_NAMES, Knob, graph_knobs
+from sntc_tpu.data.pipeline import Knob, graph_knobs
 from sntc_tpu.obs.metrics import inc, set_gauge
 from sntc_tpu.resilience import emit_event
+from sntc_tpu.resilience.control import Guardrails, TuningBudget
+
+__all__ = [
+    "AutotunePolicy",
+    "IngestAutotuner",
+    "Signal",
+    "TuningBudget",  # canonical home: sntc_tpu.resilience.control (r16)
+]
 
 
 @dataclass
@@ -78,61 +85,6 @@ class Signal:
     files_per_batch: int = 1  # offsets one micro-batch covers
 
 
-class TuningBudget:
-    """Shared cap on the EXTRA capacity autotuners may grow beyond
-    their cold defaults, per knob kind.  ``try_acquire`` charges one
-    increase (False = budget exhausted, the decision is journaled as
-    denied and not applied); ``release`` refunds a decrease.  All
-    methods are thread-safe — tenants tick on one daemon thread today,
-    but the budget must not care."""
-
-    def __init__(
-        self,
-        read_workers: Optional[int] = None,
-        prefetch_batches: Optional[int] = None,
-        pipeline_depth: Optional[int] = None,
-    ):
-        self._caps = {
-            "read_workers": read_workers,
-            "prefetch_batches": prefetch_batches,
-            "pipeline_depth": pipeline_depth,
-        }
-        self._used = {k: 0 for k in self._caps}
-        self._lock = threading.Lock()
-
-    @classmethod
-    def default_for(cls, n_tenants: int) -> "TuningBudget":
-        """The daemon default: the whole fleet may grow at most one
-        host's worth of parse threads, two staged ranges per tenant,
-        and one extra pipeline slot per tenant."""
-        import os
-
-        return cls(
-            read_workers=max(4, (os.cpu_count() or 4)),
-            prefetch_batches=max(4, 2 * n_tenants),
-            pipeline_depth=max(2, n_tenants),
-        )
-
-    def try_acquire(self, knob: str, n: int = 1) -> bool:
-        with self._lock:
-            cap = self._caps.get(knob)
-            if cap is not None and self._used[knob] + n > cap:
-                return False
-            self._used[knob] = self._used.get(knob, 0) + n
-            return True
-
-    def release(self, knob: str, n: int = 1) -> None:
-        with self._lock:
-            self._used[knob] = max(0, self._used.get(knob, 0) - n)
-
-    def snapshot(self) -> Dict[str, Dict[str, Optional[int]]]:
-        with self._lock:
-            return {
-                k: {"cap": self._caps[k], "used": self._used[k]}
-                for k in self._caps
-            }
-
-
 class IngestAutotuner:
     """The feedback loop (module docstring).  Attach to one engine via
     ``StreamingQuery(autotuner=...)`` — the engine calls
@@ -145,32 +97,41 @@ class IngestAutotuner:
         budget: Optional[TuningBudget] = None,
         tenant: Optional[str] = None,
         bounds: Optional[dict] = None,
+        exclude_knobs: Tuple[str, ...] = (),
     ):
         self.policy = policy or AutotunePolicy()
         self.budget = budget
         self.tenant = tenant
         self.bounds = bounds
-        #: applied/denied/frozen journal, oldest evicted past the cap
-        #: (a budget-starved tenant re-denies every few windows
-        #: forever; the in-memory journal must not grow with uptime —
-        #: the event stream + metrics carry the full history)
-        self.decisions: List[dict] = []
-        self.decisions_total = 0
-        self._journal_keep = 256
-        self._baseline: Dict[str, int] = {}  # knob cold-start values
-        self._budget_held: Dict[str, int] = {}  # EXTRA units we charged
+        # a ServeController owning this tuner keeps pipeline_depth for
+        # itself (one owner per knob): excluded knobs never bind
+        self.exclude_knobs = tuple(exclude_knobs)
+        # the shared hysteresis substrate (resilience/control.py):
+        # confirm-streak + cooldown + reversal-freeze + bounded journal
+        # + budget charge — extracted in r16 with zero behavior diff
+        # (the r15 property tests pin it)
+        self.guard = Guardrails(
+            policy=self.policy, budget=budget,
+            on_journal=self._on_journal,
+        )
         self._ticks = 0
-        self._windows = 0
-        self._pending: Optional[Tuple[str, int]] = None
-        self._streak = 0
-        self._cooldown = 0
-        self._last_dir: Dict[str, int] = {}
-        self._reversals: Dict[str, int] = {}
-        self.frozen: set = set()
         self._last_hits = 0
         self._last_misses = 0
         self._knobs: Optional[Dict[str, Knob]] = None
         self._engine = None
+
+    # the pre-extraction public surface, now views over the guardrails
+    @property
+    def decisions(self) -> List[dict]:
+        return self.guard.decisions
+
+    @property
+    def decisions_total(self) -> int:
+        return self.guard.decisions_total
+
+    @property
+    def frozen(self) -> set:
+        return self.guard.frozen
 
     # -- engine cadence ------------------------------------------------------
 
@@ -187,7 +148,11 @@ class IngestAutotuner:
             # bench's at-saturation reps) keeps its learned source
             # knobs; only the engine-owned pipeline_depth rebinds
             self._engine = engine
-            self._knobs = graph_knobs(engine, self.bounds)
+            self._knobs = {
+                name: k
+                for name, k in graph_knobs(engine, self.bounds).items()
+                if name not in self.exclude_knobs
+            }
         return self.observe(self._signal(engine), self._knobs)
 
     def _signal(self, engine) -> Signal:
@@ -293,61 +258,25 @@ class IngestAutotuner:
     def observe(
         self, sig: Signal, knobs: Dict[str, Knob]
     ) -> Optional[dict]:
-        """One observation window: hysteresis + budget + apply.
-        Returns the journaled record when a knob moved (or froze),
-        None otherwise."""
-        self._windows += 1
-        if self._cooldown > 0:
-            self._cooldown -= 1
-            return None
-        prop = self.propose(sig, knobs)
-        if prop != self._pending:
-            self._pending = prop
-            self._streak = 1 if prop is not None else 0
-            return None
-        if prop is None:
-            return None
-        self._streak += 1
-        if self._streak < self.policy.confirm:
-            return None
-        name, direction = prop
-        self._pending, self._streak = None, 0
-        knob = knobs[name]
-        last = self._last_dir.get(name)
-        if last is not None and last != direction:
-            self._reversals[name] = self._reversals.get(name, 0) + 1
-            if self._reversals[name] > self.policy.max_reversals:
-                self.frozen.add(name)
-                return self._journal(
-                    name, direction, knob.get(), knob.get(),
-                    action="frozen", signal=sig,
-                )
-        cur = knob.get()
-        new = knob.clamp(cur + direction * knob.step)
-        if new == cur:
-            return None
-        if self.budget is not None:
-            # budget charges only the EXTRA capacity above this knob's
-            # COLD-START value (captured at first contact): shrinking
-            # below the baseline refunds nothing (nothing was charged),
-            # and regrowing back to it costs nothing — so an idle fleet
-            # that dipped under its defaults can always recover them
-            baseline = self._baseline.setdefault(name, cur)
-            held = self._budget_held.get(name, 0)
-            want = max(0, new - baseline)
-            if want > held:
-                if not self.budget.try_acquire(name, want - held):
-                    self._cooldown = self.policy.cooldown
-                    return self._journal(
-                        name, direction, cur, cur,
-                        action="budget_denied", signal=sig,
-                    )
-            elif want < held:
-                self.budget.release(name, held - want)
-            self._budget_held[name] = want
-        knob.set(new)
-        self._last_dir[name] = direction
-        self._cooldown = self.policy.cooldown
+        """One observation window: the shared guardrails
+        (hysteresis + budget, ``resilience/control.py``) arbitrate the
+        proposal and apply it.  Returns the journaled record when a
+        knob moved (or froze), None otherwise."""
+        return self.guard.observe(
+            lambda: self.propose(sig, knobs),
+            knobs,
+            lambda: {
+                "backlog": sig.backlog,
+                "miss_rate": round(sig.miss_rate, 3),
+                "queue_occupancy": round(sig.queue_occupancy, 3),
+                "read_wait_s": round(sig.read_wait_s, 6),
+                "parse_s": round(sig.parse_s, 6),
+                "files_per_batch": sig.files_per_batch,
+            },
+            on_applied=self._mirror_applied,
+        )
+
+    def _mirror_applied(self, name: str, direction: int, new: int) -> None:
         labels = {} if self.tenant is None else {"tenant": self.tenant}
         inc(
             "sntc_ingest_autotune_decisions_total",
@@ -355,39 +284,16 @@ class IngestAutotuner:
             **labels,
         )
         set_gauge("sntc_ingest_knob_value", new, knob=name, **labels)
-        return self._journal(
-            name, direction, cur, new, action="applied", signal=sig
-        )
 
-    def _journal(self, name, direction, old, new, *, action, signal):
-        rec = {
-            "action": action,
-            "knob": name,
-            "direction": "up" if direction > 0 else "down",
-            "from": old,
-            "to": new,
-            "window": self._windows,
-            "signal": {
-                "backlog": signal.backlog,
-                "miss_rate": round(signal.miss_rate, 3),
-                "queue_occupancy": round(signal.queue_occupancy, 3),
-                "read_wait_s": round(signal.read_wait_s, 6),
-                "parse_s": round(signal.parse_s, 6),
-                "files_per_batch": signal.files_per_batch,
-            },
-        }
-        self.decisions.append(rec)
-        self.decisions_total += 1
-        if len(self.decisions) > self._journal_keep:
-            del self.decisions[0]
+    def _on_journal(self, rec: dict) -> None:
         fields = dict(
-            event="autotune_decision", action=action, knob=name,
-            direction=rec["direction"], value=new,
+            event="autotune_decision", action=rec["action"],
+            knob=rec["knob"], direction=rec["direction"],
+            value=rec["to"],
         )
         if self.tenant is not None:
             fields["tenant"] = self.tenant
         emit_event(**fields)
-        return rec
 
     # -- evidence ------------------------------------------------------------
 
@@ -401,7 +307,7 @@ class IngestAutotuner:
 
     def stats(self) -> dict:
         out = {
-            "windows": self._windows,
+            "windows": self.guard.windows,
             "decisions": self.decisions_total,
             "applied": len(self.applied()),
             "frozen": sorted(self.frozen),

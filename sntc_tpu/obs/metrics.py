@@ -257,6 +257,33 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         type=GAUGE, labels=("tenant",),
         help="Size of the last published operator-state snapshot.",
     ),
+    # -- the closed-loop SLO controller (serve/controller) -------------------
+    "sntc_ctl_windows_total": dict(
+        type=COUNTER, labels=(),
+        help="SLO-controller observation windows closed.",
+    ),
+    "sntc_ctl_decisions_total": dict(
+        type=COUNTER, labels=("action", "knob", "tenant"),
+        help="SLO-controller decisions (applied / budget_denied / "
+        "frozen / delegated / escalated), by knob and tenant.",
+    ),
+    "sntc_ctl_knob_value": dict(
+        type=GAUGE, labels=("knob", "tenant"),
+        help="Current value of each controller-steered serving knob "
+        "(pipeline_depth / shape_buckets / weight / quota / shed / "
+        "escalate; ladder knobs report their ladder index).",
+    ),
+    "sntc_ctl_slo_compliant": dict(
+        type=GAUGE, labels=("slo", "tenant"),
+        help="Per-window SLO compliance verdict (1 = compliant, 0 = "
+        "violating) for each declared SLO axis (p99 / throughput / "
+        "shed).",
+    ),
+    "sntc_ctl_window_p99_seconds": dict(
+        type=GAUGE, labels=("tenant",),
+        help="Windowed p99 batch latency the controller computed from "
+        "the sntc_batch_duration_seconds bucket deltas.",
+    ),
     # -- the tracer's own accounting -----------------------------------------
     "sntc_spans_dropped_total": dict(
         type=COUNTER, labels=(),
@@ -405,6 +432,30 @@ class MetricsRegistry:
         key = tuple(sorted((k, str(v)) for k, v in labels.items()))
         s = entry[1].get(key)
         return s.value if s is not None else None
+
+    def get_histogram(self, name: str, **labels: str) -> Optional[dict]:
+        """Live view of one histogram series (None when the series
+        does not exist yet): bucket bounds, per-bucket counts, sum,
+        count.  Lock-free like :meth:`get` — a read racing an observe
+        may be one tick stale on one bucket, never torn across the
+        registry.  The SLO controller diffs two of these to get a
+        WINDOWED latency distribution."""
+        entry = self._metrics.get(name)
+        if entry is None:
+            return None
+        spec = entry[0]
+        if spec["type"] != HISTOGRAM:
+            raise KeyError(f"{name!r} is not a cataloged histogram")
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        s = entry[1].get(key)
+        if s is None:
+            return None
+        return {
+            "bounds": list(spec["buckets"]),
+            "buckets": list(s.bucket_counts),
+            "sum": s.sum,
+            "count": s.count,
+        }
 
     def label_overflows(self) -> int:
         """WRITES that landed on an overflow series (not distinct

@@ -27,6 +27,11 @@ from sntc_tpu.resilience.faults import (
     fault_point,
     parse_faults_env,
 )
+from sntc_tpu.resilience.control import (
+    ControlPolicy,
+    Guardrails,
+    TuningBudget,
+)
 from sntc_tpu.resilience.health import HealthMonitor, HealthState
 from sntc_tpu.resilience.policy import (
     RetryExhausted,
@@ -73,6 +78,9 @@ __all__ = [
     "breaker_for",
     "breakers_snapshot",
     "reset_breakers",
+    "ControlPolicy",
+    "Guardrails",
+    "TuningBudget",
     "HealthMonitor",
     "HealthState",
     "QuerySupervisor",

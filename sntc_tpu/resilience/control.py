@@ -1,0 +1,271 @@
+"""Shared closed-loop control guardrails — the hysteresis substrate
+under every knob-steering controller in the codebase.
+
+PR 10 proved the control-loop idiom on the ingest graph: feedback
+signal → hysteresis-guarded single-knob step → journaled decision →
+provable no-oscillation bound.  PR 13 generalizes it to the whole
+serve plane, so the guardrail machinery moves HERE — one
+implementation under both the :class:`~sntc_tpu.data.autotune
+.IngestAutotuner` (which keeps its exact pre-extraction behavior;
+its property tests pass unchanged) and the
+:class:`~sntc_tpu.serve.controller.ServeController`.
+
+:class:`Guardrails` is the state machine:
+
+* **confirm streak** — a proposal must repeat ``confirm`` consecutive
+  observation windows before it applies; any different proposal (or
+  no proposal) resets the streak.
+* **cooldown** — every applied (or budget-denied) decision freezes the
+  controller for ``cooldown`` windows.
+* **reversal freeze** — a knob that reverses direction more than
+  ``max_reversals`` times is FROZEN for the controller's lifetime.
+  Total knob changes are therefore bounded by
+  ``Σ_knobs (max_reversals + 1) × (hi − lo) / step`` regardless of the
+  input signal — THE no-oscillation bound, property-tested over the
+  union of serving + ingest knobs in ``tests/test_controller.py`` and
+  over the ingest knobs alone in ``tests/test_ingest_pipeline.py``.
+* **bounded journal** — every applied/denied/frozen decision is kept
+  in memory (oldest evicted past ``journal_keep``; ``decisions_total``
+  preserved) and handed to ``on_journal`` so owners can mirror it to
+  events, metrics, and durable journals.
+
+:class:`TuningBudget` is the multi-controller arbiter: one budget
+shared by every tenant's controller caps the total EXTRA capacity
+(pool threads, staged ranges, pipeline slots, ...) the fleet may grow
+beyond its cold defaults.  The budget charges only capacity ABOVE each
+knob's cold-start baseline: shrinking below the baseline refunds
+nothing, and regrowing back to it is free — an idle fleet can always
+recover its defaults on an exhausted budget.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+@dataclass
+class ControlPolicy:
+    """The guardrail constants every controller shares.  Deliberately
+    conservative defaults — two confirming windows, two cooldown
+    windows, two reversals — so a production plane changes a knob at
+    most a handful of times, then sits still."""
+
+    confirm: int = 2          # consecutive agreeing windows to apply
+    cooldown: int = 2         # windows frozen after an apply
+    max_reversals: int = 2    # direction flips per knob before freezing
+
+
+class TuningBudget:
+    """Shared cap on the EXTRA capacity controllers may grow beyond
+    their cold defaults, per knob kind.  ``try_acquire`` charges one
+    increase (False = budget exhausted, the decision is journaled as
+    denied and not applied); ``release`` refunds a decrease.  All
+    methods are thread-safe — tenants tick on one daemon thread today,
+    but the budget must not care.
+
+    Kinds are open-ended: any keyword cap names a kind (``None`` =
+    uncapped); kinds never declared are uncapped but still tracked.
+    """
+
+    def __init__(self, **caps: Optional[int]):
+        self._caps: Dict[str, Optional[int]] = dict(caps)
+        self._used: Dict[str, int] = {k: 0 for k in self._caps}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def default_for(cls, n_tenants: int) -> "TuningBudget":
+        """The serve-daemon default: the whole fleet may grow at most
+        one host's worth of parse threads, two staged ranges per
+        tenant, and one extra pipeline slot per tenant."""
+        import os
+
+        return cls(
+            read_workers=max(4, (os.cpu_count() or 4)),
+            prefetch_batches=max(4, 2 * n_tenants),
+            pipeline_depth=max(2, n_tenants),
+        )
+
+    def try_acquire(self, knob: str, n: int = 1) -> bool:
+        with self._lock:
+            cap = self._caps.get(knob)
+            if cap is not None and self._used.get(knob, 0) + n > cap:
+                return False
+            self._used[knob] = self._used.get(knob, 0) + n
+            return True
+
+    def release(self, knob: str, n: int = 1) -> None:
+        with self._lock:
+            self._used[knob] = max(0, self._used.get(knob, 0) - n)
+
+    def snapshot(self) -> Dict[str, Dict[str, Optional[int]]]:
+        with self._lock:
+            keys = set(self._caps) | set(self._used)
+            return {
+                k: {"cap": self._caps.get(k),
+                    "used": self._used.get(k, 0)}
+                for k in sorted(keys)
+            }
+
+
+class Guardrails:
+    """The hysteresis state machine (module docstring).  Owners call
+    :meth:`observe` once per observation window with a pure
+    ``propose`` callable; the guardrails decide whether this window's
+    proposal survives confirm/cooldown/freeze/budget and, when it
+    does, apply it through the knob's live setter and journal it.
+
+    ``policy`` may be any object with ``confirm`` / ``cooldown`` /
+    ``max_reversals`` attributes (:class:`ControlPolicy`, or the
+    autotuner's richer ``AutotunePolicy``).  ``budget_kind`` maps a
+    knob name to its budget kind (identity by default — the serve
+    controller strips its ``tenant/<id>/`` namespacing here so ten
+    tenants' ``quota`` knobs draw one budget line)."""
+
+    def __init__(
+        self,
+        policy=None,
+        budget: Optional[TuningBudget] = None,
+        *,
+        journal_keep: int = 256,
+        budget_kind: Optional[Callable[[str], str]] = None,
+        on_journal: Optional[Callable[[dict], None]] = None,
+    ):
+        self.policy = policy or ControlPolicy()
+        self.budget = budget
+        self.budget_kind = budget_kind or (lambda name: name)
+        self.on_journal = on_journal
+        #: applied/denied/frozen journal, oldest evicted past the cap
+        #: (a budget-starved controller re-denies every few windows
+        #: forever; the in-memory journal must not grow with uptime —
+        #: the event stream + metrics carry the full history)
+        self.decisions: List[dict] = []
+        self.decisions_total = 0
+        self._journal_keep = int(journal_keep)
+        self._baseline: Dict[str, int] = {}  # knob cold-start values
+        self._budget_held: Dict[str, int] = {}  # EXTRA units charged
+        self.windows = 0
+        self._pending: Optional[Tuple[str, int]] = None
+        self._streak = 0
+        self._cooldown = 0
+        self._last_dir: Dict[str, int] = {}
+        self._reversals: Dict[str, int] = {}
+        self.frozen: set = set()
+
+    def usable(self, knobs: Dict, name: str, direction: int) -> bool:
+        """Can ``name`` move one step in ``direction``?  (Bounds +
+        freeze; the shared precondition every propose() checks.)"""
+        k = knobs.get(name)
+        if k is None or name in self.frozen:
+            return False
+        cur = k.get()
+        return cur < k.hi if direction > 0 else cur > k.lo
+
+    def observe(
+        self,
+        propose: Callable[[], Optional[Tuple[str, int]]],
+        knobs: Dict,
+        signal_fields,
+        on_applied: Optional[Callable[[str, int, int], None]] = None,
+    ) -> Optional[dict]:
+        """One observation window: hysteresis + budget + apply.
+        ``signal_fields`` is the journal's ``signal`` payload — a dict,
+        or a zero-arg callable evaluated only when a record is actually
+        journaled.  Returns the journaled record when a knob moved (or
+        froze, or was denied), None otherwise."""
+        self.windows += 1
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+        prop = propose()
+        if prop != self._pending:
+            self._pending = prop
+            self._streak = 1 if prop is not None else 0
+            return None
+        if prop is None:
+            return None
+        self._streak += 1
+        if self._streak < self.policy.confirm:
+            return None
+        name, direction = prop
+        self._pending, self._streak = None, 0
+        knob = knobs[name]
+        last = self._last_dir.get(name)
+        if last is not None and last != direction:
+            self._reversals[name] = self._reversals.get(name, 0) + 1
+            if self._reversals[name] > self.policy.max_reversals:
+                self.frozen.add(name)
+                return self._journal(
+                    name, direction, knob.get(), knob.get(),
+                    action="frozen", signal_fields=signal_fields,
+                )
+        cur = knob.get()
+        new = knob.clamp(cur + direction * knob.step)
+        if new == cur:
+            return None
+        if self.budget is not None:
+            # budget charges only the EXTRA capacity above this knob's
+            # COLD-START value (captured at first contact): shrinking
+            # below the baseline refunds nothing (nothing was charged),
+            # and regrowing back to it costs nothing — so an idle fleet
+            # that dipped under its defaults can always recover them
+            kind = self.budget_kind(name)
+            baseline = self._baseline.setdefault(name, cur)
+            held = self._budget_held.get(name, 0)
+            want = max(0, new - baseline)
+            if want > held:
+                if not self.budget.try_acquire(kind, want - held):
+                    self._cooldown = self.policy.cooldown
+                    return self._journal(
+                        name, direction, cur, cur,
+                        action="budget_denied",
+                        signal_fields=signal_fields,
+                    )
+            elif want < held:
+                self.budget.release(kind, held - want)
+            self._budget_held[name] = want
+        knob.set(new)
+        self._last_dir[name] = direction
+        self._cooldown = self.policy.cooldown
+        if on_applied is not None:
+            on_applied(name, direction, new)
+        return self._journal(
+            name, direction, cur, new, action="applied",
+            signal_fields=signal_fields,
+        )
+
+    def _journal(self, name, direction, old, new, *, action,
+                 signal_fields) -> dict:
+        rec = {
+            "action": action,
+            "knob": name,
+            "direction": "up" if direction > 0 else "down",
+            "from": old,
+            "to": new,
+            "window": self.windows,
+            "signal": (
+                signal_fields() if callable(signal_fields)
+                else signal_fields
+            ),
+        }
+        self.decisions.append(rec)
+        self.decisions_total += 1
+        if len(self.decisions) > self._journal_keep:
+            del self.decisions[0]
+        if self.on_journal is not None:
+            self.on_journal(rec)
+        return rec
+
+    def applied(self) -> List[dict]:
+        return [d for d in self.decisions if d["action"] == "applied"]
+
+    @staticmethod
+    def change_bound(knobs: Dict, max_reversals: int) -> int:
+        """The analytic no-oscillation bound over ``knobs``:
+        ``Σ (max_reversals + 1) × (hi − lo) / step`` applied changes,
+        regardless of the input signal."""
+        return sum(
+            (max_reversals + 1) * (k.hi - k.lo) // max(1, k.step)
+            for k in knobs.values()
+        )

@@ -32,6 +32,9 @@ Wired sites:
                         completed windows leave the keyed state
 ``flow.state_snapshot`` ``flow.FlowStateStore`` before a state snapshot
                         reaches disk
+``ctl.apply``           ``serve.ServeController`` inside every live knob
+                        setter, after the decision cleared the guardrails
+                        and before the knob actually moves
 ======================  =====================================================
 
 Env grammar (comma-separated specs)::
@@ -123,6 +126,7 @@ SITES = (
     "flow.emit",
     "flow.evict",
     "flow.state_snapshot",
+    "ctl.apply",
 )
 
 

@@ -85,6 +85,8 @@ class QuerySupervisor:
         health: Optional[HealthMonitor] = None,
         health_json: Optional[str] = None,
         clock=time.monotonic,
+        slo=None,
+        controller_policy=None,
     ):
         if max_pending_batches is not None and max_pending_batches < 1:
             raise ValueError("max_pending_batches must be >= 1 (or None)")
@@ -110,6 +112,19 @@ class QuerySupervisor:
         self.shed_total_offsets = 0
         self.batches_done = 0
         self.drained = False
+        # closed-loop SLO control (r16): a declared SloPolicy arms a
+        # ServeController over this one engine — it steers
+        # pipeline_depth / shape_buckets / the shed knob and owns the
+        # ingest tuner, journaling to <checkpoint>/controller.jsonl.
+        # Imported lazily: the controller lives in the serve package,
+        # which imports this module at its own load time.
+        self.controller = None
+        if slo is not None:
+            from sntc_tpu.serve.controller import ServeController
+
+            self.controller = ServeController.for_supervisor(
+                self, slo, policy=controller_policy, clock=clock,
+            )
 
     def close(self) -> None:
         """Supervisor teardown: detach the health monitor from the
@@ -212,6 +227,12 @@ class QuerySupervisor:
                         self.health.report(
                             site, HealthState.OK, reason="batch committed"
                         )
+        if self.controller is not None:
+            # degrade-never-kill, the lifecycle/autotune-tick contract
+            try:
+                self.controller.on_tick()
+            except Exception as e:
+                emit_event(event="controller_error", error=repr(e))
         if self.health_json:
             self.write_health_json(latest=latest)
         return delta
@@ -289,6 +310,12 @@ class QuerySupervisor:
             "batches_committed_at_drain": committed,
             "in_flight_left": self.query.in_flight_count(),
             "pid": os.getpid(),
+            # final controller-steered knob state: a restart (cold
+            # defaults) reads this to log the delta
+            "controller_knobs": (
+                self.controller.knob_values()
+                if self.controller is not None else None
+            ),
         }
         _atomic_json(
             os.path.join(self.query.checkpoint_dir, DRAIN_MARKER), marker
@@ -329,6 +356,11 @@ class QuerySupervisor:
             "drain_requested": self.drain_requested,
             "drained": self.drained,
         }
+        # closed-loop SLO control evidence (r16): declared setpoints,
+        # per-axis compliance, and the controller's knob/decision state
+        if self.controller is not None:
+            out["slo"] = self.controller.slo_status()
+            out["controller"] = self.controller.stats()
         # model-lifecycle evidence (drift / promotion / swap state)
         # rides the same dump when the engine has a lifecycle armed
         lc = getattr(q, "lifecycle", None)

@@ -13,6 +13,11 @@ from sntc_tpu.serve.streaming import (
     MemorySource,
     StreamingQuery,
 )
+from sntc_tpu.serve.controller import (
+    ServeController,
+    SloPolicy,
+    SloSignal,
+)
 from sntc_tpu.serve.tenancy import (
     ServeDaemon,
     TenantSpec,
@@ -20,6 +25,9 @@ from sntc_tpu.serve.tenancy import (
 )
 
 __all__ = [
+    "ServeController",
+    "SloPolicy",
+    "SloSignal",
     "BatchPredictor",
     "compile_pipeline",
     "compile_serving",

@@ -1,0 +1,976 @@
+"""Closed-loop SLO controller for the serve plane (r16).
+
+PR 8 made every serving knob observable and PR 10 proved the
+control-loop idiom (feedback signal → hysteresis-guarded single-knob
+step → journaled decision → provable no-oscillation bound) on the
+ingest graph.  :class:`ServeController` closes the remaining loop: the
+serving plane's own knobs — pipeline depth, shape-bucket floors, DRR
+weights, rate quotas, shed policies — stop being frozen CLI-flag
+values and steer themselves toward the per-tenant SLOs declared on
+:class:`~sntc_tpu.serve.tenancy.TenantSpec` (``slo_p99_ms``,
+``slo_min_rows_per_sec``, ``slo_max_shed_rate``).
+
+**The loop.**  Ticked at daemon-tick cadence, the controller closes an
+observation window every ``interval_ticks`` ticks.  Per window it
+diffs the :class:`~sntc_tpu.obs.metrics.MetricsRegistry` — per-tenant
+committed batches/rows, the ``sntc_batch_duration_seconds`` histogram
+buckets (→ windowed p50/p99 via :func:`window_percentile`), shed
+offsets, ladder strikes — plus the engine-local backlog, compile
+ledger, and breaker states, into one :class:`SloSignal` per tenant;
+diagnoses the binding constraint; and moves EXACTLY ONE knob one step
+through the shared :class:`~sntc_tpu.resilience.control.Guardrails`
+(confirm-streak, post-apply cooldown, per-knob direction-reversal
+freeze), so the analytic no-oscillation bound
+``Σ_knobs (max_reversals + 1) × (hi − lo)`` holds over the union of
+serving + ingest knobs.
+
+**The priority ladder.**  SLO-compliant tenants are protected first:
+their knobs are never touched on a neighbor's behalf.  A violator that
+is *flooding* (shed-rate violation, or fresh ladder strikes) is
+degraded — never its neighbors — down an explicit ladder: tighten its
+rate ``quota`` → tighten its ``shed`` cap/policy → ``escalate`` (a
+journaled ladder strike; the existing OK → THROTTLED → QUARANTINED →
+STOPPED machinery owns what happens next).  A violator that is merely
+under-served gets local remedies: latency violations lower its
+``pipeline_depth`` (queue wait is latency) or raise its
+``shape_buckets`` floor (compile churn is latency); throughput
+violations delegate to the PR-10 :class:`~sntc_tpu.data.autotune
+.IngestAutotuner` the controller OWNS for its ingest knobs, then
+deepen the pipeline, then — only while every other tenant is
+compliant — raise its DRR ``weight``.  With no violations the
+controller relaxes one previously-degraded knob per window back
+toward its cold default, under the same guardrails.
+
+**Evidence.**  Every applied / budget-denied / frozen / delegated /
+escalated decision is journaled to ``controller.jsonl`` (one JSON line
+per decision, carrying the triggering signal and the post-decision
+knob map), emitted as a ``controller_decision`` event, and mirrored to
+the cataloged ``sntc_ctl_*`` metrics.  On construction over an
+existing journal the controller writes a ``restart`` record logging
+the journal's final knob state against the fresh process's cold
+defaults — knobs are process-local, so a crash resets them and the
+restart record is the reconciliation (the per-tenant drain markers
+record the same final knob state on the graceful path).  Controller
+failures degrade (``controller_error`` event), never kill the serving
+loop — exactly the lifecycle/autotune tick contract.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from sntc_tpu.data.pipeline import Knob
+from sntc_tpu.obs.metrics import inc, registry, set_gauge
+from sntc_tpu.resilience import emit_event, fault_point
+from sntc_tpu.resilience.control import (
+    ControlPolicy,
+    Guardrails,
+    TuningBudget,
+)
+
+#: the controller's serving-knob action space (docs/RESILIENCE.md
+#: keeps a marker-delimited table; scripts/check_controller_flags.py
+#: pins CLI ⇔ TenantSpec ⇔ knob names ⇔ docs in tier-1).  weight /
+#: quota / shed / escalate exist only on daemon (multi-tenant)
+#: targets; shape_buckets only on single-stream targets (the daemon's
+#: predictors are SHARED across tenants, so no one tenant may steer
+#: their bucket floor).
+SERVE_KNOB_NAMES = (
+    "pipeline_depth",
+    "shape_buckets",
+    "weight",
+    "quota",
+    "shed",
+    "escalate",
+)
+
+#: the TenantSpec SLO fields the controller reads as setpoints
+SLO_FIELDS = ("slo_p99_ms", "slo_min_rows_per_sec", "slo_max_shed_rate")
+
+#: shape-bucket floor ladder (single-stream): the knob value is the
+#: ladder INDEX; raising it trades padding for fewer distinct compiled
+#: shapes when the window saw compile churn
+SHAPE_BUCKET_FLOORS = (0, 64, 128, 256, 512)
+
+#: quota ladder (daemon): index 0 = the spec's declared quota (or
+#: unlimited); index i > 0 throttles to ``base × factor`` where base
+#: is the max of the declared quota and the observed rows/s at first
+#: throttle — deterministic once captured, journaled with the decision
+QUOTA_FACTORS = (None, 0.5, 0.25, 0.125)
+
+#: shed ladder (daemon): index 0 = the spec's declared cap/policy;
+#: tightening lowers the backlog cap and finally switches to the
+#: sample policy (coverage at reduced resolution)
+SHED_LADDER = (None, (8, "oldest"), (4, "oldest"), (2, "sample"))
+
+#: default serving-knob bounds (ladder knobs are bounded by their
+#: ladder length; these bound the plain integer knobs)
+SERVE_KNOB_BOUNDS = {
+    "pipeline_depth": (1, 4),
+    "weight": (1, 8),
+}
+
+
+@dataclass
+class SloPolicy:
+    """A declared SLO triple (the single-stream analog of the
+    TenantSpec fields; 0 normalizes to None exactly like the spec)."""
+
+    slo_p99_ms: Optional[float] = None
+    slo_min_rows_per_sec: Optional[float] = None
+    slo_max_shed_rate: Optional[float] = None
+
+    def __post_init__(self):
+        for f in SLO_FIELDS:
+            v = getattr(self, f)
+            if v == 0:
+                setattr(self, f, None)
+            elif v is not None and v < 0:
+                raise ValueError(f"{f} must be >= 0 (0/None = unset)")
+        if (
+            self.slo_max_shed_rate is not None
+            and self.slo_max_shed_rate > 1.0
+        ):
+            # same contract as TenantSpec: a shed-rate "bound" over
+            # 1.0 can never be violated — a typo, and it must be loud
+            raise ValueError("slo_max_shed_rate is a fraction in (0, 1]")
+
+    @classmethod
+    def from_spec(cls, spec) -> "SloPolicy":
+        return cls(**{f: getattr(spec, f, None) for f in SLO_FIELDS})
+
+    def declared(self) -> bool:
+        return any(getattr(self, f) is not None for f in SLO_FIELDS)
+
+    def as_dict(self) -> Dict[str, Optional[float]]:
+        return {f: getattr(self, f) for f in SLO_FIELDS}
+
+
+@dataclass
+class SloSignal:
+    """One tenant's observation window, condensed from the registry
+    deltas + engine-local state.  Pure data so tests drive
+    :meth:`ServeController.step` synthetically."""
+
+    batches: int = 0
+    rows: int = 0
+    rows_per_s: float = 0.0
+    p50_ms: Optional[float] = None
+    p99_ms: Optional[float] = None
+    shed_offsets: int = 0
+    shed_rate: float = 0.0
+    strikes: int = 0
+    backlog: int = 0
+    compile_events: int = 0
+    breaker_open: bool = False
+    elapsed_s: float = 0.0
+
+    def as_fields(self) -> Dict[str, Any]:
+        return {
+            "batches": self.batches,
+            "rows": self.rows,
+            "rows_per_s": round(self.rows_per_s, 1),
+            "p50_ms": self.p50_ms,
+            "p99_ms": self.p99_ms,
+            "shed_offsets": self.shed_offsets,
+            "shed_rate": round(self.shed_rate, 3),
+            "strikes": self.strikes,
+            "backlog": self.backlog,
+            "compile_events": self.compile_events,
+            "breaker_open": self.breaker_open,
+        }
+
+
+def window_percentile(bounds, counts, q: float) -> Optional[float]:
+    """The q-th percentile of a WINDOWED histogram (bucket-count
+    deltas), by the upper-bound rule: the smallest bucket bound whose
+    cumulative count reaches ``ceil(q/100 × total)``.  Deterministic
+    and hand-computable — the oracle tests pin it.  Returns None on an
+    empty window and ``inf`` when the rank lands in the +Inf overflow
+    bucket (callers substitute the window mean)."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = math.ceil(q / 100.0 * total)
+    cum = 0
+    for bound, n in zip(bounds, counts):
+        cum += n
+        if cum >= rank:
+            return float(bound)
+    return float("inf")
+
+
+class _Target:
+    """One controlled stream: a tenant on the daemon, or the single
+    supervised engine.  Holds the knob objects, the previous registry
+    sample, and the per-window verdicts."""
+
+    def __init__(self, key, engine, slo, stream=None, supervisor=None):
+        self.key = key  # tenant id; None = the single-stream engine
+        self.engine = engine
+        self.slo = slo
+        self.stream = stream  # TenantStream (daemon mode)
+        self.supervisor = supervisor  # QuerySupervisor (single-stream)
+        self.tuner = None  # controller-owned IngestAutotuner
+        self.knobs: Dict[str, Knob] = {}
+        self.prev: Optional[dict] = None
+        self.prev_ts: Optional[float] = None
+        self.prev_compiles: Optional[int] = None
+        self.last_signal: Optional[SloSignal] = None
+        self.compliance: Dict[str, bool] = {}
+        self.hold: Dict[str, Tuple[int, float]] = {}  # sticky violations
+        self.quota_base: Optional[float] = None
+        self.idle_delegations = 0  # consecutive no-op tuner windows
+
+    def controllable(self) -> bool:
+        if self.stream is None:
+            return True
+        return self.stream.state not in ("QUARANTINED", "STOPPED")
+
+
+class ServeController:
+    """The closed loop (module docstring).  Construct via
+    :meth:`for_daemon` / :meth:`for_supervisor`; the owner calls
+    :meth:`on_tick` once per scheduling round and treats any exception
+    as degradation, never death.  Tests drive :meth:`step` directly
+    with synthetic :class:`SloSignal` maps."""
+
+    def __init__(
+        self,
+        *,
+        policy: Optional[ControlPolicy] = None,
+        journal_path: Optional[str] = None,
+        clock=time.monotonic,
+        wall=time.time,
+        interval_ticks: int = 1,
+        budget: Optional[TuningBudget] = None,
+        ingest: bool = True,
+        knob_bounds: Optional[dict] = None,
+        violation_hold: int = 3,
+    ):
+        self.policy = policy or ControlPolicy()
+        self.journal_path = journal_path
+        self.interval_ticks = max(1, int(interval_ticks))
+        self.ingest = bool(ingest)
+        self.budget = budget
+        self.knob_bounds = dict(SERVE_KNOB_BOUNDS, **(knob_bounds or {}))
+        # one-shot evidence (a single shed burst, a strike volley)
+        # lands in ONE window but the confirm streak needs several: a
+        # fresh violation stays live for this many further windows so
+        # bursty evidence can clear the guardrails.  Compliance gauges
+        # and status always report the INSTANTANEOUS verdict.
+        self.violation_hold = max(0, int(violation_hold))
+        self._clock = clock
+        self._wall = wall
+        self._daemon = None
+        self.targets: List[_Target] = []
+        self._knobs: Dict[str, Knob] = {}  # full name -> Knob
+        self._defaults: Dict[str, int] = {}  # full name -> cold value
+        self._ticks = 0
+        self.delegated_total = 0
+        self.escalations_total = 0
+        self.guard = Guardrails(
+            policy=self.policy,
+            budget=budget,
+            budget_kind=lambda name: name.rsplit("/", 1)[-1],
+            on_journal=self._on_journal,
+        )
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def for_daemon(cls, daemon, **kwargs) -> "ServeController":
+        """Attach to every tenant of a ``ServeDaemon`` (SLOs from the
+        TenantSpec fields).  The journal defaults to
+        ``<root>/controller.jsonl``."""
+        kwargs.setdefault(
+            "journal_path",
+            os.path.join(daemon.root_dir, "controller.jsonl"),
+        )
+        kwargs.setdefault("clock", daemon._clock)
+        kwargs.setdefault("budget", daemon.tuning_budget)
+        ctl = cls(**kwargs)
+        ctl._daemon = daemon
+        for t in daemon.tenants:
+            ctl._attach(_Target(
+                t.spec.tenant_id, t.query,
+                SloPolicy.from_spec(t.spec), stream=t,
+            ))
+        ctl._reconcile_journal()
+        return ctl
+
+    @classmethod
+    def for_supervisor(cls, supervisor, slo: SloPolicy,
+                       **kwargs) -> "ServeController":
+        """Attach to the one engine a ``QuerySupervisor`` owns.  The
+        journal defaults to ``<checkpoint>/controller.jsonl``."""
+        kwargs.setdefault(
+            "journal_path",
+            os.path.join(
+                supervisor.query.checkpoint_dir, "controller.jsonl"
+            ),
+        )
+        kwargs.setdefault("clock", supervisor._clock)
+        ctl = cls(**kwargs)
+        ctl._attach(_Target(
+            None, supervisor.query, slo, supervisor=supervisor,
+        ))
+        ctl._reconcile_journal()
+        return ctl
+
+    def _full(self, t: _Target, base: str) -> str:
+        return base if t.key is None else f"{t.key}/{base}"
+
+    def _split(self, name: str) -> Tuple[Optional[str], str]:
+        if "/" in name:
+            tid, base = name.rsplit("/", 1)
+            return tid, base
+        return None, name
+
+    def _fault_wrap(self, setter, tenant):
+        """Every live knob setter passes the ``ctl.apply`` fault point
+        first — the kill-mid-knob-apply chaos boundary.  The journal
+        record lands only AFTER the setter returns, so a kill here
+        leaves the journal reflecting exactly the fully-applied
+        decisions (the restart record reconciles the rest)."""
+
+        def _set(v):
+            fault_point("ctl.apply", tenant=tenant)
+            setter(v)
+
+        return _set
+
+    def _shed_knob(self, holder, wrap) -> Knob:
+        """The shed-ladder knob over any holder exposing
+        ``max_pending_batches``/``shed_policy`` (the supervisor on a
+        single stream, the TenantSpec on the daemon): index 0 restores
+        the declared cap/policy; tightening applies the ladder rung,
+        never loosening past an already-declared cap."""
+        orig = (holder.max_pending_batches, holder.shed_policy)
+        box = {"i": 0}
+
+        def _set_shed(i, _b=box, _h=holder, _o=orig):
+            _b["i"] = int(i)
+            if _b["i"] == 0:
+                _h.max_pending_batches, _h.shed_policy = _o
+                return
+            cap, pol = SHED_LADDER[_b["i"]]
+            if _o[0] is not None:
+                cap = min(cap, _o[0])
+            _h.max_pending_batches, _h.shed_policy = cap, pol
+
+        return Knob(
+            "shed", lambda _b=box: _b["i"], wrap(_set_shed),
+            0, len(SHED_LADDER) - 1,
+        )
+
+    def _attach(self, t: _Target) -> None:
+        self.targets.append(t)
+        eng = t.engine
+        wrap = lambda fn: self._fault_wrap(fn, t.key)  # noqa: E731
+        kn: Dict[str, Knob] = {}
+
+        lo, hi = self.knob_bounds["pipeline_depth"]
+
+        def _set_depth(n, _e=eng):
+            _e.pipeline_depth = max(1, int(n))
+
+        kn["pipeline_depth"] = Knob(
+            "pipeline_depth", lambda _e=eng: _e.pipeline_depth,
+            wrap(_set_depth), lo, hi,
+        )
+
+        if t.stream is None:
+            # single-stream: the predictor is this engine's alone, so
+            # its bucket floor is steerable (ladder-index knob)
+            pred = eng.predictor
+            ladder = tuple(sorted(
+                set(SHAPE_BUCKET_FLOORS) | {int(pred.bucket_rows)}
+            ))
+            box = {"i": ladder.index(int(pred.bucket_rows))}
+
+            def _set_buckets(i, _b=box, _l=ladder, _p=pred, _e=eng):
+                _b["i"] = int(i)
+                _p.bucket_rows = _l[_b["i"]]
+                _e.shape_buckets = _l[_b["i"]]
+
+            kn["shape_buckets"] = Knob(
+                "shape_buckets", lambda _b=box: _b["i"],
+                wrap(_set_buckets), 0, len(ladder) - 1,
+            )
+            if t.supervisor is not None:
+                kn["shed"] = self._shed_knob(t.supervisor, wrap)
+        else:
+            spec = t.stream.spec
+            wlo, whi = self.knob_bounds["weight"]
+
+            def _set_weight(n, _s=spec):
+                _s.weight = float(max(1, int(n)))
+
+            kn["weight"] = Knob(
+                "weight", lambda _s=spec: int(round(_s.weight)),
+                wrap(_set_weight), wlo, whi,
+            )
+
+            qbox = {"i": 0}
+            qorig = spec.max_rows_per_sec
+
+            def _set_quota(i, _b=qbox, _t=t, _orig=qorig):
+                _b["i"] = int(i)
+                if _b["i"] == 0:
+                    _t.stream.set_rate_quota(_orig)
+                    return
+                if _t.quota_base is None:
+                    observed = (
+                        _t.last_signal.rows_per_s
+                        if _t.last_signal is not None else 0.0
+                    )
+                    _t.quota_base = max(_orig or 0.0, observed, 1.0)
+                _t.stream.set_rate_quota(
+                    _t.quota_base * QUOTA_FACTORS[_b["i"]]
+                )
+
+            kn["quota"] = Knob(
+                "quota", lambda _b=qbox: _b["i"], wrap(_set_quota),
+                0, len(QUOTA_FACTORS) - 1,
+            )
+
+            kn["shed"] = self._shed_knob(spec, wrap)
+
+            ebox = {"n": 0}
+
+            def _escalate(n, _b=ebox, _t=t, _c=self):
+                n = int(n)
+                while _b["n"] < n:
+                    _b["n"] += 1
+                    _c.escalations_total += 1
+                    if _c._daemon is not None:
+                        _c._daemon.strike_tenant(
+                            _t.key,
+                            "controller escalation: degradation "
+                            "ladder exhausted throttle and shed",
+                        )
+
+            kn["escalate"] = Knob(
+                "escalate", lambda _b=ebox: _b["n"], wrap(_escalate),
+                0, max(1, spec.quarantine_after),
+            )
+
+        if self.ingest:
+            from sntc_tpu.data.autotune import (
+                AutotunePolicy,
+                IngestAutotuner,
+            )
+
+            # the controller owns the ingest loop: one tuner per
+            # target, ticked at most once per window when the
+            # diagnosis is throughput-bound, with pipeline_depth
+            # excluded (one owner per knob — the controller keeps it)
+            t.tuner = IngestAutotuner(
+                policy=AutotunePolicy(
+                    interval_ticks=1,
+                    confirm=self.policy.confirm,
+                    cooldown=self.policy.cooldown,
+                    max_reversals=self.policy.max_reversals,
+                ),
+                budget=self.budget,
+                tenant=t.key,
+                exclude_knobs=("pipeline_depth",),
+            )
+
+        t.knobs = kn
+        for base, knob in kn.items():
+            full = self._full(t, base)
+            self._knobs[full] = knob
+            self._defaults[full] = knob.get()
+        # prime the window baseline NOW: the first scheduling round's
+        # evidence (a shed burst on the opening backlog, the first
+        # strikes) must land in window 1's DELTA, not vanish into a
+        # cold first sample
+        t.prev = self._sample(t)
+        t.prev_ts = self._clock()
+        t.prev_compiles = t.engine.predictor.compile_events
+
+    # -- journal ------------------------------------------------------------
+
+    def knob_values(self) -> Dict[str, int]:
+        return {name: k.get() for name, k in sorted(self._knobs.items())}
+
+    def knob_values_for(self, key) -> Dict[str, int]:
+        """One target's live knob map, base-named (the drain-marker /
+        health-dump surface)."""
+        for t in self.targets:
+            if t.key == key:
+                return {b: k.get() for b, k in sorted(t.knobs.items())}
+        return {}
+
+    def _append_journal(self, rec: dict) -> None:
+        if self.journal_path is None:
+            return
+        d = os.path.dirname(self.journal_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # one write call per record: a kill can lose the tail line,
+        # never tear one (the restart reconciliation reads the tail)
+        with open(self.journal_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def _reconcile_journal(self) -> None:
+        """On construction over an existing journal: log the delta
+        between the journal's final knob state and this process's cold
+        defaults (knobs are process-local; a crash resets them)."""
+        path = self.journal_path
+        if not path or not os.path.exists(path):
+            return
+        last, torn = None, 0
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    torn += 1
+                    continue
+                if rec.get("knobs"):
+                    last = rec
+        live = self.knob_values()
+        journal_knobs = last.get("knobs") if last else None
+        rec = {
+            "action": "restart",
+            "ts": self._wall(),
+            "journal_knobs": journal_knobs,
+            "live_knobs": live,
+            "delta": (
+                {
+                    k: {"journal": journal_knobs.get(k), "live": v}
+                    for k, v in live.items()
+                    if journal_knobs.get(k) != v
+                }
+                if journal_knobs else None
+            ),
+            "torn_lines": torn,
+        }
+        self._append_journal(rec)
+        emit_event(
+            event="controller_restart",
+            knobs_changed=len(rec["delta"] or {}),
+            torn_lines=torn,
+        )
+
+    def _on_journal(self, rec: dict) -> None:
+        """Guardrails journal callback: mirror every decision to the
+        metrics plane, the event stream, and the durable journal."""
+        tid, base = self._split(rec["knob"])
+        labels = {} if tid is None else {"tenant": tid}
+        inc(
+            "sntc_ctl_decisions_total",
+            action=rec["action"], knob=base, **labels,
+        )
+        if rec["action"] == "applied":
+            set_gauge("sntc_ctl_knob_value", rec["to"], knob=base,
+                      **labels)
+        fields = dict(
+            event="controller_decision", action=rec["action"],
+            knob=base, direction=rec["direction"], value=rec["to"],
+        )
+        if tid is not None:
+            fields["tenant"] = tid
+        emit_event(**fields)
+        self._append_journal(dict(
+            rec, tenant=tid, ts=self._wall(), knobs=self.knob_values(),
+        ))
+
+    # -- the signal plane ---------------------------------------------------
+
+    def _sample(self, t: _Target) -> dict:
+        reg = registry()
+        labels = {} if t.key is None else {"tenant": t.key}
+        return {
+            "batches": reg.get(
+                "sntc_batches_committed_total", **labels) or 0.0,
+            "rows": reg.get(
+                "sntc_rows_committed_total", **labels) or 0.0,
+            "shed": reg.get(
+                "sntc_shed_offsets_total", **labels) or 0.0,
+            "strikes": reg.get(
+                "sntc_tenant_strikes_total", **labels) or 0.0,
+            "hist": reg.get_histogram(
+                "sntc_batch_duration_seconds", **labels),
+        }
+
+    def _window_signal(self, t: _Target, now: float) -> Optional[SloSignal]:
+        """Diff this target's registry counters against the previous
+        window's sample (None on the very first window — the
+        controller never acts on a cold sample)."""
+        cur = self._sample(t)
+        compiles = t.engine.predictor.compile_events
+        prev, prev_ts = t.prev, t.prev_ts
+        prev_compiles = t.prev_compiles
+        t.prev, t.prev_ts, t.prev_compiles = cur, now, compiles
+        if prev is None or prev_ts is None:
+            return None
+        elapsed = max(1e-9, now - prev_ts)
+        batches = int(cur["batches"] - prev["batches"])
+        rows = int(cur["rows"] - prev["rows"])
+        shed = int(cur["shed"] - prev["shed"])
+        strikes = int(cur["strikes"] - prev["strikes"])
+        p50 = p99 = None
+        if cur["hist"] is not None:
+            bounds = cur["hist"]["bounds"]
+            prev_counts = (
+                prev["hist"]["buckets"] if prev["hist"] is not None
+                else [0] * len(cur["hist"]["buckets"])
+            )
+            deltas = [
+                c - p for c, p in zip(cur["hist"]["buckets"],
+                                      prev_counts)
+            ]
+            p50 = window_percentile(bounds, deltas, 50)
+            p99 = window_percentile(bounds, deltas, 99)
+            if p99 is not None and math.isinf(p99):
+                # rank landed in the +Inf bucket: substitute the
+                # window mean (sum/count deltas), never journal inf
+                sum_d = cur["hist"]["sum"] - (
+                    prev["hist"]["sum"] if prev["hist"] else 0.0
+                )
+                count_d = cur["hist"]["count"] - (
+                    prev["hist"]["count"] if prev["hist"] else 0
+                )
+                p99 = (
+                    sum_d / count_d if count_d > 0 else bounds[-1]
+                )
+            if p50 is not None and math.isinf(p50):
+                p50 = p99
+        try:
+            backlog = t.engine.backlog_offsets()
+        except Exception:
+            backlog = 0
+        unit = t.engine.max_batch_offsets or 1
+        breakers = getattr(t.engine, "breakers", {})
+        sig = SloSignal(
+            batches=batches,
+            rows=rows,
+            rows_per_s=rows / elapsed,
+            p50_ms=None if p50 is None else round(p50 * 1e3, 3),
+            p99_ms=None if p99 is None else round(p99 * 1e3, 3),
+            shed_offsets=shed,
+            shed_rate=shed / max(1.0, shed + batches * unit),
+            strikes=strikes,
+            backlog=backlog,
+            compile_events=compiles - (prev_compiles or 0),
+            breaker_open=any(
+                br.state == "open" for br in breakers.values()
+            ),
+            elapsed_s=elapsed,
+        )
+        t.last_signal = sig
+        return sig
+
+    def _violations(self, t: _Target, sig: SloSignal) -> Dict[str, float]:
+        """Per-axis violation severity ratios (> 1 = violating); empty
+        = compliant on every DECLARED axis.  Also refreshes the
+        compliance map + gauges."""
+        v: Dict[str, float] = {}
+        comp: Dict[str, bool] = {}
+        slo = t.slo
+        if slo.slo_p99_ms is not None:
+            bad = sig.p99_ms is not None and sig.p99_ms > slo.slo_p99_ms
+            comp["p99"] = not bad
+            if bad:
+                v["p99"] = sig.p99_ms / slo.slo_p99_ms
+        if slo.slo_min_rows_per_sec is not None:
+            # a throughput floor binds only under demand: an idle
+            # stream (no backlog) is vacuously compliant
+            bad = (
+                sig.backlog > 0
+                and sig.rows_per_s < slo.slo_min_rows_per_sec
+            )
+            comp["throughput"] = not bad
+            if bad:
+                v["throughput"] = slo.slo_min_rows_per_sec / max(
+                    sig.rows_per_s, 1e-9
+                )
+        if slo.slo_max_shed_rate is not None:
+            bad = (
+                sig.shed_offsets > 0
+                and sig.shed_rate > slo.slo_max_shed_rate
+            )
+            comp["shed"] = not bad
+            if bad:
+                v["shed"] = sig.shed_rate / slo.slo_max_shed_rate
+        t.compliance = comp
+        labels = {} if t.key is None else {"tenant": t.key}
+        for axis, ok in comp.items():
+            set_gauge(
+                "sntc_ctl_slo_compliant", 1.0 if ok else 0.0,
+                slo=axis, **labels,
+            )
+        if sig.p99_ms is not None:
+            set_gauge(
+                "sntc_ctl_window_p99_seconds", sig.p99_ms / 1e3,
+                **labels,
+            )
+        # sticky hold (constructor docstring): an axis violated this
+        # window arms `violation_hold` further windows at its last
+        # severity; an axis quiet this window burns one hold window
+        held: Dict[str, float] = {}
+        for axis in list(t.hold):
+            left, ratio = t.hold[axis]
+            if axis in v:
+                continue
+            if left > 0:
+                held[axis] = ratio
+                t.hold[axis] = (left - 1, ratio)
+            else:
+                del t.hold[axis]
+        for axis, ratio in v.items():
+            t.hold[axis] = (self.violation_hold, ratio)
+        return dict(held, **v)
+
+    # -- the controller -----------------------------------------------------
+
+    def _usable(self, t: _Target, base: str, direction: int) -> bool:
+        return self.guard.usable(
+            {self._full(t, base): t.knobs.get(base)}
+            if t.knobs.get(base) is not None else {},
+            self._full(t, base), direction,
+        )
+
+    def _tuner_has_action_space(self, t: _Target) -> bool:
+        """Delegation is pointless once the tuner bound an EMPTY knob
+        set (a MemorySource engine exposes no live setters) — fall
+        through to the serving knobs instead.  An unbound tuner gets
+        one probe window to bind."""
+        if t.tuner is None:
+            return False
+        if t.tuner._knobs is None:
+            return True
+        return bool(t.tuner._knobs)
+
+    def _all_others_compliant(self, t: _Target) -> bool:
+        for other in self.targets:
+            if other is t or not other.controllable():
+                continue
+            if other.compliance and not all(other.compliance.values()):
+                return False
+        return True
+
+    def _plan(
+        self, by_target: Dict[Any, Tuple[_Target, Dict[str, float]]]
+    ) -> Tuple[Optional[Tuple[str, int]], Optional[_Target]]:
+        """The priority ladder (module docstring): returns
+        ``(serving-knob proposal or None, ingest-delegation target or
+        None)``."""
+        violators = [
+            (t, v) for t, v in by_target.values() if v
+        ]
+        if violators:
+            # most severe violator first; ties resolve by key order so
+            # the confirm streak can accumulate deterministically
+            violators.sort(
+                key=lambda tv: (-max(tv[1].values()), str(tv[0].key))
+            )
+            t, v = violators[0]
+            sig = t.last_signal
+            flooding = "shed" in v or sig.strikes > 0
+            if flooding and t.stream is not None:
+                # degrade the violator, never its neighbors:
+                # throttle → shed → ladder escalation
+                for base in ("quota", "shed", "escalate"):
+                    if self._usable(t, base, +1):
+                        return (self._full(t, base), +1), None
+                return None, None
+            if "p99" in v:
+                # latency is queue wait (depth) or compile churn
+                # (bucket floor); as the last resort the tenant
+                # admits less (its own quota) to serve within SLO
+                if sig.compile_events > 0 and self._usable(
+                    t, "shape_buckets", +1
+                ):
+                    return (self._full(t, "shape_buckets"), +1), None
+                if self._usable(t, "pipeline_depth", -1):
+                    return (self._full(t, "pipeline_depth"), -1), None
+                if t.stream is not None and self._usable(t, "quota", +1):
+                    return (self._full(t, "quota"), +1), None
+                return None, None
+            # throughput-bound: feed the engine first (the ingest
+            # loop the controller owns), then deepen the pipeline,
+            # then — only while every neighbor is compliant — take
+            # more of the schedule.  A tuner that keeps producing
+            # nothing (its knobs saturated or its own hysteresis
+            # holding) yields to the serving knobs after `confirm`
+            # idle windows, then gets the floor back once they are
+            # exhausted too.
+            delegate_ok = (
+                sig.backlog > 0 and self._tuner_has_action_space(t)
+            )
+            if delegate_ok and (
+                t.idle_delegations <= self.policy.confirm
+            ):
+                return None, t
+            if self._usable(t, "pipeline_depth", +1):
+                return (self._full(t, "pipeline_depth"), +1), None
+            if (
+                t.stream is not None
+                and self._all_others_compliant(t)
+                and self._usable(t, "weight", +1)
+            ):
+                return (self._full(t, "weight"), +1), None
+            if delegate_ok:
+                return None, t
+            return None, None
+        # no violations anywhere: relax ONE degraded knob toward its
+        # cold default (escalate never relaxes — strikes were spent)
+        for t in self.targets:
+            if not t.controllable():
+                continue
+            for base in ("quota", "shed", "weight", "pipeline_depth",
+                         "shape_buckets"):
+                k = t.knobs.get(base)
+                if k is None:
+                    continue
+                full = self._full(t, base)
+                if full in self.guard.frozen:
+                    continue
+                cur, default = k.get(), self._defaults[full]
+                if cur != default:
+                    return (full, 1 if cur < default else -1), None
+        return None, None
+
+    def step(
+        self, signals: Dict[Any, SloSignal]
+    ) -> Optional[dict]:
+        """One closed observation window over per-target signals
+        (:meth:`on_tick` computes them from the registry; tests pass
+        synthetic maps).  At most ONE knob moves: a serving knob
+        through the shared guardrails, or — when no serving proposal
+        is live — one delegated ingest-tuner step."""
+        if not signals:
+            return None
+        inc("sntc_ctl_windows_total")
+        by_key = {t.key: t for t in self.targets}
+        by_target: Dict[Any, Tuple[_Target, Dict[str, float]]] = {}
+        for key, sig in signals.items():
+            t = by_key.get(key)
+            if t is None:
+                continue
+            t.last_signal = sig
+            if not t.controllable():
+                continue
+            by_target[key] = (t, self._violations(t, sig))
+        prop, delegate = self._plan(by_target)
+
+        def _fields():
+            if prop is None:
+                return {}
+            tid, _base = self._split(prop[0])
+            t = by_key.get(tid)
+            return (
+                t.last_signal.as_fields()
+                if t is not None and t.last_signal is not None else {}
+            )
+
+        rec = self.guard.observe(
+            lambda: prop, self._knobs, _fields,
+            on_applied=None,
+        )
+        if rec is None and prop is None and delegate is not None:
+            irec = (
+                delegate.tuner.on_tick(delegate.engine)
+                if delegate.tuner is not None else None
+            )
+            if irec is None:
+                delegate.idle_delegations += 1
+            else:
+                delegate.idle_delegations = 0
+            if irec is not None:
+                self.delegated_total += 1
+                labels = (
+                    {} if delegate.key is None
+                    else {"tenant": delegate.key}
+                )
+                inc(
+                    "sntc_ctl_decisions_total", action="delegated",
+                    knob=irec["knob"], **labels,
+                )
+                drec = {
+                    "action": "delegated",
+                    "tenant": delegate.key,
+                    "knob": irec["knob"],
+                    "window": self.guard.windows,
+                    "ingest": irec,
+                    "ts": self._wall(),
+                    "knobs": self.knob_values(),
+                }
+                emit_event(
+                    event="controller_decision", action="delegated",
+                    knob=irec["knob"],
+                    **({} if delegate.key is None
+                       else {"tenant": delegate.key}),
+                )
+                self._append_journal(drec)
+                return drec
+        return rec
+
+    def on_tick(self) -> Optional[dict]:
+        """Owner cadence: cheap counter bump until the observation
+        window closes, then sample + step.  Exceptions propagate —
+        the OWNER (daemon tick / supervisor tick) wraps this in the
+        degrade-never-kill contract."""
+        self._ticks += 1
+        if self._ticks % self.interval_ticks:
+            return None
+        now = self._clock()
+        signals: Dict[Any, SloSignal] = {}
+        for t in self.targets:
+            sig = self._window_signal(t, now)
+            if sig is not None:
+                signals[t.key] = sig
+        return self.step(signals)
+
+    # -- evidence -----------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        out = {
+            "windows": self.guard.windows,
+            "decisions": self.guard.decisions_total,
+            "applied": len(self.guard.applied()),
+            "delegated": self.delegated_total,
+            "escalations": self.escalations_total,
+            "frozen": sorted(self.guard.frozen),
+            "knobs": self.knob_values(),
+            "recent": self.guard.decisions[-8:],
+            "journal": self.journal_path,
+        }
+        if self.budget is not None:
+            out["budget"] = self.budget.snapshot()
+        if self.ingest:
+            out["ingest"] = {
+                (t.key or "_"): t.tuner.stats()
+                for t in self.targets if t.tuner is not None
+            }
+        return out
+
+    def slo_status(self) -> Dict[str, Any]:
+        """The ``status()["slo"]`` block: per-target declared SLOs,
+        per-axis compliance, and the last window's signal."""
+        out: Dict[str, Any] = {}
+        for t in self.targets:
+            sig = t.last_signal
+            out[t.key or "_"] = {
+                "declared": t.slo.as_dict(),
+                "compliant": (
+                    all(t.compliance.values())
+                    if t.compliance else None
+                ),
+                "axes": dict(t.compliance),
+                "window": sig.as_fields() if sig is not None else None,
+            }
+        return out

@@ -145,6 +145,17 @@ class TenantSpec:
     # knobs (flow_timeout, allowed_lateness, ...) through to it
     from_capture: Optional[str] = None
     flow_options: Optional[Dict[str, Any]] = None
+    # declared SLOs (r16) — the ServeController's setpoints.  None (or
+    # 0, normalized below in the PR-7 style) = undeclared: the
+    # controller never diagnoses this tenant as a violator on that
+    # axis.  slo_p99_ms bounds the windowed p99 batch latency;
+    # slo_min_rows_per_sec is the throughput floor the tenant expects
+    # while it has backlog; slo_max_shed_rate bounds the fraction of
+    # its offsets the shedder may drop per window before the
+    # degradation ladder engages.
+    slo_p99_ms: Optional[float] = None
+    slo_min_rows_per_sec: Optional[float] = None
+    slo_max_shed_rate: Optional[float] = None
 
     def __post_init__(self):
         if not self.tenant_id or "/" in self.tenant_id:
@@ -175,6 +186,27 @@ class TenantSpec:
             # code must be explicit about what they enforce
             raise ValueError(
                 "row_policy requires a schema_contract on the spec"
+            )
+        # SLO fields: 0 normalizes to None (the CLI documents 0 =
+        # undeclared, matching the max_batch_failures convention);
+        # negative values — and a shed-rate bound over 1.0 — are typos,
+        # not contracts, and must be loud
+        for f in ("slo_p99_ms", "slo_min_rows_per_sec",
+                  "slo_max_shed_rate"):
+            v = getattr(self, f)
+            if v is None:
+                continue
+            if v == 0:
+                setattr(self, f, None)
+                continue
+            if v < 0:
+                raise ValueError(f"{f} must be >= 0 (0/None = unset)")
+        if (
+            self.slo_max_shed_rate is not None
+            and self.slo_max_shed_rate > 1.0
+        ):
+            raise ValueError(
+                "slo_max_shed_rate is a fraction in (0, 1]"
             )
 
     @classmethod
@@ -243,6 +275,24 @@ class TenantStream:
 
     def throttled(self) -> bool:
         return self.allowance is not None and self.allowance <= 0
+
+    def set_rate_quota(self, rate: Optional[float]) -> None:
+        """Live quota resize (the ServeController's throttle knob).
+        ``None`` disarms the bucket; otherwise the burst re-derives
+        from the new rate and the current allowance is clamped into it
+        so a tighter quota takes effect this round, not after one last
+        old-size burst."""
+        self.spec.max_rows_per_sec = rate
+        if rate is None:
+            self._burst = None
+            self.allowance = None
+            return
+        self._burst = max(rate, 1.0)
+        self.allowance = (
+            self._burst if self.allowance is None
+            else min(self.allowance, self._burst)
+        )
+        self._last_refill = self._clock()
 
     def charge(self, rows: int) -> None:
         if self.allowance is not None:
@@ -326,6 +376,8 @@ class ServeDaemon:
         breaker_kwargs: Optional[Dict[str, Any]] = None,
         autotune: bool = False,
         tuning_budget=None,
+        controller: bool = False,
+        controller_policy=None,
     ):
         if not specs:
             raise ValueError("ServeDaemon needs at least one TenantSpec")
@@ -353,8 +405,18 @@ class ServeDaemon:
         # inside the daemon's scheduling rounds.
         self.autotune = bool(autotune)
         self.tuning_budget = tuning_budget
-        if self.autotune and self.tuning_budget is None:
-            from sntc_tpu.data.autotune import TuningBudget
+        # closed-loop SLO controller (r16): when armed, the controller
+        # OWNS the per-tenant ingest tuners (one owner per knob — the
+        # engines do not tick their own), steers the serving knobs
+        # from the TenantSpec SLO fields, and journals every decision
+        # to <root>/controller.jsonl.  See docs/RESILIENCE.md
+        # "Closed-loop SLO control".
+        self._controller_armed = bool(controller)
+        self.controller = None
+        if (self.autotune or self._controller_armed) and (
+            self.tuning_budget is None
+        ):
+            from sntc_tpu.resilience.control import TuningBudget
 
             self.tuning_budget = TuningBudget.default_for(len(specs))
         self._owns_health = health is None
@@ -386,6 +448,12 @@ class ServeDaemon:
                 reset_breakers(prefix=t.prefix)
             raise
         self._by_id = {t.spec.tenant_id: t for t in self.tenants}
+        if self._controller_armed:
+            from sntc_tpu.serve.controller import ServeController
+
+            self.controller = ServeController.for_daemon(
+                self, policy=controller_policy,
+            )
         # strike counting rides the event stream: engine-emitted
         # UNHEALTHY-class events carry the tenant tag (overlap-mode
         # delivery threads emit too, hence the lock)
@@ -471,7 +539,10 @@ class ServeDaemon:
             for site in ("sink.write", "predict.dispatch")
         }
         autotuner = None
-        if self.autotune:
+        if self.autotune and not self._controller_armed:
+            # with the SLO controller armed the CONTROLLER owns the
+            # tuners (ticked per window, pipeline_depth excluded);
+            # engine-owned tuners would double-steer the same knobs
             from sntc_tpu.data.autotune import IngestAutotuner
 
             autotuner = IngestAutotuner(
@@ -717,6 +788,17 @@ class ServeDaemon:
                     "sntc_tenant_state", TENANT_STATES.index(t.state),
                     tenant=t.spec.tenant_id,
                 )
+            if self.controller is not None:
+                # closed-loop SLO control, once per scheduling round —
+                # degrade-never-kill exactly like the lifecycle and
+                # autotune ticks: a controller bug must not stop
+                # serving
+                try:
+                    self.controller.on_tick()
+                except Exception as e:
+                    emit_event(
+                        event="controller_error", error=repr(e)
+                    )
         if self.health_json:
             _atomic_json(self.health_json, self.status())
         if self.metrics_out:
@@ -762,6 +844,22 @@ class ServeDaemon:
                 t.state = "THROTTLED"
                 break
         return committed
+
+    def strike_tenant(self, tenant_id: str, reason: str) -> None:
+        """One ladder strike issued by the SLO controller (the top of
+        its degradation ladder: throttle → shed → escalate).  Counts
+        exactly like an event-stream strike; the existing
+        quarantine/stop thresholds own what happens next."""
+        t = self._by_id[tenant_id]
+        if t.state == "STOPPED":
+            return
+        with self._strike_lock:
+            t.strikes += 1
+        inc("sntc_tenant_strikes_total", tenant=t.spec.tenant_id)
+        emit_event(
+            event="controller_strike", tenant=t.spec.tenant_id,
+            reason=reason,
+        )
 
     def _strike(self, t: TenantStream, exc: Exception, during: str) -> None:
         """An engine error that surfaced to the scheduler (quarantine
@@ -864,6 +962,14 @@ class ServeDaemon:
                     "last_committed": t.query.last_committed(),
                     "end_offset": t.query.committed_end(),
                     "in_flight_left": t.query.in_flight_count(),
+                    # final controller-steered knob state: a restart
+                    # (cold defaults) reads this to log the delta
+                    "controller_knobs": (
+                        self.controller.knob_values_for(
+                            t.spec.tenant_id
+                        )
+                        if self.controller is not None else None
+                    ),
                 },
             )
             try:
@@ -884,6 +990,10 @@ class ServeDaemon:
                     t.spec.tenant_id: t.state for t in self.tenants
                 },
                 "batches_committed_at_drain": committed,
+                "controller_knobs": (
+                    self.controller.knob_values()
+                    if self.controller is not None else None
+                ),
             },
         )
         emit_event(
@@ -944,6 +1054,14 @@ class ServeDaemon:
             "compile_ledger": self.compile_ledger(),
             "recompiles_after_warmup": self.recompiles_after_warmup(),
             "autotune": self.autotune_stats(),
+            "slo": (
+                self.controller.slo_status()
+                if self.controller is not None else None
+            ),
+            "controller": (
+                self.controller.stats()
+                if self.controller is not None else None
+            ),
             "health": self.health.snapshot(),
             "breakers": {
                 site: snap
