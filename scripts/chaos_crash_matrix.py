@@ -50,6 +50,38 @@ SCRIPT = os.path.abspath(__file__)
 KILL_SITES = ("stream.wal", "sink.write", "stream.commit")
 KILL_EXIT_CODE = 137  # mirrors sntc_tpu.resilience.KILL_EXIT_CODE
 
+# durable-storage scenarios (r17).  The torn-WAL pair runs an
+# append-WAL engine (compaction every 2 commits so a sealed
+# wal_checkpoint.json is already behind the kill) and dies LITERALLY
+# mid-append at an exact log-write index: half of batch 2's intent
+# (or commit) line is flushed and the process ``os._exit``s inside the
+# write — the power-loss shape (a SURVIVING engine rolls its own torn
+# writes back, so only death-mid-write leaves this tail).  The restart
+# must truncate the torn tail with a journaled ``truncate_torn_tail``
+# repair record (storage_repair.jsonl) and reconverge committed state
+# AND sink file CONTENTS bitwise with an uninterrupted reference.
+# Call index map (depth 1, 1 file per batch, log appends only —
+# compaction checkpoints publish via atomic writes, not appends):
+# intent+commit per batch, so call 5 is batch 2's intent, call 6 its
+# commit.
+WAL_TORN_SCENARIOS = (
+    ("wal_torn_intent", 4),  # after=4 -> the 5th log append tears
+    ("wal_torn_commit", 5),
+)
+# the disk-fault drain scenario arms ENOSPC/EIO probabilistically at
+# every serve-reachable durable write site AT ONCE (WAL appends +
+# compaction, shed/dead-letter journals, health/drain markers, sink)
+# on a supervised loop with retry + quarantine + shed armed, then
+# SIGTERMs it: the engine must follow each artifact's declared policy
+# — degrade or quarantine, never die — and exit 0 on drain.
+DISK_FAULT_ENV = (
+    "storage.wal:enospc:0.2:7,"
+    "storage.journal:enospc:0.5:11,"
+    "storage.dead_letter:io_error:0.5:13,"
+    "storage.marker:io_error:0.3:17,"
+    "sink.write:enospc:0.2:19"
+)
+
 # stateful flow-window scenarios (r14): an engine serving RAW pcap
 # captures through the keyed-window operator (sntc_tpu/flow) is killed
 # MID-WINDOW — flows genuinely span the micro-batch boundary at death —
@@ -159,7 +191,8 @@ def sink_rows(out_dir: str) -> dict:
 def run_worker(
     watch: str, out: str, ckpt: str, *, faults: str = "",
     slow_sink_s: float = 0.0, timeout: float = 120.0,
-    pipelined: bool = False,
+    pipelined: bool = False, wal_append: bool = False,
+    torn_after: int = 0, armed: bool = False,
 ) -> subprocess.CompletedProcess:
     """One drain-and-exit engine pass in a child process."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS=faults)
@@ -170,6 +203,12 @@ def run_worker(
     ]
     if pipelined:
         cmd.append("--pipelined")
+    if wal_append:
+        cmd.append("--wal-append")
+    if torn_after:
+        cmd.extend(["--torn-after", str(torn_after)])
+    if armed:
+        cmd.append("--armed")
     return subprocess.run(
         cmd, env=env, cwd=REPO, capture_output=True, text=True,
         timeout=timeout,
@@ -289,6 +328,165 @@ def run_drain_scenario(
         "pipelined": pipelined,
         "marker": marker, "commits": {str(k): v for k, v in commits.items()},
         "sink_batches": len(rows), "stderr": stderr[-2000:],
+        "stdout": stdout[-500:],
+    }
+
+
+def append_committed_state(ckpt: str) -> dict:
+    """Committed (last batch id, end offset) recovered the append-WAL
+    way: wal_checkpoint.json (if compaction ran) + the commits.log
+    tail, tolerating a torn final line (parent-side mirror of the
+    engine's own recovery; no sntc_tpu import)."""
+    state = {"last": -1, "end": 0}
+    ck = os.path.join(ckpt, "wal_checkpoint.json")
+    if os.path.exists(ck):
+        with open(ck) as f:
+            core = json.load(f)
+        state = {"last": core["last_committed"], "end": core["end"]}
+    commits = {}
+    path = os.path.join(ckpt, "commits.log")
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail: the engine repairs it
+                commits[int(rec["batch_id"])] = rec["end"]
+    if commits and max(commits) > state["last"]:
+        state = {"last": max(commits), "end": commits[max(commits)]}
+    return state
+
+
+def _has_torn_tail(path: str) -> bool:
+    if not os.path.exists(path):
+        return False
+    with open(path, "rb") as f:
+        raw = f.read()
+    lines = [l for l in raw.split(b"\n") if l.strip()]
+    if not lines:
+        return False
+    try:
+        json.loads(lines[-1].decode())
+        return False
+    except (ValueError, UnicodeDecodeError):
+        return True
+
+
+def run_wal_reference(workdir: str) -> dict:
+    """Uninterrupted append-WAL run (compaction armed) over 6 files."""
+    d = os.path.join(workdir, "wal_reference")
+    watch = os.path.join(d, "in")
+    write_inputs(watch, n_files=6)
+    out, ckpt = os.path.join(d, "out"), os.path.join(d, "ckpt")
+    ref = run_worker(watch, out, ckpt, wal_append=True)
+    if ref.returncode != 0:
+        raise RuntimeError(
+            f"wal reference rc={ref.returncode}: {ref.stderr}"
+        )
+    return {
+        "state": append_committed_state(ckpt),
+        "sink": sink_contents(out),
+    }
+
+
+def run_wal_torn_scenario(
+    workdir: str, name: str, torn_after: int, reference: dict,
+) -> dict:
+    """Kill-mid-append: a torn_write at storage.wal stops batch 2's
+    intent/commit line partway and the worker dies (exit 137).  The
+    restart must find the torn tail, journal a truncate_torn_tail
+    repair record, and reconverge committed state + sink file CONTENTS
+    bitwise with the uninterrupted reference."""
+    d = os.path.join(workdir, name)
+    watch = os.path.join(d, "in")
+    write_inputs(watch, n_files=6)
+    out, ckpt = os.path.join(d, "out"), os.path.join(d, "ckpt")
+    killed = run_worker(
+        watch, out, ckpt, wal_append=True, torn_after=torn_after,
+    )
+    if killed.returncode != KILL_EXIT_CODE:
+        return {"site": name, "ok": False,
+                "error": f"torn run rc={killed.returncode} (expected "
+                f"{KILL_EXIT_CODE}): {killed.stderr}"}
+    torn = (
+        _has_torn_tail(os.path.join(ckpt, "offsets.log"))
+        or _has_torn_tail(os.path.join(ckpt, "commits.log"))
+    )
+    if not torn:
+        return {"site": name, "ok": False,
+                "error": "no torn WAL tail on disk after the kill"}
+    restarted = run_worker(watch, out, ckpt, wal_append=True)
+    if restarted.returncode != 0:
+        return {"site": name, "ok": False,
+                "error": f"restart rc={restarted.returncode}: "
+                f"{restarted.stderr}"}
+    repair_path = os.path.join(ckpt, "storage_repair.jsonl")
+    repairs = []
+    if os.path.exists(repair_path):
+        with open(repair_path) as f:
+            repairs = [
+                json.loads(line) for line in f if line.strip()
+            ]
+    repaired = any(
+        r.get("action") == "truncate_torn_tail" for r in repairs
+    )
+    got_state = append_committed_state(ckpt)
+    got_sink = sink_contents(out)
+    ok = (
+        repaired
+        and got_state == reference["state"]
+        and got_sink == reference["sink"]
+    )
+    return {
+        "site": name, "ok": ok, "torn_tail_on_disk": torn,
+        "repair_journaled": repaired,
+        "state": got_state, "expected_state": reference["state"],
+        "sink_files": sorted(got_sink),
+        "sink_bitwise": got_sink == reference["sink"],
+    }
+
+
+def run_disk_fault_scenario(workdir: str, timeout: float = 120.0) -> dict:
+    """ENOSPC/EIO armed probabilistically at every serve-reachable
+    durable write site at once, on a supervised loop with retry +
+    quarantine + shed armed; SIGTERM mid-stream.  Required: exit 0
+    (every artifact followed its declared policy — degrade or
+    quarantine, never die) with at least one commit landed."""
+    d = os.path.join(workdir, "disk_faults")
+    watch = os.path.join(d, "in")
+    out, ckpt = os.path.join(d, "out"), os.path.join(d, "ckpt")
+    write_inputs(watch, n_files=8)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SNTC_FAULTS=DISK_FAULT_ENV)
+    env.pop("SNTC_RESILIENCE_LOG", None)
+    cmd = [
+        sys.executable, SCRIPT, "--worker", "--serve", "--armed",
+        "--wal-append", "--watch", watch, "--out", out, "--ckpt",
+        ckpt, "--poll-interval", "0.05", "--slow-sink-s", "0.0",
+    ]
+    proc = subprocess.Popen(
+        cmd, env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        deadline = time.time() + timeout
+        while time.time() < deadline and not sink_rows(out):
+            time.sleep(0.05)
+        time.sleep(0.5)  # let a few fault rounds land
+        proc.send_signal(signal.SIGTERM)
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except Exception:
+        proc.kill()
+        raise
+    state = append_committed_state(ckpt)
+    ok = proc.returncode == 0 and state["last"] >= 0
+    return {
+        "site": "disk_faults", "ok": ok, "rc": proc.returncode,
+        "committed": state, "stderr": stderr[-2000:],
         "stdout": stdout[-500:],
     }
 
@@ -834,6 +1032,12 @@ def run_matrix(workdir: str, pipelined: bool = False) -> dict:
     results.append(run_tenant_isolation_scenario(workdir, mt_ref))
     results.append(run_controller_kill_scenario(workdir, mt_ref))
     results.append(run_controller_noisy_scenario(workdir))
+    wal_ref = run_wal_reference(workdir)
+    results.extend(
+        run_wal_torn_scenario(workdir, name, after, wal_ref)
+        for name, after in WAL_TORN_SCENARIOS
+    )
+    results.append(run_disk_fault_scenario(workdir))
     return {"ok": all(r["ok"] for r in results), "scenarios": results}
 
 
@@ -1104,7 +1308,11 @@ def flow_worker_main(args) -> int:
 def worker_main(args) -> int:
     sys.path.insert(0, REPO)
     from sntc_tpu.core.base import Transformer
-    from sntc_tpu.resilience import QuerySupervisor, default_breakers
+    from sntc_tpu.resilience import (
+        QuerySupervisor,
+        RetryPolicy,
+        default_breakers,
+    )
     from sntc_tpu.serve import CsvDirSink, FileStreamSource, StreamingQuery
 
     class Identity(Transformer):
@@ -1126,18 +1334,65 @@ def worker_main(args) -> int:
     src = FileStreamSource(
         args.watch, prefetch_batches=2 if args.pipelined else 0
     )
+    extra = {}
+    if args.wal_append:
+        # torn-WAL / disk-fault scenarios: append WAL with a short
+        # compaction interval so a sealed checkpoint is provably
+        # involved in the recovery the scenario asserts.  Depth 1
+        # keeps the storage.wal call order deterministic (intent,
+        # commit, [checkpoint] per batch) so --torn-after indexes the
+        # exact append the scenario documents.
+        extra.update(wal_mode="append", wal_compact_every=2)
+    if args.armed:
+        # the disk-fault sweep serves DEGRADED, not single-shot: retry
+        # per round + quarantine at the threshold (each artifact's
+        # declared policy owns its own failure)
+        extra.update(
+            retry_policy=RetryPolicy(
+                max_attempts=2, base_delay_s=0.01, jitter=0.0
+            ),
+            max_batch_failures=2,
+        )
     q = StreamingQuery(
         Identity(), src, sink, args.ckpt,
         max_batch_offsets=1, breakers=default_breakers(),
-        pipeline_depth=3 if args.pipelined else 2,
+        pipeline_depth=(
+            3 if args.pipelined else (1 if args.wal_append else 2)
+        ),
         overlap_sink=args.pipelined,
         shape_buckets=4 if args.pipelined else 0,
+        **extra,
     )
+    if args.torn_after:
+        # die LITERALLY mid-append on the Nth storage.wal log write:
+        # flush half the line, then os._exit — no rollback, no
+        # handlers, no engine failure path.  This is a real power loss
+        # shape (a surviving engine rolls its own torn writes back, so
+        # only death-mid-write can leave the torn tail this scenario
+        # exists to repair).
+        from sntc_tpu.resilience import storage as st
+
+        orig_append = st.append_line
+        state = {"n": 0}
+
+        def _kill_mid_append(f, text, **kw):
+            if kw.get("site") == "storage.wal":
+                state["n"] += 1
+                if state["n"] > args.torn_after:
+                    f.write(text[: max(1, len(text) // 2)])
+                    f.flush()
+                    os._exit(KILL_EXIT_CODE)
+            return orig_append(f, text, **kw)
+
+        st.append_line = _kill_mid_append
     if not args.serve:
         n = q.process_available()
         print(json.dumps({"batches": n}))
         return 0
-    sup = QuerySupervisor(q, health_json=os.path.join(args.ckpt, "health.json"))
+    sup = QuerySupervisor(
+        q, health_json=os.path.join(args.ckpt, "health.json"),
+        max_pending_batches=2 if args.armed else None,
+    )
     sup.install_signal_handlers()
     status = sup.run(poll_interval=args.poll_interval)
     print(json.dumps({"batches": status["engine"]["batches_done"],
@@ -1178,6 +1433,17 @@ def main(argv=None) -> int:
     ap.add_argument("--setup-flow-inputs", action="store_true",
                     help="worker: write the flow scenarios' capture "
                     "stream and exit")
+    ap.add_argument("--wal-append", action="store_true",
+                    help="worker: append-WAL mode with compaction "
+                    "every 2 commits (torn-WAL / disk-fault scenarios)")
+    ap.add_argument("--torn-after", type=int, default=0,
+                    help="worker: die mid-append (half the line "
+                    "flushed, os._exit 137) on the WAL log write "
+                    "after N clean ones")
+    ap.add_argument("--armed", action="store_true",
+                    help="worker: arm retry + poison-batch quarantine "
+                    "+ backlog shedding (the disk-fault sweep serves "
+                    "degraded, not single-shot)")
     ap.add_argument("--kill-site", default="",
                     help="worker: arm this site with an Nth-call kill "
                     "(--kill-after) before serving")

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Static drift check: durable write sites ⇔ storage registry ⇔ docs.
+
+The durable-storage survival plane (``sntc_tpu/resilience/storage.py``,
+r17) only bounds what it knows about.  Three things must stay in
+lockstep or an append-forever file ships silently:
+
+1. **write sites → registry**: every raw append (``open(..., "a")``)
+   and every atomic publish (``os.replace(...)``) in ``sntc_tpu/``
+   either lives inside the storage plane itself, or carries a
+   ``# storage: <artifact>`` annotation naming a registered
+   :data:`~sntc_tpu.resilience.storage.ARTIFACTS` entry — XOR an
+   explicit ``# storage: unbounded(<reason>)`` declaring it
+   deliberately outside the lifecycle (sink output, caller-owned log
+   paths).  An unannotated write site is exactly the silent
+   grow-forever (or torn-file) surface this plane exists to end.
+2. **registry → docs**: every registered artifact has a row in the
+   marker-delimited durable-artifacts table of ``docs/RESILIENCE.md``
+   (name + retention + failure policy), and every row names a
+   registered artifact with the policy the code declares.
+3. **fault grammar**: the IO kinds (``enospc`` / ``io_error`` /
+   ``torn_write``) are in ``ALL_KINDS`` and documented in the
+   fault-kinds table (``check_fault_sites.py`` owns the full kinds
+   table ⇔ ALL_KINDS check; this pins the IO subset exists at all),
+   and every registered artifact's fault site is a declared SITES
+   entry.
+
+Wired as a tier-1 test (``tests/test_storage.py``), the same
+discipline as ``check_fault_sites.py`` / ``check_metric_names.py``.
+
+Exit 0 when consistent; exit 1 with a per-site report otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DOC = "docs/RESILIENCE.md"
+TABLE_BEGIN = "<!-- durable-artifacts:begin -->"
+TABLE_END = "<!-- durable-artifacts:end -->"
+
+# a raw durable-write call: an append-mode open or an atomic rename
+_WRITE_RE = re.compile(
+    r"""open\([^)\n]*["']a["']|os\.replace\("""
+)
+_ANNOTATION_RE = re.compile(
+    r"#\s*storage:\s*([A-Za-z0-9_-]+(?:\([^)]*\))?)"
+)
+_UNBOUNDED_RE = re.compile(r"^unbounded\(.+\)$")
+# the blessed module: every write inside it IS the storage plane
+_STORAGE_MODULE = os.path.join("resilience", "storage.py")
+
+_ROW_RE = re.compile(
+    r"^\|\s*`([A-Za-z0-9_]+)`\s*\|[^|]*\|[^|]*\|\s*`?"
+    r"(fail|degrade|shed)`?\s*\|",
+    re.MULTILINE,
+)
+
+
+def _read(rel: str) -> str:
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def registry():
+    sys.path.insert(0, REPO)
+    from sntc_tpu.resilience.storage import ARTIFACTS
+
+    return ARTIFACTS
+
+
+def write_sites() -> list:
+    """Every raw durable-write line in sntc_tpu/ with its annotation
+    (or None): [(rel_path, lineno, annotation)]."""
+    out = []
+    root = os.path.join(REPO, "sntc_tpu")
+    for dirpath, _dirs, files in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, REPO)
+            if rel.endswith(_STORAGE_MODULE):
+                continue
+            with open(path) as f:
+                for i, line in enumerate(f, 1):
+                    if not _WRITE_RE.search(line):
+                        continue
+                    m = _ANNOTATION_RE.search(line)
+                    out.append((rel, i, m.group(1) if m else None))
+    return out
+
+
+def documented_artifacts() -> dict:
+    """{artifact: documented_policy} from the docs table."""
+    text = _read(DOC)
+    if TABLE_BEGIN not in text or TABLE_END not in text:
+        return {}
+    table = text.split(TABLE_BEGIN, 1)[1].split(TABLE_END, 1)[0]
+    return dict(_ROW_RE.findall(table))
+
+
+def check() -> list:
+    problems = []
+    artifacts = registry()
+
+    # 1. write sites annotated, annotations valid
+    for rel, lineno, ann in write_sites():
+        where = f"{rel}:{lineno}"
+        if ann is None:
+            problems.append(
+                f"{where}: durable write (append/os.replace) with no "
+                "'# storage: <artifact>' annotation — register it with "
+                "the storage plane or declare it "
+                "'# storage: unbounded(<reason>)'"
+            )
+        elif ann == "registered-artifact":
+            pass  # the writer helper's own parametric site
+        elif _UNBOUNDED_RE.match(ann):
+            pass
+        elif ann not in artifacts:
+            problems.append(
+                f"{where}: annotation '# storage: {ann}' names no "
+                "registered ARTIFACTS entry"
+            )
+
+    # 2. registry ⇔ docs table
+    documented = documented_artifacts()
+    if not documented:
+        problems.append(
+            f"{DOC} is missing the marker-delimited durable-artifacts "
+            f"table ({TABLE_BEGIN} ... {TABLE_END})"
+        )
+    else:
+        for name, spec in sorted(artifacts.items()):
+            if name not in documented:
+                problems.append(
+                    f"artifact {name!r} is registered in "
+                    "resilience.storage.ARTIFACTS but missing from the "
+                    f"{DOC} durable-artifacts table"
+                )
+            elif documented[name] != spec.failure_policy:
+                problems.append(
+                    f"artifact {name!r}: docs table says policy "
+                    f"{documented[name]!r} but the registry declares "
+                    f"{spec.failure_policy!r}"
+                )
+        for name in sorted(set(documented) - set(artifacts)):
+            problems.append(
+                f"{DOC} durable-artifacts table documents {name!r} but "
+                "resilience.storage.ARTIFACTS does not register it"
+            )
+
+    # 3. fault grammar: IO kinds declared + documented, artifact sites
+    # declared
+    sys.path.insert(0, REPO)
+    from sntc_tpu.resilience import ALL_KINDS, IO_KINDS, SITES
+
+    for kind in IO_KINDS:
+        if kind not in ALL_KINDS:
+            problems.append(
+                f"IO kind {kind!r} missing from ALL_KINDS"
+            )
+    kinds_doc = _read(DOC)
+    for kind in IO_KINDS:
+        if f"`{kind}`" not in kinds_doc:
+            problems.append(
+                f"IO kind {kind!r} undocumented in {DOC}"
+            )
+    for name, spec in sorted(artifacts.items()):
+        if spec.site not in SITES:
+            problems.append(
+                f"artifact {name!r} declares fault site {spec.site!r} "
+                "which is not in sntc_tpu.resilience.SITES"
+            )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("durable-artifact drift detected:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    n_sites = len(write_sites())
+    print(
+        f"ok: {n_sites} durable write sites annotated, "
+        f"{len(registry())} artifacts consistent across registry and "
+        "docs"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,6 +26,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _CALL_RE = re.compile(
     r"""fault_(?:point|data)\(\s*["']([A-Za-z0-9_.]+)["']"""
 )
+# the r17 storage sites are injected via fault_disk inside the storage
+# plane's write helpers; the helpers take the site as a kwarg, so the
+# literal at the CALL site is ``site="storage.<artifact>"`` (or a
+# direct fault_disk("storage.…") call)
+_DISK_RE = re.compile(
+    r"""(?:fault_disk\(\s*|site(?:\s*:\s*str)?\s*=\s*)"""
+    r"""["'](storage\.[A-Za-z0-9_.]+)["']"""
+)
 # docs table rows: | `site.name` | description |
 _DOC_RE = re.compile(r"^\|\s*`([A-Za-z0-9_.]+)`\s*\|", re.MULTILINE)
 # the kinds table lives between these markers in docs/RESILIENCE.md
@@ -48,7 +56,9 @@ def code_sites(root: str = None) -> set:
             if path.endswith(os.path.join("resilience", "faults.py")):
                 continue
             with open(path) as f:
-                sites.update(_CALL_RE.findall(f.read()))
+                text = f.read()
+            sites.update(_CALL_RE.findall(text))
+            sites.update(_DISK_RE.findall(text))
     return sites
 
 

@@ -50,6 +50,7 @@ FLAGS = (
     ("--row-policy", "row_policy"),
     ("--max-files-per-batch", "max_batch_offsets"),
     ("--max-batch-failures", "max_batch_failures"),
+    ("--disk-budget-mb", "disk_budget_mb"),
 )
 DOC = "docs/RESILIENCE.md"
 TABLE_BEGIN = "<!-- tenant-flags:begin -->"

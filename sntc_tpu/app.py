@@ -536,6 +536,10 @@ def cmd_serve(args) -> int:
         row_dead_letter_dir=args.row_dead_letter,
         lifecycle=lifecycle,
         autotuner=autotuner,
+        wal_mode=args.wal_mode,
+        wal_compact_every=args.wal_compact_every,
+        wal_keep_commits=args.wal_keep_commits,
+        dead_letter_keep=args.dead_letter_keep,
     )
     if args.once:
         try:
@@ -561,6 +565,7 @@ def cmd_serve(args) -> int:
         max_batch_wall_time=args.max_batch_wall_time,
         health_json=args.health_json,
         slo=slo,
+        disk_budget_mb=args.disk_budget_mb,
     )
     sup.install_signal_handlers()
     print(f"serving: watching {args.watch} -> {args.out} "
@@ -619,6 +624,7 @@ def cmd_serve_daemon(args) -> int:
         "slo_p99_ms": args.slo_p99_ms,
         "slo_min_rows_per_sec": args.slo_min_rows_per_sec,
         "slo_max_shed_rate": args.slo_max_shed_rate,
+        "disk_budget_mb": args.disk_budget_mb,
         "max_batch_offsets": args.max_files_per_batch,
         "max_batch_failures": (
             args.max_batch_failures if args.max_batch_failures > 0
@@ -673,6 +679,8 @@ def cmd_serve_daemon(args) -> int:
         metrics_out=args.metrics_out,
         autotune=args.autotune,
         controller=args.controller,
+        disk_budget_mb=args.root_disk_budget_mb,
+        dead_letter_keep=args.dead_letter_keep,
     )
     try:
         if args.once:
@@ -713,6 +721,32 @@ def cmd_serve_daemon(args) -> int:
         "health": status["health"]["overall"],
     }))
     return 0
+
+
+def cmd_fsck(args) -> int:
+    """The storage doctor (r17): walk a checkpoint root — or a whole
+    serve-daemon tenant tree — verify every registered durable
+    artifact (WAL logs + sealed compaction checkpoints, JSONL
+    journals, flow-state snapshot seals, markers, model-checkpoint
+    manifests), repair what is safe (torn JSONL tails truncate with a
+    journaled repair record; tmp orphans sweep), quarantine corrupt
+    blobs to ``.corrupt/``, and print one machine-readable JSON
+    report.  Exit 0 when the tree is (now) clean, 1 when unrepairable
+    damage remains.  See docs/RESILIENCE.md "Durable storage
+    lifecycle"."""
+    from sntc_tpu.resilience.storage import fsck
+
+    report = fsck(
+        args.root,
+        repair=not args.no_repair,
+        tenant_tree=args.tenant_tree,
+    )
+    text = json.dumps(report, indent=1)
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(text + "\n")
+    print(text)
+    return 0 if report["ok"] else 1
 
 
 def cmd_synth(args) -> int:
@@ -890,6 +924,35 @@ def main(argv=None) -> int:
                    "the incumbent to promote; with --partial-fit the "
                    "candidate is a refit of the incumbent, so refit "
                    "jitter re-promotes every window at margin 0")
+    p.add_argument("--wal-mode", default="files",
+                   choices=["files", "append"],
+                   help="WAL format under --checkpoint: 'files' (one "
+                   "json per intent/commit) or 'append' (one flushed "
+                   "JSONL log per side — the high-throughput WAL, "
+                   "compacted per --wal-compact-every)")
+    p.add_argument("--wal-compact-every", type=int, default=256,
+                   metavar="N",
+                   help="append-WAL compaction interval in commits: "
+                   "seal a wal_checkpoint.json and truncate the logs "
+                   "every N commits (replay = checkpoint + tail); "
+                   "0 = never compact")
+    p.add_argument("--wal-keep-commits", type=int, default=64,
+                   metavar="N",
+                   help="files-WAL retention: committed intent/commit "
+                   "pairs older than the last N are pruned; 0 = keep "
+                   "forever")
+    p.add_argument("--dead-letter-keep", type=int, default=200,
+                   metavar="N",
+                   help="dead-letter retention: keep the newest N "
+                   "evidence files per dead-letter dir, drop the "
+                   "oldest with a counted dead_letter_dropped; "
+                   "0 = unbounded")
+    p.add_argument("--disk-budget-mb", type=float, default=None,
+                   metavar="MB",
+                   help="byte budget for the checkpoint root: usage "
+                   "is measured into sntc_disk_* gauges each tick and "
+                   "a breach emits disk_budget_exceeded (DEGRADED "
+                   "health); unset = measure only")
     p.add_argument("--batch-retry-attempts", type=int, default=2,
                    help="in-place attempts per read/sink stage before a "
                    "round counts as failed (1 = no retry)")
@@ -1039,6 +1102,22 @@ def main(argv=None) -> int:
                    help="default per-tenant poison-batch threshold "
                    "(TenantSpec max_batch_failures); 0 = first failure "
                    "surfaces (and strikes the tenant)")
+    p.add_argument("--disk-budget-mb", type=float, default=None,
+                   metavar="MB",
+                   help="default per-tenant disk byte budget "
+                   "(TenantSpec disk_budget_mb): the tenant/<id>/ "
+                   "subtree is measured into sntc_disk_bytes{tenant=} "
+                   "each round and a breach degrades THAT tenant's "
+                   "health; 0/unset = measure only")
+    p.add_argument("--root-disk-budget-mb", type=float, default=None,
+                   metavar="MB",
+                   help="global disk byte budget for the whole daemon "
+                   "root (all tenants + shared journals)")
+    p.add_argument("--dead-letter-keep", type=int, default=200,
+                   metavar="N",
+                   help="per-tenant dead-letter retention: keep the "
+                   "newest N evidence files per dead-letter dir "
+                   "(counted dead_letter_dropped); 0 = unbounded")
     p.add_argument("--poll-interval", type=float, default=1.0)
     p.add_argument("--once", action="store_true",
                    help="drain available files across all tenants and "
@@ -1050,6 +1129,27 @@ def main(argv=None) -> int:
     _add_obs_flags(p)
     add_platform_arg(p)
     p.set_defaults(fn=cmd_serve_daemon)
+
+    p = sub.add_parser(
+        "fsck",
+        help="verify + repair every durable artifact under a "
+        "checkpoint root (WAL seals/tails, journals, flow-state "
+        "snapshots, markers, model manifests); machine-readable "
+        "report; exit 1 when unrepairable damage remains",
+    )
+    p.add_argument("root", help="checkpoint root to doctor (a serve "
+                   "--checkpoint dir, or a serve-daemon --root with "
+                   "--tenant-tree)")
+    p.add_argument("--tenant-tree", action="store_true",
+                   help="also walk every <root>/tenant/<id>/ckpt "
+                   "(the serve-daemon layout)")
+    p.add_argument("--no-repair", action="store_true",
+                   help="report only: no truncations, no quarantines, "
+                   "no tmp sweeps")
+    p.add_argument("--report", default=None, metavar="PATH",
+                   help="also write the JSON report here")
+    add_platform_arg(p)
+    p.set_defaults(fn=cmd_fsck)
 
     p = sub.add_parser("synth", help="write schema-identical synthetic day CSVs")
     p.add_argument("--out", required=True)

@@ -322,7 +322,8 @@ def write_capture_stream(
         tmp = path + ".tmp"
         with open(tmp, "wb") as fobj:
             fobj.write(data)
-        os.replace(tmp, path)  # atomic: a watching source never sees partials
+        # atomic: a watching source never sees partials
+        os.replace(tmp, path)  # storage: unbounded(synthetic dataset output)
         files.append(path)
         if flush and i == n_files:
             flush_file = path

@@ -24,9 +24,49 @@ import re
 from typing import List, Optional
 
 from sntc_tpu.resilience import fault_point
+from sntc_tpu.resilience.storage import atomic_write_bytes
 
 _MAGIC = b"SNTCFLOW1\n"
 _NAME_RE = re.compile(r"state-(\d{12})\.bin$")
+
+
+def verify_snapshot(path: str, end: Optional[int] = None) -> bytes:
+    """Verify one snapshot blob's integrity (magic, header, payload
+    length, sha256) and return the payload.  ``end`` additionally pins
+    the header's offset against the expected one.  Shared by
+    :meth:`FlowStateStore.load` and the ``sntc fsck`` doctor, so the
+    two can never disagree about what 'corrupt' means."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    if not blob.startswith(_MAGIC):
+        raise FlowStateCorruptError(
+            f"flow-state snapshot {path}: bad magic"
+        )
+    head, _, payload = blob[len(_MAGIC):].partition(b"\n")
+    try:
+        header = json.loads(head.decode())
+    except ValueError as e:
+        raise FlowStateCorruptError(
+            f"flow-state snapshot {path}: unreadable header ({e})"
+        ) from e
+    if end is not None and header.get("end") != int(end):
+        raise FlowStateCorruptError(
+            f"flow-state snapshot {path}: header names offset "
+            f"{header.get('end')}, file names {end}"
+        )
+    if len(payload) != header.get("bytes"):
+        raise FlowStateCorruptError(
+            f"flow-state snapshot {path}: {len(payload)} payload "
+            f"bytes, header says {header.get('bytes')} (torn write)"
+        )
+    got = hashlib.sha256(payload).hexdigest()
+    if got != header.get("sha256"):
+        raise FlowStateCorruptError(
+            f"flow-state snapshot {path}: sha256 mismatch "
+            f"(expected {str(header.get('sha256'))[:12]}…, got "
+            f"{got[:12]}…)"
+        )
+    return payload
 
 
 class FlowStateError(RuntimeError):
@@ -83,17 +123,16 @@ class FlowStateStore:
             "sha256": hashlib.sha256(payload).hexdigest(),
         }).encode()
         final = self._file(end)
-        tmp = f"{final}.tmp-{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(_MAGIC + header + b"\n" + payload)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, final)
-        dfd = os.open(self.path, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
+        # the physical write routes through the storage plane's atomic
+        # publish: the ``storage.state`` fault_disk site injects
+        # ENOSPC/torn-write there, and the failure POLICY is "fail" —
+        # the error propagates into the engine's commit hook, whose
+        # retry/quarantine machinery owns the consequence (a snapshot
+        # that silently degraded would break restore bracketing)
+        atomic_write_bytes(
+            final, _MAGIC + header + b"\n" + payload,
+            site="storage.state", tenant=self.tenant,
+        )
         for old in self.ends()[:-self.keep]:
             try:
                 os.unlink(self._file(old))
@@ -107,34 +146,4 @@ class FlowStateStore:
         path = self._file(end)
         if not os.path.exists(path):
             return None
-        with open(path, "rb") as f:
-            blob = f.read()
-        if not blob.startswith(_MAGIC):
-            raise FlowStateCorruptError(
-                f"flow-state snapshot {path}: bad magic"
-            )
-        head, _, payload = blob[len(_MAGIC):].partition(b"\n")
-        try:
-            header = json.loads(head.decode())
-        except ValueError as e:
-            raise FlowStateCorruptError(
-                f"flow-state snapshot {path}: unreadable header ({e})"
-            ) from e
-        if header.get("end") != int(end):
-            raise FlowStateCorruptError(
-                f"flow-state snapshot {path}: header names offset "
-                f"{header.get('end')}, file names {end}"
-            )
-        if len(payload) != header.get("bytes"):
-            raise FlowStateCorruptError(
-                f"flow-state snapshot {path}: {len(payload)} payload "
-                f"bytes, header says {header.get('bytes')} (torn write)"
-            )
-        got = hashlib.sha256(payload).hexdigest()
-        if got != header.get("sha256"):
-            raise FlowStateCorruptError(
-                f"flow-state snapshot {path}: sha256 mismatch "
-                f"(expected {str(header.get('sha256'))[:12]}…, got "
-                f"{got[:12]}…)"
-            )
-        return payload
+        return verify_snapshot(path, end)

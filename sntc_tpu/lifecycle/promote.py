@@ -214,6 +214,7 @@ class ModelPromoter:
         self.candidate = None  # serving form (grafted onto the prefix)
         self.candidate_head: Optional[ClassificationModel] = None
         self.candidate_source: Optional[str] = None
+        self._journal_writer = None
         self._shadow: Optional[BatchPredictor] = None
         self._full_shadow: Optional[BatchPredictor] = None
         self._scores: deque = deque(maxlen=self.window)
@@ -459,22 +460,28 @@ class ModelPromoter:
     def _write_marker(self, record: Dict[str, Any]) -> None:
         if self.checkpoint_dir is None:
             return
-        os.makedirs(self.checkpoint_dir, exist_ok=True)
-        path = os.path.join(self.checkpoint_dir, MODEL_MARKER)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(record, f, indent=1)
-        os.replace(tmp, path)
+        from sntc_tpu.resilience.storage import write_marker
+
+        # DEGRADE policy (r17): a marker that cannot write counts a
+        # storage_degraded episode; the promotion itself already
+        # published atomically and must not be failed retroactively
+        write_marker(
+            os.path.join(self.checkpoint_dir, MODEL_MARKER), record,
+            indent=1,
+        )
 
     def _journal(self, record: Dict[str, Any]) -> None:
         if self.checkpoint_dir is None:
             return
-        os.makedirs(self.checkpoint_dir, exist_ok=True)
         record = dict(record, ts=time.time())
-        with open(
-            os.path.join(self.checkpoint_dir, PROMOTION_JOURNAL), "a"
-        ) as f:
-            f.write(json.dumps(record) + "\n")
+        if self._journal_writer is None:
+            from sntc_tpu.resilience.storage import RotatingJsonlWriter
+
+            self._journal_writer = RotatingJsonlWriter(
+                os.path.join(self.checkpoint_dir, PROMOTION_JOURNAL),
+                artifact="promotion_journal",
+            )
+        self._journal_writer.write(record)
 
     def _publish_form(self):
         """The restart-servable pipeline naming the candidate: the raw

@@ -156,7 +156,7 @@ def _write_manifest(root: str) -> None:
     tmp = os.path.join(root, _MANIFEST + ".tmp")
     with open(tmp, "w") as f:
         json.dump({"manifest_version": 1, "files": files}, f, indent=1)
-    os.replace(tmp, os.path.join(root, _MANIFEST))
+    os.replace(tmp, os.path.join(root, _MANIFEST))  # storage: checkpoint
 
 
 def verify_checkpoint(path: str) -> bool:
@@ -233,9 +233,9 @@ def save_model(stage: PipelineStage, path: str) -> str:
         if os.path.isdir(path):
             if os.path.isdir(prev):
                 shutil.rmtree(prev)
-            os.replace(path, prev)
+            os.replace(path, prev)  # storage: checkpoint
             moved_aside = True
-        os.replace(staging, path)
+        os.replace(staging, path)  # storage: checkpoint
     except BaseException:
         # if the old checkpoint was already moved aside and the final
         # publish failed, put it back — a failed save must never leave
@@ -245,7 +245,7 @@ def save_model(stage: PipelineStage, path: str) -> str:
             and not os.path.isdir(path)
             and os.path.isdir(prev)
         ):
-            os.replace(prev, path)
+            os.replace(prev, path)  # storage: checkpoint
         if os.path.isdir(staging):
             shutil.rmtree(staging, ignore_errors=True)
         raise

@@ -289,6 +289,48 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         type=COUNTER, labels=(),
         help="Spans evicted from the trace ring buffer.",
     ),
+    # -- the durable-storage survival plane (resilience/storage, r17) --------
+    "sntc_disk_bytes": dict(
+        type=GAUGE, labels=("artifact", "tenant"),
+        help="On-disk bytes per registered durable artifact under a "
+        "checkpoint root (artifact=total is the whole tree).",
+    ),
+    "sntc_disk_files": dict(
+        type=GAUGE, labels=("artifact", "tenant"),
+        help="On-disk file count per registered durable artifact "
+        "(artifact=total is the whole tree).",
+    ),
+    "sntc_disk_budget_bytes": dict(
+        type=GAUGE, labels=("tenant",),
+        help="Declared disk byte budget for a checkpoint root "
+        "(global when unlabeled, per-tenant when labeled).",
+    ),
+    "sntc_storage_write_errors_total": dict(
+        type=COUNTER, labels=("artifact", "tenant"),
+        help="Failed durable writes (ENOSPC/EIO, real or injected), "
+        "by artifact.",
+    ),
+    "sntc_storage_degraded_state": dict(
+        type=GAUGE, labels=("artifact", "tenant"),
+        help="1 while an artifact is in a storage_degraded episode "
+        "(records buffering in memory), 0 after recovery.",
+    ),
+    "sntc_storage_repairs_total": dict(
+        type=COUNTER, labels=("artifact", "tenant"),
+        help="Automatic storage repairs (torn-tail truncations, "
+        "corrupt-blob quarantines), journaled to "
+        "storage_repair.jsonl.",
+    ),
+    "sntc_dead_letter_dropped_total": dict(
+        type=COUNTER, labels=("artifact", "tenant"),
+        help="Dead-letter evidence files dropped by the keep-N/"
+        "size-cap retention policy.",
+    ),
+    "sntc_wal_compactions_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Append-WAL compactions (sealed checkpoint written, "
+        "offsets/commits logs truncated).",
+    ),
 }
 
 _OVERFLOW_KEY: Tuple[Tuple[str, str], ...] = (("overflow", "true"),)
@@ -562,7 +604,7 @@ class MetricsRegistry:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             f.write(self.to_prometheus())
-        os.replace(tmp, path)
+        os.replace(tmp, path)  # storage: telemetry
         return path
 
     def write_jsonl(self, path: str) -> Dict[str, Any]:
@@ -576,7 +618,7 @@ class MetricsRegistry:
         }
         self._jsonl_records += 1
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path, "a") as f:
+        with open(path, "a") as f:  # storage: unbounded(caller-owned JSONL export path)
             f.write(json.dumps(record) + "\n")
         return record
 

@@ -181,7 +181,7 @@ class SpanTracer:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f)
-        os.replace(tmp, path)
+        os.replace(tmp, path)  # storage: telemetry
         return path
 
 

@@ -13,8 +13,10 @@ from sntc_tpu.resilience.circuit import (
 from sntc_tpu.resilience.faults import (
     ALL_KINDS,
     DATA_KINDS,
+    IO_KINDS,
     KILL_EXIT_CODE,
     SITES,
+    InjectedDiskFault,
     InjectedFault,
     InjectedIOFault,
     InjectedTimeoutFault,
@@ -24,6 +26,7 @@ from sntc_tpu.resilience.faults import (
     data_fault_armed,
     disarm,
     fault_data,
+    fault_disk,
     fault_point,
     parse_faults_env,
 )
@@ -60,6 +63,7 @@ __all__ = [
     "clear_events",
     "fault_point",
     "fault_data",
+    "fault_disk",
     "data_fault_armed",
     "arm",
     "disarm",
@@ -69,9 +73,11 @@ __all__ = [
     "InjectedFault",
     "InjectedIOFault",
     "InjectedTimeoutFault",
+    "InjectedDiskFault",
     "SITES",
     "ALL_KINDS",
     "DATA_KINDS",
+    "IO_KINDS",
     "KILL_EXIT_CODE",
     "CircuitBreaker",
     "CircuitOpenError",

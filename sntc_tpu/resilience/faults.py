@@ -35,6 +35,17 @@ Wired sites:
 ``ctl.apply``           ``serve.ServeController`` inside every live knob
                         setter, after the decision cleared the guardrails
                         and before the knob actually moves
+``storage.wal``         physical WAL writes (append-log lines, files-mode
+                        intent/commit json, compaction checkpoints) — a
+                        :func:`fault_disk` site taking the IO kinds
+``storage.journal``     every JSONL journal append (shed / controller /
+                        promotion / dead-letter / repair journals)
+``storage.dead_letter`` dead-letter evidence dumps (poison-batch CSVs,
+                        row-level reject journals)
+``storage.marker``      atomic marker/status writes (drain marker, health
+                        dumps, model marker, metrics snapshots)
+``storage.state``       flow-state snapshot blob writes (the physical
+                        side of ``flow.state_snapshot``)
 ======================  =====================================================
 
 Env grammar (comma-separated specs)::
@@ -80,6 +91,17 @@ class InjectedTimeoutFault(InjectedFault, TimeoutError):
     pass
 
 
+class InjectedDiskFault(InjectedIOFault):
+    """An injected *disk* failure (r17): an OSError whose ``errno`` is
+    the real ENOSPC/EIO code, so ``except OSError`` handlers and
+    errno-keyed failure policies treat it exactly like the genuine
+    article."""
+
+    def __init__(self, errno_code: int, msg: str):
+        super().__init__(errno_code, msg)
+        self.errno = errno_code
+
+
 _KINDS = {
     "exc": InjectedFault,
     "io": InjectedIOFault,
@@ -103,10 +125,22 @@ KILL_EXIT_CODE = 137
 # ``fault_point`` site is inert, and vice versa.
 DATA_KINDS = ("corrupt_bytes", "truncate", "ragged")
 
+# IO/disk kinds (r17): the storage survival plane's fault vocabulary.
+# ``enospc`` and ``io_error`` raise :class:`InjectedDiskFault` — an
+# OSError carrying the real errno (ENOSPC / EIO) — at any armed
+# :func:`fault_point` OR :func:`fault_disk` site, modeling a full or
+# failing disk at a durable write boundary.  ``torn_write`` only fires
+# at :func:`fault_disk` sites (the storage plane's physical write
+# helpers): the helper writes a seeded PREFIX of the payload, flushes
+# it, and then raises — exactly what a crash mid-``write(2)`` leaves
+# behind, so torn-tail repair paths are exercisable without a real
+# kill.  ``torn_write`` armed at a plain ``fault_point`` is inert.
+IO_KINDS = ("enospc", "io_error", "torn_write")
+
 #: every kind the SNTC_FAULTS grammar accepts (docs/RESILIENCE.md keeps
 #: a matching marker-delimited table; scripts/check_fault_sites.py
 #: fails tier-1 when the two drift)
-ALL_KINDS = tuple(sorted(_KINDS)) + (KILL_KIND,) + DATA_KINDS
+ALL_KINDS = tuple(sorted(_KINDS)) + (KILL_KIND,) + DATA_KINDS + IO_KINDS
 
 # the documented wired sites (arming others is allowed — custom call
 # sites can declare their own — but a typo'd WIRED site should be loud)
@@ -127,6 +161,16 @@ SITES = (
     "flow.evict",
     "flow.state_snapshot",
     "ctl.apply",
+    # durable-storage survival plane (r17): the PHYSICAL write
+    # boundaries behind the logical protocol sites above — one
+    # fault_disk site per durable artifact class, so an ENOSPC sweep
+    # can hit every byte that reaches disk (docs/RESILIENCE.md
+    # "Durable storage lifecycle" maps artifact -> site -> policy)
+    "storage.wal",
+    "storage.journal",
+    "storage.dead_letter",
+    "storage.marker",
+    "storage.state",
 )
 
 
@@ -335,7 +379,7 @@ def fault_point(site: str, tenant: Optional[str] = None) -> None:
         spec = _registry.get(f"tenant/{tenant}/{site}")
     if spec is None:
         spec = _registry.get(site)
-    if spec is None or spec.kind in DATA_KINDS:
+    if spec is None or spec.kind in DATA_KINDS or spec.kind == "torn_write":
         return
     site = spec.site  # event/error name the ARMED site (namespaced)
     with _lock:
@@ -350,9 +394,55 @@ def fault_point(site: str, tenant: Optional[str] = None) -> None:
             # hard crash, not an exception: no finally blocks, no WAL
             # flushes, no atexit — what a SIGKILL/OOM/preemption does
             os._exit(KILL_EXIT_CODE)
+        if spec.kind in ("enospc", "io_error"):
+            raise _disk_fault(spec.kind, site, call)
         raise _KINDS[spec.kind](
             f"injected {spec.kind} fault at site {site!r} (call {call})"
         )
+
+
+def _disk_fault(kind: str, site: str, call: int) -> "InjectedDiskFault":
+    import errno as _errno
+
+    code = _errno.ENOSPC if kind == "enospc" else _errno.EIO
+    return InjectedDiskFault(
+        code,
+        f"injected {kind} fault at site {site!r} (call {call})",
+    )
+
+
+def fault_disk(site: str, tenant: Optional[str] = None) -> Optional[float]:
+    """The physical-write hook the storage plane's helpers call before
+    bytes reach disk (``storage.*`` sites).  Unarmed — or armed with a
+    non-IO kind — it returns None.  Armed with ``enospc``/``io_error``
+    it raises :class:`InjectedDiskFault` (nothing was written, the
+    full-disk shape).  Armed with ``torn_write`` it returns a seeded
+    fraction in (0, 1): the CALLER writes that prefix of its payload,
+    flushes it, and raises — so the injected failure leaves exactly the
+    torn tail a crash mid-``write(2)`` would, for the repair paths to
+    find.  Same tenant-namespaced lookup as :func:`fault_point`."""
+    _sync_env()
+    spec = None
+    if tenant is not None:
+        spec = _registry.get(f"tenant/{tenant}/{site}")
+    if spec is None:
+        spec = _registry.get(site)
+    if spec is None or spec.kind not in IO_KINDS:
+        return None
+    site = spec.site
+    with _lock:
+        fire = spec.decide()
+        call = spec.calls
+        torn = float(spec.rng.uniform(0.2, 0.8)) if fire else 0.0
+    if not fire:
+        return None
+    _count_injection(site, spec.kind)
+    emit_event(
+        event="fault_injected", site=site, kind=spec.kind, call=call
+    )
+    if spec.kind == "torn_write":
+        return torn
+    raise _disk_fault(spec.kind, site, call)
 
 
 def _mutate(kind: str, data: bytes, draws: "np.ndarray") -> bytes:

@@ -86,6 +86,13 @@ _EVENT_STATES: Dict[str, HealthState] = {
     "model_swapped": HealthState.OK,
     "model_rollback": HealthState.DEGRADED,
     "lifecycle_error": HealthState.DEGRADED,
+    # durable-storage survival plane (r17): a journal/marker that
+    # cannot write degrades (records buffer in memory, counted) and
+    # recovers when the disk does; a breached disk budget is the same
+    # operator-visible DEGRADED until usage falls back under it
+    "storage_degraded": HealthState.DEGRADED,
+    "storage_recovered": HealthState.OK,
+    "disk_budget_exceeded": HealthState.DEGRADED,
 }
 
 

@@ -175,7 +175,7 @@ def emit_event(**fields: Any) -> Dict[str, Any]:
             record["mono"] = time.monotonic()
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            with open(path, "a") as f:
+            with open(path, "a") as f:  # storage: unbounded(opt-in debug event log)
                 f.write(json.dumps(record) + "\n")
         if len(_recent) == _recent.maxlen:
             _events_dropped += 1

@@ -44,6 +44,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from sntc_tpu.resilience import storage as storage_plane
 from sntc_tpu.resilience.circuit import CircuitBreaker, breakers_snapshot
 from sntc_tpu.resilience.health import HealthMonitor, HealthState
 from sntc_tpu.resilience.policy import emit_event, events_dropped
@@ -53,12 +54,15 @@ DRAIN_MARKER = "drain_marker.json"
 
 def _atomic_json(path: str, obj: Dict[str, Any], **dump_kwargs: Any) -> str:
     """Write ``obj`` as JSON via tmp-then-rename: readers never see a
-    torn file (the drain marker and health dump both promise this)."""
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(obj, f, **dump_kwargs)
-    os.replace(tmp, path)
+    torn file (the drain marker and health dump both promise this).
+    Routed through the storage plane's marker writer (r17): the
+    ``storage.marker`` fault site injects disk failures here, and the
+    failure policy is DEGRADE — a status dump that cannot write counts
+    a ``storage_degraded`` episode instead of killing the loop it
+    reports on."""
+    storage_plane.write_marker(
+        path, obj, indent=dump_kwargs.get("indent"), fsync=False,
+    )
     return path
 
 
@@ -87,6 +91,7 @@ class QuerySupervisor:
         clock=time.monotonic,
         slo=None,
         controller_policy=None,
+        disk_budget_mb: Optional[float] = None,
     ):
         if max_pending_batches is not None and max_pending_batches < 1:
             raise ValueError("max_pending_batches must be >= 1 (or None)")
@@ -112,6 +117,18 @@ class QuerySupervisor:
         self.shed_total_offsets = 0
         self.batches_done = 0
         self.drained = False
+        # durable-storage accounting (r17): per-tick throttled disk
+        # measurement of the engine's checkpoint root into the
+        # sntc_disk_* gauges, with an optional byte budget whose breach
+        # emits disk_budget_exceeded (DEGRADED) — the "storage" block
+        # of status()/--health-json
+        self.storage = storage_plane.StoragePlane(
+            query.checkpoint_dir,
+            budget_bytes=(
+                int(disk_budget_mb * (1 << 20))
+                if disk_budget_mb else None
+            ),
+        )
         # closed-loop SLO control (r16): a declared SloPolicy arms a
         # ServeController over this one engine — it steers
         # pipeline_depth / shape_buckets / the shed knob and owns the
@@ -356,6 +373,14 @@ class QuerySupervisor:
             "drain_requested": self.drain_requested,
             "drained": self.drained,
         }
+        # durable-storage lifecycle evidence (r17): engine-side bound
+        # config + compaction/rotation counters, plus the throttled
+        # disk-usage measurement and budget verdict for the root
+        engine_storage = getattr(q, "storage_stats", None)
+        out["storage"] = dict(
+            engine_storage() if engine_storage is not None else {},
+            disk=self.storage.status(),
+        )
         # closed-loop SLO control evidence (r16): declared setpoints,
         # per-axis compliance, and the controller's knob/decision state
         if self.controller is not None:

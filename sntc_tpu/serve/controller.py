@@ -255,6 +255,7 @@ class ServeController:
     ):
         self.policy = policy or ControlPolicy()
         self.journal_path = journal_path
+        self._journal_writer = None
         self.interval_ticks = max(1, int(interval_ticks))
         self.ingest = bool(ingest)
         self.budget = budget
@@ -512,13 +513,19 @@ class ServeController:
     def _append_journal(self, rec: dict) -> None:
         if self.journal_path is None:
             return
-        d = os.path.dirname(self.journal_path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        # one write call per record: a kill can lose the tail line,
-        # never tear one (the restart reconciliation reads the tail)
-        with open(self.journal_path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+        # rotating size-capped writer with the DEGRADE policy (r17):
+        # one write call per record — a kill can lose the tail line,
+        # never tear one (the restart reconciliation reads the tail,
+        # and the writer rolls a torn partial line back out); a disk
+        # failure buffers the record behind a counted storage_degraded
+        # episode instead of killing the control loop
+        if self._journal_writer is None:
+            from sntc_tpu.resilience.storage import RotatingJsonlWriter
+
+            self._journal_writer = RotatingJsonlWriter(
+                self.journal_path, artifact="controller_journal",
+            )
+        self._journal_writer.write(rec)
 
     def _reconcile_journal(self) -> None:
         """On construction over an existing journal: log the delta
@@ -528,18 +535,24 @@ class ServeController:
         if not path or not os.path.exists(path):
             return
         last, torn = None, 0
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    torn += 1
-                    continue
-                if rec.get("knobs"):
-                    last = rec
+        # oldest rotated segment first (the journal rotates at a size
+        # cap, r17): the knob tail may live in the CURRENT segment's
+        # predecessor when a rotation landed just before the crash
+        for seg in (f"{path}.2", f"{path}.1", path):
+            if not os.path.exists(seg):
+                continue
+            with open(seg) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        torn += 1
+                        continue
+                    if rec.get("knobs"):
+                        last = rec
         live = self.knob_values()
         journal_knobs = last.get("knobs") if last else None
         rec = {

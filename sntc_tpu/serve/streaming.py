@@ -38,6 +38,7 @@ from sntc_tpu.resilience import (
     fault_point,
     with_retries,
 )
+from sntc_tpu.resilience import storage as storage_plane
 from sntc_tpu.serve.transform import BatchPredictor
 from sntc_tpu.utils.profiling import TransferLedger, ledger_scope
 
@@ -468,26 +469,47 @@ class CsvDirSink(StreamSink):
             c for c in frame.columns if frame[c].ndim == 1
         ]
         # atomic tmp-then-rename: a crash (or injected fault) mid-write
-        # leaves no torn batch_*.csv for downstream readers to ingest
+        # leaves no torn batch_*.csv for downstream readers to ingest.
+        # The sink output is the PRODUCT, not a lifecycle-managed
+        # artifact — it grows with the data served, by design.
         final = os.path.join(self.path, f"batch_{batch_id:06d}.csv")
         tmp = final + ".tmp"
-        pacsv.write_csv(frame.select(cols).to_arrow(), tmp)
-        if self.durable:
-            fd = os.open(tmp, os.O_RDONLY)
+        try:
+            pacsv.write_csv(frame.select(cols).to_arrow(), tmp)
+            if self.durable:
+                fd = os.open(tmp, os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+            os.replace(tmp, final)  # storage: unbounded(sink output)
+            if self.durable:
+                # the rename is only durable once the DIRECTORY entry is
+                # on disk — without this, power loss after commit can
+                # lose the published file entirely (data fsynced, dirent
+                # not)
+                dfd = os.open(self.path, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+        except OSError as e:
+            # partial-write attribution (r17, the PR-5 parser-error
+            # discipline applied to sinks): name the file and how many
+            # bytes landed before the failure, so an ENOSPC/EIO names
+            # WHERE the disk died instead of a bare errno
+            written = 0
             try:
-                os.fsync(fd)
-            finally:
-                os.close(fd)
-        os.replace(tmp, final)
-        if self.durable:
-            # the rename is only durable once the DIRECTORY entry is on
-            # disk — without this, power loss after commit can lose the
-            # published file entirely (data fsynced, dirent not)
-            dfd = os.open(self.path, os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
+                written = os.path.getsize(tmp)
+            except OSError:
+                pass
+            raise OSError(
+                e.errno,
+                f"sink write for batch {batch_id} failed at {tmp} "
+                f"({written} bytes written, {frame.num_rows} rows): "
+                f"{e.strerror or e}",
+                tmp,
+            ) from e
 
 
 class ConsoleSink(StreamSink):
@@ -598,6 +620,9 @@ class StreamingQuery:
         lifecycle=None,
         tenant: Optional[str] = None,
         autotuner=None,
+        wal_compact_every: int = 256,
+        wal_keep_commits: int = 64,
+        dead_letter_keep: int = 200,
     ):
         # a pre-built BatchPredictor passes through unchanged (its own
         # bucket config wins — bench warmup shares one predictor across
@@ -714,6 +739,11 @@ class StreamingQuery:
         # loop stays alive — instead of hammering a dead dependency
         self.breakers: dict = dict(breakers or {})
         self._batch_failures: dict = {}
+        # batches whose quarantine evidence is already journaled but
+        # whose COMMIT deferred (transient WAL failure): the next round
+        # must not re-quarantine them — duplicate dead-letter records
+        # and a second quarantine event (= a second tenant strike)
+        self._quarantined_ids: set = set()
         self._in_flight: List[tuple] = []
         self._sample_next: Optional[int] = None  # stride for next intent
         self._stopped = False
@@ -725,6 +755,28 @@ class StreamingQuery:
         if wal_mode not in ("files", "append"):
             raise ValueError("wal_mode must be 'files' or 'append'")
         self.wal_mode = wal_mode
+        # durable-storage lifecycle (r17): every artifact under this
+        # checkpoint dir is BOUNDED — the append WAL compacts into a
+        # sealed checkpoint every ``wal_compact_every`` commits, the
+        # files-mode WAL prunes committed intent/commit pairs beyond
+        # ``wal_keep_commits``, journals rotate at a size cap, and the
+        # dead-letter dirs keep the newest ``dead_letter_keep`` batch
+        # dumps.  0 disables the respective bound (the pre-r17
+        # grow-forever behavior, for equivalence tests).
+        self.wal_compact_every = max(0, int(wal_compact_every))
+        self.wal_keep_commits = max(0, int(wal_keep_commits))
+        self.dead_letter_keep = max(0, int(dead_letter_keep))
+        self._commits_since_compact = 0
+        self.wal_compactions = 0
+        self.wal_prunes = 0
+        self._shed_writer = None
+        self._dead_letter_writer = None
+        # the light construction-time doctor: repair torn journal tails
+        # and sweep tmp orphans a previous crash left (never fatal; the
+        # WAL's own torn-tail repair lives in its reader below)
+        self.storage_scan = storage_plane.quick_scan(
+            checkpoint_dir, tenant=tenant
+        )
         self._offsets_dir = os.path.join(checkpoint_dir, "offsets")
         self._commits_dir = os.path.join(checkpoint_dir, "commits")
         if wal_mode == "append":
@@ -739,6 +791,8 @@ class StreamingQuery:
             # durability)
             self._last_committed = self._scan_last_committed()
             self._end_offset = self._read_committed_end(self._last_committed)
+            ids = self._log_ids(self._commits_dir)
+            self._prune_cursor = ids[0] if ids else 0
         self._next_start = self._end_offset
         # stateful sources (sntc_tpu/flow): rewind operator state to
         # the snapshot matching the recovered committed offset BEFORE
@@ -752,7 +806,24 @@ class StreamingQuery:
         commits) with a single flushed append write per batch — the
         high-throughput WAL.  Same recovery contract as the per-file
         format (uncommitted logged intents replay on restart); the two
-        formats are per-checkpoint-dir exclusive."""
+        formats are per-checkpoint-dir exclusive.
+
+        **Torn-tail repair (r17):** a crash mid-append leaves a partial
+        final line; recovery tolerates exactly that shape — the torn
+        tail is truncated out with a journaled repair record
+        (``storage_repair.jsonl``) instead of crashing the restart with
+        a ``JSONDecodeError``.  A torn intent is a batch that was never
+        fully planned (it replans); a torn commit is a batch whose
+        commit never landed (it replays; the sink dedupes) — both are
+        the crash contract the WAL already promises.
+
+        **Compaction (r17):** recovery is ``wal_checkpoint.json`` (a
+        sealed summary of everything the logs said at the last
+        compaction: last committed batch, end offset, pending intents)
+        plus the log TAILS written since.  Records the checkpoint
+        already covers replay idempotently, so a crash between the
+        checkpoint publish and the log truncation recovers identically.
+        """
         if os.path.isdir(self._offsets_dir) or os.path.isdir(
             self._commits_dir
         ):
@@ -763,27 +834,42 @@ class StreamingQuery:
         os.makedirs(checkpoint_dir, exist_ok=True)
         offsets_path = os.path.join(checkpoint_dir, "offsets.log")
         commits_path = os.path.join(checkpoint_dir, "commits.log")
+        self._wal_ckpt_path = os.path.join(
+            checkpoint_dir, "wal_checkpoint.json"
+        )
+        base_last, base_end = -1, 0
+        pending: dict = {}
+        if os.path.exists(self._wal_ckpt_path):
+            core = storage_plane.load_sealed_json(self._wal_ckpt_path)
+            base_last = int(core["last_committed"])
+            base_end = int(core["end"])
+            pending = {
+                int(k): v for k, v in core.get("pending", {}).items()
+            }
 
         def read_log(path):
-            if not os.path.exists(path):
-                return {}
-            out = {}
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if line:
-                        rec = json.loads(line)
-                        out[int(rec["batch_id"])] = rec
-            return out
+            records, _repair = storage_plane.read_jsonl_tolerant(
+                path, repair=True, artifact="wal_append",
+                tenant=self.tenant, repair_dir=checkpoint_dir,
+            )
+            return {int(rec["batch_id"]): rec for rec in records}
 
-        self._pending_intents = read_log(offsets_path)
+        for bid, rec in read_log(offsets_path).items():
+            pending[bid] = rec
         commits = read_log(commits_path)
-        self._last_committed = max(commits) if commits else -1
-        self._end_offset = (
-            commits[self._last_committed]["end"] if commits else 0
-        )
-        self._offsets_log = open(offsets_path, "a")
-        self._commits_log = open(commits_path, "a")
+        if commits and max(commits) > base_last:
+            base_last = max(commits)
+            base_end = commits[base_last]["end"]
+        self._last_committed = base_last
+        self._end_offset = base_end
+        # intents at/below the committed horizon are history, not
+        # replay work — keeping them would only grow memory with uptime
+        self._pending_intents = {
+            bid: rec for bid, rec in pending.items()
+            if bid > self._last_committed
+        }
+        self._offsets_log = open(offsets_path, "a")  # storage: wal_append
+        self._commits_log = open(commits_path, "a")  # storage: wal_append
 
     # -- checkpoint bookkeeping -------------------------------------------
 
@@ -795,7 +881,25 @@ class StreamingQuery:
 
     def _scan_last_committed(self) -> int:
         ids = self._log_ids(self._commits_dir)
-        return ids[-1] if ids else -1
+        while ids:
+            last = ids[-1]
+            path = os.path.join(self._commits_dir, f"{last}.json")
+            try:
+                with open(path) as f:
+                    json.load(f)
+                return last
+            except ValueError:
+                # a torn commit record is a commit that never fully
+                # landed: quarantine the evidence and fall back to the
+                # previous one — the batch replays, the sink dedupes
+                # (the crash contract, applied at recovery time)
+                storage_plane.quarantine_blob(
+                    path, artifact="wal_files",
+                    detail="torn commit record at recovery",
+                    root=self.checkpoint_dir, tenant=self.tenant,
+                )
+                ids.pop()
+        return -1
 
     def _read_committed_end(self, last: int) -> int:
         if last < 0:
@@ -819,31 +923,145 @@ class StreamingQuery:
             return self._pending_intents.get(batch_id)
         path = os.path.join(self._offsets_dir, f"{batch_id}.json")
         if os.path.exists(path):
-            with open(path) as f:
-                return json.load(f)
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except ValueError:
+                # a torn intent record is a batch that was never fully
+                # planned: it replans from scratch, exactly as if the
+                # crash had landed one instruction earlier
+                return None
         return None
+
+    def _append_log(self, attr: str, name: str):
+        """The live append-WAL handle, reopened lazily ("a", never
+        truncating) if a failed compaction left it closed — a sick disk
+        degrades compaction, it must not strand the WAL behind a dead
+        handle forever."""
+        f = getattr(self, attr)
+        if f is None or f.closed:
+            f = open(  # storage: wal_append
+                os.path.join(self.checkpoint_dir, name), "a"
+            )
+            setattr(self, attr, f)
+        return f
 
     def _wal_intent(self, batch_id: int, intent: dict) -> None:
         if self.wal_mode == "append":
-            self._offsets_log.write(json.dumps(intent) + "\n")
-            self._offsets_log.flush()
+            # the PHYSICAL write boundary: storage.wal injects
+            # enospc/io_error/torn_write here; policy FAIL — the error
+            # propagates into the dispatch loop's per-batch failure
+            # machinery (retry next round, quarantine at the threshold)
+            storage_plane.append_line(
+                self._append_log("_offsets_log", "offsets.log"),
+                json.dumps(intent) + "\n",
+                site="storage.wal", tenant=self.tenant,
+            )
             self._pending_intents[batch_id] = intent
         else:
-            with open(
-                os.path.join(self._offsets_dir, f"{batch_id}.json"), "w"
-            ) as f:
-                json.dump(intent, f)
+            storage_plane.atomic_write_json(
+                os.path.join(self._offsets_dir, f"{batch_id}.json"),
+                intent, site="storage.wal", tenant=self.tenant,
+                fsync=False,
+            )
 
     def _wal_commit(self, batch_id: int, intent: dict) -> None:
         if self.wal_mode == "append":
-            self._commits_log.write(json.dumps(intent) + "\n")
-            self._commits_log.flush()
+            storage_plane.append_line(
+                self._append_log("_commits_log", "commits.log"),
+                json.dumps(intent) + "\n",
+                site="storage.wal", tenant=self.tenant,
+            )
             self._pending_intents.pop(batch_id, None)
+            # the caller's bookkeeping (_last_committed/_end_offset)
+            # updates after this write returns — pass the just-committed
+            # state explicitly so the sealed checkpoint can never trail
+            # the log it replaces
+            self._maybe_compact_wal(batch_id, intent["end"])
         else:
-            with open(
-                os.path.join(self._commits_dir, f"{batch_id}.json"), "w"
-            ) as f:
-                json.dump(intent, f)
+            storage_plane.atomic_write_json(
+                os.path.join(self._commits_dir, f"{batch_id}.json"),
+                intent, site="storage.wal", tenant=self.tenant,
+                fsync=False,
+            )
+            self._prune_files_wal(batch_id)
+
+    # -- WAL lifecycle (r17): compaction / pruning ---------------------------
+
+    def _maybe_compact_wal(self, last_committed: int, end: int) -> None:
+        """Append-mode compaction: every ``wal_compact_every`` commits,
+        seal the recovered-state summary (last committed batch, end
+        offset, pending intents) into an atomic ``wal_checkpoint.json``
+        and truncate both logs — replay becomes checkpoint + tail, and
+        the log footprint is bounded by the compaction interval instead
+        of the query's lifetime.  A compaction that cannot write
+        DEGRADES (counted, the logs simply keep growing until the disk
+        recovers) — bounding storage must never lose the WAL."""
+        if self.wal_compact_every <= 0:
+            return
+        self._commits_since_compact += 1
+        if self._commits_since_compact < self.wal_compact_every:
+            return
+        core = {
+            "version": 1,
+            "last_committed": last_committed,
+            "end": end,
+            "pending": {
+                str(bid): rec
+                for bid, rec in self._pending_intents.items()
+            },
+        }
+        try:
+            storage_plane.atomic_write_json(
+                self._wal_ckpt_path, storage_plane.seal_record(core),
+                site="storage.wal", tenant=self.tenant,
+            )
+            # the checkpoint is durable: the logs' history is now
+            # redundant.  A crash between here and the truncations
+            # replays the tails over the checkpoint idempotently — and
+            # so does a PARTIAL truncation (one log reopened, the other
+            # failed): records the checkpoint covers replay as no-ops.
+            # A failed reopen leaves the handle closed; the next write
+            # reopens it lazily in append mode (_append_log), so a sick
+            # disk degrades compaction without stranding the WAL.
+            for attr, name in (
+                ("_offsets_log", "offsets.log"),
+                ("_commits_log", "commits.log"),
+            ):
+                getattr(self, attr).close()
+                setattr(self, attr, open(  # storage: wal_append
+                    os.path.join(self.checkpoint_dir, name), "w"
+                ))
+        except OSError as e:
+            storage_plane.note_write_error(
+                "wal_append", self._wal_ckpt_path, e, tenant=self.tenant
+            )
+            return
+        storage_plane.note_write_ok("wal_append", tenant=self.tenant)
+        self._commits_since_compact = 0
+        self.wal_compactions += 1
+        labels = {} if self.tenant is None else {"tenant": self.tenant}
+        inc("sntc_wal_compactions_total", **labels)
+
+    def _prune_files_wal(self, batch_id: int) -> None:
+        """Files-mode retention: committed intent/commit PAIRS below
+        the ``wal_keep_commits`` horizon are deleted (one pair per
+        commit in steady state — O(1)).  Uncommitted intents are above
+        the horizon by construction (every batch id at or below
+        ``last committed - keep`` has a commit record), so replay
+        evidence is never pruned."""
+        if self.wal_keep_commits <= 0:
+            return
+        horizon = batch_id - self.wal_keep_commits
+        while self._prune_cursor <= horizon:
+            bid = self._prune_cursor
+            for d in (self._offsets_dir, self._commits_dir):
+                try:
+                    os.unlink(os.path.join(d, f"{bid}.json"))
+                    self.wal_prunes += 1
+                except OSError:
+                    pass
+            self._prune_cursor += 1
 
     # -- engine ------------------------------------------------------------
 
@@ -878,12 +1096,32 @@ class StreamingQuery:
                 intent["end"] = latest
                 intent["sample_stride"] = self._sample_next
                 self._sample_next = None
-            # kill point pre-WAL: a crash here leaves NO intent — the
-            # restarted query plans the batch fresh (chaos matrix row 1)
-            fault_point("stream.wal", tenant=self.tenant)
-            # intent WAL before any processing (OffsetSeqLog)
-            with span("stream.wal", batch=batch_id):
-                self._wal_intent(batch_id, intent)
+            try:
+                # kill point pre-WAL: a crash here leaves NO intent —
+                # the restarted query plans the batch fresh (chaos
+                # matrix row 1)
+                fault_point("stream.wal", tenant=self.tenant)
+                # intent WAL before any processing (OffsetSeqLog)
+                with span("stream.wal", batch=batch_id):
+                    self._wal_intent(batch_id, intent)
+            except Exception as e:
+                # WAL failure policy (r17): FAIL into the existing
+                # per-batch machinery — an unwritable intent defers the
+                # batch (retry next round; transient ENOSPC recovers)
+                # and quarantines at the threshold.  Unarmed engines
+                # keep the r5 single-shot raise.
+                fails = self._bump_failures(batch_id, "stream.wal")
+                if self.max_batch_failures is None:
+                    raise
+                if fails < self.max_batch_failures or self._in_flight:
+                    return False
+                self._quarantine(batch_id, intent, None, e,
+                                 site="stream.wal")
+                self._commit_batch(batch_id, intent, n_rows=0,
+                                   t0=time.perf_counter(),
+                                   quarantined=True)
+                self._next_start = max(self._next_start, intent["end"])
+                return True
 
         # stage the FOLLOWING range before this batch's read blocks: the
         # prefetch thread parses batch N+1 while this round waits on
@@ -1092,15 +1330,33 @@ class StreamingQuery:
                 raise exc  # quarantine unarmed: r5 single-shot semantics
             if fails < self.max_batch_failures:
                 return False  # stays queued; retried next round
-            self._quarantine(batch_id, intent, frame, exc,
-                             site="sink.write")
+            if batch_id not in self._quarantined_ids:
+                self._quarantine(batch_id, intent, frame, exc,
+                                 site="sink.write")
+                self._quarantined_ids.add(batch_id)
             quarantined = True
         else:
             if breaker is not None:
                 breaker.record_success()
+        try:
+            self._commit_batch(batch_id, intent, n_rows=n_rows, t0=t0,
+                               quarantined=quarantined)
+        except Exception as ce:
+            # WAL-commit failure policy (r17): the sink already has the
+            # batch, only the commit record is missing — defer (the
+            # batch stays queued; next round re-delivers and the sink
+            # dedupes, then retries the commit) below the threshold.
+            # Persistent commit failure raises: exactly-once cannot
+            # survive a WAL that never writes again.
+            fails = self._bump_failures(batch_id, "stream.commit")
+            if (
+                self.max_batch_failures is None
+                or fails >= self.max_batch_failures
+            ):
+                raise ce
+            return False
         self._in_flight.pop(0)
-        self._commit_batch(batch_id, intent, n_rows=n_rows, t0=t0,
-                           quarantined=quarantined)
+        self._quarantined_ids.discard(batch_id)
         self._delivered_batches += 1
         if not quarantined and self.lifecycle is not None:
             # drift scoring / shadow promotion observe the committed
@@ -1331,6 +1587,7 @@ class StreamingQuery:
         admission = self.admission_stats()
         if admission is not None:
             stats["admission"] = admission
+        stats["storage"] = self.storage_stats()
         if self.lifecycle is not None:
             lc_stats = getattr(self.lifecycle, "stats", None)
             stats["lifecycle"] = dict(
@@ -1338,6 +1595,37 @@ class StreamingQuery:
                 models_swapped=self.models_swapped,
             )
         return stats
+
+    def storage_stats(self) -> dict:
+        """Durable-storage lifecycle evidence for THIS engine's
+        checkpoint dir: WAL bound config + compaction/prune counters,
+        journal-writer health, and the construction-time scan verdict.
+        The supervisor/daemon ``storage`` status block layers the
+        disk-usage measurements (``StoragePlane``) on top."""
+        out = {
+            "wal_mode": self.wal_mode,
+            "wal_compact_every": self.wal_compact_every,
+            "wal_keep_commits": self.wal_keep_commits,
+            "dead_letter_keep": self.dead_letter_keep,
+            "wal_compactions": self.wal_compactions,
+            "wal_prunes": self.wal_prunes,
+        }
+        for name, writer in (
+            ("shed_journal", self._shed_writer),
+            ("dead_letter_journal", self._dead_letter_writer),
+        ):
+            if writer is not None:
+                out[name] = writer.stats()
+        if self.storage_scan is not None and (
+            self.storage_scan["repaired"]
+            or self.storage_scan["errors"]
+            or self.storage_scan["cleaned"]
+        ):
+            out["startup_scan"] = {
+                k: self.storage_scan[k]
+                for k in ("repaired", "errors", "cleaned")
+            }
+        return out
 
     def _commit_batch(self, batch_id: int, intent: dict, *, n_rows: int,
                       t0: float, quarantined: bool) -> None:
@@ -1457,11 +1745,28 @@ class StreamingQuery:
             records = [
                 r for r in prior if _key(r) not in fresh
             ] + records
-        tmp = final + ".tmp"
-        with open(tmp, "w") as f:
-            for rec in records:
-                f.write(json.dumps(rec) + "\n")
-        os.replace(tmp, final)  # atomic + idempotent on WAL replay
+        try:
+            # atomic + idempotent on WAL replay; the storage.dead_letter
+            # site injects disk faults here, and the failure policy is
+            # SHED: evidence that cannot be journaled is counted and
+            # dropped — it must never fail the batch it describes
+            storage_plane.atomic_write_bytes(
+                final,
+                "".join(json.dumps(rec) + "\n" for rec in records).encode(),
+                site="storage.dead_letter", tenant=self.tenant,
+                fsync=False,
+            )
+        except OSError as e:
+            storage_plane.note_write_error(
+                "dead_letter_rows", final, e, tenant=self.tenant,
+            )
+            return
+        storage_plane.note_write_ok("dead_letter_rows", tenant=self.tenant)
+        if self.dead_letter_keep > 0:
+            storage_plane.prune_dir_keep_newest(
+                self.row_dead_letter_dir, self.dead_letter_keep,
+                artifact="dead_letter_rows", tenant=self.tenant,
+            )
         if not first_journal:
             return
         self._rows_rejected_total += len(records)
@@ -1520,10 +1825,23 @@ class StreamingQuery:
                 record["rows_file"] = f"batch_{batch_id:06d}.csv"
             except Exception as dump_err:
                 record["dump_error"] = repr(dump_err)
-        with open(
-            os.path.join(self.dead_letter_dir, "dead_letter.jsonl"), "a"
-        ) as f:
-            f.write(json.dumps(record) + "\n")
+        # the record journal rotates at a size cap and DEGRADES on disk
+        # failure (buffered in memory, flushed on recovery) — losing a
+        # quarantine record must never kill the quarantine itself
+        if self._dead_letter_writer is None:
+            self._dead_letter_writer = storage_plane.RotatingJsonlWriter(
+                os.path.join(self.dead_letter_dir, "dead_letter.jsonl"),
+                artifact="dead_letter", tenant=self.tenant,
+            )
+        self._dead_letter_writer.write(record)
+        if self.dead_letter_keep > 0:
+            storage_plane.prune_dir_keep_newest(
+                self.dead_letter_dir, self.dead_letter_keep,
+                artifact="dead_letter", tenant=self.tenant,
+                protect=tuple(
+                    f"dead_letter.jsonl{s}" for s in ("", ".1", ".2")
+                ),
+            )
         self._emit(
             event="quarantine", site=self._sites.get(site, site),
             batch_id=batch_id, error=repr(exc),
@@ -1688,11 +2006,15 @@ class StreamingQuery:
                 offsets_shed=0,
             )
             self._sample_next = stride
-        os.makedirs(self.checkpoint_dir, exist_ok=True)
-        with open(
-            os.path.join(self.checkpoint_dir, "shed.jsonl"), "a"
-        ) as f:
-            f.write(json.dumps(record) + "\n")
+        # rotating + DEGRADE policy (r17): a shed decision that cannot
+        # journal still sheds — the record buffers and flushes when the
+        # disk recovers, behind a counted storage_degraded episode
+        if self._shed_writer is None:
+            self._shed_writer = storage_plane.RotatingJsonlWriter(
+                os.path.join(self.checkpoint_dir, "shed.jsonl"),
+                artifact="shed_journal", tenant=self.tenant,
+            )
+        self._shed_writer.write(record)
         self._emit(
             event="load_shed", site=self._sites["stream.read"],
             policy=policy,

@@ -68,6 +68,7 @@ from sntc_tpu.resilience import (
     events_dropped,
     reset_breakers,
 )
+from sntc_tpu.resilience import storage as _storage
 from sntc_tpu.resilience.health import HealthMonitor
 from sntc_tpu.resilience.policy import RetryPolicy
 from sntc_tpu.serve.streaming import (
@@ -156,6 +157,12 @@ class TenantSpec:
     slo_p99_ms: Optional[float] = None
     slo_min_rows_per_sec: Optional[float] = None
     slo_max_shed_rate: Optional[float] = None
+    # durable-storage budget (r17): a per-tenant cap on the bytes this
+    # tenant's checkpoint tree (tenant/<id>/) may hold — measured into
+    # sntc_disk_bytes{tenant=<id>} by the daemon's StoragePlane; a
+    # breach emits disk_budget_exceeded + DEGRADED health for the
+    # tenant.  None/0 = unbudgeted.
+    disk_budget_mb: Optional[float] = None
 
     def __post_init__(self):
         if not self.tenant_id or "/" in self.tenant_id:
@@ -192,7 +199,7 @@ class TenantSpec:
         # negative values — and a shed-rate bound over 1.0 — are typos,
         # not contracts, and must be loud
         for f in ("slo_p99_ms", "slo_min_rows_per_sec",
-                  "slo_max_shed_rate"):
+                  "slo_max_shed_rate", "disk_budget_mb"):
             v = getattr(self, f)
             if v is None:
                 continue
@@ -378,6 +385,8 @@ class ServeDaemon:
         tuning_budget=None,
         controller: bool = False,
         controller_policy=None,
+        disk_budget_mb: Optional[float] = None,
+        dead_letter_keep: int = 200,
     ):
         if not specs:
             raise ValueError("ServeDaemon needs at least one TenantSpec")
@@ -389,6 +398,7 @@ class ServeDaemon:
         self.pipeline_depth = max(1, int(pipeline_depth))
         self.quantum = float(quantum)
         self.health_json = health_json
+        self.dead_letter_keep = max(0, int(dead_letter_keep))
         # observability (r13): when set, every scheduling round also
         # atomically republishes the registry's Prometheus text here —
         # per-tenant series (rows/batches/deficit/state/transfers) are
@@ -419,6 +429,29 @@ class ServeDaemon:
             from sntc_tpu.resilience.control import TuningBudget
 
             self.tuning_budget = TuningBudget.default_for(len(specs))
+        # durable-storage accounting (r17): one StoragePlane over the
+        # whole daemon root (global budget from the flag) plus one per
+        # tenant subtree (budget from TenantSpec.disk_budget_mb) — the
+        # sntc_disk_* gauges and the status()["storage"] block.  The
+        # tree walks are throttled inside the planes.
+        self.storage = _storage.StoragePlane(
+            root_dir,
+            budget_bytes=(
+                int(disk_budget_mb * (1 << 20)) if disk_budget_mb
+                else None
+            ),
+        )
+        self._tenant_storage: Dict[str, _storage.StoragePlane] = {
+            s.tenant_id: _storage.StoragePlane(
+                self.tenant_dir(s.tenant_id),
+                tenant=s.tenant_id,
+                budget_bytes=(
+                    int(s.disk_budget_mb * (1 << 20))
+                    if s.disk_budget_mb else None
+                ),
+            )
+            for s in specs
+        }
         self._owns_health = health is None
         self.health = health or HealthMonitor(clock=clock).attach()
         # shared program cache: one BatchPredictor per distinct model —
@@ -563,6 +596,7 @@ class ServeDaemon:
             row_policy=spec.row_policy,
             tenant=spec.tenant_id,
             autotuner=autotuner,
+            dead_letter_keep=self.dead_letter_keep,
         )
         return TenantStream(spec, query, self._clock)
 
@@ -799,6 +833,13 @@ class ServeDaemon:
                     emit_event(
                         event="controller_error", error=repr(e)
                     )
+        # disk accounting + budget verdicts once per round (the planes
+        # throttle the actual tree walks): a tenant over its declared
+        # byte budget gets a disk_budget_exceeded event → DEGRADED
+        # health under its own namespace, never a neighbor's
+        self.storage.check_budget()
+        for plane in self._tenant_storage.values():
+            plane.check_budget()
         if self.health_json:
             _atomic_json(self.health_json, self.status())
         if self.metrics_out:
@@ -1072,6 +1113,20 @@ class ServeDaemon:
             "events_dropped_by_tenant": events_dropped(by_tenant=True),
             "drain_requested": self.drain_requested,
             "drained": self.drained,
+            # durable-storage lifecycle (r17): whole-root accounting +
+            # per-tenant subtree accounting/budgets, plus each engine's
+            # WAL/journal bound counters
+            "storage": {
+                "global": self.storage.status(),
+                "tenants": {
+                    tid: plane.status()
+                    for tid, plane in self._tenant_storage.items()
+                },
+                "engines": {
+                    t.spec.tenant_id: t.query.storage_stats()
+                    for t in self.tenants
+                },
+            },
         }
 
     def close(self) -> None:

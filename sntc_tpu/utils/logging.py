@@ -36,7 +36,7 @@ class MetricsLogger:
         }
         self._step += 1
         if self.path:
-            with open(self.path, "a") as f:
+            with open(self.path, "a") as f:  # storage: unbounded(caller-owned log path)
                 f.write(json.dumps(record) + "\n")
         return record
 

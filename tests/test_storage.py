@@ -1,0 +1,740 @@
+"""Durable-storage survival plane (r17): torn-tail WAL repair,
+rotating journals, append-WAL compaction + files-WAL pruning with
+restart equivalence, dead-letter retention, the ENOSPC/io_error sweep
+over every registered durable write site, the fsck doctor, disk
+accounting/budgets, and the durable-artifact drift check.  Chaos
+(kill-mid-append via torn_write at storage.wal) rides the crash
+matrix script, driven here in tier-1."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import sntc_tpu.resilience as R
+from sntc_tpu.core.base import Transformer
+from sntc_tpu.core.frame import Frame
+from sntc_tpu.data.schema import ColumnSpec, SchemaContract
+from sntc_tpu.obs.metrics import registry
+from sntc_tpu.resilience import (
+    InjectedDiskFault,
+    QuerySupervisor,
+    RetryPolicy,
+    storage,
+)
+from sntc_tpu.serve import CsvDirSink, MemorySink, MemorySource, StreamingQuery
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    R.clear()
+    R.clear_events()
+    R.reset_breakers()
+    storage.reset_degradation()
+    yield
+    R.clear()
+    R.clear_events()
+    R.reset_breakers()
+    storage.reset_degradation()
+
+
+def _get(name, **labels):
+    return registry().get(name, **labels) or 0
+
+
+class _Identity(Transformer):
+    def transform(self, frame):
+        return frame
+
+
+def _frames(n, rows=6):
+    return [
+        Frame({"x": np.arange(rows, dtype=np.float64) + 100 * b})
+        for b in range(n)
+    ]
+
+
+def _engine(tmp, name, frames, **kwargs):
+    sink = MemorySink()
+    q = StreamingQuery(
+        _Identity(), MemorySource(frames), sink,
+        os.path.join(str(tmp), name), max_batch_offsets=1, **kwargs,
+    )
+    return q, sink
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: append-WAL torn-tail repair (the JSONDecodeError regression)
+# ---------------------------------------------------------------------------
+
+
+def test_append_wal_torn_tail_repaired_on_recovery(tmp_path):
+    """A crash mid-append leaves a partial final line in offsets.log;
+    construction used to die with JSONDecodeError — now it truncates
+    the torn tail, journals the repair, and replays what is whole."""
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    intent0 = {"batch_id": 0, "start": 0, "end": 1}
+    with open(ckpt / "offsets.log", "w") as f:
+        f.write(json.dumps(intent0) + "\n")
+        f.write('{"batch_id": 1, "sta')  # torn mid-append
+    q, sink = _engine(
+        tmp_path, "ckpt", _frames(2), wal_mode="append",
+    )
+    # the whole intent replays; the torn one is gone
+    assert q._pending_intents == {0: intent0}
+    with open(ckpt / "offsets.log") as f:
+        assert f.read() == json.dumps(intent0) + "\n"
+    repairs = [
+        json.loads(line)
+        for line in open(ckpt / "storage_repair.jsonl")
+    ]
+    assert repairs and repairs[0]["action"] == "truncate_torn_tail"
+    assert repairs[0]["path"].endswith("offsets.log")
+    assert _get("sntc_storage_repairs_total", artifact="wal_append") >= 1
+    # and the engine serves normally from the repaired state
+    assert q.process_available() == 2
+    q.stop()
+
+
+def test_append_wal_torn_commit_tail_replays_batch(tmp_path):
+    """A torn commits.log tail = a commit that never landed: the batch
+    replays (exactly-once comes from the sink dedupe, as in a crash)."""
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    intent = {"batch_id": 0, "start": 0, "end": 1}
+    with open(ckpt / "offsets.log", "w") as f:
+        f.write(json.dumps(intent) + "\n")
+    with open(ckpt / "commits.log", "w") as f:
+        f.write('{"batch_id": 0, "end"')  # commit tore mid-append
+    q, sink = _engine(tmp_path, "ckpt", _frames(1), wal_mode="append")
+    assert q.last_committed() == -1  # torn commit reads as absent
+    assert q.process_available() == 1
+    assert q.last_committed() == 0
+    q.stop()
+
+
+def test_mid_file_wal_corruption_is_loud(tmp_path):
+    """Damage that is NOT the crash shape (a bad line with real records
+    after it) must raise, not silently elide history."""
+    path = tmp_path / "commits.log"
+    with open(path, "w") as f:
+        f.write('{"batch_id": 0, "end": 1}\n')
+        f.write("GARBAGE\n")
+        f.write('{"batch_id": 2, "end": 3}\n')
+    with pytest.raises(storage.JsonlCorruptError, match="line 2"):
+        storage.read_jsonl_tolerant(str(path), repair=True)
+
+
+def test_files_wal_torn_records_tolerated(tmp_path):
+    """Files mode: a torn commit record at recovery quarantines (the
+    batch replays); a torn intent record reads as absent (replans)."""
+    q, _ = _engine(tmp_path, "ckpt", _frames(3))
+    assert q.process_available() == 3
+    q.stop()
+    ckpt = tmp_path / "ckpt"
+    with open(ckpt / "commits" / "2.json", "w") as f:
+        f.write('{"batch_id": 2, "e')  # torn
+    q2, sink2 = _engine(tmp_path, "ckpt", _frames(3))
+    assert q2.last_committed() == 1  # fell back past the torn record
+    assert os.path.exists(ckpt / "commits" / ".corrupt" / "2.json")
+    assert q2.process_available() == 1  # batch 2 replays
+    assert q2.last_committed() == 2
+    q2.stop()
+
+
+# ---------------------------------------------------------------------------
+# RotatingJsonlWriter: caps, rotation, degrade/recover
+# ---------------------------------------------------------------------------
+
+
+def test_rotating_writer_bounds_footprint(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    w = storage.RotatingJsonlWriter(path, max_bytes=400, keep=2)
+    for i in range(200):
+        assert w.write({"i": i, "pad": "x" * 20})
+    files = sorted(os.listdir(tmp_path))
+    assert files == ["j.jsonl", "j.jsonl.1", "j.jsonl.2"]
+    for name in files:
+        assert os.path.getsize(tmp_path / name) <= 400 + 64
+    # newest record is present in the live segment
+    last = [json.loads(line) for line in open(path)][-1]
+    assert last["i"] == 199
+    assert w.stats()["rotations"] > 0
+
+
+def test_rotating_writer_degrades_and_recovers(tmp_path):
+    from sntc_tpu.resilience import HealthMonitor, HealthState
+
+    h = HealthMonitor().attach()
+    try:
+        w = storage.RotatingJsonlWriter(str(tmp_path / "j.jsonl"))
+        R.arm("storage.journal", kind="enospc", times=2)
+        assert w.write({"i": 0}) is False
+        assert w.write({"i": 1}) is False
+        assert h.state_of("storage.shed_journal") == HealthState.DEGRADED
+        assert _get(
+            "sntc_storage_write_errors_total", artifact="shed_journal"
+        ) >= 2
+        # disk recovers: the buffered backlog flushes IN ORDER first
+        assert w.write({"i": 2}) is True
+        assert [r["i"] for r in map(
+            json.loads, open(tmp_path / "j.jsonl")
+        )] == [0, 1, 2]
+        assert h.state_of("storage.shed_journal") == HealthState.OK
+        events = [e["event"] for e in R.recent_events()]
+        assert events.count("storage_degraded") == 1  # once per episode
+        assert "storage_recovered" in events
+    finally:
+        h.close()
+
+
+def test_rotating_writer_torn_write_rolls_back(tmp_path):
+    """A torn journal append must not leave a partial line that
+    corrupts the middle of the file once later appends land."""
+    w = storage.RotatingJsonlWriter(str(tmp_path / "j.jsonl"))
+    R.arm("storage.journal", kind="torn_write", times=1)
+    assert w.write({"x": "y" * 200}) is False
+    assert w.write({"z": 1}) is True
+    records = [json.loads(line) for line in open(tmp_path / "j.jsonl")]
+    assert records == [{"x": "y" * 200}, {"z": 1}]
+
+
+# ---------------------------------------------------------------------------
+# satellite 4: restart equivalence — replay after compaction/rotation is
+# bitwise-identical to replay from the uncompacted log
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("wal_mode,bounded_kwargs,unbounded_kwargs", [
+    ("append", dict(wal_compact_every=3), dict(wal_compact_every=0)),
+    ("files", dict(wal_keep_commits=4), dict(wal_keep_commits=0)),
+])
+def test_restart_equivalence_bounded_vs_unbounded_wal(
+    tmp_path, wal_mode, bounded_kwargs, unbounded_kwargs,
+):
+    frames = _frames(11)
+    more = frames + _frames(5, rows=4)
+    results = {}
+    for name, kwargs in (
+        ("bounded", bounded_kwargs), ("unbounded", unbounded_kwargs),
+    ):
+        q, _ = _engine(
+            tmp_path, name, frames, wal_mode=wal_mode, **kwargs
+        )
+        assert q.process_available() == 11
+        q.stop()
+        # a fresh engine on the same checkpoint + 5 more source frames:
+        # recovery state and continued output must be IDENTICAL whether
+        # the history was compacted/pruned or kept whole
+        q2, sink2 = _engine(
+            tmp_path, name, more, wal_mode=wal_mode, **kwargs
+        )
+        recovered = (q2.last_committed(), q2.committed_end())
+        assert q2.process_available() == 5
+        out = [
+            (bid, {c: f[c].tolist() for c in f.columns})
+            for bid, f in sink2.batches
+        ]
+        q2.stop()
+        results[name] = (recovered, out)
+    assert results["bounded"] == results["unbounded"]
+    if wal_mode == "append":
+        # the bound actually bit: a sealed checkpoint exists and the
+        # live logs hold only the tail
+        ckpt = tmp_path / "bounded"
+        core = storage.load_sealed_json(
+            str(ckpt / "wal_checkpoint.json")
+        )
+        assert core["last_committed"] >= 11
+        n_lines = sum(
+            1 for line in open(ckpt / "commits.log") if line.strip()
+        )
+        assert n_lines < 4
+    else:
+        kept = os.listdir(tmp_path / "bounded" / "commits")
+        assert len(kept) <= 5  # keep=4 (+ the one just landed)
+        full = os.listdir(tmp_path / "unbounded" / "commits")
+        assert len(full) == 16
+
+
+def test_flow_state_store_retention_equivalence(tmp_path):
+    """Restore from the keep-2 pruned store equals restore from an
+    unpruned one, byte for byte."""
+    from sntc_tpu.flow.state import FlowStateStore
+
+    payloads = {
+        end: (b"state-%d" % end) * 17 for end in (2, 4, 6, 8, 10)
+    }
+    pruned = FlowStateStore(str(tmp_path / "pruned"), keep=2)
+    full = FlowStateStore(str(tmp_path / "full"), keep=5)
+    for end, payload in payloads.items():
+        pruned.publish(end, payload)
+        full.publish(end, payload)
+    assert pruned.ends() == [8, 10]
+    for end in pruned.ends():
+        assert pruned.load(end) == full.load(end) == payloads[end]
+
+
+# ---------------------------------------------------------------------------
+# dead-letter retention (keep-N + counted drops)
+# ---------------------------------------------------------------------------
+
+
+def test_dead_letter_retention_bounds_and_counts(tmp_path):
+    class FailSink:
+        def add_batch(self, batch_id, frame):
+            raise IOError(f"sink down for {batch_id}")
+
+    q = StreamingQuery(
+        _Identity(), MemorySource(_frames(6)), FailSink(),
+        str(tmp_path / "ckpt"), max_batch_offsets=1,
+        max_batch_failures=1, dead_letter_keep=3,
+    )
+    assert q.process_available() == 6  # all quarantined, all committed
+    q.stop()
+    dl = tmp_path / "ckpt" / "dead_letter"
+    csvs = [n for n in os.listdir(dl) if n.endswith(".csv")]
+    assert len(csvs) == 3  # newest three kept
+    assert sorted(csvs)[-1] == "batch_000005.csv"
+    # the record journal survives retention (protected) and holds all 6
+    records = [
+        json.loads(line) for line in open(dl / "dead_letter.jsonl")
+    ]
+    assert len(records) == 6
+    assert _get(
+        "sntc_dead_letter_dropped_total", artifact="dead_letter"
+    ) >= 3
+    events = [e for e in R.recent_events()
+              if e["event"] == "dead_letter_dropped"]
+    assert events and events[-1]["keep"] == 3
+
+
+# ---------------------------------------------------------------------------
+# acceptance: ENOSPC / io_error at every registered durable write site —
+# follow the declared policy, never die, drain clean
+# ---------------------------------------------------------------------------
+
+
+ENGINE_SWEEP_SITES = (
+    "stream.wal", "stream.commit", "sink.write",
+    "storage.wal", "storage.journal", "storage.dead_letter",
+)
+
+
+@pytest.mark.parametrize("kind", ["enospc", "io_error"])
+@pytest.mark.parametrize("site", ENGINE_SWEEP_SITES)
+def test_disk_fault_sweep_engine_survives(tmp_path, site, kind):
+    """Transient disk failure at each engine-reachable durable write
+    site: the armed engine (retry + quarantine + salvage admission +
+    shed) must keep serving, follow the artifact's declared policy,
+    and drain with zero exceptions.  Deferred rounds re-run via
+    repeated process_available calls — each call is one poll tick."""
+    contract = SchemaContract(
+        {"x": ColumnSpec(dtype="float64", allow_nan=False)},
+        mode="salvage",
+    )
+    frames = _frames(6)
+    # one poison row so the row-level dead-letter path genuinely writes
+    frames[2]["x"][1] = np.nan
+    q = StreamingQuery(
+        _Identity(), MemorySource(frames),
+        CsvDirSink(str(tmp_path / "out"), durable=False),
+        str(tmp_path / "ckpt"), max_batch_offsets=1,
+        wal_mode="append", wal_compact_every=2,
+        retry_policy=RetryPolicy(
+            max_attempts=2, base_delay_s=0.0, jitter=0.0
+        ),
+        max_batch_failures=3,
+        schema_contract=contract,
+    )
+    R.arm(site, kind=kind, times=2)
+    expected_last = 5
+    if site == "storage.journal":
+        # the shed journal is this site's durable write: shed under the
+        # fault — the DECISION must stand even though the record could
+        # only buffer (degrade, not die)
+        record = q.shed_backlog(2, policy="oldest", latest=6)
+        assert record is not None and record["offsets_shed"] == 4
+        expected_last = 1  # 2 surviving offsets -> 2 one-frame batches
+    for _ in range(12):  # deferred rounds retry, one per call
+        q.process_available()
+        if q.last_committed() == expected_last:
+            break
+    assert q.last_committed() == expected_last
+    assert q.in_flight_count() == 0
+    q.stop()
+    assert R.call_count(site) > 0  # the site was actually exercised
+    if site == "storage.journal":
+        assert _get(
+            "sntc_storage_write_errors_total", artifact="shed_journal"
+        ) >= 1
+    if site == "storage.dead_letter":
+        assert _get(
+            "sntc_storage_write_errors_total",
+            artifact="dead_letter_rows",
+        ) >= 1
+
+
+def test_disk_fault_marker_degrades_supervisor(tmp_path):
+    """storage.marker faults: health dumps + drain marker degrade
+    (counted) and the supervised drain still exits clean."""
+    q, _ = _engine(tmp_path, "ckpt", _frames(3))
+    sup = QuerySupervisor(
+        q, health_json=str(tmp_path / "ckpt" / "health.json")
+    )
+    try:
+        R.arm("storage.marker", kind="enospc", times=10)
+        sup.tick()
+        assert not os.path.exists(tmp_path / "ckpt" / "health.json")
+        assert _get(
+            "sntc_storage_write_errors_total", artifact="markers"
+        ) >= 1
+        R.clear()
+        status = sup.drain_now("test")
+        assert status["drained"] is True
+        assert os.path.exists(
+            tmp_path / "ckpt" / "drain_marker.json"
+        )
+    finally:
+        sup.close()
+
+
+def test_disk_fault_flow_snapshot_fails_loud(tmp_path):
+    """storage.state policy is FAIL: a snapshot publish under ENOSPC
+    raises (the engine's commit hook owns the retry), leaves no torn
+    blob behind, and the next publish succeeds."""
+    from sntc_tpu.flow.state import FlowStateStore
+
+    store = FlowStateStore(str(tmp_path / "fs"), keep=2)
+    R.arm("storage.state", kind="enospc", times=1)
+    with pytest.raises(OSError):
+        store.publish(4, b"payload")
+    assert store.ends() == []
+    store.publish(4, b"payload")
+    assert store.load(4) == b"payload"
+
+
+def test_enospc_is_a_real_oserror():
+    import errno
+
+    R.arm("stream.wal", kind="enospc", times=1)
+    with pytest.raises(OSError) as ei:
+        R.fault_point("stream.wal")
+    assert ei.value.errno == errno.ENOSPC
+    assert isinstance(ei.value, InjectedDiskFault)
+    # torn_write is inert at a plain fault_point site
+    R.arm("stream.wal", kind="torn_write", times=1)
+    R.fault_point("stream.wal")
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: sink / journal write errors carry path + offset context
+# ---------------------------------------------------------------------------
+
+
+def test_sink_write_error_names_file_and_bytes(tmp_path, monkeypatch):
+    sink = CsvDirSink(str(tmp_path / "out"), durable=False)
+    frame = _frames(1)[0]
+
+    def boom(src, dst):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError) as ei:
+        sink.add_batch(7, frame)
+    msg = str(ei.value)
+    assert "batch 7" in msg
+    assert "batch_000007.csv.tmp" in msg
+    assert "bytes written" in msg
+    assert ei.value.errno == 28
+
+
+def test_wal_append_error_names_file_and_offset(tmp_path):
+    q, _ = _engine(tmp_path, "ckpt", _frames(2), wal_mode="append")
+    assert q.process_available() == 2
+
+    class Dead:
+        name = str(tmp_path / "ckpt" / "offsets.log")
+
+        def tell(self):
+            return 123
+
+        def write(self, text):
+            raise OSError(5, "Input/output error")
+
+        def truncate(self, pos):
+            pass
+
+        def seek(self, pos):
+            pass
+
+    with pytest.raises(OSError) as ei:
+        storage.append_line(Dead(), '{"x": 1}\n', site="storage.wal")
+    assert "offsets.log" in str(ei.value)
+    assert "offset 123" in str(ei.value)
+    q.stop()
+
+
+# ---------------------------------------------------------------------------
+# fsck: the doctor
+# ---------------------------------------------------------------------------
+
+
+def _make_dirty_root(tmp_path):
+    """A checkpoint root with one of every kind of damage."""
+    root = tmp_path / "ckpt"
+    q, _ = _engine(tmp_path, "ckpt", _frames(4), wal_mode="append")
+    assert q.process_available() == 4
+    q.stop()
+    # torn journal tail
+    with open(root / "shed.jsonl", "w") as f:
+        f.write('{"ok": 1}\n{"torn')
+    # corrupt flow snapshot
+    from sntc_tpu.flow.state import FlowStateStore
+
+    store = FlowStateStore(str(root / "flow_state"), keep=2)
+    store.publish(2, b"good-state")
+    snap = store._file(2)
+    with open(snap, "r+b") as f:
+        f.seek(-3, os.SEEK_END)
+        f.write(b"XXX")
+    # corrupt marker + a tmp orphan
+    with open(root / "drain_marker.json", "w") as f:
+        f.write('{"half": ')
+    with open(root / "whatever.json.tmp-123", "w") as f:
+        f.write("orphan")
+    return root, snap
+
+
+def test_fsck_repairs_quarantines_and_reports(tmp_path):
+    root, snap = _make_dirty_root(tmp_path)
+    report = storage.fsck(str(root), repair=True)
+    assert report["ok"] is True
+    repaired = {r["path"] for r in report["repaired"]}
+    assert str(root / "shed.jsonl") in repaired
+    quarantined = {
+        (r["artifact"], os.path.basename(r["path"]))
+        for r in report["quarantined"]
+    }
+    assert ("flow_state", os.path.basename(snap)) in quarantined
+    assert ("markers", "drain_marker.json") in quarantined
+    assert os.path.exists(
+        root / "flow_state" / ".corrupt" / os.path.basename(snap)
+    )
+    assert report["cleaned"]  # tmp orphan swept
+    assert not os.path.exists(root / "whatever.json.tmp-123")
+    # the journal parses clean after repair; the repair journal records
+    # every action
+    records = [
+        json.loads(line) for line in open(root / "storage_repair.jsonl")
+    ]
+    actions = {r["action"] for r in records}
+    assert {"truncate_torn_tail", "quarantine_corrupt"} <= actions
+    # idempotent: a second pass finds a clean tree
+    again = storage.fsck(str(root), repair=True)
+    assert again["ok"] and not again["repaired"]
+    assert not again["quarantined"]
+
+
+def test_fsck_no_repair_reports_without_touching(tmp_path):
+    root, snap = _make_dirty_root(tmp_path)
+    report = storage.fsck(str(root), repair=False)
+    assert report["ok"] is False
+    assert report["errors"]
+    assert not report["repaired"] and not report["quarantined"]
+    assert os.path.exists(snap)  # nothing moved
+    with open(root / "shed.jsonl") as f:
+        assert f.read().endswith('{"torn')  # nothing truncated
+
+
+def test_fsck_corrupt_wal_checkpoint_is_unrepairable(tmp_path):
+    q, _ = _engine(
+        tmp_path, "ckpt", _frames(7), wal_mode="append",
+        wal_compact_every=2,
+    )
+    assert q.process_available() == 7
+    q.stop()
+    path = tmp_path / "ckpt" / "wal_checkpoint.json"
+    core = json.loads(open(path).read())
+    core["last_committed"] = 999  # forged without resealing
+    with open(path, "w") as f:
+        json.dump(core, f)
+    report = storage.fsck(str(tmp_path / "ckpt"), repair=True)
+    assert report["ok"] is False
+    assert any(
+        "sha256 mismatch" in e["detail"] for e in report["errors"]
+    )
+
+
+def test_fsck_tenant_tree_and_cli(tmp_path):
+    # daemon-shaped layout: a root plus two tenant checkpoints
+    root = tmp_path / "droot"
+    for tid in ("a", "b"):
+        q, _ = _engine(
+            root, os.path.join("tenant", tid, "ckpt"), _frames(2),
+        )
+        assert q.process_available() == 2
+        q.stop()
+    with open(root / "tenant" / "a" / "ckpt" / "shed.jsonl", "w") as f:
+        f.write('{"torn')
+    from sntc_tpu.app import main
+
+    rc = main([
+        "fsck", str(root), "--tenant-tree",
+        "--report", str(tmp_path / "report.json"),
+        "--platform", "cpu",
+    ])
+    assert rc == 0
+    report = json.loads(open(tmp_path / "report.json").read())
+    assert report["tenant_tree"] is True
+    assert report["ok"] is True
+    assert {r["tenant"] for r in report["roots"]} == {None, "a", "b"}
+    tenant_a = [r for r in report["roots"] if r["tenant"] == "a"][0]
+    assert tenant_a["repaired"]
+
+
+def test_engine_quick_scan_heals_journals(tmp_path):
+    """The construction-time auto-scan: a torn shed.jsonl tail from a
+    crashed run heals before the new engine serves."""
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    with open(ckpt / "shed.jsonl", "w") as f:
+        f.write('{"ok": 1}\n{"torn')
+    q, _ = _engine(tmp_path, "ckpt", _frames(1))
+    assert q.storage_scan is not None
+    assert q.storage_scan["repaired"]
+    with open(ckpt / "shed.jsonl") as f:
+        assert f.read() == '{"ok": 1}\n'
+    assert "startup_scan" in q.storage_stats()
+    q.stop()
+
+
+# ---------------------------------------------------------------------------
+# disk accounting, budgets, status blocks
+# ---------------------------------------------------------------------------
+
+
+def test_storage_plane_usage_and_budget(tmp_path):
+    q, _ = _engine(
+        tmp_path, "ckpt", _frames(5), wal_mode="append",
+    )
+    assert q.process_available() == 5
+    q.stop()
+    plane = storage.StoragePlane(
+        str(tmp_path / "ckpt"), budget_bytes=10, min_interval_s=0.0,
+    )
+    status = plane.status()
+    assert status["over_budget"] is True
+    assert status["total_bytes"] > 10
+    assert "wal_append" in status["artifacts"]
+    assert _get("sntc_disk_bytes", artifact="total") > 0
+    assert _get("sntc_disk_budget_bytes") == 10
+    events = [e["event"] for e in R.recent_events()]
+    assert events.count("disk_budget_exceeded") == 1
+    plane.status()  # same breach: no second event
+    events = [e["event"] for e in R.recent_events()]
+    assert events.count("disk_budget_exceeded") == 1
+    plane.budget_bytes = 10**9
+    assert plane.status()["over_budget"] is False
+
+
+def test_supervisor_status_carries_storage_block(tmp_path):
+    q, _ = _engine(
+        tmp_path, "ckpt", _frames(3), wal_mode="append",
+        wal_compact_every=2,
+    )
+    sup = QuerySupervisor(q, disk_budget_mb=1.0)
+    try:
+        sup.tick()
+        sup.tick()
+        sup.tick()
+        st = sup.status()["storage"]
+        assert st["wal_mode"] == "append"
+        assert st["wal_compactions"] >= 1
+        assert st["disk"]["budget_bytes"] == 1 << 20
+        assert st["disk"]["total_bytes"] > 0
+    finally:
+        sup.close()
+        q.stop()
+
+
+def test_daemon_status_carries_storage_block(tmp_path):
+    from sntc_tpu.serve.tenancy import ServeDaemon, TenantSpec
+
+    frames = _frames(2)
+    specs = [
+        TenantSpec(
+            tenant_id=tid, model=_Identity(),
+            source=MemorySource(list(frames)), sink=MemorySink(),
+            disk_budget_mb=0.000001 if tid == "a" else None,
+        )
+        for tid in ("a", "b")
+    ]
+    daemon = ServeDaemon(specs, str(tmp_path / "root"))
+    try:
+        daemon.process_available()
+        st = daemon.status()["storage"]
+        assert set(st["tenants"]) == {"a", "b"}
+        assert st["tenants"]["a"]["over_budget"] is True
+        assert st["tenants"]["b"]["budget_bytes"] is None
+        assert st["engines"]["a"]["wal_mode"] == "files"
+        assert st["global"]["total_bytes"] > 0
+        # the budget breach degraded ONLY tenant a's namespace
+        from sntc_tpu.resilience import HealthState
+
+        assert daemon.health.worst_under(
+            "tenant/a/"
+        ) == HealthState.DEGRADED
+        assert daemon.health.worst_under(
+            "tenant/b/"
+        ) == HealthState.OK
+    finally:
+        daemon.close()
+
+
+# ---------------------------------------------------------------------------
+# drift check + chaos wiring (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_check_durable_artifacts_consistent():
+    checker = _load_script("check_durable_artifacts")
+    assert checker.check() == []
+
+
+def test_chaos_wal_torn_scenarios(tmp_path):
+    """Kill-mid-append (the worker os._exits with half of batch 2's
+    intent / commit line flushed); the restart journals a
+    truncate_torn_tail repair record and reconverges committed state +
+    sink file CONTENTS bitwise with the uninterrupted compacting
+    reference."""
+    chaos = _load_script("chaos_crash_matrix")
+    ref = chaos.run_wal_reference(str(tmp_path))
+    for name, after in chaos.WAL_TORN_SCENARIOS:
+        verdict = chaos.run_wal_torn_scenario(
+            str(tmp_path), name, after, ref
+        )
+        assert verdict["ok"], verdict
+        assert verdict["torn_tail_on_disk"] and verdict["repair_journaled"]
+
+
+def test_chaos_disk_fault_drain(tmp_path):
+    """ENOSPC/EIO armed at every serve-reachable durable write site at
+    once: the supervised worker serves degraded and exits 0 on drain."""
+    chaos = _load_script("chaos_crash_matrix")
+    verdict = chaos.run_disk_fault_scenario(str(tmp_path))
+    assert verdict["ok"], verdict
