@@ -252,6 +252,7 @@ DEFAULT_ROWS = {
     "10": int(os.environ.get("BENCH_ROWS", 500_000)) // 4,
     "11": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
     "12": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
+    "13": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
 }
 
 
@@ -2331,6 +2332,227 @@ def bench_config12(n_rows, mesh):
     }
 
 
+# config 13: the mid-stream device-fault storm (r18).  The question:
+# does the compute-plane fault domain actually SURVIVE realistic device
+# failure — seeded OOM bursts, one poisoned compile signature, and a
+# device-lost/recover arc, all landing mid-stream — without losing or
+# duplicating a single batch, and what does degraded-mode serving cost?
+# Two arms serve the SAME file stream through identical fused+bucketed
+# predictors (domains armed on both; faults injected only in the storm
+# arm), phase by phase:
+#   A  OOM burst    — device.dispatch:device_oom seeded-probabilistic:
+#                     the splitter halves batches and retries on device
+#   B  poison       — fuse.compile:compile_error on a FRESH signature
+#                     (a new batch size): exactly one (segment,
+#                     signature) leaves the device plan cache; its
+#                     batches serve the eager host fallback
+#   C  lost/recover — device.dispatch:device_lost once: HOST_DEGRADED
+#                     serving (the degraded rows/s floor) until the
+#                     probe-gated recovery tick restores the device
+# Evidence: commits identical (zero lost/duplicated batches), sink
+# files byte-identical (the tolerance contract's bitwise half: the
+# sink carries the f64 prediction column), per-phase rows/s, the
+# degraded-mode floor, and the recovery latency — all journaled.
+BENCH13_PHASE_FILES = (6, 4, 6)
+BENCH13_CHUNK = (384, 700, 384)  # phase B's 700 is a FRESH bucket
+BENCH13_SHAPE_BUCKETS = 256
+
+
+def bench_config13(n_rows, mesh):
+    """Mid-stream device-fault storm vs an unfaulted reference
+    (docs/RESILIENCE.md "Compute-plane fault domain")."""
+    import shutil
+    import tempfile
+
+    import pyarrow.csv as pacsv
+
+    from sntc_tpu.core.base import Pipeline, PipelineModel
+    from sntc_tpu.data import CICIDS2017_FEATURES
+    from sntc_tpu.models import LogisticRegression
+    from sntc_tpu.resilience import (
+        DeviceFaultDomain,
+        DevicePolicy,
+        arm,
+        clear,
+    )
+    from sntc_tpu.serve import (
+        BatchPredictor,
+        CsvDirSink,
+        FileStreamSource,
+        StreamingQuery,
+        compile_serving,
+    )
+
+    train, test = _dataset(n_rows, binary=True)
+    # the config-6 fused pipeline (the scaler fold can't absorb the
+    # DCT/PCA run, so compile_serving yields a REAL fused segment —
+    # the fuse.compile boundary phase B poisons genuinely exists)
+    from sntc_tpu.feature import DCT, MinMaxScaler, PCA
+
+    pipe = Pipeline(stages=_feature_stages(mesh, with_scaler=False) + [
+        MinMaxScaler(inputCol="rawFeatures", outputCol="mm"),
+        DCT(inputCol="mm", outputCol="dct"),
+        PCA(mesh=mesh, inputCol="dct", outputCol="features",
+            k=BENCH6_PCA_K),
+        LogisticRegression(mesh=mesh, maxIter=20),
+    ]).fit(train)
+    serve_model = PipelineModel(stages=pipe.getStages()[1:])
+
+    tmp = tempfile.mkdtemp()
+    try:
+        watch = os.path.join(tmp, "in")
+        os.makedirs(watch)
+        arms = {}
+        for name in ("reference", "storm"):
+            # degrade_after=2: one isolated poisoned compile must NOT
+            # flip HOST_DEGRADED (the poison response absorbs it);
+            # device_lost degrades unconditionally
+            dom = DeviceFaultDomain(
+                DevicePolicy(probe_interval_s=0.0, degrade_after=2),
+                probe_fn=lambda: True, probe_async=False,
+            )
+            pred = BatchPredictor(
+                compile_serving(serve_model),
+                bucket_rows=BENCH13_SHAPE_BUCKETS, device_domain=dom,
+            )
+            q = StreamingQuery(
+                pred, FileStreamSource(watch),
+                CsvDirSink(os.path.join(tmp, f"out_{name}"),
+                           durable=False),
+                os.path.join(tmp, f"ckpt_{name}"),
+                max_batch_offsets=1, max_batch_failures=3,
+            )
+            arms[name] = {"q": q, "dom": dom, "pred": pred,
+                          "phase_s": [], "phase_rows": []}
+
+        # the storm arm's per-phase injections (programmatic arming:
+        # deterministic seeded schedules, exactly like the chaos tests)
+        storm_faults = (
+            lambda: arm("device.dispatch", "device_oom", prob=0.35,
+                        seed=7, times=None),
+            lambda: arm("fuse.compile", "compile_error", times=1),
+            lambda: arm("device.dispatch", "device_lost", times=1),
+        )
+        # one phase at a time: write the phase's files, arm the storm
+        # arm's fault, serve both arms to the new high-water mark —
+        # the faults land genuinely MID-STREAM, with committed batches
+        # already behind them
+        file_idx = 0
+        src_rows = 0
+        for phase, n_files in enumerate(BENCH13_PHASE_FILES):
+            size = BENCH13_CHUNK[phase]
+            lo = file_idx
+            for _ in range(n_files):
+                at = (file_idx * 131) % max(1, test.num_rows - size)
+                chunk = test.slice(at, at + size)
+                pacsv.write_csv(
+                    chunk.select(CICIDS2017_FEATURES).to_arrow(),
+                    os.path.join(watch, f"part_{file_idx:06d}.csv"),
+                )
+                src_rows += chunk.num_rows
+                file_idx += 1
+            hi = file_idx
+            for name, c in arms.items():
+                clear()
+                if name == "storm":
+                    storm_faults[phase]()
+                t0 = time.perf_counter()
+                # a deferred device-classified batch replays next round
+                for _ in range(12):
+                    c["q"].process_available()
+                    if c["q"].last_committed() + 1 >= hi:
+                        break
+                dt = time.perf_counter() - t0
+                clear()
+                rows = sum(
+                    p["numInputRows"]
+                    for p in c["q"].recentProgress[-(hi - lo):]
+                )
+                c["phase_s"].append(dt)
+                c["phase_rows"].append(rows)
+        # drive the recovery tick to completion on the storm arm (the
+        # sync probe recovers on the first post-fault round; phase C
+        # already served through it, so this is only a guard)
+        storm = arms["storm"]
+        for _ in range(3):
+            if not storm["dom"].host_degraded:
+                break
+            storm["dom"].tick()
+
+        def _commits(name):
+            d = os.path.join(tmp, f"ckpt_{name}", "commits")
+            return sorted(
+                os.path.basename(p) for p in glob.glob(
+                    os.path.join(d, "*.json"))
+            )
+
+        def _sink_bytes(name):
+            out = {}
+            for p in sorted(glob.glob(
+                os.path.join(tmp, f"out_{name}", "batch_*.csv")
+            )):
+                with open(p, "rb") as f:
+                    out[os.path.basename(p)] = f.read()
+            return out
+
+        commits_match = _commits("reference") == _commits("storm")
+        ref_sink, storm_sink = _sink_bytes("reference"), _sink_bytes(
+            "storm")
+        sink_match = ref_sink == storm_sink
+        dev = storm["dom"].stats()
+        ref = arms["reference"]
+        phases = []
+        for i, label in enumerate(("oom_burst", "poisoned_signature",
+                                   "device_lost_recover")):
+            phases.append({
+                "phase": label,
+                "files": BENCH13_PHASE_FILES[i],
+                "rows_per_s": round(
+                    storm["phase_rows"][i] / storm["phase_s"][i], 1
+                ),
+                "reference_rows_per_s": round(
+                    ref["phase_rows"][i] / ref["phase_s"][i], 1
+                ),
+            })
+        for name, c in arms.items():
+            c["q"].stop()
+        storm_evidence = {
+            "stream_files": file_idx,
+            "stream_rows": src_rows,
+            "zero_lost_or_duplicated": commits_match,
+            "sink_bitwise_match": sink_match,
+            "sink_files": len(storm_sink),
+            "phases": phases,
+            # the degraded-mode floor: phase C served HOST_DEGRADED
+            # until the probe-gated tick recovered the device
+            "degraded_rows_per_s_floor": phases[2]["rows_per_s"],
+            "degraded_over_reference": _round_ratio(
+                phases[2]["rows_per_s"]
+                / phases[2]["reference_rows_per_s"]
+            ),
+            "recovery_latency_s": dev["recovery_latency_s"],
+            "device": {
+                k: dev[k] for k in (
+                    "state", "faults", "oom_splits",
+                    "bucket_floor_steps", "poisoned_signatures",
+                    "fallback_batches", "degradations", "recoveries",
+                )
+            },
+        }
+        total_rows = sum(storm["phase_rows"])
+        total_s = sum(storm["phase_s"])
+    finally:
+        clear()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "metric": "cicids2017_device_storm_rows_per_s",
+        "_datasets": (train, test),
+        "value": round(total_rows / total_s, 1), "unit": "rows/s",
+        "quality": {"device_storm": storm_evidence},
+        "n_rows": total_rows,
+    }
+
+
 BENCHES = {
     "1": bench_config1,
     "2": bench_config2,
@@ -2344,6 +2566,7 @@ BENCHES = {
     "10": bench_config10,
     "11": bench_config11,
     "12": bench_config12,
+    "13": bench_config13,
 }
 
 
@@ -2937,6 +3160,9 @@ PROXIES = {
     # config 12 is the same serving job soaked over many cycles with
     # the storage lifecycle armed; the external anchor is unchanged
     "12": proxy_config5,
+    # config 13 is the same serving job with the device-fault storm
+    # landing mid-stream; the external anchor stays the config-5 proxy
+    "13": proxy_config5,
 }
 
 
@@ -3105,7 +3331,7 @@ def run_config(cfg: str, rows, pair: bool = True):
         # invocation, on the same train/test split — both sides of the
         # ratio see the same host state (VERDICT r4 item 2)
         proxy = PROXIES[cfg](train, test)
-        if cfg in ("5", "6", "7", "8", "9", "10", "11", "12"):
+        if cfg in ("5", "6", "7", "8", "9", "10", "11", "12", "13"):
             line["vs_baseline"] = _round_ratio(
                 result["value"] / proxy["rows_per_s"]
             )

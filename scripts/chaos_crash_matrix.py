@@ -131,6 +131,28 @@ CTL_KILL_SITES = ("ctl.apply",)
 CTL_NOISY_FILES = 12
 CTL_NOISY_POISON_EVERY = 3
 
+# compute-plane fault-domain scenarios (r18): a fused + shape-bucketed
+# engine (real LR pipeline through compile_serving, DeviceFaultDomain
+# armed) is killed at each DEVICE boundary and restarted clean; restart
+# must converge commits AND sink bytes BITWISE with an uninterrupted
+# reference.  The ``device.dispatch`` row is the KILL-MID-FALLBACK
+# scenario: the worker also arms ``fuse.compile:compile_error``
+# (unlimited), so every fused signature is poisoned and the stream is
+# serving through the eager host fallback when the kill lands — the
+# fallback path must hold the same crash contract as the device path
+# (and the fallback's sink bytes must equal the device reference's,
+# which is the bitwise half of the tolerance contract).
+DEVICE_KILL_SITES = ("device.dispatch", "predict.compile", "fuse.compile")
+DEVICE_KILL_AFTER = {
+    # dispatch fires once per batch: after=2 kills mid-stream on the
+    # 3rd batch, with committed fallback batches already behind it
+    "device.dispatch": 2,
+    # the compile sites fire on FRESH shapes/signatures only: kill on
+    # the first (batch 0's compile — nothing durable yet)
+    "predict.compile": 0,
+    "fuse.compile": 0,
+}
+
 # kill-mid-promotion points (r11): where the model-lifecycle promotion
 # protocol dies.  pre_publish = before anything reached disk (the
 # promotion is simply lost; the incumbent keeps serving); pre_swap =
@@ -514,6 +536,91 @@ def sink_contents(out_dir: str) -> dict:
         with open(p, "rb") as f:
             out[os.path.basename(p)] = f.read()
     return out
+
+
+def run_device_worker(
+    watch: str, out: str, ckpt: str, *, kill_site: str = "",
+    kill_after: int = 0, poison_fused: bool = False,
+    timeout: float = 120.0,
+) -> subprocess.CompletedProcess:
+    """One drain-and-exit pass of the fused/bucketed device-domain
+    engine in a child process (the r18 scenarios)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS="")
+    env.pop("SNTC_RESILIENCE_LOG", None)
+    cmd = [
+        sys.executable, SCRIPT, "--worker", "--device",
+        "--watch", watch, "--out", out, "--ckpt", ckpt,
+    ]
+    if kill_site:
+        cmd.extend(["--kill-site", kill_site,
+                    "--kill-after", str(kill_after)])
+    if poison_fused:
+        cmd.append("--poison-fused")
+    return subprocess.run(
+        cmd, env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+def run_device_reference(workdir: str) -> dict:
+    """Uninterrupted device-domain run (device path end to end) — the
+    convergence AND bitwise-tolerance baseline for every DEVICE kill
+    scenario."""
+    d = os.path.join(workdir, "device_reference")
+    watch = os.path.join(d, "in")
+    write_inputs(watch)
+    out, ckpt = os.path.join(d, "out"), os.path.join(d, "ckpt")
+    ref = run_device_worker(watch, out, ckpt)
+    if ref.returncode != 0:
+        raise RuntimeError(
+            f"device reference rc={ref.returncode}: {ref.stderr}"
+        )
+    return {
+        "commits": committed_state(ckpt),
+        "contents": sink_contents(out),
+    }
+
+
+def run_device_kill_scenario(
+    workdir: str, site: str, reference: dict,
+) -> dict:
+    """Kill the device-domain engine at ``site`` (mid-fallback for
+    ``device.dispatch`` — every fused signature poisoned first),
+    restart clean, require commits + sink BYTES identical to the
+    uninterrupted device-path reference."""
+    mid_fallback = site == "device.dispatch"
+    d = os.path.join(workdir, "device_" + site.replace(".", "_"))
+    watch = os.path.join(d, "in")
+    write_inputs(watch)
+    out, ckpt = os.path.join(d, "out"), os.path.join(d, "ckpt")
+    killed = run_device_worker(
+        watch, out, ckpt, kill_site=site,
+        kill_after=DEVICE_KILL_AFTER[site],
+        poison_fused=mid_fallback,
+    )
+    if killed.returncode != KILL_EXIT_CODE:
+        return {"site": site, "ok": False, "mid_fallback": mid_fallback,
+                "error": f"kill run rc={killed.returncode} (expected "
+                f"{KILL_EXIT_CODE}): {killed.stderr}"}
+    restarted = run_device_worker(watch, out, ckpt)
+    if restarted.returncode != 0:
+        return {"site": site, "ok": False, "mid_fallback": mid_fallback,
+                "error": f"restart rc={restarted.returncode}: "
+                f"{restarted.stderr}"}
+    got_commits = committed_state(ckpt)
+    got_contents = sink_contents(out)
+    ok = (
+        got_commits == reference["commits"]
+        and got_contents == reference["contents"]
+    )
+    return {
+        "site": site, "ok": ok, "mid_fallback": mid_fallback,
+        "commits": {str(k): v for k, v in got_commits.items()},
+        "expected_commits": {
+            str(k): v for k, v in reference["commits"].items()
+        },
+        "sink_bitwise": got_contents == reference["contents"],
+    }
 
 
 def run_flow_worker(
@@ -1038,6 +1145,11 @@ def run_matrix(workdir: str, pipelined: bool = False) -> dict:
         for name, after in WAL_TORN_SCENARIOS
     )
     results.append(run_disk_fault_scenario(workdir))
+    dev_ref = run_device_reference(workdir)
+    results.extend(
+        run_device_kill_scenario(workdir, s, dev_ref)
+        for s in DEVICE_KILL_SITES
+    )
     return {"ok": all(r["ok"] for r in results), "scenarios": results}
 
 
@@ -1305,6 +1417,73 @@ def flow_worker_main(args) -> int:
     return 0
 
 
+def _device_pipeline():
+    """A servable pipeline with a REAL fused segment (the assembler
+    stays eager by the single-upload rule; a DCT + const-class LR head
+    fuse into one jitted program) — the fuse.compile boundary genuinely
+    fires, unlike the assembler-only promotion pipeline."""
+    import numpy as np
+
+    from sntc_tpu.core.base import PipelineModel
+    from sntc_tpu.feature import VectorAssembler
+    from sntc_tpu.feature.dct import DCT
+    from sntc_tpu.models.logistic_regression import (
+        LogisticRegressionModel,
+    )
+
+    head = LogisticRegressionModel(
+        coefficient_matrix=np.zeros((2, 1), np.float32),
+        intercepts=np.asarray([0.0, -50.0], np.float32),
+        is_binomial=True,
+    )
+    head.setFeaturesCol("dct")
+    return PipelineModel(stages=[
+        VectorAssembler(inputCols=["x"], outputCol="features"),
+        DCT(inputCol="features", outputCol="dct"),
+        head,
+    ])
+
+
+def device_worker_main(args) -> int:
+    """Compute-plane scenario engine pass: the DCT+LR pipeline through
+    ``compile_serving`` (one fused segment), shape buckets, and a
+    DeviceFaultDomain on the predictor.  ``--poison-fused`` arms
+    ``fuse.compile:compile_error`` unlimited so every fused signature
+    poisons onto the eager host fallback (the kill then lands
+    MID-FALLBACK); ``--kill-site``/``--kill-after`` arm the Nth-call
+    kill programmatically."""
+    sys.path.insert(0, REPO)
+    from sntc_tpu.resilience import DeviceFaultDomain, DevicePolicy, arm
+    from sntc_tpu.serve import (
+        BatchPredictor,
+        CsvDirSink,
+        FileStreamSource,
+        StreamingQuery,
+        compile_serving,
+    )
+
+    if args.poison_fused:
+        arm("fuse.compile", kind="compile_error", times=None)
+    if args.kill_site:
+        arm(args.kill_site, kind="kill", after=args.kill_after, times=1)
+    dom = DeviceFaultDomain(
+        DevicePolicy(), probe_fn=lambda: True, probe_async=False,
+    )
+    pred = BatchPredictor(
+        compile_serving(_device_pipeline()),
+        bucket_rows=4, device_domain=dom,
+    )
+    q = StreamingQuery(
+        pred,
+        FileStreamSource(args.watch),
+        CsvDirSink(args.out, columns=["x", "prediction"]),
+        args.ckpt, max_batch_offsets=1,
+    )
+    n = q.process_available()
+    print(json.dumps({"batches": n, "device": dom.stats()}))
+    return 0
+
+
 def worker_main(args) -> int:
     sys.path.insert(0, REPO)
     from sntc_tpu.core.base import Transformer
@@ -1430,6 +1609,13 @@ def main(argv=None) -> int:
     ap.add_argument("--flow", action="store_true",
                     help="worker: raw-capture flow-window engine pass "
                     "(stateful-operator scenarios)")
+    ap.add_argument("--device", action="store_true",
+                    help="worker: fused/bucketed device-fault-domain "
+                    "engine pass (compute-plane scenarios)")
+    ap.add_argument("--poison-fused", action="store_true",
+                    help="worker: arm fuse.compile:compile_error "
+                    "unlimited so every fused signature serves the "
+                    "host fallback (kill-mid-fallback)")
     ap.add_argument("--setup-flow-inputs", action="store_true",
                     help="worker: write the flow scenarios' capture "
                     "stream and exit")
@@ -1470,6 +1656,8 @@ def main(argv=None) -> int:
             return setup_flow_inputs_main(args)
         if args.flow:
             return flow_worker_main(args)
+        if args.device:
+            return device_worker_main(args)
         if args.daemon:
             return daemon_worker_main(args)
         if args.model_dir:

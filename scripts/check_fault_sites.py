@@ -118,9 +118,11 @@ def check_kinds() -> list:
 
 _CHAOS = "scripts/chaos_crash_matrix.py"
 # the kill-site tuples the crash matrix drives; every stream.*/sink.*,
-# every flow.*, and every ctl.* site must appear in one of them
+# every flow.*, every ctl.*, every device.* site — and every *.compile
+# site (the r18 compute-plane boundaries) — must appear in one of them
 _CHAOS_TUPLE_RE = re.compile(
-    r"^(?:KILL_SITES|FLOW_KILL_SITES|CTL_KILL_SITES)\s*=\s*\(([^)]*)\)",
+    r"^(?:KILL_SITES|FLOW_KILL_SITES|CTL_KILL_SITES|DEVICE_KILL_SITES)"
+    r"\s*=\s*\(([^)]*)\)",
     re.MULTILINE,
 )
 
@@ -137,18 +139,23 @@ def chaos_kill_sites() -> set:
 
 
 def check_chaos_coverage() -> list:
-    """Every engine-protocol fault site (stream.*/sink.*/flow.*) must
-    have a kill-and-restart scenario in the crash matrix — a declared
-    site nobody ever kills at is untested crash surface."""
+    """Every engine-protocol fault site (stream.*/sink.*/flow.*/
+    device.*) and every *.compile site must have a kill-and-restart
+    scenario in the crash matrix — a declared site nobody ever kills
+    at is untested crash surface."""
     covered = chaos_kill_sites()
     must_cover = {
         s for s in declared_sites()
-        if s.split(".")[0] in ("stream", "sink", "flow", "ctl")
+        if (
+            s.split(".")[0] in ("stream", "sink", "flow", "ctl",
+                                "device")
+            or s.endswith(".compile")
+        )
         and s != "stream.read"  # read kills pre-WAL == stream.wal row
     }
     return [
         f"fault site {site!r} has no kill scenario in {_CHAOS} "
-        "(KILL_SITES/FLOW_KILL_SITES)"
+        "(KILL_SITES/FLOW_KILL_SITES/DEVICE_KILL_SITES)"
         for site in sorted(must_cover - covered)
     ]
 

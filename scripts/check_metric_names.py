@@ -42,7 +42,8 @@ CODE_ROOTS = ("sntc_tpu", "bench.py", "scripts")
 # past unrelated "sntc_*" literals like the package name itself)
 _NAME_RE = re.compile(
     r'"(sntc_[a-z0-9_]+_(?:total|seconds|bytes|state|deficit|'
-    r'divergence|flows|packets|depth|value|compliant|files))"'
+    r'divergence|flows|packets|depth|value|compliant|files|'
+    r'signatures))"'
 )
 
 

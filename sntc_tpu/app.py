@@ -515,6 +515,27 @@ def cmd_serve(args) -> int:
         from sntc_tpu.data.autotune import IngestAutotuner
 
         autotuner = IngestAutotuner()
+    # compute-plane fault domain (r18, default armed): device/XLA
+    # errors classify and respond per kind — OOM splits the
+    # micro-batch, a failed/over-budget compile poisons its signature
+    # onto the host fallback, a lost device flips HOST_DEGRADED with
+    # probe-gated recovery — instead of riding the generic poison-batch
+    # machinery.  The pre-built predictor carries the domain into the
+    # engine (and every fused segment).
+    if args.device_faults:
+        from sntc_tpu.resilience.device import (
+            DeviceFaultDomain,
+            DevicePolicy,
+        )
+        from sntc_tpu.serve import BatchPredictor
+
+        model = BatchPredictor(
+            model,
+            bucket_rows=args.shape_buckets,
+            device_domain=DeviceFaultDomain(DevicePolicy(
+                compile_budget_s=args.compile_budget_s or None,
+            )),
+        )
     q = StreamingQuery(
         model,
         source,
@@ -681,6 +702,8 @@ def cmd_serve_daemon(args) -> int:
         controller=args.controller,
         disk_budget_mb=args.root_disk_budget_mb,
         dead_letter_keep=args.dead_letter_keep,
+        device_faults=args.device_faults,
+        compile_budget_s=args.compile_budget_s or None,
     )
     try:
         if args.once:
@@ -741,6 +764,18 @@ def cmd_fsck(args) -> int:
         repair=not args.no_repair,
         tenant_tree=args.tenant_tree,
     )
+    if args.compile_cache or args.compile_cache_dir:
+        # the persistent XLA compilation cache (r18): quarantine
+        # unreadable/zero-length entries to .corrupt/ so serving
+        # RECOMPILES a clean miss instead of crashing on a torn
+        # executable; rides the same report + exit-code contract
+        from sntc_tpu.utils.compile_cache import fsck_compile_cache
+
+        cache_report = fsck_compile_cache(
+            args.compile_cache_dir, repair=not args.no_repair,
+        )
+        report["compile_cache"] = cache_report
+        report["ok"] = report["ok"] and cache_report["ok"]
     text = json.dumps(report, indent=1)
     if args.report:
         with open(args.report, "w") as f:
@@ -988,6 +1023,24 @@ def main(argv=None) -> int:
                    "windows: beyond it the oldest flows force-evict "
                    "early (reason state_cap) so operator state stays "
                    "bounded under any replay")
+    p.add_argument("--device-faults", action="store_true",
+                   dest="device_faults", default=True,
+                   help="arm the compute-plane fault domain: classify "
+                   "device/XLA errors (OOM / compile / device lost) "
+                   "and respond per kind — OOM-adaptive batch "
+                   "splitting, per-signature compile poisoning with "
+                   "host fallback, HOST_DEGRADED with probe-gated "
+                   "recovery (default)")
+    p.add_argument("--no-device-faults", action="store_false",
+                   dest="device_faults",
+                   help="pre-r18 behavior: device errors raise through "
+                   "the generic retry/quarantine machinery")
+    p.add_argument("--compile-budget-s", type=float, default=30.0,
+                   metavar="S",
+                   help="per-signature compile wall-time watchdog: a "
+                   "fused-program compile exceeding this poisons that "
+                   "(segment, signature) and serves it through the "
+                   "eager host fallback; 0 = unarmed")
     _add_obs_flags(p)
     add_platform_arg(p)
     p.set_defaults(fn=cmd_serve)
@@ -1118,6 +1171,21 @@ def main(argv=None) -> int:
                    help="per-tenant dead-letter retention: keep the "
                    "newest N evidence files per dead-letter dir "
                    "(counted dead_letter_dropped); 0 = unbounded")
+    p.add_argument("--device-faults", action="store_true",
+                   dest="device_faults", default=True,
+                   help="arm ONE compute-plane fault domain shared by "
+                   "every tenant's predictor (tenants share the "
+                   "physical device): device/XLA errors respond per "
+                   "kind and never strike a tenant's ladder (default)")
+    p.add_argument("--no-device-faults", action="store_false",
+                   dest="device_faults",
+                   help="pre-r18 behavior: device errors ride the "
+                   "generic per-tenant retry/quarantine machinery")
+    p.add_argument("--compile-budget-s", type=float, default=30.0,
+                   metavar="S",
+                   help="per-signature compile wall-time watchdog for "
+                   "the shared predictors (see serve --compile-"
+                   "budget-s); 0 = unarmed")
     p.add_argument("--poll-interval", type=float, default=1.0)
     p.add_argument("--once", action="store_true",
                    help="drain available files across all tenants and "
@@ -1146,6 +1214,16 @@ def main(argv=None) -> int:
     p.add_argument("--no-repair", action="store_true",
                    help="report only: no truncations, no quarantines, "
                    "no tmp sweeps")
+    p.add_argument("--compile-cache", action="store_true",
+                   help="also doctor the persistent XLA compilation "
+                   "cache (the dir enable_persistent_cache manages, "
+                   "from JAX_COMPILATION_CACHE_DIR / the default "
+                   "base): zero-length/unreadable entries quarantine "
+                   "to .corrupt/ so serving recompiles instead of "
+                   "crashing; tmp orphans sweep")
+    p.add_argument("--compile-cache-dir", default=None, metavar="DIR",
+                   help="explicit compilation-cache directory to "
+                   "doctor (implies --compile-cache)")
     p.add_argument("--report", default=None, metavar="PATH",
                    help="also write the JSON report here")
     add_platform_arg(p)

@@ -16,6 +16,7 @@ Public surface:
 
 from sntc_tpu.fuse.planner import (
     FusedSegment,
+    attach_device_domain,
     compile_pipeline,
     fused_segments,
     fusion_stats,
@@ -35,6 +36,7 @@ compile_serving = compile_pipeline
 __all__ = [
     "DevicePlan",
     "FusedSegment",
+    "attach_device_domain",
     "compile_pipeline",
     "compile_serving",
     "device_plan_for",

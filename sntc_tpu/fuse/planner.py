@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -64,6 +65,11 @@ from sntc_tpu.fuse.rules import fold_scalers
 from sntc_tpu.models.base import ClassificationModel
 from sntc_tpu.obs.metrics import inc
 from sntc_tpu.obs.trace import span
+from sntc_tpu.resilience.device import (
+    DeviceExecError,
+    classify_device_error,
+)
+from sntc_tpu.resilience.faults import fault_point
 from sntc_tpu.utils.profiling import active_ledgers
 
 
@@ -105,6 +111,16 @@ class FusedSegment(Transformer):
         self.compile_events = 0  # distinct input signatures compiled
         self.invocations = 0  # fused dispatches
         self.fallbacks = 0  # eager fallbacks (empty/dtype-gated)
+        # compute-plane fault domain (r18): set by
+        # attach_device_domain (via BatchPredictor).  A compile failure
+        # or watchdog breach poisons exactly (this segment, that input
+        # signature) — later binds of the signature take the eager
+        # host path while every other signature keeps compiling on
+        # device; HOST_DEGRADED diverts ALL binds eagerly.
+        self._domain = None
+        self.segment_index: Optional[int] = None  # position in the plan
+        self._poisoned: dict = {}  # signature -> reason
+        self.poisoned_served = 0  # binds served off a poisoned signature
         # SNTC_OBS_COST_ANALYSIS=1: XLA cost_analysis() per compiled
         # signature (flops / bytes accessed), keyed by signature repr —
         # the device-cost side of the obs span correlation
@@ -212,21 +228,29 @@ class FusedSegment(Transformer):
                 args.append(col.astype(np.float32, copy=False))
         return args
 
-    def _program(self, args: List[np.ndarray]):
+    @staticmethod
+    def _signature(args: List[np.ndarray]):
         import jax
 
         # donation frees the uploaded input buffers for reuse by the
         # program's outputs; on CPU the backend ignores donation (and the
         # host buffer may be aliased zero-copy), so gate it off there
         donate = jax.default_backend() != "cpu"
-        sig = (
+        return (
             tuple((a.shape, a.dtype.str) for a in args),
             donate,
         )
+
+    def _program(self, args: List[np.ndarray], sig=None):
+        if sig is None:
+            sig = self._signature(args)
         with self._lock:
             prog = self._programs.get(sig)
             if prog is not None:
                 return prog
+        import jax
+
+        donate = sig[1]
         names = [n for n, _ in self._external]
         plans, head, live = self._plans, self._head, self._live_writes
 
@@ -289,32 +313,120 @@ class FusedSegment(Transformer):
     def transform(self, frame: Frame) -> Frame:
         return self.transform_async(frame)()
 
+    def _eager_async(self, frame: Frame, poisoned: bool = False):
+        """One eager fallback serve (the shared bookkeeping for the
+        empty/dtype gate, poisoned signatures, and HOST_DEGRADED)."""
+        self.fallbacks += 1
+        inc("sntc_fuse_fallbacks_total")
+        if poisoned:
+            with self._lock:
+                self.poisoned_served += 1
+        out = self._transform_eager(frame)
+        return lambda: out
+
+    def _poison(self, sig, reason: str, site: str) -> None:
+        with self._lock:
+            fresh = sig not in self._poisoned
+            self._poisoned[sig] = reason
+        if fresh and self._domain is not None:
+            self._domain.note_poisoned(
+                site=site, signature=repr(sig[0]), reason=reason,
+                segment=self.segment_index,
+            )
+
     def transform_async(self, frame: Frame):
         args = self._bind(frame) if frame.num_rows else None
         if args is None:
-            self.fallbacks += 1
-            inc("sntc_fuse_fallbacks_total")
-            out = self._transform_eager(frame)
-            return lambda: out
-        prog = self._program(args)
+            return self._eager_async(frame)
+        dom = self._domain
+        if dom is not None and dom.host_degraded:
+            dom.note_fallback()
+            return self._eager_async(frame)
+        sig = self._signature(args)
+        if sig in self._poisoned:
+            if dom is not None:
+                dom.note_fallback(poisoned=True)
+            return self._eager_async(frame, poisoned=True)
+        fresh = sig not in self._programs
+        budget = dom.policy.compile_budget_s if dom is not None else None
         # snapshot the ledgers to record into AT DISPATCH TIME: the
         # engine scopes its own (per-tenant) ledger on its thread, and
         # the finalize closure below may run on the delivery thread —
         # capturing here keeps attribution correct across threads
         ledgers = active_ledgers()
-        up_bytes = sum(a.nbytes for a in args)
-        for led in ledgers:
-            led.record_uploads(len(args), up_bytes)
-        with span("fuse.dispatch", args=len(args)):
-            outs = prog(*args)  # async dispatch; finalize materializes
+        try:
+            if fresh:
+                # the DEVICE fault boundary for the fused-program
+                # compile (chaos arms compile_error / kill here)
+                fault_point("fuse.compile")
+            t0 = time.perf_counter() if fresh else 0.0
+            prog = self._program(args, sig)
+            up_bytes = sum(a.nbytes for a in args)
+            for led in ledgers:
+                led.record_uploads(len(args), up_bytes)
+            with span("fuse.dispatch", args=len(args)):
+                # async dispatch; finalize materializes.  For a fresh
+                # signature THIS call triggers the XLA compile, so the
+                # wall time below is the watchdog's compile measurement.
+                outs = prog(*args)
+            if fresh and budget is not None:
+                elapsed = time.perf_counter() - t0
+                if elapsed > budget:
+                    # the compile finished but blew the budget: a
+                    # signature this expensive to (re)compile is a
+                    # serving hazard — poison it and serve the host
+                    # path, exactly like a failed compile.  The
+                    # just-compiled executable is EVICTED too: a
+                    # poisoned signature never binds again, so keeping
+                    # it would pin dead device memory for the process
+                    # lifetime
+                    with self._lock:
+                        self._programs.pop(sig, None)
+                    self._poison(
+                        sig,
+                        f"compile watchdog: {elapsed:.3f}s > "
+                        f"budget {budget}s",
+                        site="fuse.compile",
+                    )
+                    if dom is not None:
+                        dom.note_fallback(poisoned=True)
+                    return self._eager_async(frame, poisoned=True)
+        except Exception as e:
+            kind = classify_device_error(e)
+            if dom is not None and kind == "compile_error":
+                # poison exactly (this segment, this signature); other
+                # signatures keep compiling on device
+                self._poison(sig, repr(e), site="fuse.compile")
+                dom.note_fault(kind, site="fuse.compile")
+                dom.note_fallback(poisoned=True)
+                return self._eager_async(frame, poisoned=True)
+            raise  # OOM / device_lost respond at the predictor layer
         with self._lock:
             self.invocations += 1
             self.uploads += len(args)
         head, live = self._head, self._live_writes
+        seg_index, sig_repr = self.segment_index, repr(sig[0])
 
         def finalize() -> Frame:
-            with span("fuse.finalize"):
-                host = [np.asarray(o) for o in outs]
+            try:
+                with span("fuse.finalize"):
+                    host = [np.asarray(o) for o in outs]
+            except Exception as e:
+                kind = classify_device_error(e)
+                if kind is None:
+                    raise
+                # device-side materialization failure (overlap mode
+                # surfaces these on the delivery thread): thread the
+                # execution context — segment, signature — through the
+                # error chain so the journaled evidence names the work
+                # that died, not just the symptom (the engine adds the
+                # batch id)
+                raise DeviceExecError(
+                    f"device {kind} while finalizing fused segment "
+                    f"{seg_index} ({type(self).__name__}) signature "
+                    f"{sig_repr}: {e}",
+                    kind=kind, segment=seg_index, signature=sig_repr,
+                ) from e
             down_bytes = sum(h.nbytes for h in host)
             for led in ledgers:
                 led.record_downloads(len(host), down_bytes)
@@ -418,10 +530,31 @@ def compile_pipeline(
         later_reads = set(keep)
         for later in stages[i:]:
             later_reads.update(later.input_columns())
-        out.append(
-            FusedSegment(seg_stages, seg_plans, head=head, keep=later_reads)
+        seg = FusedSegment(
+            seg_stages, seg_plans, head=head, keep=later_reads
         )
+        # stable position among the plan's fused segments — the
+        # execution context device-attributed errors carry (r18)
+        seg.segment_index = sum(
+            1 for s in out if isinstance(s, FusedSegment)
+        )
+        out.append(seg)
     return PipelineModel(stages=out)
+
+
+def attach_device_domain(model, domain) -> int:
+    """Hand a :class:`~sntc_tpu.resilience.device.DeviceFaultDomain`
+    to every fused segment reachable from ``model`` (the
+    BatchPredictor does this at construction and re-attaches on every
+    hot-swap): segment-level compile failures then poison per
+    (segment, signature) and HOST_DEGRADED diverts the fused programs
+    to their eager path.  Returns the segment count."""
+    segs = fused_segments(model)
+    for i, seg in enumerate(segs):
+        seg._domain = domain
+        if seg.segment_index is None:
+            seg.segment_index = i
+    return len(segs)
 
 
 def fused_segments(model) -> List[FusedSegment]:
@@ -458,6 +591,8 @@ def fusion_stats(model) -> Optional[dict]:
         "fallbacks": sum(s.fallbacks for s in segs),
         "uploads": sum(s.uploads for s in segs),
         "downloads": sum(s.downloads for s in segs),
+        "poisoned_signatures": sum(len(s._poisoned) for s in segs),
+        "poisoned_served": sum(s.poisoned_served for s in segs),
     }
     # keyed per SEGMENT: two segments can compile identically-shaped
     # signatures, and a flat sig-keyed merge would attribute one

@@ -174,11 +174,23 @@ class ForestDeviceMixin:
         return (f.feature, f.threshold, f.leaf_stats)
 
     def _device_forest(self) -> tuple:
-        if self._dev_forest is None:
-            self._dev_forest = tuple(
+        forest = self._dev_forest
+        if forest is None:
+            forest = tuple(
                 jnp.asarray(a) for a in self._forest_arrays()
             )
-        return self._dev_forest
+            # never cache values created under an active trace: the
+            # fusion planner jits THROUGH _predict_all_dev, so inside
+            # its tracing these constants are tracers — caching one
+            # would poison every later trace AND the eager host-
+            # fallback path with UnexpectedTracerError (the same guard
+            # LogisticRegression/MLP got in r12; bites exactly when a
+            # fused trace runs before the first eager transform)
+            import jax
+
+            if not any(isinstance(a, jax.core.Tracer) for a in forest):
+                self._dev_forest = forest
+        return forest
 
 
 def resolve_feature_subset_k(strategy, n_features: int, n_trees: int,

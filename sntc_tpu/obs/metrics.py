@@ -331,6 +331,40 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         help="Append-WAL compactions (sealed checkpoint written, "
         "offsets/commits logs truncated).",
     ),
+    # -- the compute-plane fault domain (resilience/device, r18) --------------
+    "sntc_device_state": dict(
+        type=GAUGE, labels=(),
+        help="Device serving state of the process's fault domain "
+        "(0=DEVICE_OK, 1=HOST_DEGRADED — every dispatch on the eager "
+        "host fallback until the recovery probe succeeds).",
+    ),
+    "sntc_device_faults_total": dict(
+        type=COUNTER, labels=("kind", "site"),
+        help="Classified device/XLA runtime failures (device_oom / "
+        "compile_error / device_lost), by fault site.",
+    ),
+    "sntc_device_oom_splits_total": dict(
+        type=COUNTER, labels=(),
+        help="Micro-batch halvings the OOM responder performed "
+        "(device_oom_split decisions; retried on device at the "
+        "smaller shape).",
+    ),
+    "sntc_device_poisoned_signatures": dict(
+        type=GAUGE, labels=(),
+        help="(segment, signature) pairs poisoned out of the device "
+        "plan cache after a compile failure or watchdog breach — each "
+        "serves through the eager host fallback.",
+    ),
+    "sntc_device_fallback_batches_total": dict(
+        type=COUNTER, labels=(),
+        help="Dispatches served through the eager host fallback "
+        "(poisoned signature or HOST_DEGRADED).",
+    ),
+    "sntc_device_recoveries_total": dict(
+        type=COUNTER, labels=(),
+        help="HOST_DEGRADED -> DEVICE_OK transitions (the probe-gated "
+        "recovery tick restored device serving).",
+    ),
 }
 
 _OVERFLOW_KEY: Tuple[Tuple[str, str], ...] = (("overflow", "true"),)

@@ -206,6 +206,17 @@ class CircuitBreaker:
                     window=len(self._outcomes),
                 )
 
+    def release(self) -> None:
+        """Withdraw a reserved half-open probe slot WITHOUT recording
+        an outcome — for an allowed call whose result cannot fairly
+        score this dependency (r18: a device-classified platform fault
+        is the compute plane's evidence, not the guarded site's; the
+        slot must not leak, or the breaker wedges half-open forever).
+        No-op outside HALF_OPEN."""
+        with self._lock:
+            if self._state == HALF_OPEN and self._probes_in_flight > 0:
+                self._probes_in_flight -= 1
+
     def reset(self) -> None:
         """Administrative reset to a fresh CLOSED breaker: window
         cleared, probes cleared, cooldown forgotten.  ``open_count``

@@ -46,6 +46,12 @@ Wired sites:
                         dumps, model marker, metrics snapshots)
 ``storage.state``       flow-state snapshot blob writes (the physical
                         side of ``flow.state_snapshot``)
+``predict.compile``     ``BatchPredictor`` before a FRESH row shape's
+                        dispatch (the predict-program compile) — takes
+                        the DEVICE kinds
+``fuse.compile``        ``fuse.FusedSegment`` before a fresh input
+                        signature compiles its fused XLA program
+``device.dispatch``     ``BatchPredictor`` before every device dispatch
 ======================  =====================================================
 
 Env grammar (comma-separated specs)::
@@ -54,9 +60,12 @@ Env grammar (comma-separated specs)::
 
 ``kind`` is ``exc`` (RuntimeError), ``io`` (OSError), ``timeout``
 (TimeoutError), ``kill`` (``os._exit`` — the chaos-harness process
-crash), or a DATA kind — ``corrupt_bytes``/``truncate``/``ragged`` —
+crash), a DATA kind — ``corrupt_bytes``/``truncate``/``ragged`` —
 which mutates the payload at a :func:`fault_data` site instead of
-raising; ``prob`` in [0, 1] is evaluated per call with a
+raising, or a DEVICE kind —
+``device_oom``/``compile_error``/``device_lost`` — which raises an
+:class:`InjectedDeviceFault` whose message replicates the matching
+XlaRuntimeError shape; ``prob`` in [0, 1] is evaluated per call with a
 generator seeded by ``seed`` — the same env string yields the same
 fault sequence in every run.  Example: arm the sink to fail ~30% of
 writes deterministically::
@@ -102,6 +111,20 @@ class InjectedDiskFault(InjectedIOFault):
         self.errno = errno_code
 
 
+class InjectedDeviceFault(InjectedFault):
+    """An injected *device/XLA-runtime* failure (r18): the message
+    mimics the real ``XlaRuntimeError`` status shapes
+    (``RESOURCE_EXHAUSTED: Out of memory ...``, ``INTERNAL: during
+    XLA compilation ...``, ``UNAVAILABLE: device lost ...``) so
+    :func:`sntc_tpu.resilience.device.classify_device_error` treats
+    injected and genuine device faults identically — the compute-plane
+    response ladder is exercisable without real hardware."""
+
+    def __init__(self, msg: str, kind: str):
+        super().__init__(msg)
+        self.device_kind = kind
+
+
 _KINDS = {
     "exc": InjectedFault,
     "io": InjectedIOFault,
@@ -137,10 +160,25 @@ DATA_KINDS = ("corrupt_bytes", "truncate", "ragged")
 # kill.  ``torn_write`` armed at a plain ``fault_point`` is inert.
 IO_KINDS = ("enospc", "io_error", "torn_write")
 
+# DEVICE kinds (r18): the compute-plane fault domain's vocabulary.
+# Each raises :class:`InjectedDeviceFault` whose MESSAGE replicates the
+# XlaRuntimeError status shape the real backend produces (so the
+# classifier in ``resilience/device.py`` cannot tell them apart):
+# ``device_oom`` = RESOURCE_EXHAUSTED allocation failure, the per-batch
+# OOM the dispatch splitter responds to; ``compile_error`` = a failed
+# XLA compilation, the per-signature poisoning trigger;
+# ``device_lost`` = the backend disappeared mid-run (tunnel drop,
+# preemption), the HOST_DEGRADED trigger.  Armable at the compute
+# sites ``predict.compile`` / ``fuse.compile`` / ``device.dispatch``.
+DEVICE_KINDS = ("device_oom", "compile_error", "device_lost")
+
 #: every kind the SNTC_FAULTS grammar accepts (docs/RESILIENCE.md keeps
 #: a matching marker-delimited table; scripts/check_fault_sites.py
 #: fails tier-1 when the two drift)
-ALL_KINDS = tuple(sorted(_KINDS)) + (KILL_KIND,) + DATA_KINDS + IO_KINDS
+ALL_KINDS = (
+    tuple(sorted(_KINDS)) + (KILL_KIND,) + DATA_KINDS + IO_KINDS
+    + DEVICE_KINDS
+)
 
 # the documented wired sites (arming others is allowed — custom call
 # sites can declare their own — but a typo'd WIRED site should be loud)
@@ -171,6 +209,18 @@ SITES = (
     "storage.dead_letter",
     "storage.marker",
     "storage.state",
+    # compute-plane fault domain (r18): the DEVICE boundaries —
+    # ``predict.compile`` fires on a FRESH dispatched row shape (the
+    # predict program compile), ``fuse.compile`` on a fresh FusedSegment
+    # input signature (the fused XLA program compile), and
+    # ``device.dispatch`` on every device dispatch.  DEVICE kinds armed
+    # here raise realistic XlaRuntimeError shapes; the response ladder
+    # (OOM split / signature poison / HOST_DEGRADED) lives in
+    # ``resilience/device.py`` — see docs/RESILIENCE.md "Compute-plane
+    # fault domain".
+    "predict.compile",
+    "fuse.compile",
+    "device.dispatch",
 )
 
 
@@ -396,6 +446,8 @@ def fault_point(site: str, tenant: Optional[str] = None) -> None:
             os._exit(KILL_EXIT_CODE)
         if spec.kind in ("enospc", "io_error"):
             raise _disk_fault(spec.kind, site, call)
+        if spec.kind in DEVICE_KINDS:
+            raise _device_fault(spec.kind, site, call)
         raise _KINDS[spec.kind](
             f"injected {spec.kind} fault at site {site!r} (call {call})"
         )
@@ -409,6 +461,29 @@ def _disk_fault(kind: str, site: str, call: int) -> "InjectedDiskFault":
         code,
         f"injected {kind} fault at site {site!r} (call {call})",
     )
+
+
+def _device_fault(kind: str, site: str, call: int) -> "InjectedDeviceFault":
+    """The message replicates the real XlaRuntimeError status line for
+    the kind, so ``classify_device_error`` exercises the SAME pattern
+    match genuine backend failures would hit."""
+    if kind == "device_oom":
+        msg = (
+            "RESOURCE_EXHAUSTED: Out of memory while trying to "
+            "allocate 1073741824 bytes. "
+            f"[injected device_oom at site {site!r} (call {call})]"
+        )
+    elif kind == "compile_error":
+        msg = (
+            "INTERNAL: during XLA compilation: injected compile_error "
+            f"at site {site!r} (call {call})"
+        )
+    else:  # device_lost
+        msg = (
+            "UNAVAILABLE: device lost: backend restarted "
+            f"[injected device_lost at site {site!r} (call {call})]"
+        )
+    return InjectedDeviceFault(msg, kind)
 
 
 def fault_disk(site: str, tenant: Optional[str] = None) -> Optional[float]:

@@ -93,6 +93,15 @@ _EVENT_STATES: Dict[str, HealthState] = {
     "storage_degraded": HealthState.DEGRADED,
     "storage_recovered": HealthState.OK,
     "disk_budget_exceeded": HealthState.DEGRADED,
+    # compute-plane fault domain (r18): HOST_DEGRADED flips the model
+    # component DEGRADED (serving continues on the host path — degraded,
+    # not dead); the probe-gated recovery is the paired OK signal.
+    # Individual device_fault / signature_poisoned events deliberately
+    # do NOT map: they carry a site, would create a component with no
+    # recovery signal, and the response ladder already absorbed them —
+    # their evidence lives in the sntc_device_* series instead.
+    "device_degraded": HealthState.DEGRADED,
+    "device_recovered": HealthState.OK,
 }
 
 

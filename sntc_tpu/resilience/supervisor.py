@@ -381,6 +381,11 @@ class QuerySupervisor:
             engine_storage() if engine_storage is not None else {},
             disk=self.storage.status(),
         )
+        # compute-plane fault domain evidence (r18): serving state +
+        # response-ladder counters for the predictor's device domain
+        dom = getattr(q.predictor, "device_domain", None)
+        if dom is not None:
+            out["device"] = dom.stats()
         # closed-loop SLO control evidence (r16): declared setpoints,
         # per-axis compliance, and the controller's knob/decision state
         if self.controller is not None:

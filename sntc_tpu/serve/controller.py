@@ -252,6 +252,7 @@ class ServeController:
         ingest: bool = True,
         knob_bounds: Optional[dict] = None,
         violation_hold: int = 3,
+        device_check=None,
     ):
         self.policy = policy or ControlPolicy()
         self.journal_path = journal_path
@@ -268,6 +269,15 @@ class ServeController:
         self.violation_hold = max(0, int(violation_hold))
         self._clock = clock
         self._wall = wall
+        # compute-plane awareness (r18): a callable returning True
+        # while the shared device serves HOST_DEGRADED.  A platform
+        # fault collapses every tenant's throughput at once — the
+        # controller keeps steering the local knobs through its
+        # existing SLO signal, but it must NOT climb the tenant
+        # escalation ladder for it (device-attributed failure is not
+        # tenant misbehavior).
+        self._device_check = device_check
+        self.platform_deferrals = 0
         self._daemon = None
         self.targets: List[_Target] = []
         self._knobs: Dict[str, Knob] = {}  # full name -> Knob
@@ -295,6 +305,7 @@ class ServeController:
         )
         kwargs.setdefault("clock", daemon._clock)
         kwargs.setdefault("budget", daemon.tuning_budget)
+        kwargs.setdefault("device_check", daemon.device_degraded)
         ctl = cls(**kwargs)
         ctl._daemon = daemon
         for t in daemon.tenants:
@@ -317,6 +328,11 @@ class ServeController:
             ),
         )
         kwargs.setdefault("clock", supervisor._clock)
+        dom = getattr(supervisor.query.predictor, "device_domain", None)
+        if dom is not None:
+            kwargs.setdefault(
+                "device_check", lambda _d=dom: _d.host_degraded
+            )
         ctl = cls(**kwargs)
         ctl._attach(_Target(
             None, supervisor.query, slo, supervisor=supervisor,
@@ -749,6 +765,17 @@ class ServeController:
 
     # -- the controller -----------------------------------------------------
 
+    def _platform_degraded(self) -> bool:
+        """True while the shared compute plane is HOST_DEGRADED (the
+        device fault domain's verdict); a failing check reads False —
+        awareness must never break the control loop."""
+        if self._device_check is None:
+            return False
+        try:
+            return bool(self._device_check())
+        except Exception:
+            return False
+
     def _usable(self, t: _Target, base: str, direction: int) -> bool:
         return self.guard.usable(
             {self._full(t, base): t.knobs.get(base)}
@@ -795,8 +822,15 @@ class ServeController:
             flooding = "shed" in v or sig.strikes > 0
             if flooding and t.stream is not None:
                 # degrade the violator, never its neighbors:
-                # throttle → shed → ladder escalation
+                # throttle → shed → ladder escalation.  While the
+                # compute plane serves HOST_DEGRADED the escalate rung
+                # is off the table: the collapse is device-attributed,
+                # and striking a tenant for a platform fault is exactly
+                # the mis-attribution the fault domain exists to stop.
                 for base in ("quota", "shed", "escalate"):
+                    if base == "escalate" and self._platform_degraded():
+                        self.platform_deferrals += 1
+                        continue
                     if self._usable(t, base, +1):
                         return (self._full(t, base), +1), None
                 return None, None
@@ -957,6 +991,8 @@ class ServeController:
             "applied": len(self.guard.applied()),
             "delegated": self.delegated_total,
             "escalations": self.escalations_total,
+            "platform_deferrals": self.platform_deferrals,
+            "platform_degraded": self._platform_degraded(),
             "frozen": sorted(self.guard.frozen),
             "knobs": self.knob_values(),
             "recent": self.guard.decisions[-8:],

@@ -38,6 +38,7 @@ from sntc_tpu.resilience import (
     fault_point,
     with_retries,
 )
+from sntc_tpu.resilience.device import annotate_batch, classify_device_error
 from sntc_tpu.resilience import storage as storage_plane
 from sntc_tpu.serve.transform import BatchPredictor
 from sntc_tpu.utils.profiling import TransferLedger, ledger_scope
@@ -1246,13 +1247,43 @@ class StreamingQuery:
                         self.predictor.predict_frame_async,
                         frame, row_valid=row_mask,
                     )
-            except Exception:
+            except Exception as de:
+                # a device-attributed failure is a PLATFORM fault: it
+                # must not open the predict breaker (breaker_open is a
+                # tenant-strike event, and the device belongs to the
+                # platform, not the tenant) — but a half-open probe
+                # slot allow() reserved must be RELEASED, not leaked,
+                # or the breaker wedges half-open forever
                 if br_predict is not None:
-                    br_predict.record_failure()
+                    if (
+                        self._device_domain() is not None
+                        and classify_device_error(de) is not None
+                    ):
+                        br_predict.release()
+                    else:
+                        br_predict.record_failure()
                 raise
             if br_predict is not None:
                 br_predict.record_success()
         except Exception as e:
+            dom = self._device_domain()
+            if dom is not None:
+                kind = classify_device_error(e)
+                if kind is not None:
+                    # dispatch-scope classification (r18): the batch is
+                    # NOT poison — the platform is.  No failure bump, no
+                    # quarantine, no tenant strike: the domain absorbs
+                    # the fault (split / poison / HOST_DEGRADED) and the
+                    # deferred batch replays next round through the
+                    # response path.  Errors reaching here are the
+                    # terminal shapes the predictor could not absorb
+                    # in-place (e.g. an at-floor OOM before degradation).
+                    if not getattr(e, "_sntc_device_counted", False):
+                        dom.note_fault(
+                            kind, site=self._sites["predict.dispatch"],
+                            batch_id=batch_id,
+                        )
+                    return False
             fails = self._bump_failures(batch_id, stage)
             if self.max_batch_failures is None:
                 raise  # quarantine unarmed: r5 single-shot semantics
@@ -1274,6 +1305,12 @@ class StreamingQuery:
         # journaled shed and double-count it on the next tick
         self._next_start = max(self._next_start, intent["end"])
         return True
+
+    def _device_domain(self):
+        """The predictor's compute-plane fault domain (None when
+        unarmed) — shared across every engine serving this predictor,
+        exactly as the tenants share the physical device."""
+        return getattr(self.predictor, "device_domain", None)
 
     def _bump_failures(self, batch_id: int, stage: str) -> int:
         """Per-(batch, stage) failure rounds: a read flake and a sink
@@ -1297,7 +1334,15 @@ class StreamingQuery:
 
             def _deliver() -> None:
                 fault_point("sink.write", tenant=self.tenant)
-                self.sink.add_batch(batch_id, finalize())
+                try:
+                    self.sink.add_batch(batch_id, finalize())
+                except Exception as e:
+                    # finalize runs HERE — on the delivery thread in
+                    # overlap mode — where a device-side error would
+                    # otherwise surface with no batch context; thread
+                    # the batch id through the chain (the fused
+                    # segment already added segment + signature)
+                    raise annotate_batch(e, batch_id)
 
             with span("sink.deliver", batch=batch_id):
                 if self.retry_policy is not None:
@@ -1321,20 +1366,62 @@ class StreamingQuery:
         breaker = self.breakers.get("sink.write")
         quarantined = False
         if exc is not None:
-            # one breaker outcome per retirement ROUND (a failure that
-            # survived the whole retry cycle is real trouble)
-            if breaker is not None:
-                breaker.record_failure()
-            fails = self._bump_failures(batch_id, "sink.write")
-            if self.max_batch_failures is None:
-                raise exc  # quarantine unarmed: r5 single-shot semantics
-            if fails < self.max_batch_failures:
-                return False  # stays queued; retried next round
-            if batch_id not in self._quarantined_ids:
-                self._quarantine(batch_id, intent, frame, exc,
-                                 site="sink.write")
-                self._quarantined_ids.add(batch_id)
-            quarantined = True
+            dom = self._device_domain()
+            kind = (
+                classify_device_error(exc) if dom is not None else None
+            )
+            if kind is not None:
+                # a device failure surfacing at finalize/delivery is a
+                # PLATFORM fault: it never scores the sink breaker —
+                # release the reserved half-open probe slot (a leaked
+                # slot would wedge the breaker half-open forever; a
+                # recorded failure would open it on evidence the sink
+                # never produced).  Note the fault (degrading the
+                # domain on repeats) and RE-DISPATCH the head through
+                # the response path: the memoized finalize cached the
+                # device failure, only a fresh dispatch can take the
+                # split/fallback route.
+                if breaker is not None:
+                    breaker.release()
+                if not getattr(exc, "_sntc_device_counted", False):
+                    dom.note_fault(
+                        kind, site=self._sites["predict.dispatch"],
+                        batch_id=batch_id,
+                    )
+                fails = self._bump_failures(batch_id, "device.dispatch")
+                limit = (
+                    (self.max_batch_failures or 1)
+                    + dom.policy.degrade_after
+                )
+                if fails <= limit and frame is not None:
+                    self._redispatch_head()
+                    return False
+                # the safety valve: even the host fallback keeps dying
+                # device-shaped.  Quarantine attributed to the DEVICE
+                # path — never to the sink the failure rode in on
+                if self.max_batch_failures is None:
+                    raise exc  # unarmed: r5 single-shot semantics
+                if batch_id not in self._quarantined_ids:
+                    self._quarantine(batch_id, intent, frame, exc,
+                                     site="predict.dispatch")
+                    self._quarantined_ids.add(batch_id)
+                quarantined = True
+            else:
+                # one breaker outcome per retirement ROUND (a failure
+                # that survived the whole retry cycle is real trouble)
+                if breaker is not None:
+                    breaker.record_failure()
+                fails = self._bump_failures(batch_id, "sink.write")
+                if self.max_batch_failures is None:
+                    # quarantine unarmed: r5 single-shot semantics
+                    raise exc
+                if fails < self.max_batch_failures:
+                    return False  # stays queued; retried next round
+                if batch_id not in self._quarantined_ids:
+                    self._quarantine(batch_id, intent, frame, exc,
+                                     site="sink.write")
+                    self._quarantined_ids.add(batch_id)
+                quarantined = True
         else:
             if breaker is not None:
                 breaker.record_success()
@@ -1377,6 +1464,29 @@ class StreamingQuery:
                     batch_id=batch_id, error=repr(e),
                 )
         return True
+
+    def _redispatch_head(self) -> None:
+        """Replace the head batch's (failed, failure-memoized) finalize
+        with a FRESH predictor dispatch of its stored frame — the
+        device response ladder (split / poisoned-signature fallback /
+        HOST_DEGRADED host path) can only engage on a new dispatch.
+        Failures here degrade (the old finalize stays; the next
+        settle round classifies again), never kill."""
+        (batch_id, intent, _old, t0, n_rows, frame,
+         row_mask) = self._in_flight[0]
+        try:
+            with ledger_scope(self.transfer):
+                fin = self.predictor.predict_frame_async(
+                    frame, row_valid=row_mask
+                )
+            self._in_flight[0] = (
+                batch_id, intent, fin, t0, n_rows, frame, row_mask
+            )
+        except Exception as e:
+            self._emit(
+                event="device_error", batch_id=batch_id,
+                error=repr(e), during="redispatch",
+            )
 
     def _retire_oldest(self) -> bool:
         """Serial retire: materialize the oldest in-flight batch, sink
@@ -1584,6 +1694,9 @@ class StreamingQuery:
         fusion = self.predictor.fusion_stats()
         if fusion is not None:
             stats["fusion"] = fusion
+        dom = self._device_domain()
+        if dom is not None:
+            stats["device"] = dom.stats()
         admission = self.admission_stats()
         if admission is not None:
             stats["admission"] = admission
@@ -1859,6 +1972,15 @@ class StreamingQuery:
         that finished during the dispatch window commits now)."""
         before = self._last_committed
         self._lifecycle_tick()
+        dom = self._device_domain()
+        if dom is not None:
+            # the probe-gated recovery tick (cheap when DEVICE_OK);
+            # degrade-never-kill like the lifecycle/autotune ticks
+            try:
+                dom.tick()
+            except Exception as e:
+                self._emit(event="device_error", error=repr(e),
+                           during="tick")
         if self.autotuner is not None:
             # poll-tick cadence; same degrade-never-kill contract as
             # the lifecycle tick — a controller bug must not stop

@@ -387,6 +387,9 @@ class ServeDaemon:
         controller_policy=None,
         disk_budget_mb: Optional[float] = None,
         dead_letter_keep: int = 200,
+        device_faults: bool = True,
+        device_policy=None,
+        compile_budget_s: Optional[float] = None,
     ):
         if not specs:
             raise ValueError("ServeDaemon needs at least one TenantSpec")
@@ -454,6 +457,22 @@ class ServeDaemon:
         }
         self._owns_health = health is None
         self.health = health or HealthMonitor(clock=clock).attach()
+        # compute-plane fault domain (r18): ONE domain for the whole
+        # daemon — every tenant's predictor shares the physical device,
+        # so a device OOM / failed compile / lost backend degrades the
+        # plane once, never once per tenant (and never strikes one).
+        # See docs/RESILIENCE.md "Compute-plane fault domain".
+        self.device_domain = None
+        if device_faults:
+            from sntc_tpu.resilience.device import (
+                DeviceFaultDomain,
+                DevicePolicy,
+            )
+
+            self.device_domain = DeviceFaultDomain(
+                device_policy
+                or DevicePolicy(compile_budget_s=compile_budget_s)
+            )
         # shared program cache: one BatchPredictor per distinct model —
         # keyed by checkpoint path (str specs) or object identity —
         # handed to every tenant that declared it
@@ -527,9 +546,21 @@ class ServeDaemon:
         key, model = self._resolve_model(spec)
         pred = self._predictors.get(key)
         if pred is None:
-            pred = BatchPredictor(model, bucket_rows=self.shape_buckets)
+            pred = BatchPredictor(
+                model, bucket_rows=self.shape_buckets,
+                device_domain=self.device_domain,
+            )
             self._predictors[key] = pred
         return pred
+
+    def device_degraded(self) -> bool:
+        """True while the shared compute plane serves HOST_DEGRADED —
+        the SLO controller reads this to steer knobs instead of
+        escalating tenant ladders for a platform fault."""
+        return (
+            self.device_domain is not None
+            and self.device_domain.host_degraded
+        )
 
     def tenant_dir(self, tenant_id: str) -> str:
         return os.path.join(self.root_dir, "tenant", tenant_id)
@@ -1094,6 +1125,13 @@ class ServeDaemon:
             },
             "compile_ledger": self.compile_ledger(),
             "recompiles_after_warmup": self.recompiles_after_warmup(),
+            # compute-plane fault domain (r18): the shared device's
+            # serving state + response-ladder evidence (one block —
+            # tenants share the physical device)
+            "device": (
+                self.device_domain.stats()
+                if self.device_domain is not None else None
+            ),
             "autotune": self.autotune_stats(),
             "slo": (
                 self.controller.slo_status()

@@ -31,6 +31,8 @@ from sntc_tpu.core.base import Transformer
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.obs.metrics import inc
 from sntc_tpu.obs.trace import span
+from sntc_tpu.resilience.device import classify_device_error
+from sntc_tpu.resilience.faults import fault_point
 
 # row-validity mask column threaded through bucketed transforms: True for
 # real rows, False for bucket-padding rows.  Row-DROPPING stages
@@ -38,6 +40,25 @@ from sntc_tpu.obs.trace import span
 # so finalize recovers exactly the surviving real rows even when the
 # stage dropped some.
 VALID_COL = "__sntc_row_valid"
+
+
+def _eager_transform(model: Transformer, frame: Frame) -> Frame:
+    """The whole-model HOST path: fused segments run their eager
+    stage-by-stage transform (``FusedSegment._transform_eager``),
+    everything else its plain ``transform`` — no jitted program, no
+    device dispatch.  The compute-plane fault domain serves poisoned
+    signatures and HOST_DEGRADED batches through this."""
+    from sntc_tpu.core.base import PipelineModel
+    from sntc_tpu.fuse import FusedSegment
+
+    if isinstance(model, FusedSegment):
+        return model._transform_eager(frame)
+    if isinstance(model, PipelineModel):
+        out = frame
+        for stage in model.getStages():
+            out = _eager_transform(stage, out)
+        return out
+    return model.transform(frame)
 
 
 def bucket_rows_for(n_rows: int, floor: int) -> int:
@@ -68,6 +89,7 @@ class BatchPredictor:
         model: Transformer,
         chunk_rows: int = 131_072,
         bucket_rows: int = 0,
+        device_domain=None,
     ):
         self.model = model
         self.chunk_rows = int(chunk_rows)
@@ -76,12 +98,35 @@ class BatchPredictor:
         self.bucket_hits = 0  # dispatches that reused a seen shape
         self.padded_rows_total = 0  # wasted rows the buckets cost
         self._shapes_seen: set = set()
+        # compute-plane fault domain (r18): classify device/XLA errors
+        # at the dispatch boundary and respond per kind — OOM splits
+        # the micro-batch, a failed compile poisons the shape, a lost
+        # device flips HOST_DEGRADED (eager host serving until the
+        # recovery probe succeeds).  None = pre-r18 raise-through.
+        self.device_domain = device_domain
+        self._poisoned_shapes: set = set()
+        # the OOM responder's floor step-down is transient, not a
+        # ratchet: remember the cold floor and restore it after
+        # `floor_restore_after` clean dispatches (policy)
+        self._cold_bucket_rows = self.bucket_rows
+        self._clean_streak = 0
+        if device_domain is not None:
+            self._attach_domain(model)
         # oversized-frame window refills dispatch from inside finalize,
         # which the pipelined engine runs on its delivery thread — the
         # shape ledger must tolerate concurrent dispatchers
         import threading
 
         self._ledger_lock = threading.Lock()
+
+    def _attach_domain(self, model: Transformer) -> None:
+        """Hand the fault domain to every fused segment in ``model``
+        so segment-level compile failures poison per (segment,
+        signature) and HOST_DEGRADED diverts the fused programs to
+        their eager path."""
+        from sntc_tpu.fuse import attach_device_domain
+
+        attach_device_domain(model, self.device_domain)
 
     def swap_model(self, model: Transformer) -> Transformer:
         """Hot-swap the wrapped model IN PLACE, keeping the shape /
@@ -92,6 +137,24 @@ class BatchPredictor:
         closures bound it at dispatch time; the engine only calls this
         between micro-batches.  Returns the replaced model."""
         old, self.model = self.model, model
+        if self.device_domain is not None:
+            self._attach_domain(model)
+            # predictor-level poisons belong to the REPLACED model's
+            # predict programs (the fused-segment poison maps live on
+            # the old model's segments and leave with it) — the fresh
+            # model earns a clean device plan cache, or a promotion
+            # could never lift a shape off the host floor.  The
+            # domain's live poisoned-signatures gauge drops by every
+            # pair that just left serving.
+            from sntc_tpu.fuse import fused_segments
+
+            cleared = len(self._poisoned_shapes) + sum(
+                len(s._poisoned) for s in fused_segments(old)
+            )
+            with self._ledger_lock:
+                self._poisoned_shapes.clear()
+            if cleared:
+                self.device_domain.note_unpoisoned(cleared)
         return old
 
     # -- bucketed dispatch --------------------------------------------------
@@ -121,6 +184,7 @@ class BatchPredictor:
         frame: Frame,
         row_valid: "np.ndarray | None" = None,
         model=None,
+        _oom_depth: int = 0,
     ) -> Callable[[], Frame]:
         """Dispatch ONE at-most-chunk_rows frame through the model's
         async transform, bucket-padded when armed; the returned finalize
@@ -132,29 +196,195 @@ class BatchPredictor:
         finalize through the same ``VALID_COL`` mechanism as bucket
         padding, so salvage never changes the dispatched shape and the
         jitted programs never recompile (``compile_events`` stays
-        flat)."""
+        flat).
+
+        With a :class:`~sntc_tpu.resilience.device.DeviceFaultDomain`
+        armed, device/XLA errors classify and respond per kind instead
+        of raising through: OOM recursively halves the batch (floored
+        at the bucket minimum) and steps the bucket floor down; a
+        compile failure poisons the dispatched shape and serves the
+        eager host fallback; a lost device flips HOST_DEGRADED."""
+        dom = self.device_domain
+        if model is None:
+            model = self.model
+        if dom is not None and dom.host_degraded:
+            return self._fallback_dispatch(frame, row_valid, model)
         n = frame.num_rows
         target = bucket_rows_for(n, self.bucket_rows)
         all_admitted = row_valid is None or bool(np.all(row_valid))
-        if model is None:
-            model = self.model
-        if (target == n or n == 0) and all_admitted:
-            self._record_shape(n)
-            return model.transform_async(frame)
-        self._record_shape(target, padded=target - n)
-        with span("predict.bucket", rows=n, bucket=target):
-            valid = np.zeros(target, dtype=bool)
-            valid[:n] = True if row_valid is None else row_valid
-            padded = frame.pad_rows(target).with_column(VALID_COL, valid)
-        fin = model.transform_async(padded)
+        plain = (target == n or n == 0) and all_admitted
+        shape = n if plain else target
+        if shape in self._poisoned_shapes:
+            return self._fallback_dispatch(
+                frame, row_valid, model, poisoned=True
+            )
+        # a fused segment can ABSORB a compile failure inside this
+        # dispatch (poison + eager fallback, no exception escapes):
+        # such a dispatch "succeeds" but must not reset the domain's
+        # consecutive-fault streak — degradation would otherwise
+        # depend on which layer the same fault surfaced at
+        faults_before = dom.fault_count() if dom is not None else 0
+        try:
+            # the DEVICE fault boundaries: a fresh shape is (at most)
+            # one XLA compile of the predict program; every dispatch
+            # touches the device.  Unarmed these are dict misses.
+            if n and shape not in self._shapes_seen:
+                fault_point("predict.compile")
+            fault_point("device.dispatch")
+            if plain:
+                self._record_shape(n)
+                fin = model.transform_async(frame)
+            else:
+                self._record_shape(target, padded=target - n)
+                with span("predict.bucket", rows=n, bucket=target):
+                    valid = np.zeros(target, dtype=bool)
+                    valid[:n] = True if row_valid is None else row_valid
+                    padded = frame.pad_rows(target).with_column(
+                        VALID_COL, valid
+                    )
+                inner = model.transform_async(padded)
+
+                def fin() -> Frame:
+                    out = inner()
+                    mask = np.asarray(out[VALID_COL])
+                    out = out.drop(VALID_COL)
+                    # a row-dropping stage (handleInvalid='skip') may
+                    # have filtered the padded frame: the mask column
+                    # was filtered in lockstep, so it still marks
+                    # exactly the surviving real rows
+                    if mask.all():
+                        return out
+                    return out.filter(mask)
+
+        except Exception as e:
+            if dom is None:
+                raise
+            kind = classify_device_error(e)
+            if kind is None:
+                raise
+            return self._respond_device(
+                kind, e, frame, row_valid, model, shape, _oom_depth
+            )
+        if dom is not None and dom.fault_count() == faults_before:
+            dom.note_success()
+            if self.bucket_rows != self._cold_bucket_rows:
+                # clean-streak restoration: the OOM pressure passed —
+                # give small batches their shared buckets back
+                self._clean_streak += 1
+                if self._clean_streak >= dom.policy.floor_restore_after:
+                    dom.note_bucket_restore(
+                        self.bucket_rows, self._cold_bucket_rows
+                    )
+                    self.bucket_rows = self._cold_bucket_rows
+                    self._clean_streak = 0
+        return fin
+
+    # -- the device response ladder (resilience/device) ---------------------
+
+    def _respond_device(
+        self, kind: str, exc: BaseException, frame: Frame,
+        row_valid, model, shape: int, depth: int,
+    ) -> Callable[[], Frame]:
+        """Per-kind response to a classified device failure (module:
+        docs/RESILIENCE.md "Compute-plane fault domain")."""
+        dom = self.device_domain
+        if kind == "device_oom":
+            self._clean_streak = 0
+            n = frame.num_rows
+            floor = max(1, self.bucket_rows)
+            if n > floor and depth < dom.policy.oom_split_depth:
+                # split in half, retry ON DEVICE at the smaller shape;
+                # halves that still OOM split again until the floor.
+                # The bucket floor steps down ONCE per top-level
+                # dispatch (not once per recursion level — a 3-deep
+                # split must not cost floor/8)
+                dom.note_oom_split(
+                    rows=n, depth=depth, bucket_floor=self.bucket_rows
+                )
+                if depth == 0:
+                    self._step_bucket_floor()
+                mid = (n + 1) // 2
+                lmask = None if row_valid is None else row_valid[:mid]
+                rmask = None if row_valid is None else row_valid[mid:]
+                left = self._dispatch_one(
+                    frame.slice(0, mid), lmask, model=model,
+                    _oom_depth=depth + 1,
+                )
+                right = self._dispatch_one(
+                    frame.slice(mid, n), rmask, model=model,
+                    _oom_depth=depth + 1,
+                )
+                return lambda: Frame.concat_all([left(), right()])
+            # at the floor and still OOM: that is a platform fault, not
+            # a splittable batch — count it toward degradation
+            dom.note_fault(
+                kind, site="device.dispatch", rows=frame.num_rows,
+            )
+            if dom.host_degraded:
+                return self._fallback_dispatch(frame, row_valid, model)
+            try:  # already counted: the engine must not double-book it
+                exc._sntc_device_counted = True
+            except Exception:
+                pass
+            raise exc
+        if kind == "compile_error":
+            # poison exactly this dispatched shape: later batches in
+            # the same bucket take the host path; other shapes keep
+            # compiling on device
+            with self._ledger_lock:
+                fresh = shape not in self._poisoned_shapes
+                self._poisoned_shapes.add(shape)
+            if fresh:
+                dom.note_poisoned(
+                    site="predict.compile", signature=f"rows={shape}",
+                    reason=repr(exc),
+                )
+            dom.note_fault(kind, site="predict.compile")
+            return self._fallback_dispatch(
+                frame, row_valid, model, poisoned=True
+            )
+        # device_lost: the domain degrades immediately; serve this
+        # dispatch (and everything after it) through the host path
+        dom.note_fault(kind, site="device.dispatch")
+        return self._fallback_dispatch(frame, row_valid, model)
+
+    def _step_bucket_floor(self) -> None:
+        """OOM pressure response: halve the shape-bucket floor (never
+        below the policy minimum) so small batches stop padding up to
+        a bucket the device cannot hold."""
+        dom = self.device_domain
+        if self.bucket_rows <= dom.policy.bucket_floor_min:
+            return
+        new = max(dom.policy.bucket_floor_min, self.bucket_rows // 2)
+        if new != self.bucket_rows:
+            dom.note_bucket_floor(self.bucket_rows, new)
+            self.bucket_rows = new
+
+    def _fallback_dispatch(
+        self, frame: Frame, row_valid, model, poisoned: bool = False,
+    ) -> Callable[[], Frame]:
+        """The eager HOST path: no bucket padding, no device fault
+        surface, fused segments divert to their stage-by-stage eager
+        transform (they carry the same domain).  Output is pinned
+        bitwise against the device path for f64-preserving stages and
+        at documented tolerances for f32 device-cast stages
+        (docs/RESILIENCE.md tolerance table)."""
+        dom = self.device_domain
+        if dom is not None:
+            dom.note_fallback(poisoned=poisoned)
+        all_admitted = row_valid is None or bool(np.all(row_valid))
+        if all_admitted:
+            def finalize() -> Frame:
+                return _eager_transform(model, frame)
+
+            return finalize
+        valid = np.asarray(row_valid, dtype=bool)
+        carried = frame.with_column(VALID_COL, valid)
 
         def finalize() -> Frame:
-            out = fin()
+            out = _eager_transform(model, carried)
             mask = np.asarray(out[VALID_COL])
             out = out.drop(VALID_COL)
-            # a row-dropping stage (handleInvalid='skip') may have
-            # filtered the padded frame: the mask column was filtered in
-            # lockstep, so it still marks exactly the surviving real rows
             if mask.all():
                 return out
             return out.filter(mask)

@@ -73,7 +73,8 @@ def _ok_marker() -> str:
 
 
 def probe_default_backend(
-    timeout_s: float | None = None, *, specific_env: str | None = None
+    timeout_s: float | None = None, *, specific_env: str | None = None,
+    use_cache: bool = True,
 ) -> bool:
     """True if the default JAX backend initializes within the timeout.
 
@@ -83,7 +84,9 @@ def probe_default_backend(
     trusts the backend.  A success is cached in a marker file for
     5 minutes so repeated CLI calls on a healthy backend don't pay a
     full subprocess backend init each time (failures are never cached —
-    a tunnel can come back any moment)."""
+    a tunnel can come back any moment).  ``use_cache=False`` forces a
+    REAL probe: a caller asking whether a just-dead device came back
+    must not be answered from stale success evidence."""
     if timeout_s is None:
         raw = None
         if specific_env:
@@ -101,11 +104,12 @@ def probe_default_backend(
     if timeout_s <= 0:
         return True
     marker = _ok_marker()
-    try:
-        if time.time() - os.path.getmtime(marker) < _OK_TTL_S:
-            return True
-    except OSError:
-        pass
+    if use_cache:
+        try:
+            if time.time() - os.path.getmtime(marker) < _OK_TTL_S:
+                return True
+        except OSError:
+            pass
     policy = _probe_policy(deadline_s=timeout_s)
     attempt_timeout = timeout_s / policy.max_attempts
 
@@ -137,6 +141,29 @@ def probe_default_backend(
         except OSError:
             pass
     return ok
+
+
+def probe_for_recovery(timeout_s: float | None = None) -> bool:
+    """The compute-plane fault domain's recovery probe (r18): same
+    subprocess liveness check, but bounded by
+    ``SNTC_RECOVERY_PROBE_TIMEOUT_S`` (default 20 s) instead of the
+    startup budget — a HOST_DEGRADED predictor probes periodically from
+    a background thread, and each probe must stay short enough that a
+    still-dead tunnel never stacks minutes of subprocess waits.
+
+    The 5-minute success-marker cache is BYPASSED: the whole question
+    is whether a device that just died came back, and a marker written
+    minutes before the death would answer yes from stale evidence —
+    flapping the domain OK → dead dispatch → degraded on every probe
+    interval.  A genuine success still refreshes the marker for the
+    startup-probe callers."""
+    if timeout_s is None:
+        raw = os.environ.get("SNTC_RECOVERY_PROBE_TIMEOUT_S", 20)
+        try:
+            timeout_s = float(raw)
+        except (TypeError, ValueError):
+            timeout_s = 20.0
+    return probe_default_backend(timeout_s, use_cache=False)
 
 
 def add_platform_arg(parser) -> None:

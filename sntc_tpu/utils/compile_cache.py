@@ -79,6 +79,102 @@ def resolve_cache_dir(cache_dir: str | None = None) -> str | None:
     return os.path.join(base, part)
 
 
+def fsck_compile_cache(
+    cache_dir: str | None = None, *, repair: bool = True
+) -> dict:
+    """Doctor the persistent XLA compilation cache (r18, the ``sntc
+    fsck`` extension): a crash or ENOSPC mid-write can leave
+    zero-length, unreadable, or orphaned-tmp entries under the
+    directory :func:`enable_persistent_cache` manages — jax then either
+    warns per hit or, in the worst case, dies deserializing a torn
+    executable.  Poisoned entries are QUARANTINED to ``.corrupt/``
+    beside the cache (the r17 ``.corrupt/`` discipline — evidence
+    preserved, never deleted) so the next compile is a clean miss that
+    RECOMPILES instead of crashing; ``*.tmp`` orphans are swept.
+
+    Cache entries are opaque compressed executables, so "verify" means
+    structural health: readable, non-empty, not a tmp orphan — content
+    validity stays jax's job (a quarantined entry costs one recompile,
+    which is exactly the safe outcome).
+
+    Returns a machine-readable report mirroring the storage-plane fsck
+    shape; ``repair=False`` reports without moving anything."""
+    resolved = cache_dir or resolve_cache_dir()
+    report: dict = {
+        "cache_dir": resolved,
+        "repair": bool(repair),
+        "checked": 0,
+        "quarantined": [],
+        "cleaned": [],
+        "errors": [],
+        "ok": True,
+    }
+    if resolved is None or not os.path.isdir(resolved):
+        return report
+
+    def _quarantine(path: str, detail: str) -> None:
+        entry = {"path": path, "detail": detail}
+        if not repair:
+            report["errors"].append(entry)
+            return
+        # the storage plane's shared quarantine: .corrupt/ beside the
+        # blob + a journaled repair record (storage_repair.jsonl under
+        # the cache dir) — 'quarantine' means one thing repo-wide
+        from sntc_tpu.resilience.storage import quarantine_blob
+
+        dest = quarantine_blob(
+            path, artifact="compile_cache", detail=detail,
+            root=resolved,
+        )
+        if dest is None:
+            report["errors"].append(
+                dict(entry, detail=f"{detail}; quarantine failed")
+            )
+            return
+        entry["quarantined_to"] = dest
+        report["quarantined"].append(entry)
+
+    for dirpath, dirs, files in os.walk(resolved):
+        dirs[:] = [d for d in dirs if d != ".corrupt"]
+        for name in files:
+            if name.startswith("storage_repair.jsonl"):
+                continue  # the quarantine journal, not a cache entry
+            path = os.path.join(dirpath, name)
+            stem, _, suffix = name.rpartition(".tmp")
+            if stem and (not suffix or suffix.lstrip("-").isdigit()):
+                # an orphaned atomic-write temp: a cache writer died
+                # mid-publish; the entry it was building never existed
+                report["checked"] += 1
+                if repair:
+                    try:
+                        os.unlink(path)
+                        report["cleaned"].append({"path": path})
+                    except OSError as e:
+                        report["errors"].append(
+                            {"path": path,
+                             "detail": f"unlink failed: {e}"}
+                        )
+                else:
+                    report["errors"].append(
+                        {"path": path, "detail": "orphaned tmp file"}
+                    )
+                continue
+            report["checked"] += 1
+            try:
+                size = os.path.getsize(path)
+                if size == 0:
+                    _quarantine(path, "zero-length cache entry")
+                    continue
+                # readable? (permission damage / torn inode both
+                # surface here) — one short read, not a full load
+                with open(path, "rb") as f:
+                    f.read(64)
+            except OSError as e:
+                _quarantine(path, f"unreadable cache entry: {e}")
+    report["ok"] = not report["errors"]
+    return report
+
+
 def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     """Turn on JAX's on-disk compilation cache; returns the dir (or None
     when disabled).  Safe to call more than once and before/after other
