@@ -232,7 +232,8 @@ LR_MAX_ITER = 100
 # spends its split budget on the large classes), so the config-3 quality
 # bar would certify nothing; at depth 10 both our RF and the proxy land
 # ~0.8 — a discriminative regime where a broken grower shows
-RF_TREES, RF_DEPTH = 20, 10
+RF_TREES = int(os.environ.get("BENCH_RF_TREES", 20))
+RF_DEPTH = int(os.environ.get("BENCH_RF_DEPTH", 10))
 CHISQ_TOP = 40
 GBT_ROUNDS, GBT_DEPTH = 10, 4
 # 128 quantile bins ≈ sklearn's exact splits in macro-F1 on this workload
@@ -253,6 +254,7 @@ DEFAULT_ROWS = {
     "11": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
     "12": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
     "13": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
+    "14": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
 }
 
 
@@ -2553,6 +2555,288 @@ def bench_config13(n_rows, mesh):
     }
 
 
+# config 14: elastic-fleet worker-death recovery (r19).  The question:
+# when one of three REAL worker processes is SIGKILLed mid-stream, does
+# the coordinator's lease-expiry → dead-source migration path actually
+# deliver zero committed-row loss AND recovered throughput?  Two
+# passes serve the SAME 10-tenant file stream through a 3-worker fleet
+# (in-process coordinator — its sntc_fleet_* series land in this
+# process's obs delta — real `fleet-serve --fleet-worker-id` worker
+# children): a reference pass runs unkilled; the kill pass SIGKILLs
+# the most-loaded worker once every tenant has committed batches, then
+# scales out a replacement (the elastic half: a fresh worker earns its
+# consistent-hash share through the same migration path) and phase-2
+# files land only after the fleet has re-converged.  Evidence:
+# per-tenant sink unions byte-identical across the passes (zero rows
+# lost or duplicated through the kill + migrations), the recovery
+# latency, and post-recovery rows/s against the reference's.
+BENCH14_WORKERS = 3
+BENCH14_TENANTS = 10
+BENCH14_PHASE_FILES = (3, 3)  # per tenant: pre-kill, post-recovery
+
+
+def bench_config14(n_rows, mesh):
+    """Fleet worker-death recovery vs an unkilled reference
+    (docs/RESILIENCE.md "Elastic serve fleet")."""
+    import shutil
+    import subprocess
+    import tempfile
+    from types import SimpleNamespace
+
+    import pyarrow.csv as pacsv
+
+    from sntc_tpu.core.base import Pipeline
+    from sntc_tpu.data import CICIDS2017_FEATURES
+    from sntc_tpu.mlio import save_model
+    from sntc_tpu.models import LogisticRegression
+    from sntc_tpu.serve.fleet import FleetCoordinator
+
+    train, test = _dataset(n_rows, binary=True)
+    pipe = Pipeline(stages=_feature_stages(mesh) + [
+        LogisticRegression(mesh=mesh, maxIter=20)
+    ]).fit(train)
+
+    n_files = sum(BENCH14_PHASE_FILES)
+    chunk = max(96, min(512, n_rows // 120))
+    tids = [f"t{i}" for i in range(BENCH14_TENANTS)]
+    worker_ids = [f"w{i}" for i in range(BENCH14_WORKERS)]
+    tmp = tempfile.mkdtemp()
+    try:
+        model_dir = os.path.join(tmp, "model")
+        save_model(pipe, model_dir)
+        # stage every input file ONCE: both passes serve identical bytes
+        staging = os.path.join(tmp, "staging")
+        os.makedirs(staging)
+        rows_per_file = {}
+        for ti, tid in enumerate(tids):
+            for fi in range(n_files):
+                at = ((ti * n_files + fi) * 131) % max(
+                    1, test.num_rows - chunk
+                )
+                part = test.slice(at, at + chunk)
+                pacsv.write_csv(
+                    part.select(CICIDS2017_FEATURES).to_arrow(),
+                    os.path.join(staging, f"{tid}_part_{fi:03d}.csv"),
+                )
+                rows_per_file[tid, fi] = part.num_rows
+
+        def _feed(pass_dir, tid, lo, hi):
+            for fi in range(lo, hi):
+                src = os.path.join(staging, f"{tid}_part_{fi:03d}.csv")
+                dst = os.path.join(
+                    pass_dir, "in", tid, f"part_{fi:03d}.csv"
+                )
+                shutil.copy(src, dst + ".tmp")
+                os.rename(dst + ".tmp", dst)
+
+        def _batches(pass_dir, tid):
+            return sorted(glob.glob(os.path.join(
+                pass_dir, "out", tid, "batch_*.csv"
+            )))
+
+        def _rows_done(pass_dir):
+            done = 0
+            for tid in tids:
+                for p in _batches(pass_dir, tid):
+                    with open(p, "rb") as f:
+                        done += max(0, f.read().count(b"\n") - 1)
+            return done
+
+        def _run_pass(name, kill):
+            pass_dir = os.path.join(tmp, name)
+            root = os.path.join(pass_dir, "root")
+            entries = []
+            for tid in tids:
+                os.makedirs(os.path.join(pass_dir, "in", tid))
+                entries.append({
+                    "id": tid, "model": model_dir,
+                    "watch": os.path.join(pass_dir, "in", tid),
+                    "out": os.path.join(pass_dir, "out", tid),
+                })
+                _feed(pass_dir, tid, 0, BENCH14_PHASE_FILES[0])
+            tenants_json = os.path.join(pass_dir, "tenants.json")
+            with open(tenants_json, "w") as f:
+                json.dump({"tenants": entries}, f)
+            coord = FleetCoordinator(
+                root, worker_ids,
+                {tid: SimpleNamespace(placement_cost=None, weight=1.0,
+                                      pinned_worker=None)
+                 for tid in tids},
+                lease_ttl_s=1.0, boot_grace_s=600.0,
+            )
+            argv = [
+                sys.executable, "-m", "sntc_tpu", "fleet-serve",
+                "--tenants", tenants_json, "--root", root,
+                "--poll-interval", "0.05", "--no-device-faults",
+            ]
+            procs = {
+                wid: subprocess.Popen(
+                    argv + ["--fleet-worker-id", wid],
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                )
+                for wid in worker_ids
+            }
+
+            def _wait(pred, what, timeout=600.0):
+                deadline = time.time() + timeout
+                while time.time() < deadline:
+                    coord.tick()
+                    if pred():
+                        return
+                    time.sleep(0.05)
+                raise RuntimeError(
+                    f"config 14 {name}: timed out waiting for {what}"
+                )
+
+            out = {}
+            try:
+                # mid-stream milestone: every tenant has committed
+                # batches, every worker is carrying real load
+                _wait(
+                    lambda: all(_batches(pass_dir, t) for t in tids),
+                    "first committed batch per tenant",
+                )
+                t_mid = time.perf_counter()
+                rows_mid = _rows_done(pass_dir)
+                if kill:
+                    victim = max(
+                        worker_ids,
+                        key=lambda w: sum(
+                            1 for e in coord.assignments.values()
+                            if e["worker"] == w
+                        ),
+                    )
+                    out["killed_worker"] = victim
+                    out["dead_tenants"] = sorted(
+                        t for t, e in coord.assignments.items()
+                        if e["worker"] == victim
+                    )
+                    procs[victim].kill()
+                    procs[victim].wait()
+                    _wait(
+                        lambda: (
+                            coord.status()["workers"][victim]["state"]
+                            == "dead"
+                            and all(
+                                e["phase"] == "serving"
+                                and e["worker"] != victim
+                                for e in coord.assignments.values()
+                            )
+                        ),
+                        "dead-worker recovery",
+                    )
+                    out["recovery_s"] = round(
+                        time.perf_counter() - t_mid, 2
+                    )
+                    # the elastic half: a replacement worker joins and
+                    # earns its consistent-hash share back through the
+                    # same migration path, restoring fleet capacity
+                    newid = f"w{BENCH14_WORKERS}"
+                    procs[newid] = subprocess.Popen(
+                        argv + ["--fleet-worker-id", newid],
+                        stdout=subprocess.DEVNULL,
+                        stderr=subprocess.DEVNULL,
+                    )
+                    coord.add_worker(newid)
+                    out["scaled_out_worker"] = newid
+                    _wait(
+                        lambda: (
+                            coord.status()["workers"][newid]["state"]
+                            == "live"
+                            and all(
+                                e["phase"] == "serving"
+                                for e in coord.assignments.values()
+                            )
+                        ),
+                        "scale-out worker joining",
+                    )
+                # phase 2: the post-recovery (or reference) window
+                t2 = time.perf_counter()
+                for tid in tids:
+                    _feed(pass_dir, tid, BENCH14_PHASE_FILES[0],
+                          n_files)
+                _wait(
+                    lambda: all(
+                        len(_batches(pass_dir, t)) == n_files
+                        for t in tids
+                    ),
+                    "every tenant fully served",
+                )
+                t_end = time.perf_counter()
+                rows_end = _rows_done(pass_dir)
+                out["rows"] = rows_end
+                out["rows_per_s"] = round(
+                    (rows_end - rows_mid) / (t_end - t_mid), 1
+                )
+                phase2_rows = sum(
+                    rows_per_file[t, fi] for t in tids
+                    for fi in range(BENCH14_PHASE_FILES[0], n_files)
+                )
+                out["recovered_rows_per_s"] = round(
+                    phase2_rows / (t_end - t2), 1
+                )
+                out["migrations"] = dict(coord.migrations)
+                out["sinks"] = {
+                    tid: {
+                        os.path.basename(p): open(p, "rb").read()
+                        for p in _batches(pass_dir, tid)
+                    }
+                    for tid in tids
+                }
+            finally:
+                coord.drain_fleet("bench_complete")
+                deadline = time.time() + 60
+                for p in procs.values():
+                    if p.poll() is None:
+                        try:
+                            p.wait(timeout=max(
+                                0.1, deadline - time.time()
+                            ))
+                        except subprocess.TimeoutExpired:
+                            p.kill()
+                            p.wait()
+                coord.tick()
+                coord.close()
+            return out
+
+        ref = _run_pass("reference", kill=False)
+        killed = _run_pass("killed", kill=True)
+        sink_match = all(
+            killed["sinks"][t] == ref["sinks"][t] for t in tids
+        )
+        fleet_evidence = {
+            "workers": BENCH14_WORKERS,
+            "tenants": BENCH14_TENANTS,
+            "stream_files": BENCH14_TENANTS * n_files,
+            "killed_worker": killed["killed_worker"],
+            "scaled_out_worker": killed["scaled_out_worker"],
+            "dead_tenants_migrated": len(killed["dead_tenants"]),
+            "migrations": killed["migrations"],
+            "recovery_s": killed["recovery_s"],
+            # the headline invariants: nothing lost through the kill,
+            # throughput back after the survivors absorb the load
+            "zero_committed_rows_lost": sink_match,
+            "recovered_rows_per_s": killed["recovered_rows_per_s"],
+            "reference_rows_per_s": ref["recovered_rows_per_s"],
+            "recovered_over_reference": _round_ratio(
+                killed["recovered_rows_per_s"]
+                / ref["recovered_rows_per_s"]
+            ),
+        }
+        total_rows = killed["rows"]
+        value = killed["rows_per_s"]
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "metric": "cicids2017_fleet_recovery_rows_per_s",
+        "_datasets": (train, test),
+        "value": value, "unit": "rows/s",
+        "quality": {"fleet_recovery": fleet_evidence},
+        "n_rows": total_rows,
+    }
+
+
 BENCHES = {
     "1": bench_config1,
     "2": bench_config2,
@@ -2567,6 +2851,7 @@ BENCHES = {
     "11": bench_config11,
     "12": bench_config12,
     "13": bench_config13,
+    "14": bench_config14,
 }
 
 
@@ -3163,6 +3448,10 @@ PROXIES = {
     # config 13 is the same serving job with the device-fault storm
     # landing mid-stream; the external anchor stays the config-5 proxy
     "13": proxy_config5,
+    # config 14 is the same serving job spread over a worker fleet
+    # with one worker killed; the external anchor stays the config-5
+    # proxy
+    "14": proxy_config5,
 }
 
 
@@ -3331,7 +3620,8 @@ def run_config(cfg: str, rows, pair: bool = True):
         # invocation, on the same train/test split — both sides of the
         # ratio see the same host state (VERDICT r4 item 2)
         proxy = PROXIES[cfg](train, test)
-        if cfg in ("5", "6", "7", "8", "9", "10", "11", "12", "13"):
+        if cfg in ("5", "6", "7", "8", "9", "10", "11", "12", "13",
+                   "14"):
             line["vs_baseline"] = _round_ratio(
                 result["value"] / proxy["rows_per_s"]
             )

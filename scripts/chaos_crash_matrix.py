@@ -169,6 +169,23 @@ PROMOTE_EXPECT_CANDIDATE = {
     "post_swap": True,
 }
 
+# elastic serve fleet scenarios (r19): a coordinator child supervising
+# two fleet-worker children over four tenants, killed at each fleet
+# protocol boundary.  ``fleet.lease`` kills a WORKER mid-heartbeat
+# (worker-crash: the coordinator expires its lease and migrates its
+# tenants to the survivor — the dead-source migration path, no drain);
+# ``fleet.assign`` kills the COORDINATOR mid-publish (restart adopts
+# the last published epoch through recover());  ``fleet.migrate``
+# kills the coordinator mid-ship during an explicit tenant migration
+# (restart quarantines the torn ``.shipping`` copy and re-ships from
+# the intact source).  Every scenario must end with each tenant
+# serving on exactly one worker and per-tenant sink BYTES identical
+# to an unkilled fleet reference — migration never loses a committed
+# row.
+FLEET_KILL_SITES = ("fleet.lease", "fleet.assign", "fleet.migrate")
+FLEET_WORKER_IDS = ("fw0", "fw1")
+FLEET_TENANT_IDS = ("ft0", "ft1", "ft2", "ft3")
+
 
 # ---------------------------------------------------------------------------
 # scenario inputs / state readers (parent side; no sntc_tpu import)
@@ -1114,6 +1131,324 @@ def run_controller_noisy_scenario(workdir: str) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# elastic-serve-fleet scenarios (r19)
+# ---------------------------------------------------------------------------
+
+FLEET_FILES_PER_TENANT = 3
+FLEET_ROWS_PER_FILE = 6
+FLEET_EXPECTED_ROWS = (
+    len(FLEET_TENANT_IDS) * FLEET_FILES_PER_TENANT * FLEET_ROWS_PER_FILE
+)
+
+
+def _write_fleet_inputs(d: str) -> None:
+    """Per-tenant input dirs with DISTINCT row values (tenant index in
+    the hundred-thousands block) so cross-tenant mixups during a
+    migration would show in the sink bytes."""
+    for k, tid in enumerate(FLEET_TENANT_IDS):
+        tdir = os.path.join(d, "in", tid)
+        os.makedirs(tdir, exist_ok=True)
+        for i in range(FLEET_FILES_PER_TENANT):
+            with open(
+                os.path.join(tdir, f"in_{i:03d}.csv"), "w", newline=""
+            ) as f:
+                w = csv.writer(f)
+                w.writerow(["x"])
+                for r in range(FLEET_ROWS_PER_FILE):
+                    w.writerow([k * 100_000 + i * 1000 + r])
+
+
+def _fleet_sink_state(d: str) -> dict:
+    """Per-tenant sink-dir bytes — the sinks are SHARED absolute dirs
+    outside the worker trees, so this is the per-tenant union across
+    every worker that ever served the tenant."""
+    return {
+        tid: sink_contents(os.path.join(d, "out", tid))
+        for tid in FLEET_TENANT_IDS
+    }
+
+
+def _fleet_rows_served(d: str) -> int:
+    total = 0
+    for contents in _fleet_sink_state(d).values():
+        for data in contents.values():
+            lines = data.decode(errors="replace").strip().splitlines()
+            total += max(0, len(lines) - 1)  # minus the header
+    return total
+
+
+def _fleet_tenant_homes(d: str) -> dict:
+    """Which workers hold an on-disk tree for each tenant — the
+    single-home evidence (exactly one after any migration)."""
+    homes = {}
+    for tid in FLEET_TENANT_IDS:
+        homes[tid] = sorted(
+            os.path.basename(os.path.dirname(os.path.dirname(p)))
+            for p in glob.glob(
+                os.path.join(d, "root", "worker", "*", "tenant", tid)
+            )
+        )
+    return homes
+
+
+def _fleet_assignment_doc(d: str) -> dict:
+    try:
+        with open(
+            os.path.join(d, "root", "fleet", "assignments.json")
+        ) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _spawn_fleet_child(d: str, extra) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS="")
+    env.pop("SNTC_RESILIENCE_LOG", None)
+    return subprocess.Popen(
+        [
+            sys.executable, SCRIPT, "--worker",
+            "--fleet-root", os.path.join(d, "root"),
+            "--watch", os.path.join(d, "in"),
+            "--out", os.path.join(d, "out"),
+            "--tenants", ",".join(FLEET_TENANT_IDS),
+            "--poll-interval", "0.05",
+        ] + list(extra),
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+
+
+def _spawn_fleet_worker(
+    d: str, wid: str, *, kill_site: str = "", kill_after: int = 0,
+) -> subprocess.Popen:
+    extra = ["--fleet-worker", "--worker-id", wid]
+    if kill_site:
+        extra += ["--kill-site", kill_site,
+                  "--kill-after", str(kill_after)]
+    return _spawn_fleet_child(d, extra)
+
+
+def _spawn_fleet_coordinator(
+    d: str, *, kill_site: str = "", kill_after: int = 0,
+    migrate: str = "",
+) -> subprocess.Popen:
+    extra = [
+        "--fleet-coordinator",
+        "--workers", ",".join(FLEET_WORKER_IDS),
+        "--lease-ttl", "2.0", "--boot-grace", "60",
+    ]
+    if kill_site:
+        extra += ["--kill-site", kill_site,
+                  "--kill-after", str(kill_after)]
+    if migrate:
+        extra += ["--migrate-tenant", migrate]
+    return _spawn_fleet_child(d, extra)
+
+
+def _raise_fleet_drain(d: str) -> None:
+    # parent-side (no sntc_tpu import): a plain atomic JSON marker
+    fdir = os.path.join(d, "root", "fleet")
+    os.makedirs(fdir, exist_ok=True)
+    tmp = os.path.join(fdir, "fleet_drain_marker.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump({"reason": "matrix", "ts": time.time()}, f)
+    os.replace(tmp, os.path.join(fdir, "fleet_drain_marker.json"))
+
+
+def _run_fleet_pass(
+    d: str, *, coord_kill=("", 0), worker_kill=None, migrate: str = "",
+    wait_for=None, timeout: float = 240.0,
+) -> dict:
+    """Drive one coordinator + two-worker fleet pass to completion:
+    restart a coordinator the armed fault killed (workers killed at
+    ``fleet.lease`` stay down — that IS the worker-crash scenario),
+    raise the fleet drain marker once every input row reached a sink
+    (and ``wait_for(d)`` holds), and return the evidence."""
+    worker_kill = dict(worker_kill or {})
+    _write_fleet_inputs(d)
+    coord = _spawn_fleet_coordinator(
+        d, kill_site=coord_kill[0], kill_after=coord_kill[1],
+        migrate=migrate,
+    )
+    workers = {}
+    for wid in FLEET_WORKER_IDS:
+        site, after = worker_kill.get(wid, ("", 0))
+        workers[wid] = _spawn_fleet_worker(
+            d, wid, kill_site=site, kill_after=after
+        )
+    kills, error, status = [], None, None
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        served = _fleet_rows_served(d)
+        if served >= FLEET_EXPECTED_ROWS and (
+            wait_for is None or wait_for(d, kills)
+        ):
+            break
+        rc = coord.poll()
+        if rc is not None:
+            if rc == KILL_EXIT_CODE:
+                kills.append(["coordinator", rc])
+                # restart WITHOUT the armed kill / migrate flags: the
+                # in-flight migration lives in the assignment marker
+                coord = _spawn_fleet_coordinator(d)
+            else:
+                _o, e = coord.communicate()
+                error = f"coordinator exited rc={rc} mid-pass: {e[-800:]}"
+                break
+        for wid, proc in workers.items():
+            if proc is None:
+                continue
+            rc = proc.poll()
+            if rc is None:
+                continue
+            if rc == KILL_EXIT_CODE:
+                kills.append([wid, rc])
+                workers[wid] = None  # stays dead: worker-crash
+            else:
+                _o, e = proc.communicate()
+                error = f"worker {wid} exited rc={rc} mid-pass: {e[-800:]}"
+                break
+        if error:
+            break
+        time.sleep(0.2)
+    else:
+        error = (
+            f"timed out: {_fleet_rows_served(d)}/{FLEET_EXPECTED_ROWS} "
+            f"rows served, kills={kills}"
+        )
+    _raise_fleet_drain(d)
+    procs = [coord] + [p for p in workers.values() if p is not None]
+    for proc in procs:
+        try:
+            out, err = proc.communicate(timeout=90)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+            error = error or f"child hung past the drain marker: {err[-500:]}"
+            continue
+        if proc is coord:
+            try:
+                status = json.loads(
+                    [ln for ln in out.splitlines()
+                     if ln.startswith("{")][-1]
+                )
+            except (IndexError, ValueError):
+                error = error or (
+                    f"no coordinator verdict (rc={proc.returncode}): "
+                    f"{err[-500:]}"
+                )
+        if proc.returncode not in (0, KILL_EXIT_CODE) and not error:
+            error = f"child drain rc={proc.returncode}: {err[-500:]}"
+    return {
+        "sinks": _fleet_sink_state(d),
+        "homes": _fleet_tenant_homes(d),
+        "status": status,
+        "kills": kills,
+        "error": error,
+    }
+
+
+def run_fleet_reference(workdir: str) -> dict:
+    """One unkilled coordinator + two-worker fleet pass — the bitwise
+    baseline every fleet kill scenario compares its per-tenant sink
+    union against."""
+    res = _run_fleet_pass(os.path.join(workdir, "fleet_reference"))
+    if res["error"]:
+        raise RuntimeError(f"fleet reference failed: {res['error']}")
+    return res
+
+
+def run_fleet_kill_scenario(
+    workdir: str, site: str, reference: dict,
+) -> dict:
+    """Kill the fleet at ``site`` and require convergence: the armed
+    child died rc-137, every tenant ends serving from EXACTLY ONE
+    worker, and the per-tenant sink union is byte-identical to the
+    unkilled reference — no committed row lost, none duplicated."""
+    d = os.path.join(workdir, "fleet_" + site.replace(".", "_"))
+
+    def _killed(_d, kills):
+        return bool(kills)
+
+    if site == "fleet.lease":
+        # worker-crash: fw0 dies on its SECOND heartbeat (one serve
+        # round behind it, its tenants' streams unfinished) and STAYS
+        # dead; the coordinator expires the lease and must migrate its
+        # tenants to the survivor before the remaining rows can land
+        dead = FLEET_WORKER_IDS[0]
+
+        def _recovered(_d, kills):
+            if not kills:
+                return False
+            tenants = _fleet_assignment_doc(_d).get("tenants", {})
+            return bool(tenants) and all(
+                e.get("phase") == "serving" and e.get("worker") != dead
+                for e in tenants.values()
+            )
+
+        res = _run_fleet_pass(
+            d, worker_kill={dead: (site, 1)}, wait_for=_recovered,
+        )
+        expect_killed = dead
+    elif site == "fleet.assign":
+        # the coordinator dies mid-publish on epoch 2 (the first
+        # liveness transition) and restarts through recover()
+        res = _run_fleet_pass(
+            d, coord_kill=(site, 1), wait_for=_killed
+        )
+        expect_killed = "coordinator"
+    else:  # fleet.migrate: kill-mid-ship during an explicit migration
+        moved = FLEET_TENANT_IDS[0]
+
+        def _migrated(_d, kills):
+            # the kill fired AND the re-ship completed: a sealed
+            # manifest exists and the tenant is back to serving
+            if not kills:
+                return False
+            entry = _fleet_assignment_doc(_d).get("tenants", {}).get(
+                moved, {}
+            )
+            return entry.get("phase") == "serving" and os.path.exists(
+                os.path.join(
+                    _d, "root", "fleet", "migrations", f"{moved}.json"
+                )
+            )
+
+        res = _run_fleet_pass(
+            d, coord_kill=(site, 1), migrate=moved, wait_for=_migrated
+        )
+        expect_killed = "coordinator"
+    if res["error"]:
+        return {"site": site, "ok": False, "error": res["error"],
+                "kills": res["kills"], "status": res["status"]}
+    killed_ok = any(
+        who == expect_killed and rc == KILL_EXIT_CODE
+        for who, rc in res["kills"]
+    )
+    single_homed = all(
+        len(homes) == 1 for homes in res["homes"].values()
+    )
+    phases = (res["status"] or {}).get("phases", {})
+    all_serving = phases.get("serving", 0) == len(FLEET_TENANT_IDS)
+    bitwise = res["sinks"] == reference["sinks"]
+    migrated_ok = site == "fleet.assign" or (
+        ((res["status"] or {}).get("migrations") or {})
+        .get("completed", 0) >= 1
+    )
+    ok = (killed_ok and single_homed and all_serving and bitwise
+          and migrated_ok)
+    return {
+        "site": site, "ok": ok, "kills": res["kills"],
+        "killed_expected": killed_ok,
+        "tenant_homes": res["homes"],
+        "single_homed": single_homed,
+        "phases": phases,
+        "sink_bitwise": bitwise,
+        "migrations": (res["status"] or {}).get("migrations"),
+    }
+
+
 def run_matrix(workdir: str, pipelined: bool = False) -> dict:
     """The full matrix: reference is ALWAYS the serial engine; kill and
     drain scenarios run serial or pipelined per ``pipelined`` and must
@@ -1149,6 +1484,11 @@ def run_matrix(workdir: str, pipelined: bool = False) -> dict:
     results.extend(
         run_device_kill_scenario(workdir, s, dev_ref)
         for s in DEVICE_KILL_SITES
+    )
+    fleet_ref = run_fleet_reference(workdir)
+    results.extend(
+        run_fleet_kill_scenario(workdir, s, fleet_ref)
+        for s in FLEET_KILL_SITES
     )
     return {"ok": all(r["ok"] for r in results), "scenarios": results}
 
@@ -1353,6 +1693,95 @@ def daemon_worker_main(args) -> int:
         },
         "ctl": ctl_report,
     }))
+    return 0
+
+
+def _fleet_child_specs(args) -> dict:
+    """The shared tenant catalog both fleet child roles build: one
+    Identity-model file-watch tenant per id, sinks at SHARED absolute
+    paths outside the worker trees (the union across workers is the
+    migration-survival evidence)."""
+    from sntc_tpu.core.base import Transformer
+    from sntc_tpu.serve import TenantSpec
+
+    class Identity(Transformer):
+        def transform(self, frame):
+            return frame
+
+    model = Identity()
+    return {
+        tid: TenantSpec(
+            tenant_id=tid, model=model,
+            watch=os.path.join(args.watch, tid),
+            out=os.path.join(args.out, tid),
+            out_columns=["x"], max_batch_offsets=1,
+        )
+        for tid in args.tenants.split(",")
+    }
+
+
+def fleet_worker_main(args) -> int:
+    """One fleet worker: renew the lease, apply the published
+    assignment, serve — until SIGTERM or the fleet drain marker.
+    ``--kill-site fleet.lease`` arms the worker-crash kill."""
+    sys.path.insert(0, REPO)
+    from sntc_tpu.resilience import arm
+    from sntc_tpu.serve.fleet import FleetWorker
+
+    if args.kill_site:
+        arm(args.kill_site, kind="kill", after=args.kill_after, times=1)
+    worker = FleetWorker(
+        args.worker_id, args.fleet_root, _fleet_child_specs(args)
+    )
+    status = worker.run(poll_interval=args.poll_interval)
+    print(json.dumps({
+        "worker": args.worker_id,
+        "tenants": sorted(status.get("tenants", {})),
+    }))
+    return 0
+
+
+def fleet_coordinator_main(args) -> int:
+    """The coordinator child: tick until the fleet drain marker.
+    ``--migrate-tenant`` starts one explicit migration once every
+    worker is live and rows are flowing (the kill-mid-migrate
+    scenario arms ``--kill-site fleet.migrate`` on top)."""
+    sys.path.insert(0, REPO)
+    from sntc_tpu.resilience import arm
+    from sntc_tpu.serve.fleet import (
+        FLEET_DRAIN_MARKER,
+        FleetCoordinator,
+        fleet_meta_dir,
+    )
+
+    if args.kill_site:
+        arm(args.kill_site, kind="kill", after=args.kill_after, times=1)
+    coord = FleetCoordinator(
+        args.fleet_root, args.workers.split(","),
+        _fleet_child_specs(args),
+        lease_ttl_s=args.lease_ttl, boot_grace_s=args.boot_grace,
+    )
+    pending = args.migrate_tenant or None
+    marker = os.path.join(
+        fleet_meta_dir(args.fleet_root), FLEET_DRAIN_MARKER
+    )
+    try:
+        while True:
+            st = coord.tick()
+            if pending is not None:
+                ws = st["workers"].values()
+                if all(w["state"] == "live" for w in ws) and any(
+                    w["rows_done"] > 0 for w in ws
+                ):
+                    coord.migrate_tenant(pending, reason="rebalance")
+                    pending = None
+            if os.path.exists(marker):
+                break
+            time.sleep(args.poll_interval)
+        coord.tick()
+    finally:
+        coord.close()
+    print(json.dumps(coord.status()))
     return 0
 
 
@@ -1646,6 +2075,30 @@ def main(argv=None) -> int:
     ap.add_argument("--kill-point", default="",
                     help="worker: post_swap arms the SECOND model.swap "
                     "call programmatically (after=1)")
+    ap.add_argument("--fleet-worker", action="store_true",
+                    help="worker: one elastic-fleet worker loop "
+                    "(lease + assignment + serve; fleet scenarios)")
+    ap.add_argument("--fleet-coordinator", action="store_true",
+                    help="worker: the elastic-fleet coordinator loop "
+                    "(fleet scenarios)")
+    ap.add_argument("--fleet-root", default=None,
+                    help="fleet child: the shared coordinator root")
+    ap.add_argument("--worker-id", default="fw0",
+                    help="fleet worker child: this worker's id")
+    ap.add_argument("--workers", default=",".join(FLEET_WORKER_IDS),
+                    help="fleet coordinator child: comma-separated "
+                    "worker ids")
+    ap.add_argument("--tenants", default=",".join(FLEET_TENANT_IDS),
+                    help="fleet child: comma-separated tenant ids "
+                    "(catalog = <watch>/<tid> -> <out>/<tid>)")
+    ap.add_argument("--lease-ttl", type=float, default=2.0,
+                    help="fleet coordinator child: lease TTL seconds")
+    ap.add_argument("--boot-grace", type=float, default=60.0,
+                    help="fleet coordinator child: first-heartbeat "
+                    "grace seconds")
+    ap.add_argument("--migrate-tenant", default="",
+                    help="fleet coordinator child: migrate this tenant "
+                    "once the fleet is live (kill-mid-migrate)")
     ap.add_argument("--workdir", default=None,
                     help="matrix scratch dir (default: a fresh tempdir)")
     args = ap.parse_args(argv)
@@ -1658,6 +2111,10 @@ def main(argv=None) -> int:
             return flow_worker_main(args)
         if args.device:
             return device_worker_main(args)
+        if args.fleet_worker:
+            return fleet_worker_main(args)
+        if args.fleet_coordinator:
+            return fleet_coordinator_main(args)
         if args.daemon:
             return daemon_worker_main(args)
         if args.model_dir:

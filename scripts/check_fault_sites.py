@@ -118,10 +118,12 @@ def check_kinds() -> list:
 
 _CHAOS = "scripts/chaos_crash_matrix.py"
 # the kill-site tuples the crash matrix drives; every stream.*/sink.*,
-# every flow.*, every ctl.*, every device.* site — and every *.compile
-# site (the r18 compute-plane boundaries) — must appear in one of them
+# every flow.*, every ctl.*, every device.*, every fleet.* site — and
+# every *.compile site (the r18 compute-plane boundaries) — must
+# appear in one of them
 _CHAOS_TUPLE_RE = re.compile(
-    r"^(?:KILL_SITES|FLOW_KILL_SITES|CTL_KILL_SITES|DEVICE_KILL_SITES)"
+    r"^(?:KILL_SITES|FLOW_KILL_SITES|CTL_KILL_SITES|DEVICE_KILL_SITES"
+    r"|FLEET_KILL_SITES)"
     r"\s*=\s*\(([^)]*)\)",
     re.MULTILINE,
 )
@@ -148,7 +150,7 @@ def check_chaos_coverage() -> list:
         s for s in declared_sites()
         if (
             s.split(".")[0] in ("stream", "sink", "flow", "ctl",
-                                "device")
+                                "device", "fleet")
             or s.endswith(".compile")
         )
         and s != "stream.read"  # read kills pre-WAL == stream.wal row

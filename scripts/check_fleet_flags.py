@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Static drift check: fleet knobs across CLI ⇔ FleetCoordinator ⇔ docs.
+
+The elastic serve fleet (r19) is one feature spread over three layers
+— ``python -m sntc_tpu fleet-serve`` flags, the
+:class:`sntc_tpu.serve.fleet.FleetCoordinator` keyword arguments they
+fill, and the marker-delimited fleet-flags table in
+``docs/RESILIENCE.md`` — and each knob must exist in all of them:
+
+==================== ==============================
+``--workers``        (CLI-only: spawn count)
+``--worker-ids``     (CLI-only: explicit ids)
+``--lease-ttl``      ``lease_ttl_s``
+``--boot-grace``     ``boot_grace_s``
+``--vnodes``         ``vnodes``
+``--slack``          ``slack``
+``--drain-timeout``  (CLI-only: SIGTERM fan-out window)
+``--fleet-worker-id``(CLI-only: worker-child re-invocation)
+==================== ==============================
+
+Every flag (and its coordinator kwarg, where one exists) must appear
+in the fleet-flags table, every ``FleetCoordinator`` tunable must be
+reachable from the CLI, and the README must carry a fleet-serve
+quickstart.  Wired as a tier-1 test (``tests/test_fleet.py``) so the
+three layers cannot drift silently — the ``check_tenant_flags.py``
+discipline applied to the fleet surface.
+
+Exit 0 when consistent; exit 1 with a per-knob report otherwise.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (fleet-serve CLI flag, FleetCoordinator kwarg it fills, or None for
+# flags consumed by the CLI process-supervision layer itself)
+FLAGS = (
+    ("--workers", None),
+    ("--worker-ids", None),
+    ("--lease-ttl", "lease_ttl_s"),
+    ("--boot-grace", "boot_grace_s"),
+    ("--vnodes", "vnodes"),
+    ("--slack", "slack"),
+    ("--drain-timeout", None),
+    ("--fleet-worker-id", None),
+)
+# coordinator ctor params that are NOT CLI-surfaced on purpose:
+# positional wiring plus test-injection seams
+_CTOR_INTERNAL = {"self", "root", "worker_ids", "specs_by_id", "wall",
+                  "scale_out_hook"}
+DOC = "docs/RESILIENCE.md"
+TABLE_BEGIN = "<!-- fleet-flags:begin -->"
+TABLE_END = "<!-- fleet-flags:end -->"
+README_NEEDLE = "fleet-serve"
+
+
+def _read(rel: str) -> str:
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def _doc_table() -> str:
+    text = _read(DOC)
+    if TABLE_BEGIN not in text or TABLE_END not in text:
+        return ""
+    return text.split(TABLE_BEGIN, 1)[1].split(TABLE_END, 1)[0]
+
+
+def check() -> list:
+    """Returns a list of human-readable drift complaints (empty = ok)."""
+    problems = []
+    app_src = _read(os.path.join("sntc_tpu", "app.py"))
+    # flags must be declared inside the fleet-serve subparser block
+    fleet_src = app_src.split('sub.add_parser(\n        "fleet-serve"', 1)
+    fleet_src = fleet_src[1] if len(fleet_src) == 2 else ""
+    sys.path.insert(0, REPO)
+    from sntc_tpu.serve.fleet import FleetCoordinator
+
+    sig = inspect.signature(FleetCoordinator.__init__)
+    ctor_kwargs = set(sig.parameters) - _CTOR_INTERNAL
+    table = _doc_table()
+    if not table:
+        problems.append(
+            f"{DOC} is missing the marker-delimited fleet-flags table "
+            f"({TABLE_BEGIN} ... {TABLE_END})"
+        )
+    for flag, kwarg in FLAGS:
+        if f'"{flag}"' not in fleet_src:
+            problems.append(
+                f"fleet-serve CLI flag {flag!r} missing from the "
+                "fleet-serve parser in sntc_tpu/app.py"
+            )
+        if kwarg is not None and kwarg not in ctor_kwargs:
+            problems.append(
+                f"FleetCoordinator has no {kwarg!r} kwarg for {flag!r} "
+                "to fill"
+            )
+        if table and flag not in table:
+            problems.append(
+                f"{flag!r} missing from the {DOC} fleet-flags table"
+            )
+        if table and kwarg is not None and f"`{kwarg}`" not in table:
+            problems.append(
+                f"FleetCoordinator kwarg {kwarg!r} missing from the "
+                f"{DOC} fleet-flags table"
+            )
+    # every coordinator tunable must be reachable from the CLI
+    mapped = {k for _, k in FLAGS if k is not None}
+    for kwarg in sorted(ctor_kwargs - mapped):
+        problems.append(
+            f"FleetCoordinator kwarg {kwarg!r} has no fleet-serve CLI "
+            "flag (add one, or list it in _CTOR_INTERNAL with a reason)"
+        )
+    # the reverse direction: every table row must be a known flag
+    for row_flag in re.findall(r"`(--[a-z-]+)`", table):
+        if row_flag not in {f for f, _ in FLAGS}:
+            problems.append(
+                f"{DOC} fleet-flags table documents {row_flag!r} but "
+                "the checker's FLAGS mapping does not declare it"
+            )
+    if README_NEEDLE not in _read("README.md"):
+        problems.append("README.md has no fleet-serve quickstart")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("fleet-flag drift detected:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print(
+        f"ok: {len(FLAGS)} fleet flags consistent across the "
+        "fleet-serve CLI, FleetCoordinator kwargs, and docs"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -113,13 +113,15 @@ def check() -> list:
                 f"{DOC} flow-flags table documents {row_flag!r} but "
                 "the checker's FLAGS mapping does not declare it"
             )
-    # the daemon side: the per-tenant default flag and its spec field
-    daemon_src = app_src.split('sub.add_parser(\n        "serve-daemon"', 1)
+    # the daemon side: the per-tenant default flag and its spec field.
+    # the flag lives on the shared daemon_flags parent parser (r19:
+    # serve-daemon and fleet-serve both inherit it)
+    daemon_src = app_src.split("p = daemon_flags = ", 1)
     daemon_src = daemon_src[1] if len(daemon_src) == 2 else ""
     if '"--from-capture"' not in daemon_src:
         problems.append(
-            "serve-daemon parser is missing the '--from-capture' "
-            "per-tenant default flag"
+            "daemon_flags parent parser is missing the "
+            "'--from-capture' per-tenant default flag"
         )
     from dataclasses import fields as dc_fields
 

@@ -74,8 +74,10 @@ def check() -> list:
     """Returns a list of human-readable drift complaints (empty = ok)."""
     problems = []
     app_src = _read(os.path.join("sntc_tpu", "app.py"))
-    # flags must be declared inside the serve-daemon subparser block
-    daemon_src = app_src.split('sub.add_parser(\n        "serve-daemon"', 1)
+    # flags must be declared on the shared daemon_flags parent parser
+    # (r19: serve-daemon and fleet-serve both inherit the whole
+    # daemon flag surface from it)
+    daemon_src = app_src.split("p = daemon_flags = ", 1)
     daemon_src = daemon_src[1] if len(daemon_src) == 2 else ""
     sys.path.insert(0, REPO)
     from dataclasses import fields as dc_fields

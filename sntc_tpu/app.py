@@ -622,11 +622,73 @@ def cmd_serve_daemon(args) -> int:
     ``stop_after``, ``row_policy``, ...).  Tenants naming the SAME
     model checkpoint share one predictor — and therefore one set of
     compiled device programs."""
-    from sntc_tpu.mlio import load_model
-    from sntc_tpu.resilience import RetryPolicy
-    from sntc_tpu.serve import ServeDaemon, TenantSpec
+    from sntc_tpu.serve import ServeDaemon
 
     _obs_start(args)
+    specs = _load_tenant_specs(args)
+    daemon = ServeDaemon(
+        specs, args.root,
+        shape_buckets=args.shape_buckets,
+        pipeline_depth=args.pipeline_depth,
+        health_json=args.health_json,
+        metrics_out=args.metrics_out,
+        autotune=args.autotune,
+        controller=args.controller,
+        disk_budget_mb=args.root_disk_budget_mb,
+        dead_letter_keep=args.dead_letter_keep,
+        device_faults=args.device_faults,
+        compile_budget_s=args.compile_budget_s or None,
+    )
+    try:
+        if args.once:
+            with _device_trace_ctx(args):
+                n = daemon.process_available()
+            # the --once pass IS the warmup; the drain that follows
+            # must not compile anything new on the shared cache
+            daemon.mark_warm()
+            daemon.drain()
+            status = daemon.status()
+        else:
+            daemon.install_signal_handlers()
+            print(
+                f"serve-daemon: {len(specs)} tenants -> {args.root}; "
+                "SIGTERM/Ctrl-C drains every tenant",
+                file=sys.stderr,
+            )
+            try:
+                with _device_trace_ctx(args):
+                    status = daemon.run(
+                        poll_interval=args.poll_interval
+                    )
+            except KeyboardInterrupt:
+                daemon.request_drain("KeyboardInterrupt")
+                daemon.drain()
+                status = daemon.status()
+            n = status["aggregate"]["batches_done"]
+    finally:
+        daemon.close()
+        _obs_finish(args)
+    print(json.dumps({
+        "batches": n,
+        "tenants": {
+            tid: row["state"] for tid, row in status["tenants"].items()
+        },
+        "recompiles_after_warmup": status["recompiles_after_warmup"],
+        "drained": status["drained"],
+        "health": status["health"]["overall"],
+    }))
+    return 0
+
+
+def _load_tenant_specs(args) -> list:
+    """The serve-daemon / fleet-serve tenant catalog: parse the
+    ``--tenants`` JSON, load + compile each DISTINCT model checkpoint
+    once, apply the flag-level defaults, and return the TenantSpec
+    list."""
+    from sntc_tpu.mlio import load_model
+    from sntc_tpu.resilience import RetryPolicy
+    from sntc_tpu.serve import TenantSpec
+
     with open(args.tenants) as f:
         doc = json.load(f)
     entries = doc["tenants"] if isinstance(doc, dict) else doc
@@ -692,57 +754,137 @@ def cmd_serve_daemon(args) -> int:
         else:
             e.pop("row_policy", None)
         specs.append(TenantSpec.from_dict(e, defaults))
-    daemon = ServeDaemon(
-        specs, args.root,
-        shape_buckets=args.shape_buckets,
-        pipeline_depth=args.pipeline_depth,
-        health_json=args.health_json,
-        metrics_out=args.metrics_out,
-        autotune=args.autotune,
-        controller=args.controller,
-        disk_budget_mb=args.root_disk_budget_mb,
-        dead_letter_keep=args.dead_letter_keep,
-        device_faults=args.device_faults,
-        compile_budget_s=args.compile_budget_s or None,
+    return specs
+
+
+def cmd_fleet_serve(args) -> int:
+    """Elastic serve fleet (r19): ONE coordinator process supervising
+    N worker processes, each a plain ServeDaemon over its assigned
+    tenant slice.  Placement is consistent hashing over tenant ids
+    with the DRR weights as costs; liveness is a filesystem
+    lease + heartbeat; a worker whose lease expires is declared dead
+    and its tenants migrate (drain -> ship the fsck-verifiable state
+    tree -> resume) to the survivors — the SAME first-class migration
+    path rebalancing and the controller's ``migrate`` rung use.
+    SIGTERM/Ctrl-C raises the fleet drain marker and fans SIGTERM out
+    to every worker.  See docs/RESILIENCE.md "Elastic serve fleet".
+
+    Internally re-invoked with ``--fleet-worker-id`` for each worker
+    child (same flags, one worker identity)."""
+    import itertools
+    import signal as _signal
+    import subprocess
+
+    from sntc_tpu.serve.fleet import FleetCoordinator, FleetWorker
+
+    if args.fleet_worker_id:
+        # ---- worker mode (spawned by the coordinator) ----
+        specs = {s.tenant_id: s for s in _load_tenant_specs(args)}
+        worker = FleetWorker(
+            args.fleet_worker_id, args.root, specs,
+            daemon_kwargs=dict(
+                shape_buckets=args.shape_buckets,
+                pipeline_depth=args.pipeline_depth,
+                autotune=args.autotune,
+                dead_letter_keep=args.dead_letter_keep,
+                device_faults=args.device_faults,
+                compile_budget_s=args.compile_budget_s or None,
+            ),
+            controller=args.controller,
+        )
+        status = worker.run(poll_interval=args.poll_interval)
+        print(json.dumps({
+            "worker": args.fleet_worker_id,
+            "tenants": {
+                tid: row["state"]
+                for tid, row in status.get("tenants", {}).items()
+            },
+        }))
+        return 0
+
+    # ---- coordinator mode ----
+    _obs_start(args)
+    with open(args.tenants) as f:
+        doc = json.load(f)
+    entries = doc["tenants"] if isinstance(doc, dict) else doc
+    if not entries:
+        raise SystemExit(f"{args.tenants}: no tenants declared")
+
+    class _PlacementSpec:
+        """The coordinator needs placement facts only — it never
+        loads a model checkpoint (the workers do)."""
+
+        def __init__(self, entry):
+            self.placement_cost = entry.get("placement_cost")
+            self.weight = float(entry.get("weight",
+                                          args.tenant_weight))
+            self.pinned_worker = entry.get("pinned_worker")
+
+    specs = {e["id"]: _PlacementSpec(e) for e in entries}
+    worker_ids = (
+        args.worker_ids.split(",") if args.worker_ids
+        else [f"w{i}" for i in range(args.workers)]
+    )
+    procs = {}
+    child_argv = [sys.executable, "-m", "sntc_tpu"] + sys.argv[1:]
+
+    def _spawn(wid):
+        procs[wid] = subprocess.Popen(
+            child_argv + ["--fleet-worker-id", wid]
+        )
+
+    fresh_ids = itertools.count(len(worker_ids))
+
+    def _scale_out(reason):
+        wid = f"w{next(fresh_ids)}"
+        _spawn(wid)
+        return wid
+
+    coord = FleetCoordinator(
+        args.root, worker_ids, specs,
+        lease_ttl_s=args.lease_ttl, boot_grace_s=args.boot_grace,
+        vnodes=args.vnodes, slack=args.slack,
+        scale_out_hook=_scale_out,
+    )
+    stop = {"sig": None}
+
+    def _term(signum, frame):
+        stop["sig"] = signum
+
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        try:
+            _signal.signal(sig, _term)
+        except ValueError:
+            pass
+    for wid in worker_ids:
+        _spawn(wid)
+    print(
+        f"fleet-serve: coordinator over {len(worker_ids)} workers x "
+        f"{len(specs)} tenants -> {args.root}; SIGTERM/Ctrl-C drains "
+        "the fleet",
+        file=sys.stderr,
     )
     try:
-        if args.once:
-            with _device_trace_ctx(args):
-                n = daemon.process_available()
-            # the --once pass IS the warmup; the drain that follows
-            # must not compile anything new on the shared cache
-            daemon.mark_warm()
-            daemon.drain()
-            status = daemon.status()
-        else:
-            daemon.install_signal_handlers()
-            print(
-                f"serve-daemon: {len(specs)} tenants -> {args.root}; "
-                "SIGTERM/Ctrl-C drains every tenant",
-                file=sys.stderr,
-            )
-            try:
-                with _device_trace_ctx(args):
-                    status = daemon.run(
-                        poll_interval=args.poll_interval
-                    )
-            except KeyboardInterrupt:
-                daemon.request_drain("KeyboardInterrupt")
-                daemon.drain()
-                status = daemon.status()
-            n = status["aggregate"]["batches_done"]
+        while stop["sig"] is None:
+            coord.tick()
+            time.sleep(args.poll_interval)
     finally:
-        daemon.close()
+        # the fan-out: raise the fleet drain marker (the workers'
+        # loops watch it), then SIGTERM every child and wait
+        coord.drain_fleet(f"signal {stop['sig']}")
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + args.drain_timeout
+        for p in procs.values():
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        coord.tick()
+        coord.close()
         _obs_finish(args)
-    print(json.dumps({
-        "batches": n,
-        "tenants": {
-            tid: row["state"] for tid, row in status["tenants"].items()
-        },
-        "recompiles_after_warmup": status["recompiles_after_warmup"],
-        "drained": status["drained"],
-        "health": status["health"]["overall"],
-    }))
+    print(json.dumps(coord.status()))
     return 0
 
 
@@ -759,11 +901,16 @@ def cmd_fsck(args) -> int:
     lifecycle"."""
     from sntc_tpu.resilience.storage import fsck
 
-    report = fsck(
-        args.root,
-        repair=not args.no_repair,
-        tenant_tree=args.tenant_tree,
-    )
+    if args.fleet_root:
+        from sntc_tpu.serve.fleet import fsck_fleet
+
+        report = fsck_fleet(args.root, repair=not args.no_repair)
+    else:
+        report = fsck(
+            args.root,
+            repair=not args.no_repair,
+            tenant_tree=args.tenant_tree,
+        )
     if args.compile_cache or args.compile_cache_dir:
         # the persistent XLA compilation cache (r18): quarantine
         # unreadable/zero-length entries to .corrupt/ so serving
@@ -1045,12 +1192,9 @@ def main(argv=None) -> int:
     add_platform_arg(p)
     p.set_defaults(fn=cmd_serve)
 
-    p = sub.add_parser(
-        "serve-daemon",
-        help="multi-tenant streaming inference: N tenant streams, one "
-        "shared device program cache, fair scheduling, per-tenant "
-        "isolation (docs/RESILIENCE.md)",
-    )
+    # flags shared by serve-daemon and fleet-serve (the fleet workers
+    # are plain serve daemons, so the whole daemon surface forwards)
+    p = daemon_flags = argparse.ArgumentParser(add_help=False)
     p.add_argument("--tenants", required=True, metavar="JSON",
                    help="tenant spec file: {\"tenants\": [{\"id\", "
                    "\"model\", \"watch\", \"out\", ...per-tenant "
@@ -1196,7 +1340,58 @@ def main(argv=None) -> int:
                    "breakers) here every scheduling round")
     _add_obs_flags(p)
     add_platform_arg(p)
+
+    p = sub.add_parser(
+        "serve-daemon",
+        parents=[daemon_flags],
+        help="multi-tenant streaming inference: N tenant streams, one "
+        "shared device program cache, fair scheduling, per-tenant "
+        "isolation (docs/RESILIENCE.md)",
+    )
     p.set_defaults(fn=cmd_serve_daemon)
+
+    p = sub.add_parser(
+        "fleet-serve",
+        parents=[daemon_flags],
+        help="elastic serve fleet: one coordinator process supervising "
+        "N serve-daemon workers with leases, consistent-hash "
+        "placement, worker-death recovery, and first-class tenant "
+        "migration (docs/RESILIENCE.md)",
+    )
+    p.add_argument("--workers", type=int, default=2, metavar="N",
+                   help="worker processes to spawn (ids w0..wN-1); "
+                   "each runs a plain ServeDaemon over its assigned "
+                   "tenant slice under <root>/worker/<id>/")
+    p.add_argument("--worker-ids", default=None, metavar="IDS",
+                   help="explicit comma-separated worker ids "
+                   "(overrides --workers; the ids TenantSpec "
+                   "pinned_worker entries must name)")
+    p.add_argument("--lease-ttl", type=float, default=5.0, metavar="S",
+                   help="worker lease TTL (FleetCoordinator "
+                   "lease_ttl_s): a worker whose heartbeat marker is "
+                   "older is declared DEAD and its tenants migrate to "
+                   "the survivors")
+    p.add_argument("--boot-grace", type=float, default=30.0,
+                   metavar="S",
+                   help="first-heartbeat grace (FleetCoordinator "
+                   "boot_grace_s): how long a spawned worker may take "
+                   "to come up before it counts as dead")
+    p.add_argument("--vnodes", type=int, default=64,
+                   help="virtual nodes per worker on the consistent-"
+                   "hash ring (FleetCoordinator vnodes)")
+    p.add_argument("--slack", type=float, default=1.25,
+                   help="bounded-load placement slack (FleetCoordinator "
+                   "slack): per-worker capacity = slack x total "
+                   "placement cost / workers")
+    p.add_argument("--drain-timeout", type=float, default=60.0,
+                   metavar="S",
+                   help="seconds to wait for workers to settle after "
+                   "the SIGTERM fan-out before killing them")
+    p.add_argument("--fleet-worker-id", default=None,
+                   help="internal: run as the named fleet WORKER "
+                   "instead of the coordinator (the coordinator "
+                   "re-invokes itself with this flag per worker)")
+    p.set_defaults(fn=cmd_fleet_serve)
 
     p = sub.add_parser(
         "fsck",
@@ -1211,6 +1406,13 @@ def main(argv=None) -> int:
     p.add_argument("--tenant-tree", action="store_true",
                    help="also walk every <root>/tenant/<id>/ckpt "
                    "(the serve-daemon layout)")
+    p.add_argument("--fleet-root", action="store_true",
+                   help="treat ROOT as an elastic-fleet coordinator "
+                   "root: doctor the fleet metadata (assignment "
+                   "marker + journal, leases, request journals, "
+                   "sealed migration manifests, torn mid-ship "
+                   "copies) plus every <root>/worker/<id>/ daemon "
+                   "tree; an unrepairable migration manifest exits 1")
     p.add_argument("--no-repair", action="store_true",
                    help="report only: no truncations, no quarantines, "
                    "no tmp sweeps")

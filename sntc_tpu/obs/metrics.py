@@ -271,7 +271,8 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         type=GAUGE, labels=("knob", "tenant"),
         help="Current value of each controller-steered serving knob "
         "(pipeline_depth / shape_buckets / weight / quota / shed / "
-        "escalate; ladder knobs report their ladder index).",
+        "escalate / migrate / scale_out; ladder knobs report their "
+        "ladder index).",
     ),
     "sntc_ctl_slo_compliant": dict(
         type=GAUGE, labels=("slo", "tenant"),
@@ -364,6 +365,38 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         type=COUNTER, labels=(),
         help="HOST_DEGRADED -> DEVICE_OK transitions (the probe-gated "
         "recovery tick restored device serving).",
+    ),
+    # -- the elastic serve fleet (serve/fleet, r19) ---------------------------
+    "sntc_fleet_worker_state": dict(
+        type=GAUGE, labels=("worker",),
+        help="Coordinator's liveness verdict per worker (1 = lease "
+        "current, 0 = lease expired / declared dead).",
+    ),
+    "sntc_fleet_leases_renewed_total": dict(
+        type=COUNTER, labels=("worker",),
+        help="Worker lease/heartbeat renewals observed by the "
+        "coordinator.",
+    ),
+    "sntc_fleet_leases_expired_total": dict(
+        type=COUNTER, labels=("worker",),
+        help="Lease expiries — a worker missed its TTL and was "
+        "declared dead; its tenants were redistributed.",
+    ),
+    "sntc_fleet_migrations_total": dict(
+        type=COUNTER, labels=("reason", "outcome"),
+        help="Tenant migrations by reason (rebalance / worker_dead / "
+        "controller / join) and outcome (completed / reverted).",
+    ),
+    "sntc_fleet_tenants_value": dict(
+        type=GAUGE, labels=("worker",),
+        help="Tenants currently assigned to each worker (the "
+        "coordinator's placement view).",
+    ),
+    "sntc_fleet_rows_value": dict(
+        type=GAUGE, labels=("worker",),
+        help="Rows committed as reported by each worker's last "
+        "heartbeat (worker=fleet is the aggregate across live "
+        "workers).",
     ),
 }
 

@@ -52,6 +52,13 @@ Wired sites:
 ``fuse.compile``        ``fuse.FusedSegment`` before a fresh input
                         signature compiles its fused XLA program
 ``device.dispatch``     ``BatchPredictor`` before every device dispatch
+``fleet.lease``         ``serve.fleet`` worker lease renewal, before the
+                        heartbeat marker reaches the coordinator root
+``fleet.assign``        ``serve.fleet`` coordinator assignment publish
+                        (epoch marker + assignment journal append)
+``fleet.migrate``       ``serve.fleet`` tenant migration mid-ship, after
+                        the source drain and before the sealed manifest
+                        lands at the destination
 ======================  =====================================================
 
 Env grammar (comma-separated specs)::
@@ -221,6 +228,17 @@ SITES = (
     "predict.compile",
     "fuse.compile",
     "device.dispatch",
+    # elastic serve fleet (r19): the COORDINATION boundaries of the
+    # multi-process serve plane — ``fleet.lease`` before a worker's
+    # lease/heartbeat marker is renewed, ``fleet.assign`` before the
+    # coordinator publishes an assignment epoch, ``fleet.migrate``
+    # mid-ship of a tenant's state tree (after the source drain,
+    # before the sealed manifest lands).  A ``kill`` armed here is the
+    # worker-crash / torn-migration chaos scenario; see
+    # docs/RESILIENCE.md "Elastic serve fleet".
+    "fleet.lease",
+    "fleet.assign",
+    "fleet.migrate",
 )
 
 

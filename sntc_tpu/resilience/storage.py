@@ -180,6 +180,47 @@ ARTIFACTS: Dict[str, ArtifactSpec] = {
             "pre-existing)",
             FAIL,
         ),
+        # -- the elastic serve fleet (serve/fleet, r19): patterns are
+        # relative to a coordinator FLEET root, not a daemon root -----
+        ArtifactSpec(
+            "fleet_lease", "marker", "storage.marker",
+            ("fleet/workers/*/lease.json",),
+            "atomic overwrite per heartbeat (one lease per worker)",
+            DEGRADE,
+        ),
+        ArtifactSpec(
+            "fleet_assignments", "marker", "storage.marker",
+            ("fleet/assignments.json",),
+            "atomic epoch overwrite in place (one marker per fleet)",
+            FAIL,
+        ),
+        ArtifactSpec(
+            "fleet_assignment_journal", "journal", "storage.journal",
+            ("fleet/assignments.jsonl*",),
+            "RotatingJsonlWriter: size-capped segments, keep 2 rotated",
+            DEGRADE,
+        ),
+        ArtifactSpec(
+            "fleet_migration_manifest", "marker", "storage.marker",
+            ("fleet/migrations/*.json",),
+            "sealed, one per tenant, overwritten by the next migration",
+            FAIL,
+        ),
+        ArtifactSpec(
+            "fleet_markers", "marker", "storage.marker",
+            ("fleet/coordinator.json", "fleet/fleet_drain_marker.json",
+             "fleet/workers/*/release/*.json"),
+            "atomic overwrite in place; release markers removed once "
+            "the coordinator consumes them",
+            DEGRADE,
+        ),
+        ArtifactSpec(
+            "fleet_request_journal", "journal", "storage.journal",
+            ("fleet/workers/*/requests.jsonl*",),
+            "append-only, offset-consumed by the coordinator, bounded "
+            "by the one-shot fleet knobs (≤2 lines per tenant lifetime)",
+            DEGRADE,
+        ),
     )
 }
 

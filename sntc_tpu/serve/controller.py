@@ -79,7 +79,13 @@ from sntc_tpu.resilience.control import (
 #: quota / shed / escalate exist only on daemon (multi-tenant)
 #: targets; shape_buckets only on single-stream targets (the daemon's
 #: predictors are SHARED across tenants, so no one tenant may steer
-#: their bucket floor).
+#: their bucket floor).  migrate / scale_out are the FLEET rungs (r19)
+#: above escalate: they exist only when the daemon is wired into an
+#: elastic fleet (``daemon.fleet_hook`` set by the fleet worker) and
+#: each fires at most once per tenant per daemon lifetime — a request
+#: marker the coordinator honors, never a local state change — and,
+#: like escalate, they are suppressed while the platform is degraded
+#: (moving a tenant cannot fix a device fault).
 SERVE_KNOB_NAMES = (
     "pipeline_depth",
     "shape_buckets",
@@ -87,7 +93,13 @@ SERVE_KNOB_NAMES = (
     "quota",
     "shed",
     "escalate",
+    "migrate",
+    "scale_out",
 )
+
+#: the fleet rungs of the degradation ladder (subset of
+#: SERVE_KNOB_NAMES); one-way like escalate — never relaxed
+FLEET_RUNGS = ("migrate", "scale_out")
 
 #: the TenantSpec SLO fields the controller reads as setpoints
 SLO_FIELDS = ("slo_p99_ms", "slo_min_rows_per_sec", "slo_max_shed_rate")
@@ -285,6 +297,7 @@ class ServeController:
         self._ticks = 0
         self.delegated_total = 0
         self.escalations_total = 0
+        self.fleet_requests_total = 0
         self.guard = Guardrails(
             policy=self.policy,
             budget=budget,
@@ -339,6 +352,17 @@ class ServeController:
         ))
         ctl._reconcile_journal()
         return ctl
+
+    def attach_tenant(self, stream) -> None:
+        """Attach one LATE tenant (r19: a fleet worker applying a new
+        assignment mid-run) exactly like :meth:`for_daemon` attaches
+        the initial set — its SLOs come from the spec, its knobs join
+        the shared guardrails, and its first window baseline primes
+        now."""
+        self._attach(_Target(
+            stream.spec.tenant_id, stream.query,
+            SloPolicy.from_spec(stream.spec), stream=stream,
+        ))
 
     def _full(self, t: _Target, base: str) -> str:
         return base if t.key is None else f"{t.key}/{base}"
@@ -477,6 +501,34 @@ class ServeController:
                 "escalate", lambda _b=ebox: _b["n"], wrap(_escalate),
                 0, max(1, spec.quarantine_after),
             )
+
+            # fleet rungs (r19): only when the daemon is wired into an
+            # elastic fleet.  The setter posts a request through the
+            # daemon's fleet hook (the coordinator decides and acts);
+            # bound 0..1 = at most one request per tenant per daemon
+            # lifetime, and like escalate the rung never relaxes.
+            if (
+                self._daemon is not None
+                and getattr(self._daemon, "fleet_hook", None) is not None
+            ):
+                for action in FLEET_RUNGS:
+                    fbox = {"n": 0}
+
+                    def _fleet(n, _b=fbox, _t=t, _c=self, _a=action):
+                        n = int(n)
+                        while _b["n"] < n:
+                            _b["n"] += 1
+                            _c.fleet_requests_total += 1
+                            _c._daemon.request_fleet(
+                                _a, _t.key,
+                                reason="controller: local degradation "
+                                "ladder exhausted",
+                            )
+
+                    kn[action] = Knob(
+                        action, lambda _b=fbox: _b["n"], wrap(_fleet),
+                        0, 1,
+                    )
 
         if self.ingest:
             from sntc_tpu.data.autotune import (
@@ -827,8 +879,12 @@ class ServeController:
                 # is off the table: the collapse is device-attributed,
                 # and striking a tenant for a platform fault is exactly
                 # the mis-attribution the fault domain exists to stop.
-                for base in ("quota", "shed", "escalate"):
-                    if base == "escalate" and self._platform_degraded():
+                for base in ("quota", "shed", "escalate") + FLEET_RUNGS:
+                    if base in FLEET_RUNGS and base not in t.knobs:
+                        continue  # not wired into a fleet
+                    if (
+                        base == "escalate" or base in FLEET_RUNGS
+                    ) and self._platform_degraded():
                         self.platform_deferrals += 1
                         continue
                     if self._usable(t, base, +1):
@@ -991,6 +1047,7 @@ class ServeController:
             "applied": len(self.guard.applied()),
             "delegated": self.delegated_total,
             "escalations": self.escalations_total,
+            "fleet_requests": self.fleet_requests_total,
             "platform_deferrals": self.platform_deferrals,
             "platform_degraded": self._platform_degraded(),
             "frozen": sorted(self.guard.frozen),

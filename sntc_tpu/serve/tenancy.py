@@ -163,6 +163,15 @@ class TenantSpec:
     # breach emits disk_budget_exceeded + DEGRADED health for the
     # tenant.  None/0 = unbudgeted.
     disk_budget_mb: Optional[float] = None
+    # fleet placement (r19): the elastic serve fleet's coordinator
+    # places tenants on workers by consistent hashing with a per-tenant
+    # COST (the bounded-load capacity unit).  placement_cost defaults
+    # to the DRR weight — a heavy tenant costs proportionally more of a
+    # worker's capacity; pinned_worker skips hashing entirely and nails
+    # the tenant to one worker id (it still migrates on that worker's
+    # death).  Both are inert outside a fleet.
+    placement_cost: Optional[float] = None
+    pinned_worker: Optional[str] = None
 
     def __post_init__(self):
         if not self.tenant_id or "/" in self.tenant_id:
@@ -199,7 +208,8 @@ class TenantSpec:
         # negative values — and a shed-rate bound over 1.0 — are typos,
         # not contracts, and must be loud
         for f in ("slo_p99_ms", "slo_min_rows_per_sec",
-                  "slo_max_shed_rate", "disk_budget_mb"):
+                  "slo_max_shed_rate", "disk_budget_mb",
+                  "placement_cost"):
             v = getattr(self, f)
             if v is None:
                 continue
@@ -518,6 +528,19 @@ class ServeDaemon:
         self._drain_reason: Optional[str] = None
         self.drained = False
         self._closed = False
+        # the scheduler/drain mutex (r19, satellite bugfix): tick() and
+        # drain() both take it, so a drain invoked from another thread
+        # (a fleet coordinator, a signal-adjacent watchdog) SETTLES the
+        # in-flight scheduling round before it starts tearing tenants
+        # down instead of racing it.  Re-entrant: the daemon's own
+        # thread draining from inside run()'s finally (or a signal
+        # handler interrupting tick() on the main thread) must not
+        # deadlock against itself.
+        self._sched_lock = threading.RLock()
+        # elastic-fleet wiring (r19): the fleet worker installs a
+        # callable here; the controller's migrate/scale_out rungs post
+        # requests through request_fleet().  None = not in a fleet.
+        self.fleet_hook = None
 
     # -- construction -------------------------------------------------------
 
@@ -793,7 +816,7 @@ class ServeDaemon:
         now = self._clock()
         inc("sntc_daemon_ticks_total")
         committed_total = 0
-        with span("daemon.tick"):
+        with self._sched_lock, span("daemon.tick"):
             self._escalate(now)
             runnable: List[TenantStream] = []
             for t in self.tenants:
@@ -985,6 +1008,117 @@ class ServeDaemon:
             self._drain_reason = reason
             self._drain.set()
 
+    # -- dynamic membership (r19: the elastic serve fleet) ------------------
+
+    def add_tenant(self, spec: TenantSpec) -> TenantStream:
+        """Admit one tenant into the RUNNING daemon (the fleet worker's
+        assignment-apply path): build its engine against the shared
+        program cache, register its storage plane, and — when the SLO
+        controller is armed — attach its knobs.  Serialized against the
+        scheduler, so the tenant is either absent from a round or fully
+        present in it."""
+        with self._sched_lock:
+            if spec.tenant_id in self._by_id:
+                raise ValueError(
+                    f"tenant {spec.tenant_id!r} already served"
+                )
+            t = self._build_tenant(spec)
+            self.tenants.append(t)
+            self._by_id[spec.tenant_id] = t
+            self._tenant_storage[spec.tenant_id] = _storage.StoragePlane(
+                self.tenant_dir(spec.tenant_id),
+                tenant=spec.tenant_id,
+                budget_bytes=(
+                    int(spec.disk_budget_mb * (1 << 20))
+                    if spec.disk_budget_mb else None
+                ),
+            )
+            if self.controller is not None:
+                try:
+                    self.controller.attach_tenant(t)
+                except Exception as e:  # degrade-never-kill
+                    emit_event(
+                        event="controller_error", error=repr(e)
+                    )
+            emit_event(
+                event="tenant_added", tenant=spec.tenant_id,
+                tenants=len(self.tenants),
+            )
+            return t
+
+    def remove_tenant(
+        self, tenant_id: str, *, drain: bool = True,
+        reason: str = "remove_tenant",
+    ) -> Dict[str, Any]:
+        """Evict one tenant from the RUNNING daemon (the migration
+        source path): settle it through the same bounded drain +
+        marker + stop recipe the whole-daemon drain uses (``drain=False``
+        skips the settle for an already-stopped engine), evict its
+        breakers, and forget it.  Its on-disk tree is untouched — the
+        caller (the fleet coordinator) owns shipping or deleting it.
+        Returns a summary the coordinator journals."""
+        with self._sched_lock:
+            t = self._by_id.get(tenant_id)
+            if t is None:
+                raise KeyError(f"no tenant {tenant_id!r}")
+            committed = 0
+            was_mid_batch = (
+                t.state != "STOPPED" and t.query.in_flight_count() > 0
+            )
+            if drain and t.state != "STOPPED":
+                committed = self._settle_tenant(t, reason, was_mid_batch)
+            else:
+                try:
+                    t.query.stop()
+                except Exception:
+                    pass
+            close = getattr(t.query.source, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+            reset_breakers(prefix=t.prefix)
+            self.tenants.remove(t)
+            del self._by_id[tenant_id]
+            self._tenant_storage.pop(tenant_id, None)
+            emit_event(
+                event="tenant_removed", tenant=tenant_id,
+                reason=reason, tenants=len(self.tenants),
+            )
+            return {
+                "tenant": tenant_id,
+                "reason": reason,
+                "batches_committed_at_remove": committed,
+                "last_committed": t.query.last_committed(),
+                "was_mid_batch": was_mid_batch,
+                "rows_done": t.rows_done,
+            }
+
+    def request_fleet(
+        self, action: str, tenant_id: str, reason: str = ""
+    ) -> bool:
+        """Post one fleet request (``migrate`` / ``scale_out``) through
+        the installed fleet hook — the controller's fleet rungs land
+        here.  Returns False (and emits, never raises) when the daemon
+        is not in a fleet or the hook fails: a fleet request is advice
+        to the coordinator, not a local state change."""
+        if self.fleet_hook is None:
+            return False
+        try:
+            self.fleet_hook(action, tenant_id, reason)
+        except Exception as e:
+            emit_event(
+                event="fleet_request_error", tenant=tenant_id,
+                action=action, error=repr(e),
+            )
+            return False
+        emit_event(
+            event="fleet_request", tenant=tenant_id, action=action,
+            reason=reason,
+        )
+        return True
+
     @property
     def drain_requested(self) -> bool:
         return self._drain.is_set()
@@ -999,80 +1133,114 @@ class ServeDaemon:
         except ValueError:  # not the main thread
             return False
 
+    def _settle_tenant(
+        self, t: TenantStream, reason: Optional[str],
+        was_mid_batch: bool,
+    ) -> int:
+        """Settle ONE tenant: bounded engine drain (anything still
+        deferring stays in its WAL for a restart, the crash contract),
+        atomic per-tenant drain marker, engine stop.  Shared by the
+        whole-daemon :meth:`drain` and the fleet's per-tenant
+        :meth:`remove_tenant`; returns batches committed."""
+        try:
+            done = t.query.drain()
+        except Exception as e:
+            emit_event(
+                event="tenant_error", tenant=t.spec.tenant_id,
+                error=repr(e), during="drain",
+            )
+            done = 0
+        for progress in t.query.recentProgress[-done:] if done else []:
+            t.record_commit(progress)
+        _atomic_json(
+            os.path.join(
+                self.tenant_dir(t.spec.tenant_id), "drain_marker.json"
+            ),
+            {
+                "ts": time.time(),
+                "tenant": t.spec.tenant_id,
+                "reason": reason,
+                "last_committed": t.query.last_committed(),
+                "end_offset": t.query.committed_end(),
+                "in_flight_left": t.query.in_flight_count(),
+                # the tenant had un-committed in-flight batches when
+                # the drain was requested (they were settled — or
+                # WAL-parked — before this marker was written)
+                "was_mid_batch": was_mid_batch,
+                # final controller-steered knob state: a restart
+                # (cold defaults) reads this to log the delta
+                "controller_knobs": (
+                    self.controller.knob_values_for(
+                        t.spec.tenant_id
+                    )
+                    if self.controller is not None else None
+                ),
+            },
+        )
+        try:
+            t.query.stop()
+        except Exception as e:
+            emit_event(
+                event="tenant_error", tenant=t.spec.tenant_id,
+                error=repr(e), during="stop",
+            )
+        return done
+
     def drain(self) -> int:
         """Settle every live tenant: finish + commit its in-flight
         batches (the engine's bounded drain — anything still deferring
         stays in its WAL for a restart, the crash contract), write one
         atomic marker per tenant and one for the daemon, stop the
         engines.  Idempotent; returns batches committed during the
-        drain."""
-        if self.drained:
-            return 0
-        committed = 0
-        for t in self.tenants:
-            if t.state == "STOPPED":
-                continue
-            try:
-                done = t.query.drain()
-            except Exception as e:
-                emit_event(
-                    event="tenant_error", tenant=t.spec.tenant_id,
-                    error=repr(e), during="drain",
+        drain.
+
+        Takes the scheduler mutex, so a drain requested from another
+        thread mid-:meth:`tick` waits for the in-flight scheduling
+        round to settle instead of racing it (r19 bugfix) — and the
+        markers record which tenants were MID-BATCH at that moment,
+        the evidence a coordinator-initiated drain needs to decide
+        whether a migration may ship immediately or must wait for a
+        WAL-replay restart."""
+        with self._sched_lock:
+            if self.drained:
+                return 0
+            # capture the mid-batch set BEFORE settling: after
+            # t.query.drain() the in-flight evidence is gone
+            mid_batch = [
+                t.spec.tenant_id for t in self.tenants
+                if t.state != "STOPPED" and t.query.in_flight_count() > 0
+            ]
+            committed = 0
+            for t in self.tenants:
+                if t.state == "STOPPED":
+                    continue
+                committed += self._settle_tenant(
+                    t, self._drain_reason,
+                    t.spec.tenant_id in mid_batch,
                 )
-                done = 0
-            committed += done
-            for progress in t.query.recentProgress[-done:] if done else []:
-                t.record_commit(progress)
+            self.drained = True
             _atomic_json(
-                os.path.join(
-                    self.tenant_dir(t.spec.tenant_id), "drain_marker.json"
-                ),
+                os.path.join(self.root_dir, DAEMON_DRAIN_MARKER),
                 {
                     "ts": time.time(),
-                    "tenant": t.spec.tenant_id,
                     "reason": self._drain_reason,
-                    "last_committed": t.query.last_committed(),
-                    "end_offset": t.query.committed_end(),
-                    "in_flight_left": t.query.in_flight_count(),
-                    # final controller-steered knob state: a restart
-                    # (cold defaults) reads this to log the delta
+                    "pid": os.getpid(),
+                    "tenants": {
+                        t.spec.tenant_id: t.state for t in self.tenants
+                    },
+                    "mid_batch_tenants": mid_batch,
+                    "batches_committed_at_drain": committed,
                     "controller_knobs": (
-                        self.controller.knob_values_for(
-                            t.spec.tenant_id
-                        )
+                        self.controller.knob_values()
                         if self.controller is not None else None
                     ),
                 },
             )
-            try:
-                t.query.stop()
-            except Exception as e:
-                emit_event(
-                    event="tenant_error", tenant=t.spec.tenant_id,
-                    error=repr(e), during="stop",
-                )
-        self.drained = True
-        _atomic_json(
-            os.path.join(self.root_dir, DAEMON_DRAIN_MARKER),
-            {
-                "ts": time.time(),
-                "reason": self._drain_reason,
-                "pid": os.getpid(),
-                "tenants": {
-                    t.spec.tenant_id: t.state for t in self.tenants
-                },
-                "batches_committed_at_drain": committed,
-                "controller_knobs": (
-                    self.controller.knob_values()
-                    if self.controller is not None else None
-                ),
-            },
-        )
-        emit_event(
-            event="daemon_drained", reason=self._drain_reason,
-            tenants=len(self.tenants), committed=committed,
-        )
-        return committed
+            emit_event(
+                event="daemon_drained", reason=self._drain_reason,
+                tenants=len(self.tenants), committed=committed,
+            )
+            return committed
 
     def run(
         self,

@@ -21,6 +21,11 @@ def test_bench_config_emits_contract_line(cfg):
         BENCH_PLATFORM="cpu",
         BENCH_PROBE_TIMEOUT_S="0",
         BENCH_NO_JOURNAL="1",  # committed journal holds real runs only
+        # a toy forest: config 3's depth-10 × 20-tree compile dominates
+        # the suite's wall clock and certifies nothing here (no quality
+        # assertion below — real measurements use the defaults)
+        BENCH_RF_TREES="4",
+        BENCH_RF_DEPTH="5",
         XLA_FLAGS="--xla_force_host_platform_device_count=8",
     )
     proc = subprocess.run(
