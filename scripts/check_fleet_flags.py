@@ -12,6 +12,7 @@ fill, and the marker-delimited fleet-flags table in
 ``--worker-ids``     (CLI-only: explicit ids)
 ``--lease-ttl``      ``lease_ttl_s``
 ``--boot-grace``     ``boot_grace_s``
+``--dead-grace``     ``dead_grace_s``
 ``--vnodes``         ``vnodes``
 ``--slack``          ``slack``
 ``--drain-timeout``  (CLI-only: SIGTERM fan-out window)
@@ -44,6 +45,7 @@ FLAGS = (
     ("--worker-ids", None),
     ("--lease-ttl", "lease_ttl_s"),
     ("--boot-grace", "boot_grace_s"),
+    ("--dead-grace", "dead_grace_s"),
     ("--vnodes", "vnodes"),
     ("--slack", "slack"),
     ("--drain-timeout", None),

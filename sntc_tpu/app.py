@@ -843,6 +843,7 @@ def cmd_fleet_serve(args) -> int:
     coord = FleetCoordinator(
         args.root, worker_ids, specs,
         lease_ttl_s=args.lease_ttl, boot_grace_s=args.boot_grace,
+        dead_grace_s=args.dead_grace,
         vnodes=args.vnodes, slack=args.slack,
         scale_out_hook=_scale_out,
     )
@@ -1376,6 +1377,13 @@ def main(argv=None) -> int:
                    help="first-heartbeat grace (FleetCoordinator "
                    "boot_grace_s): how long a spawned worker may take "
                    "to come up before it counts as dead")
+    p.add_argument("--dead-grace", type=float, default=None,
+                   metavar="S",
+                   help="ship fence (FleetCoordinator dead_grace_s): "
+                   "a dead worker's tenant trees only ship after its "
+                   "lease stays expired this much LONGER, with a final "
+                   "lease re-read — a slow-but-alive worker gets the "
+                   "window to renew (default: 2 x lease TTL)")
     p.add_argument("--vnodes", type=int, default=64,
                    help="virtual nodes per worker on the consistent-"
                    "hash ring (FleetCoordinator vnodes)")

@@ -364,6 +364,23 @@ class ServeController:
             SloPolicy.from_spec(stream.spec), stream=stream,
         ))
 
+    def detach_tenant(self, tenant_id: str) -> bool:
+        """Detach one tenant (r19: the symmetric inverse of
+        :meth:`attach_tenant`, called from ``ServeDaemon.remove_tenant``):
+        drop its target and unregister its knobs, so the loop stops
+        sampling the stopped engine, stops evaluating its SLO windows,
+        and can never post a fleet request for a tenant another worker
+        now owns."""
+        for t in list(self.targets):
+            if t.stream is not None and t.key == tenant_id:
+                self.targets.remove(t)
+                for base in t.knobs:
+                    full = self._full(t, base)
+                    self._knobs.pop(full, None)
+                    self._defaults.pop(full, None)
+                return True
+        return False
+
     def _full(self, t: _Target, base: str) -> str:
         return base if t.key is None else f"{t.key}/{base}"
 

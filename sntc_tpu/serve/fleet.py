@@ -19,8 +19,15 @@ distributed training:
 * **Liveness** — each worker renews a lease marker
   (``fleet/workers/<id>/lease.json``, through the ``fleet.lease`` fault
   point) carrying its heartbeat payload (rows committed, tenants
-  served, applied epoch).  The coordinator declares a worker whose
-  lease outlives ``lease_ttl_s`` DEAD and redistributes its tenants.
+  served, applied epoch).  A dedicated heartbeat thread keeps renewing
+  while the serving thread sits inside a minutes-long model compile,
+  so a slow worker never reads as dead.  The coordinator declares a
+  worker whose lease outlives ``lease_ttl_s`` DEAD and redistributes
+  its tenants — but a dead source's tree only ships after the lease
+  stays expired an extra ``dead_grace_s`` AND a final lease re-read
+  shows no renewal (the fencing discipline), and that tree is retired
+  into ``fleet/retired/`` rather than deleted, so a zombie's writes
+  are never destroyed.
 * **Migration is first-class** — rebalancing and dead-worker recovery
   ride ONE code path: the coordinator marks the tenant ``draining``
   (the source worker settles it through the PR 2/7 drain machinery and
@@ -77,6 +84,7 @@ ASSIGN_JOURNAL = "assignments.jsonl"
 REQUESTS_JOURNAL = "requests.jsonl"
 RELEASE_DIR = "release"
 MIGRATIONS_DIR = "migrations"
+RETIRED_DIR = "retired"
 FLEET_DRAIN_MARKER = "fleet_drain_marker.json"
 COORDINATOR_MARKER = "coordinator.json"
 
@@ -86,9 +94,31 @@ DEFAULT_LEASE_TTL_S = 5.0
 #: a configured worker that has never heartbeat gets this long to boot
 #: (subprocess spawn + backend import dwarf the steady-state TTL)
 DEFAULT_BOOT_GRACE_S = 30.0
+#: the worker's dedicated heartbeat-thread cadence: leases renew even
+#: while the serving thread sits inside a minutes-long model compile,
+#: so a SLOW worker is never declared dead — only a silent one
+DEFAULT_HEARTBEAT_S = 1.0
 #: a migration that keeps failing verification is abandoned (phase
 #: ``failed``) after this many ship attempts
 MAX_SHIP_ATTEMPTS = 3
+#: worker ids the metric plane reserves (the fleet-wide aggregate row
+#: is published as ``worker="fleet"``; a real worker under that name
+#: would silently collide with it)
+RESERVED_WORKER_IDS = frozenset({"fleet"})
+
+
+def validate_worker_id(worker_id: str) -> str:
+    if not worker_id or "/" in worker_id or os.sep in worker_id:
+        raise ValueError(
+            f"worker_id must be a non-empty path-safe string, got "
+            f"{worker_id!r}"
+        )
+    if worker_id in RESERVED_WORKER_IDS:
+        raise ValueError(
+            f"worker_id {worker_id!r} is reserved for the fleet-wide "
+            "metric aggregate"
+        )
+    return worker_id
 
 
 def fleet_meta_dir(root: str) -> str:
@@ -228,15 +258,11 @@ class FleetWorker:
         daemon_kwargs: Optional[Dict[str, Any]] = None,
         controller: bool = False,
         controller_policy=None,
+        heartbeat_interval_s: float = DEFAULT_HEARTBEAT_S,
         clock=time.monotonic,
         wall=time.time,
     ):
-        if not worker_id or "/" in worker_id:
-            raise ValueError(
-                f"worker_id must be a non-empty path-safe string, got "
-                f"{worker_id!r}"
-            )
-        self.worker_id = worker_id
+        self.worker_id = validate_worker_id(worker_id)
         self.root = root
         self.specs = dict(specs_by_id)
         self.daemon_kwargs = dict(daemon_kwargs or {})
@@ -244,12 +270,16 @@ class FleetWorker:
         self.daemon_kwargs.pop("controller_policy", None)
         self._controller_armed = bool(controller)
         self._controller_policy = controller_policy
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
         self._clock = clock
         self._wall = wall
         self.daemon: Optional[ServeDaemon] = None
         self._seq = 0
         self._epoch = -1
         self._failed: Dict[str, str] = {}  # tid -> error (poisoned spec)
+        self._lease_lock = threading.Lock()
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
         os.makedirs(self.meta_dir, exist_ok=True)
         os.makedirs(os.path.join(self.meta_dir, RELEASE_DIR),
                     exist_ok=True)
@@ -271,31 +301,68 @@ class FleetWorker:
 
     def lease_payload(self) -> Dict[str, Any]:
         d = self.daemon
+        tenants = list(d.tenants) if d is not None else []
         return {
             "worker": self.worker_id,
             "pid": os.getpid(),
             "ts": self._wall(),
             "seq": self._seq,
             "epoch": self._epoch,
-            "tenants": self.serving(),
-            "rows_done": (
-                sum(t.rows_done for t in d.tenants) if d else 0
-            ),
-            "batches_done": (
-                sum(t.batches_done for t in d.tenants) if d else 0
-            ),
+            "tenants": sorted(t.spec.tenant_id for t in tenants),
+            "rows_done": sum(t.rows_done for t in tenants),
+            "batches_done": sum(t.batches_done for t in tenants),
             "failed": dict(self._failed),
         }
 
     def renew_lease(self) -> bool:
         """One heartbeat: the ``fleet.lease`` fault boundary, then the
         atomic lease-marker publish (DEGRADE — a full disk must not
-        kill the worker; the coordinator sees the stale lease)."""
+        kill the worker; the coordinator sees the stale lease).
+        Serialized, because the dedicated heartbeat thread and the
+        tick loop both renew."""
         fault_point("fleet.lease")
-        self._seq += 1
-        return _storage.write_marker(
-            lease_path(self.root, self.worker_id), self.lease_payload()
+        with self._lease_lock:
+            self._seq += 1
+            return _storage.write_marker(
+                lease_path(self.root, self.worker_id),
+                self.lease_payload(),
+            )
+
+    def start_heartbeat(self) -> bool:
+        """Renew the lease from a dedicated daemon thread.  The tick
+        loop shares its thread with ``daemon.tick()`` and the
+        ``add_tenant`` model compiles — minutes against a seconds-TTL
+        lease — so without this a merely SLOW worker reads as dead and
+        the coordinator ships a tree the live daemon still writes to.
+        The foreground :meth:`run` loop arms it; the steppable test
+        path may call it explicitly."""
+        if self._hb_thread is not None or self.heartbeat_interval_s <= 0:
+            return False
+        self._hb_stop.clear()
+
+        def _beat() -> None:
+            while not self._hb_stop.wait(self.heartbeat_interval_s):
+                try:
+                    self.renew_lease()
+                except Exception as e:
+                    emit_event(
+                        event="fleet_lease_error",
+                        worker=self.worker_id, error=repr(e),
+                    )
+
+        self._hb_thread = threading.Thread(
+            target=_beat, name=f"fleet-heartbeat-{self.worker_id}",
+            daemon=True,
         )
+        self._hb_thread.start()
+        return True
+
+    def stop_heartbeat(self) -> None:
+        if self._hb_thread is None:
+            return
+        self._hb_stop.set()
+        self._hb_thread.join(timeout=5.0)
+        self._hb_thread = None
 
     # -- fleet requests (the controller's migrate/scale_out rungs) ----------
 
@@ -490,6 +557,7 @@ class FleetWorker:
             )
         except ValueError:  # not the main thread
             pass
+        self.start_heartbeat()
         try:
             while not stop.is_set():
                 delta = self.tick()
@@ -499,6 +567,7 @@ class FleetWorker:
                     stop.wait(poll_interval)
         finally:
             self.drain("fleet_shutdown")
+            self.stop_heartbeat()
             status = (
                 self.daemon.status() if self.daemon is not None
                 else {"tenants": {}}
@@ -528,6 +597,7 @@ class FleetCoordinator:
         *,
         lease_ttl_s: float = DEFAULT_LEASE_TTL_S,
         boot_grace_s: float = DEFAULT_BOOT_GRACE_S,
+        dead_grace_s: Optional[float] = None,
         vnodes: int = DEFAULT_VNODES,
         slack: float = DEFAULT_SLACK,
         wall=time.time,
@@ -535,10 +605,19 @@ class FleetCoordinator:
     ):
         if not worker_ids:
             raise ValueError("a fleet needs at least one worker id")
+        for w in worker_ids:
+            validate_worker_id(w)
         self.root = root
         self.specs = dict(specs_by_id)
         self.lease_ttl_s = float(lease_ttl_s)
         self.boot_grace_s = float(boot_grace_s)
+        # the ship fence: a DEAD source's tree may only ship after its
+        # lease stayed expired this much longer — a slow-but-alive
+        # worker gets the window to renew before its tree is taken
+        self.dead_grace_s = (
+            float(dead_grace_s) if dead_grace_s is not None
+            else 2.0 * self.lease_ttl_s
+        )
         self.vnodes = int(vnodes)
         self.slack = float(slack)
         self._wall = wall
@@ -546,11 +625,7 @@ class FleetCoordinator:
         self.epoch = 0
         now = self._wall()
         self.workers: Dict[str, Dict[str, Any]] = {
-            w: {
-                "state": "pending", "seq": -1, "ts": None,
-                "registered": now, "rows_done": 0, "tenants": 0,
-            }
-            for w in worker_ids
+            w: self._worker_row(now) for w in worker_ids
         }
         #: tid -> {"worker", "phase", and for migrations "src"/"dst"/
         #: "reason"/"attempts"} — phase ∈ serving | draining | failed
@@ -587,6 +662,14 @@ class FleetCoordinator:
                 "tenants": len(self.specs),
             },
         )
+
+    @staticmethod
+    def _worker_row(now: float) -> Dict[str, Any]:
+        return {
+            "state": "pending", "seq": -1, "ts": None,
+            "registered": now, "rows_done": 0, "tenants": 0,
+            "epoch": -1, "died_at": None,
+        }
 
     # -- placement ----------------------------------------------------------
 
@@ -674,11 +757,13 @@ class FleetCoordinator:
                     ts=float(lease.get("ts", now)),
                     rows_done=int(lease.get("rows_done", 0)),
                     tenants=len(lease.get("tenants", ())),
+                    epoch=int(lease.get("epoch", -1)),
                 )
                 for tid, err in (lease.get("failed") or {}).items():
                     self._mark_failed(tid, wid, err)
                 if row["state"] != "live":
                     row["state"] = "live"
+                    row["died_at"] = None
                     self._dirty = True  # the doc carries worker states
                     emit_event(
                         event="fleet_worker_live", worker=wid,
@@ -701,6 +786,7 @@ class FleetCoordinator:
             )
             if row["state"] in ("live", "pending") and age > ttl:
                 row["state"] = "dead"
+                row["died_at"] = now
                 inc("sntc_fleet_leases_expired_total", worker=wid)
                 emit_event(
                     event="fleet_worker_dead", worker=wid,
@@ -776,11 +862,28 @@ class FleetCoordinator:
             f"{tenant_id}.json",
         )
 
-    def _source_released(self, e: Dict[str, Any],
-                         tenant_id: str) -> bool:
+    def _source_released(self, e: Dict[str, Any], tenant_id: str,
+                         now: float) -> bool:
         src = e["src"]
-        if self.workers.get(src, {}).get("state") == "dead":
-            return True  # a dead source cannot drain; ship as-is
+        row = self.workers.get(src)
+        if row is not None and row.get("state") == "dead":
+            # a dead source cannot drain — but "dead" is a TTL verdict,
+            # not proof.  Fence before shipping its tree out from under
+            # a possibly-still-writing daemon: (1) the lease must stay
+            # expired an extra dead_grace_s past the declaration, and
+            # (2) a final lease re-read must show no renewal since (a
+            # renewal here revives the worker on the next liveness
+            # pass, which then drains the tenant properly).
+            died_at = row.get("died_at")
+            if died_at is None:
+                row["died_at"] = now  # adopt: fence from first sight
+                return False
+            if now - died_at < self.dead_grace_s:
+                return False
+            lease = self._read_lease(src)
+            if lease is not None and int(lease.get("seq", -1)) > row["seq"]:
+                return False
+            return True
         path = self._release_marker(src, tenant_id)
         if not os.path.exists(path):
             return False
@@ -791,7 +894,7 @@ class FleetCoordinator:
             return False
         return int(rec.get("epoch", -1)) >= int(e.get("epoch", 0))
 
-    def _continue_migrations(self) -> None:
+    def _continue_migrations(self, now: float) -> None:
         for tid in sorted(self.assignments):
             e = self.assignments[tid]
             if e["phase"] != "draining":
@@ -799,8 +902,33 @@ class FleetCoordinator:
             if e.get("dst") is None:
                 e["dst"] = self._choose_dst(tid, exclude=(e["src"],))
                 if e["dst"] is None:
-                    continue  # nowhere to go yet; retry next tick
-            if self._source_released(e, tid):
+                    # nowhere to go.  If the SOURCE is back, revert to
+                    # it (the torn-ship discipline) instead of leaving
+                    # the tenant stranded in draining forever — its
+                    # tree at the source is intact until a flip.
+                    src = e["src"]
+                    if self.workers.get(src, {}).get("state") == "live":
+                        self.assignments[tid] = {
+                            "worker": src, "phase": "serving",
+                        }
+                        self._remove_release(src, tid)
+                        inc(
+                            "sntc_fleet_migrations_total",
+                            reason=e.get("reason", "?"),
+                            outcome="reverted",
+                        )
+                        self.migrations["reverted"] += 1
+                        emit_event(
+                            event="fleet_migrate_reverted", tenant=tid,
+                            src=src, dst=None,
+                            reason=e.get("reason"),
+                            error="no eligible destination",
+                            resumed_at=src,
+                        )
+                        self._dirty = True
+                    continue  # retry next tick
+                self._dirty = True  # the doc carries the new dst
+            if self._source_released(e, tid, now):
                 self._ship_and_flip(tid, e)
 
     def _manifest_path(self, tenant_id: str) -> str:
@@ -939,8 +1067,7 @@ class FleetCoordinator:
         # flipped: the destination owns the tenant from this epoch on
         self.assignments[tenant_id] = {"worker": dst, "phase": "serving"}
         self._remove_release(src, tenant_id)
-        if os.path.isdir(src_tree):
-            shutil.rmtree(src_tree, ignore_errors=True)
+        self._retire_src_tree(tenant_id, src, src_tree)
         inc(
             "sntc_fleet_migrations_total", reason=reason,
             outcome="completed",
@@ -958,6 +1085,40 @@ class FleetCoordinator:
         except OSError:
             pass
 
+    def _retire_src_tree(
+        self, tenant_id: str, src: str, src_tree: str,
+        *, assume_dead: bool = False,
+    ) -> None:
+        """Dispose of the source copy after a completed flip.  A LIVE
+        source acked the move (its release marker carries the epoch) —
+        its daemon no longer touches the tree, so deletion is safe.  A
+        DEAD source may be a zombie still writing: never destroy its
+        bytes — rename the tree aside into ``fleet/retired/`` (out of
+        the serving namespace, preserved as evidence; a rename keeps
+        the single-home invariant under ``worker/*/tenant/``)."""
+        if not os.path.isdir(src_tree):
+            return
+        if not assume_dead and (
+            self.workers.get(src, {}).get("state") != "dead"
+        ):
+            shutil.rmtree(src_tree, ignore_errors=True)
+            return
+        dest_root = os.path.join(fleet_meta_dir(self.root), RETIRED_DIR)
+        dest = os.path.join(
+            dest_root, f"{tenant_id}.{src}.{self.epoch + 1}"
+        )
+        try:
+            os.makedirs(dest_root, exist_ok=True)
+            if os.path.isdir(dest):
+                shutil.rmtree(dest)
+            shutil.move(src_tree, dest)
+        except OSError:
+            dest = None  # left in place; recovery retries the retire
+        emit_event(
+            event="fleet_src_tree_retired", tenant=tenant_id,
+            worker=src, retired_to=dest,
+        )
+
     # -- fleet requests ------------------------------------------------------
 
     def _consume_requests(self) -> None:
@@ -969,21 +1130,26 @@ class FleetCoordinator:
                 continue
             offset = self._request_offsets.get(wid, 0)
             try:
-                with open(path) as f:
+                with open(path, "rb") as f:
                     f.seek(offset)
                     tail = f.read()
             except OSError:
                 continue
-            if not tail:
+            # binary read + newline-bounded cut: the offset is a BYTE
+            # position, and a torn (partial) last line stays unconsumed
+            # for the next tick rather than being silently dropped —
+            # these requests fire at most once per tenant per daemon
+            # lifetime, so a lost line is never re-posted
+            cut = tail.rfind(b"\n") + 1
+            if cut == 0:
                 continue
-            consumed = len(tail)
-            for line in tail.splitlines():
+            for line in tail[:cut].splitlines():
                 try:
                     rec = json.loads(line)
                 except ValueError:
-                    continue  # torn tail: re-read next tick
+                    continue  # a genuinely corrupt (mid-file) line
                 self._handle_request(rec)
-            self._request_offsets[wid] = offset + consumed
+            self._request_offsets[wid] = offset + cut
 
     def _handle_request(self, rec: Dict[str, Any]) -> None:
         action = rec.get("action")
@@ -1004,17 +1170,20 @@ class FleetCoordinator:
                     )
                     return
                 if new_wid:
-                    self.add_worker(new_wid)
+                    try:
+                        self.add_worker(new_wid)
+                    except ValueError as e:
+                        emit_event(
+                            event="fleet_scale_out_error", error=repr(e)
+                        )
 
     # -- membership ----------------------------------------------------------
 
     def add_worker(self, worker_id: str) -> None:
         if worker_id in self.workers:
             return
-        self.workers[worker_id] = {
-            "state": "pending", "seq": -1, "ts": None,
-            "registered": self._wall(), "rows_done": 0, "tenants": 0,
-        }
+        validate_worker_id(worker_id)
+        self.workers[worker_id] = self._worker_row(self._wall())
         emit_event(event="fleet_worker_added", worker=worker_id)
         self.rebalance(reason="join")
 
@@ -1104,10 +1273,12 @@ class FleetCoordinator:
             self._quarantine_shipping(
                 shipping, tid, "torn mid-ship copy found at recovery"
             )
-        # a crash between flip and source-tree removal leaves a stale
-        # source copy: the assignment is the truth — remove trees at
-        # workers that no longer own the tenant IF a verified manifest
-        # records the completed move
+        # a crash between flip and source-tree retirement leaves a
+        # stale source copy: the assignment is the truth — retire trees
+        # at workers that no longer own the tenant IF a verified
+        # manifest records the completed move.  Retire (rename aside),
+        # never rmtree: a restarted coordinator has no liveness
+        # verdict yet, and the worker could be a zombie mid-write.
         for tid, e in sorted(self.assignments.items()):
             if e.get("phase") != "serving":
                 continue
@@ -1120,9 +1291,12 @@ class FleetCoordinator:
                 continue
             if manifest.get("dst") != e.get("worker"):
                 continue
-            stale = tenant_tree(self.root, manifest.get("src", ""), tid)
-            if manifest.get("src") and os.path.isdir(stale):
-                shutil.rmtree(stale, ignore_errors=True)
+            if manifest.get("src"):
+                self._retire_src_tree(
+                    tid, manifest["src"],
+                    tenant_tree(self.root, manifest["src"], tid),
+                    assume_dead=True,
+                )
         emit_event(
             event="fleet_recovered", epoch=self.epoch,
             tenants=len(self.assignments),
@@ -1145,7 +1319,7 @@ class FleetCoordinator:
             return self.status()
         self._check_liveness(now)
         self._consume_requests()
-        self._continue_migrations()
+        self._continue_migrations(now)
         self.publish()
         self._publish_gauges()
         return self.status()

@@ -1078,6 +1078,20 @@ class ServeDaemon:
                     close()
                 except Exception:
                     pass
+            # the engine is stopped for good: flip the stream state
+            # BEFORE detaching, so anything still holding the stream
+            # sees a non-controllable tenant, and detach the armed
+            # controller (its target list must not keep sampling a
+            # ghost — nor post fleet requests for a tenant another
+            # worker now owns)
+            t.state = "STOPPED"
+            if self.controller is not None:
+                try:
+                    self.controller.detach_tenant(tenant_id)
+                except Exception as e:  # degrade-never-kill
+                    emit_event(
+                        event="controller_error", error=repr(e)
+                    )
             reset_breakers(prefix=t.prefix)
             self.tenants.remove(t)
             del self._by_id[tenant_id]

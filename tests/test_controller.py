@@ -141,6 +141,41 @@ def test_windowed_p99_from_registry_deltas_matches_oracle(tmp_path):
         daemon.close()
 
 
+def test_remove_tenant_detaches_controller_target(tmp_path):
+    """``ServeDaemon.remove_tenant`` must detach the tenant from an
+    armed controller (the symmetric inverse of ``attach_tenant``):
+    the target list must not keep a ghost whose ``controllable()``
+    stays True — it would keep sampling the stopped engine, keep
+    evaluating SLO windows, and could post a fleet request for a
+    tenant another worker now owns."""
+    clock = FakeClock()
+    daemon = ServeDaemon(
+        [_spec("a", _frames(2)), _spec("b", _frames(2))],
+        str(tmp_path / "root"), clock=clock,
+    )
+    ctl = ServeController.for_daemon(
+        daemon, policy=ControlPolicy(confirm=1, cooldown=0),
+        ingest=False,
+    )
+    daemon.controller = ctl
+    try:
+        clock.t += 1.0
+        daemon.tick()
+        assert sorted(t.key for t in ctl.targets) == ["a", "b"]
+        summary = daemon.remove_tenant("a", drain=True, reason="moved")
+        assert summary["tenant"] == "a"
+        assert [t.key for t in ctl.targets] == ["b"]
+        assert not any(
+            name.startswith("a/") for name in ctl.knob_values()
+        )
+        # the loop keeps running clean on the survivor alone
+        clock.t += 2.0
+        ctl.on_tick()
+        daemon.tick()
+    finally:
+        daemon.close()
+
+
 # ---------------------------------------------------------------------------
 # TenantSpec SLO fields
 # ---------------------------------------------------------------------------

@@ -13,6 +13,7 @@ import importlib.util
 import json
 import os
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -369,6 +370,205 @@ def test_poisoned_spec_degrades_tenant_never_kills_worker(tmp_path):
     # parked means parked: further rounds never reassign it
     _step(coord, workers, wall, 5)
     assert coord.assignments["bad"]["phase"] == "failed"
+    for w in workers.values():
+        w.close()
+    coord.close()
+
+
+# ---------------------------------------------------------------------------
+# the review-hardening regressions: fencing, torn request tails,
+# stranded drains, ghost controller targets, reserved ids
+# ---------------------------------------------------------------------------
+
+
+def test_worker_id_fleet_is_reserved(tmp_path):
+    wall = FakeWall()
+    specs, _ = _specs(1)
+    with pytest.raises(ValueError, match="reserved"):
+        FleetWorker("fleet", str(tmp_path / "r"), specs, wall=wall)
+    with pytest.raises(ValueError, match="reserved"):
+        FleetCoordinator(
+            str(tmp_path / "r"), ["w0", "fleet"], specs, wall=wall
+        )
+    root, coord, workers = _fleet(tmp_path, ["w0"], specs, wall)
+    coord.add_worker("w1")  # a legal join still works
+    with pytest.raises(ValueError, match="reserved"):
+        coord.add_worker("fleet")
+    for w in workers.values():
+        w.close()
+    coord.close()
+
+
+def test_heartbeat_thread_renews_lease_without_ticks(tmp_path):
+    """The r19 fix for slow-worker false death: the dedicated
+    heartbeat thread keeps the lease fresh while the serving thread is
+    parked (a minutes-long compile in real life)."""
+    specs, _ = _specs(1)
+    w = FleetWorker(
+        "w0", str(tmp_path / "fleet"), specs,
+        heartbeat_interval_s=0.02,
+    )
+    assert w.start_heartbeat()
+    assert not w.start_heartbeat()  # idempotent: one thread only
+    try:
+        deadline = time.time() + 5.0
+        lease_file = os.path.join(
+            str(tmp_path / "fleet"), "fleet", "workers", "w0",
+            "lease.json",
+        )
+        seq = -1
+        while time.time() < deadline and seq < 3:
+            time.sleep(0.01)
+            try:
+                seq = json.load(open(lease_file))["seq"]
+            except (OSError, ValueError):
+                pass
+        # several renewals landed although tick() never ran
+        assert seq >= 3
+    finally:
+        w.stop_heartbeat()
+        w.close()
+
+
+def test_dead_source_ship_fenced_by_grace_and_lease_recheck(tmp_path):
+    """A worker declared dead off a 5s TTL must NOT have its tree
+    shipped immediately: the ship waits the extra dead-grace, and a
+    lease renewal inside that window aborts the ship entirely —
+    split-brain fencing for the slow-but-alive worker."""
+    wall = FakeWall()
+    specs, sinks = _specs(4)
+    root, coord, workers = _fleet(
+        tmp_path, ["w0", "w1"], specs, wall,
+        lease_ttl_s=5.0, dead_grace_s=10.0,
+    )
+    _step(coord, workers, wall, 4)
+    w1_tenants = [
+        t for t, e in coord.assignments.items() if e["worker"] == "w1"
+    ]
+    assert w1_tenants
+    # w1 goes silent just past the TTL: declared dead, NOT shipped
+    for _ in range(8):
+        wall.t += 1.0
+        workers["w0"].tick()
+        coord.tick()
+    assert coord.status()["workers"]["w1"]["state"] == "dead"
+    for tid in w1_tenants:
+        assert coord.assignments[tid]["phase"] == "draining"
+        assert "w1" in _tenant_homes(root, tid)  # tree untouched
+    assert coord.migrations["completed"] == 0
+    # w1 renews INSIDE the grace window: the ship aborts, the worker
+    # revives, and the tenants settle through the normal drain path
+    _step(coord, workers, wall, 30)
+    st = coord.status()
+    assert st["workers"]["w1"]["state"] == "live"
+    for tid, e in coord.assignments.items():
+        assert e["phase"] == "serving", (tid, e)
+        assert _tenant_homes(root, tid) == [e["worker"]]
+    for tid, sink in sinks.items():
+        assert len(sink.batches) == 3, tid  # zero committed rows lost
+    for w in workers.values():
+        w.close()
+    coord.close()
+
+
+def test_dead_source_tree_retires_instead_of_rmtree(tmp_path):
+    """After a truly-dead source's tenants ship, its trees move to
+    fleet/retired/ (evidence preserved for a zombie writer) instead of
+    being deleted — and the serving namespace stays single-homed."""
+    wall = FakeWall()
+    specs, sinks = _specs(4)
+    root, coord, workers = _fleet(
+        tmp_path, ["w0", "w1"], specs, wall,
+        lease_ttl_s=5.0, dead_grace_s=4.0,
+    )
+    _step(coord, workers, wall, 4)
+    dead_tenants = [
+        t for t, e in coord.assignments.items() if e["worker"] == "w1"
+    ]
+    for _ in range(20):
+        wall.t += 1.0
+        workers["w0"].tick()
+        coord.tick()
+    for tid in dead_tenants:
+        assert coord.assignments[tid] == {
+            "worker": "w0", "phase": "serving",
+        }
+        assert _tenant_homes(root, tid) == ["w0"]
+        assert glob.glob(os.path.join(
+            root, "fleet", "retired", f"{tid}.w1.*"
+        )), tid
+    _step(coord, workers, wall, 10)
+    for tid, sink in sinks.items():
+        assert len(sink.batches) == 3, tid
+    for w in workers.values():
+        w.close()
+    coord.close()
+
+
+def test_torn_request_tail_is_not_dropped(tmp_path):
+    """A partially-appended fleet request (torn tail, non-ASCII reason
+    included) must be consumed on the tick AFTER the line completes —
+    these requests fire once per tenant per daemon lifetime, so a
+    dropped line is never re-posted."""
+    wall = FakeWall()
+    specs, _ = _specs(4)
+    root, coord, workers = _fleet(tmp_path, ["w0", "w1"], specs, wall)
+    _step(coord, workers, wall, 3)
+    tid = next(
+        t for t, e in coord.assignments.items() if e["worker"] == "w0"
+    )
+    line = json.dumps({
+        "action": "migrate", "tenant": tid,
+        "reason": "café-überload",  # non-ASCII: bytes ≠ chars
+        "worker": "w0",
+    }, ensure_ascii=False).encode()
+    path = os.path.join(
+        root, "fleet", "workers", "w0", "requests.jsonl"
+    )
+    with open(path, "ab") as f:  # torn mid-append: no newline yet
+        f.write(line[:len(line) // 2])
+    coord.tick()
+    assert coord.assignments[tid]["phase"] == "serving"  # not consumed
+    with open(path, "ab") as f:  # the append completes
+        f.write(line[len(line) // 2:] + b"\n")
+    coord.tick()
+    assert coord.assignments[tid]["phase"] == "draining"
+    _step(coord, workers, wall, 20)
+    assert coord.assignments[tid]["phase"] == "serving"
+    assert coord.migrations["completed"] == 1
+    for w in workers.values():
+        w.close()
+    coord.close()
+
+
+def test_draining_tenant_reverts_to_source_when_dst_dies(tmp_path):
+    """Destination dies mid-migration with no other live worker: the
+    draining tenant must revert to its intact source instead of being
+    stranded in 'draining' forever."""
+    wall = FakeWall()
+    specs, sinks = _specs(4, batches=4)
+    root, coord, workers = _fleet(
+        tmp_path, ["w0", "w1"], specs, wall, lease_ttl_s=5.0
+    )
+    _step(coord, workers, wall, 3)
+    tid = next(
+        t for t, e in coord.assignments.items() if e["worker"] == "w0"
+    )
+    assert coord.migrate_tenant(tid, "w1", reason="rebalance")
+    # w1 (the destination) goes silent while the SOURCE is still
+    # mid-drain (it heartbeats but never applies the draining epoch,
+    # so it never releases) — the classic dst-death-mid-migration
+    for _ in range(25):
+        wall.t += 1.0
+        workers["w0"].renew_lease()
+        coord.tick()
+    assert coord.status()["workers"]["w1"]["state"] == "dead"
+    assert coord.assignments[tid] == {"worker": "w0", "phase": "serving"}
+    assert coord.migrations["reverted"] >= 1
+    # the source never even noticed: serving resumes there untouched
+    _step(coord, {"w0": workers["w0"]}, wall, 30)
+    for t, sink in sinks.items():
+        assert len(sink.batches) == 4, t  # zero committed rows lost
     for w in workers.values():
         w.close()
     coord.close()
