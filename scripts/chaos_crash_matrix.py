@@ -40,6 +40,7 @@ import glob
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import time
@@ -185,6 +186,31 @@ PROMOTE_EXPECT_CANDIDATE = {
 FLEET_KILL_SITES = ("fleet.lease", "fleet.assign", "fleet.migrate")
 FLEET_WORKER_IDS = ("fw0", "fw1")
 FLEET_TENANT_IDS = ("ft0", "ft1", "ft2", "ft3")
+
+# live-ingress scenarios (r20): an engine serving straight off a
+# socket — a UDP listener spooling NetFlow datagrams into the ingress
+# WAL (sntc_tpu/serve/ingress), replayed by NetFlowSpoolSource under a
+# supervised StreamingQuery.  The kill scenarios send real loopback
+# datagrams with a RESEND-UNTIL-SEALED sender (the sealed capture
+# file's atomic rename is the ack): ``ingress.recv`` kills at the
+# receive boundary, ``ingress.spool`` kills inside the seal — in both
+# cases no sealed file appears for the in-flight payload, the parent
+# restarts the worker and resends, and the run must converge to the
+# uninterrupted reference's commits and sink BYTES bitwise (exactly-
+# once into the spool: sent unique payloads == sealed files ==
+# committed batches, zero drops journaled).  The burst scenario floods
+# a deliberately tiny ring (ring=4) through a slowed spool: the shed
+# ladder must engage (counted ``ring_overflow`` drops) instead of
+# unbounded buffering, the daemon must stay alive through the burst
+# and exit 0 on SIGTERM, and the drained stats must satisfy the
+# conservation law EXACTLY: received == spooled + sum(dropped).
+INGRESS_KILL_SITES = ("ingress.recv", "ingress.spool")
+INGRESS_KILL_AFTER = {
+    "ingress.recv": 1,   # the 2nd datagram dies at the boundary
+    "ingress.spool": 1,  # the 2nd seal dies before the atomic write
+}
+INGRESS_BURST_DATAGRAMS = 150
+STATS_NAME = "ingress_stats.json"  # mirrors serve.ingress.STATS_FILE
 
 
 # ---------------------------------------------------------------------------
@@ -1449,6 +1475,296 @@ def run_fleet_kill_scenario(
     }
 
 
+# ---------------------------------------------------------------------------
+# live-ingress scenarios (r20)
+# ---------------------------------------------------------------------------
+
+
+def _setup_ingress_inputs(d: str) -> list:
+    """Datagram payload files for one ingress scenario (written by a
+    child process — the parent side never imports sntc_tpu).  Returns
+    the payload byte strings in send order."""
+    pdir = os.path.join(d, "payloads")
+    setup = subprocess.run(
+        [
+            sys.executable, SCRIPT, "--worker", "--setup-ingress-inputs",
+            "--watch", pdir,
+        ],
+        env=dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS=""),
+        cwd=REPO, capture_output=True, text=True, timeout=120.0,
+    )
+    if setup.returncode != 0:
+        raise RuntimeError(f"ingress input setup failed: {setup.stderr}")
+    payloads = []
+    for p in sorted(glob.glob(os.path.join(pdir, "payload_*.bin"))):
+        with open(p, "rb") as f:
+            payloads.append(f.read())
+    if not payloads:
+        raise RuntimeError("ingress input setup wrote no payloads")
+    return payloads
+
+
+def _spawn_ingress_worker(
+    d: str, *, kill_site: str = "", kill_after: int = 0,
+    ring: int = 4096, seal_every: int = 1, slow_spool_s: float = 0.0,
+) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS="")
+    env.pop("SNTC_RESILIENCE_LOG", None)
+    cmd = [
+        sys.executable, SCRIPT, "--worker", "--ingress",
+        "--watch", os.path.join(d, "spool"),
+        "--out", os.path.join(d, "out"),
+        "--ckpt", os.path.join(d, "ckpt"),
+        "--poll-interval", "0.05",
+        "--ring", str(ring), "--seal-every", str(seal_every),
+    ]
+    if slow_spool_s:
+        cmd += ["--slow-spool-s", str(slow_spool_s)]
+    if kill_site:
+        cmd += ["--kill-site", kill_site, "--kill-after", str(kill_after)]
+    return subprocess.Popen(
+        cmd, env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+
+
+def _ingress_stats(d: str) -> dict:
+    try:
+        with open(os.path.join(d, "spool", "ingress_stats.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _wait_ingress_port(d: str, proc: subprocess.Popen,
+                       timeout: float = 90.0) -> int:
+    """Block until the worker publishes its ephemeral UDP port in
+    ``ingress_stats.json`` (the listener's start() does this)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = _ingress_stats(d)
+        if st.get("port"):
+            return int(st["port"])
+        if proc.poll() is not None:
+            _o, e = proc.communicate()
+            raise RuntimeError(
+                f"ingress worker died before publishing its port "
+                f"(rc={proc.returncode}): {e[-800:]}"
+            )
+        time.sleep(0.05)
+    raise RuntimeError("ingress worker never published its port")
+
+
+def _sealed_count(d: str) -> int:
+    return len(glob.glob(os.path.join(d, "spool", "capture_*.nf5")))
+
+
+def _drive_ingress_pass(
+    d: str, payloads: list, *, kill_site: str = "", kill_after: int = 0,
+    timeout: float = 180.0,
+) -> dict:
+    """Send each payload as one loopback datagram with seal_every=1, so
+    the sealed capture file IS the ack: payload ``k`` is resent only
+    after a worker death (the kill scenarios' exactly-once contract —
+    a blind resend would seal a duplicate and break the bitwise
+    comparison).  A worker killed by the armed fault (rc 137) is
+    restarted WITHOUT the fault.  Once every payload is sealed and
+    committed, SIGTERM drains the worker.  Returns the evidence."""
+    proc = _spawn_ingress_worker(
+        d, kill_site=kill_site, kill_after=kill_after,
+    )
+    kills = []
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        port = _wait_ingress_port(d, proc)
+        deadline = time.time() + timeout
+        k = _sealed_count(d)
+        sent = 0
+        pending_since = None
+        while k < len(payloads):
+            if time.time() > deadline:
+                proc.kill()
+                proc.communicate()
+                return {"error": f"timed out with {k}/{len(payloads)} "
+                        f"payloads sealed, kills={kills}"}
+            rc = proc.poll()
+            if rc is not None:
+                if rc != KILL_EXIT_CODE:
+                    _o, e = proc.communicate()
+                    return {"error": f"worker died rc={rc} (expected "
+                            f"{KILL_EXIT_CODE}): {e[-800:]}"}
+                kills.append(rc)
+                # the restart rebinds a fresh ephemeral port: drop the
+                # stale stats marker so the port wait can't race it
+                try:
+                    os.unlink(os.path.join(d, "spool", STATS_NAME))
+                except OSError:
+                    pass
+                proc = _spawn_ingress_worker(d)
+                port = _wait_ingress_port(d, proc)
+                pending_since = None  # resend the unsealed payload
+            if pending_since is None:
+                sock.sendto(payloads[k], ("127.0.0.1", port))
+                sent += 1
+                pending_since = time.time()
+            if _sealed_count(d) > k:
+                k = _sealed_count(d)
+                pending_since = None
+                continue
+            time.sleep(0.05)
+        # every payload sealed: wait for the engine to commit them all,
+        # then drain via SIGTERM (listeners first, then the engine)
+        while time.time() < deadline:
+            if len(committed_state(os.path.join(d, "ckpt"))) >= len(payloads):
+                break
+            if proc.poll() is not None:
+                _o, e = proc.communicate()
+                return {"error": f"worker died while committing "
+                        f"(rc={proc.returncode}): {e[-800:]}"}
+            time.sleep(0.05)
+        else:
+            proc.kill()
+            proc.communicate()
+            return {"error": "timed out waiting for commits"}
+        proc.send_signal(signal.SIGTERM)
+        stdout, stderr = proc.communicate(timeout=90)
+    finally:
+        sock.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    return {
+        "rc": proc.returncode, "kills": kills, "sent": sent,
+        "sealed": _sealed_count(d),
+        "stats": _ingress_stats(d),
+        "commits": committed_state(os.path.join(d, "ckpt")),
+        "sink": sink_contents(os.path.join(d, "out")),
+        "stderr": stderr[-2000:], "stdout": stdout[-500:],
+        "error": None,
+    }
+
+
+def run_ingress_reference(workdir: str) -> dict:
+    """One uninterrupted socket-fed pass over the payload set — the
+    bitwise baseline for both ingress kill scenarios."""
+    d = os.path.join(workdir, "ingress_reference")
+    payloads = _setup_ingress_inputs(d)
+    res = _drive_ingress_pass(d, payloads)
+    if res["error"] or res["rc"] != 0:
+        raise RuntimeError(
+            f"ingress reference failed: {res.get('error')} "
+            f"rc={res.get('rc')} stderr={res.get('stderr', '')[-500:]}"
+        )
+    return {"payloads": payloads, "commits": res["commits"],
+            "sink": res["sink"]}
+
+
+def run_ingress_kill_scenario(
+    workdir: str, site: str, reference: dict,
+) -> dict:
+    """Kill the socket-fed engine at ``site`` mid-traffic, restart it,
+    keep resending until sealed.  Required: the kill landed (rc 137 at
+    least once), the drained final pass exits 0, sent unique payloads
+    == sealed files == committed batches with ZERO journaled drops
+    (sent == committed + journaled_drops, exactly), the final epoch's
+    conservation law holds, and commits + sink bytes are identical to
+    the uninterrupted reference."""
+    d = os.path.join(workdir, "ingress_" + site.replace(".", "_"))
+    payloads = _setup_ingress_inputs(d)
+    res = _drive_ingress_pass(
+        d, payloads, kill_site=site,
+        kill_after=INGRESS_KILL_AFTER[site],
+    )
+    if res["error"]:
+        return {"site": site, "ok": False, "error": res["error"]}
+    stats = res["stats"]
+    dropped = sum(stats.get("dropped", {}).values())
+    law = (
+        stats.get("received", -1)
+        == stats.get("spooled", -2) + dropped
+    )
+    bitwise = res["sink"] == reference["sink"]
+    ok = (
+        res["rc"] == 0
+        and len(res["kills"]) >= 1
+        and res["sealed"] == len(payloads)
+        and len(payloads) == len(res["commits"]) + dropped  # sent==committed+drops
+        and law
+        and stats.get("drained") is True
+        and res["commits"] == reference["commits"]
+        and bitwise
+    )
+    return {
+        "site": site, "ok": ok, "rc": res["rc"],
+        "kills": res["kills"], "sent": res["sent"],
+        "sealed": res["sealed"], "committed": len(res["commits"]),
+        "journaled_drops": dropped, "law_exact": law,
+        "sink_bitwise": bitwise,
+    }
+
+
+def run_ingress_burst_scenario(
+    workdir: str, timeout: float = 180.0,
+) -> dict:
+    """Flood a tiny-ring (4 datagrams) worker through a slowed spool:
+    the shed ladder must engage (counted ``ring_overflow``) instead of
+    unbounded buffering, the worker must stay alive through the burst
+    and exit 0 on SIGTERM, and the drained stats must satisfy
+    received == spooled + sum(dropped) EXACTLY."""
+    d = os.path.join(workdir, "ingress_burst")
+    payloads = _setup_ingress_inputs(d)
+    proc = _spawn_ingress_worker(
+        d, ring=4, seal_every=8, slow_spool_s=0.05,
+    )
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        port = _wait_ingress_port(d, proc)
+        for i in range(INGRESS_BURST_DATAGRAMS):
+            sock.sendto(payloads[i % len(payloads)], ("127.0.0.1", port))
+            time.sleep(0.002)
+        # let the spooler work the backlog down and the engine commit a
+        # few sealed files before the drain lands
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if committed_state(os.path.join(d, "ckpt")):
+                break
+            if proc.poll() is not None:
+                _o, e = proc.communicate()
+                return {"site": "ingress_burst", "ok": False,
+                        "error": f"worker died mid-burst "
+                        f"(rc={proc.returncode}): {e[-800:]}"}
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        stdout, stderr = proc.communicate(timeout=90)
+    finally:
+        sock.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    stats = _ingress_stats(d)
+    dropped = stats.get("dropped", {})
+    law = (
+        stats.get("received", -1)
+        == stats.get("spooled", -2) + sum(dropped.values())
+    )
+    commits = committed_state(os.path.join(d, "ckpt"))
+    ok = (
+        proc.returncode == 0
+        and stats.get("drained") is True
+        and law
+        and dropped.get("ring_overflow", 0) > 0
+        and stats.get("spooled", 0) > 0
+        and len(commits) >= 1
+    )
+    return {
+        "site": "ingress_burst", "ok": ok, "rc": proc.returncode,
+        "received": stats.get("received"),
+        "spooled": stats.get("spooled"), "dropped": dropped,
+        "law_exact": law, "commits": len(commits),
+        "stderr": stderr[-2000:],
+    }
+
+
 def run_matrix(workdir: str, pipelined: bool = False) -> dict:
     """The full matrix: reference is ALWAYS the serial engine; kill and
     drain scenarios run serial or pipelined per ``pipelined`` and must
@@ -1490,6 +1806,12 @@ def run_matrix(workdir: str, pipelined: bool = False) -> dict:
         run_fleet_kill_scenario(workdir, s, fleet_ref)
         for s in FLEET_KILL_SITES
     )
+    ingress_ref = run_ingress_reference(workdir)
+    results.extend(
+        run_ingress_kill_scenario(workdir, s, ingress_ref)
+        for s in INGRESS_KILL_SITES
+    )
+    results.append(run_ingress_burst_scenario(workdir))
     return {"ok": all(r["ok"] for r in results), "scenarios": results}
 
 
@@ -1846,6 +2168,120 @@ def flow_worker_main(args) -> int:
     return 0
 
 
+def setup_ingress_inputs_main(args) -> int:
+    """Write the ingress scenarios' datagram payload files: the synth
+    NetFlow capture stream's file payloads (``data/synth
+    .write_capture_stream(format="netflow")``), one send unit per
+    ``payload_NNN.bin`` — so the parent can replay them over a real
+    loopback socket without importing sntc_tpu."""
+    import shutil
+
+    sys.path.insert(0, REPO)
+    from sntc_tpu.data.synth import write_capture_stream
+
+    os.makedirs(args.watch, exist_ok=True)
+    gen = os.path.join(args.watch, "_gen")
+    write_capture_stream(
+        gen, n_files=6, flows_per_file=3, packets_per_flow=4,
+        seed=23, format="netflow", flush=False,
+    )
+    n = 0
+    for i, path in enumerate(
+        sorted(glob.glob(os.path.join(gen, "*.nf5")))
+    ):
+        with open(path, "rb") as f:
+            data = f.read()
+        with open(
+            os.path.join(args.watch, f"payload_{i:03d}.bin"), "wb"
+        ) as f:
+            f.write(data)
+        n += 1
+    shutil.rmtree(gen, ignore_errors=True)
+    print(json.dumps({"payloads": n}))
+    return 0
+
+
+#: a float-heavy NetFlow-populated subset of the 78 CICIDS2017 flow
+#: features the ingress scenarios journal — bitwise sink comparison
+#: must cover derived statistics, not just counts
+INGRESS_SINK_COLS = [
+    "Destination Port", "Flow Duration", "Total Fwd Packets",
+    "Total Length of Fwd Packets", "Flow Bytes/s", "Flow Packets/s",
+]
+
+
+def ingress_worker_main(args) -> int:
+    """One supervised socket-fed engine pass: a UDP ingress listener
+    (ephemeral port, published in ``ingress_stats.json``) spooling
+    into ``--watch`` with ``--seal-every`` datagrams per capture file
+    and a ``--ring``-datagram ring, replayed by NetFlowSpoolSource
+    under a supervised StreamingQuery until SIGTERM (listeners drain
+    FIRST, then the engine — the cmd_serve ordering).
+    ``--slow-spool-s`` slows every seal (the burst scenario's lever),
+    ``--kill-site``/``--kill-after`` arm the Nth-call kill."""
+    sys.path.insert(0, REPO)
+    from sntc_tpu.core.base import Transformer
+    from sntc_tpu.resilience import QuerySupervisor, arm
+    from sntc_tpu.serve import CsvDirSink, StreamingQuery
+    from sntc_tpu.serve.ingress import build_ingress, wire_committed_offset
+
+    class Identity(Transformer):
+        def transform(self, frame):
+            return frame
+
+    if args.kill_site:
+        arm(args.kill_site, kind="kill", after=args.kill_after, times=1)
+    source, listeners = build_ingress(
+        args.watch, listen_udp=0, keep_files=10_000,
+        ring=args.ring, seal_every=args.seal_every,
+    )
+    if args.slow_spool_s > 0:
+        spool = source.spool
+        real_seal = spool.seal
+
+        def slow_seal(payload, units, extra=None):
+            time.sleep(args.slow_spool_s)
+            return real_seal(payload, units, extra)
+
+        spool.seal = slow_seal
+    q = StreamingQuery(
+        Identity(), source,
+        CsvDirSink(args.out, columns=INGRESS_SINK_COLS),
+        args.ckpt, max_batch_offsets=1,
+    )
+    wire_committed_offset(source, q.committed_end)
+    for l in listeners:
+        l.start()
+    sup = QuerySupervisor(
+        q, health_json=os.path.join(args.ckpt, "health.json"),
+    )
+    sup.install_signal_handlers()
+
+    def _drain_ingress_then_engine(signum, frame):
+        for l in listeners:
+            try:
+                l.drain()
+            except Exception:
+                pass
+        sup.request_drain("SIGTERM")
+
+    signal.signal(signal.SIGTERM, _drain_ingress_then_engine)
+    try:
+        status = sup.run(poll_interval=args.poll_interval)
+    finally:
+        for l in listeners:
+            try:
+                l.close()
+            except Exception:
+                pass
+    print(json.dumps({
+        "batches": status["engine"]["batches_done"],
+        "drained": status["drained"],
+        "ingress": listeners[0].stats.snapshot(),
+    }))
+    return 0
+
+
 def _device_pipeline():
     """A servable pipeline with a REAL fused segment (the assembler
     stays eager by the single-upload rule; a DCT + const-class LR head
@@ -2048,6 +2484,23 @@ def main(argv=None) -> int:
     ap.add_argument("--setup-flow-inputs", action="store_true",
                     help="worker: write the flow scenarios' capture "
                     "stream and exit")
+    ap.add_argument("--setup-ingress-inputs", action="store_true",
+                    help="worker: write the ingress scenarios' "
+                    "datagram payload files and exit")
+    ap.add_argument("--ingress", action="store_true",
+                    help="worker: supervised socket-fed engine pass "
+                    "(UDP ingress listener -> spool -> "
+                    "NetFlowSpoolSource; live-ingress scenarios)")
+    ap.add_argument("--ring", type=int, default=4096,
+                    help="ingress worker: bounded ring size in "
+                    "datagrams (tiny for the burst scenario)")
+    ap.add_argument("--seal-every", type=int, default=1,
+                    help="ingress worker: datagrams per sealed "
+                    "capture file (1 makes the sealed file the "
+                    "per-datagram ack)")
+    ap.add_argument("--slow-spool-s", type=float, default=0.0,
+                    help="ingress worker: sleep before every seal "
+                    "(forces ring overflow in the burst scenario)")
     ap.add_argument("--wal-append", action="store_true",
                     help="worker: append-WAL mode with compaction "
                     "every 2 commits (torn-WAL / disk-fault scenarios)")
@@ -2107,6 +2560,10 @@ def main(argv=None) -> int:
             return setup_models_main(args)
         if args.setup_flow_inputs:
             return setup_flow_inputs_main(args)
+        if args.setup_ingress_inputs:
+            return setup_ingress_inputs_main(args)
+        if args.ingress:
+            return ingress_worker_main(args)
         if args.flow:
             return flow_worker_main(args)
         if args.device:

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Static drift check: live-ingress surface across CLI ⇔ build_ingress
+⇔ TenantSpec ⇔ metric catalog ⇔ docs.
+
+The network front door (r20) is one feature spread over five layers —
+``python -m sntc_tpu serve`` flags, the ``serve.ingress.build_ingress``
+constructor they feed, the ``TenantSpec.ingress`` block serve-daemon
+tenants configure, the ``sntc_ingress_*`` metric family that journals
+the loss-accounting law, and the resilience documentation — and they
+must stay in lockstep:
+
+1. **CLI**: ``--listen-udp`` / ``--listen-tcp`` / ``--ingress-spool-mb``
+   exist on BOTH serve and serve-daemon;
+2. **CLI → build_ingress**: every flag-exposed knob is a real
+   ``build_ingress`` keyword;
+3. **TenantSpec → build_ingress**: every ``tenancy.INGRESS_KEYS`` entry
+   is a real ``build_ingress`` keyword (the per-tenant block and the
+   builder cannot drift apart);
+4. **metrics**: the full ``sntc_ingress_*`` family is declared in
+   ``obs.metrics.CATALOG`` (``check_metric_names.py`` owns catalog ⇔
+   docs; this check pins the family exists at all);
+5. **docs**: ``docs/RESILIENCE.md`` carries a marker-delimited
+   ingress-flag table (``<!-- ingress-flags:begin/end -->``) with one
+   row per CLI knob naming its flag — stale/extra rows are drift.
+
+Wired as a tier-1 test (``tests/test_ingress.py``), the same
+discipline as ``check_ingest_flags.py`` / ``check_tenant_flags.py``.
+
+Exit 0 when consistent; exit 1 with a per-item report otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DOC = "docs/RESILIENCE.md"
+TABLE_BEGIN = "<!-- ingress-flags:begin -->"
+TABLE_END = "<!-- ingress-flags:end -->"
+
+#: CLI-exposed ingress knob -> its flag (on serve AND serve-daemon)
+FLAG_KNOBS = {
+    "listen_udp": "--listen-udp",
+    "listen_tcp": "--listen-tcp",
+    "spool_mb": "--ingress-spool-mb",
+}
+
+#: the catalog rows the ingress plane emits
+INGRESS_METRICS = (
+    "sntc_ingress_datagrams_total",
+    "sntc_ingress_frames_total",
+    "sntc_ingress_bytes_total",
+    "sntc_ingress_dropped_total",
+    "sntc_ingress_sealed_files_total",
+    "sntc_ingress_pruned_files_total",
+    "sntc_ingress_spool_bytes",
+    "sntc_ingress_ring_depth",
+    "sntc_ingress_backpressure_state",
+    "sntc_ingress_connections",
+)
+
+
+def _read(rel: str) -> str:
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def _doc_rows() -> dict:
+    """knob -> documented flag, from the marker-delimited table."""
+    text = _read(DOC)
+    if TABLE_BEGIN not in text or TABLE_END not in text:
+        return {}
+    table = text.split(TABLE_BEGIN, 1)[1].split(TABLE_END, 1)[0]
+    rows = {}
+    for line in table.splitlines():
+        m = re.match(r"\s*\|\s*`([a-z_]+)`\s*\|\s*`(--[a-z-]+)`", line)
+        if m:
+            rows[m.group(1)] = m.group(2)
+    return rows
+
+
+def check() -> list:
+    """Returns human-readable drift complaints (empty = consistent)."""
+    problems = []
+    sys.path.insert(0, REPO)
+    import inspect
+
+    from sntc_tpu.obs.metrics import CATALOG
+    from sntc_tpu.serve.ingress import build_ingress
+    from sntc_tpu.serve.tenancy import INGRESS_KEYS
+
+    app_src = _read(os.path.join("sntc_tpu", "app.py"))
+
+    # 1. CLI surface: each flag on BOTH serve and serve-daemon
+    for knob, flag in FLAG_KNOBS.items():
+        n = app_src.count(f'"{flag}"')
+        if n < 2:
+            problems.append(
+                f"ingress knob {knob!r} needs its {flag!r} flag on "
+                f"BOTH serve and serve-daemon CLIs (found {n} "
+                "declarations in sntc_tpu/app.py)"
+            )
+
+    # 2/3. every CLI knob and every TenantSpec ingress key is a real
+    # build_ingress keyword
+    params = set(inspect.signature(build_ingress).parameters)
+    for knob in FLAG_KNOBS:
+        if knob not in params:
+            problems.append(
+                f"CLI knob {knob!r} is not a build_ingress kwarg"
+            )
+    for key in sorted(INGRESS_KEYS):
+        if key not in params:
+            problems.append(
+                f"TenantSpec ingress key {key!r} is not a "
+                "build_ingress kwarg"
+            )
+    for knob in FLAG_KNOBS:
+        if knob not in INGRESS_KEYS:
+            problems.append(
+                f"CLI knob {knob!r} missing from tenancy.INGRESS_KEYS "
+                "(serve-daemon tenants could not configure it)"
+            )
+
+    # 4. catalog
+    for name in INGRESS_METRICS:
+        if name not in CATALOG:
+            problems.append(
+                f"ingress metric {name!r} missing from "
+                "obs.metrics.CATALOG"
+            )
+    extra = sorted(
+        n for n in CATALOG
+        if n.startswith("sntc_ingress_") and n not in INGRESS_METRICS
+    )
+    for name in extra:
+        problems.append(
+            f"catalog declares {name!r} but the checker's ingress "
+            "family does not list it — update both"
+        )
+
+    # 5. docs
+    doc = _doc_rows()
+    if not doc:
+        problems.append(
+            f"{DOC} is missing the marker-delimited ingress-flag "
+            f"table ({TABLE_BEGIN} ... {TABLE_END})"
+        )
+    else:
+        for knob, flag in FLAG_KNOBS.items():
+            if knob not in doc:
+                problems.append(
+                    f"knob {knob!r} missing from the {DOC} flag table"
+                )
+            elif doc[knob] != flag:
+                problems.append(
+                    f"{knob!r}: docs say flag {doc[knob]!r}, CLI has "
+                    f"{flag!r}"
+                )
+        for knob in sorted(set(doc) - set(FLAG_KNOBS)):
+            problems.append(
+                f"{DOC} flag table documents unknown knob {knob!r}"
+            )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("ingress-flag drift detected:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print(
+        f"ok: {len(FLAG_KNOBS)} ingress flags + "
+        f"{len(INGRESS_METRICS)} metrics consistent across CLI, "
+        "build_ingress, TenantSpec, catalog, and docs"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

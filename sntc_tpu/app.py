@@ -463,7 +463,49 @@ def cmd_serve(args) -> int:
     # <checkpoint>/flow_state) and the emitted feature rows ride the
     # SAME admission → predict → sink path the CSV mode serves.  See
     # docs/RESILIENCE.md "Stateful flow windows".
-    if args.from_capture:
+    # --listen-udp / --listen-tcp (r20): the live network front door.
+    # The watch directory becomes the ingress SPOOL: a supervised
+    # listener seals socket payloads (NetFlow v5 datagrams over UDP,
+    # length-prefixed CSV rows over TCP) into replayable capture files
+    # there, and the engine serves the sealed files through the
+    # ordinary directory-source machinery — WAL replay, admission, the
+    # autotuner and the SLO controller all compose unchanged.  See
+    # docs/RESILIENCE.md "Network ingress".
+    ingress_listeners = []
+    if args.listen_udp is not None or args.listen_tcp is not None:
+        from sntc_tpu.serve import ingress as _ingress
+
+        if args.from_capture:
+            raise SystemExit(
+                "--listen-udp/--listen-tcp spool their own capture "
+                "format; drop --from-capture (UDP serves NetFlow v5 "
+                "directly)"
+            )
+        ingress_columns = None
+        if args.listen_tcp is not None:
+            # framed TCP rows carry VALUES only; the sealed CSV files
+            # need a header naming them — the admission contract's
+            # column order is the wire contract
+            from sntc_tpu.data import CICIDS2017_CONTRACT
+
+            ingress_columns = list(
+                (contract or CICIDS2017_CONTRACT).columns
+            )
+        source, ingress_listeners = _ingress.build_ingress(
+            args.watch,
+            listen_udp=args.listen_udp,
+            listen_tcp=args.listen_tcp,
+            spool_mb=args.ingress_spool_mb,
+            columns=ingress_columns,
+            source_kwargs=dict(
+                prefetch_batches=(
+                    args.prefetch_batches if pipelined else 0
+                ),
+                read_workers=args.read_workers,
+                parse_salvage=contract is not None,
+            ),
+        )
+    elif args.from_capture:
         from sntc_tpu.flow import FlowCaptureSource
 
         source = FlowCaptureSource(
@@ -562,10 +604,26 @@ def cmd_serve(args) -> int:
         wal_keep_commits=args.wal_keep_commits,
         dead_letter_keep=args.dead_letter_keep,
     )
+    if ingress_listeners:
+        from sntc_tpu.serve import ingress as _ingress
+
+        # retention prunes only BELOW the committed horizon, and the
+        # listeners go live only once the engine that replays their
+        # spool exists
+        _ingress.wire_committed_offset(source, q.committed_end)
+        for l in ingress_listeners:
+            l.start()
     if args.once:
         try:
             with _device_trace_ctx(args):
                 n = q.process_available()
+                if ingress_listeners:
+                    # settle the front door (intake stops, tail seals),
+                    # then serve what it sealed — '--once' means the
+                    # spool is drained too
+                    for l in ingress_listeners:
+                        l.drain()
+                    n += q.process_available()
         finally:
             # publish even when the drain crashed — the partial
             # metrics/trace are the debugging evidence
@@ -589,6 +647,22 @@ def cmd_serve(args) -> int:
         disk_budget_mb=args.disk_budget_mb,
     )
     sup.install_signal_handlers()
+    if ingress_listeners:
+        # SIGTERM settles the FRONT DOOR first — intake stops and the
+        # ring tail seals durably — and only then requests the engine
+        # drain, so nothing a sender was acked (the sealed file) can
+        # die in listener memory
+        import signal as _signal
+
+        def _drain_ingress_then_engine(signum, frame):
+            for l in ingress_listeners:
+                try:
+                    l.drain()
+                except Exception:
+                    pass
+            sup.request_drain("SIGTERM")
+
+        _signal.signal(_signal.SIGTERM, _drain_ingress_then_engine)
     print(f"serving: watching {args.watch} -> {args.out} "
           f"(checkpoint {args.checkpoint}); SIGTERM/Ctrl-C drains",
           file=sys.stderr)
@@ -598,6 +672,11 @@ def cmd_serve(args) -> int:
     except KeyboardInterrupt:
         status = sup.drain_now("KeyboardInterrupt")
     finally:
+        for l in ingress_listeners:
+            try:
+                l.close()
+            except Exception:
+                pass
         sup.close()  # unsubscribe the health monitor from the event bus
         _obs_finish(args)
     print(json.dumps({
@@ -717,6 +796,21 @@ def _load_tenant_specs(args) -> list:
             RetryPolicy(max_attempts=retries, base_delay_s=0.2,
                         jitter=0.1)
             if retries > 1 else None
+        ),
+        # live network front door (r20): daemon-level listener flags
+        # become the default per-tenant ingress block (a tenant's own
+        # 'ingress' JSON block replaces it wholesale); port 0 gives
+        # every tenant its own ephemeral port, published in its
+        # <watch>/ingress_stats.json
+        "ingress": (
+            {
+                "listen_udp": args.listen_udp,
+                "listen_tcp": args.listen_tcp,
+                "spool_mb": args.ingress_spool_mb,
+            }
+            if (args.listen_udp is not None
+                or args.listen_tcp is not None)
+            else None
         ),
     }
     # each distinct checkpoint path loads and compiles ONCE; tenants
@@ -1189,6 +1283,27 @@ def main(argv=None) -> int:
                    "fused-program compile exceeding this poisons that "
                    "(segment, signature) and serves it through the "
                    "eager host fallback; 0 = unarmed")
+    p.add_argument("--listen-udp", type=int, default=None, metavar="PORT",
+                   help="live network front door: bind a supervised "
+                   "UDP listener for NetFlow v5 datagrams; --watch "
+                   "becomes the ingress SPOOL the listener seals "
+                   "replayable capture files into (0 = ephemeral "
+                   "port, published in <watch>/ingress_stats.json); "
+                   "loss is counted, never silent — see "
+                   "docs/RESILIENCE.md 'Network ingress'")
+    p.add_argument("--listen-tcp", type=int, default=None, metavar="PORT",
+                   help="live network front door: bind a framed TCP "
+                   "row listener (4-byte big-endian length + one CSV "
+                   "row per frame); --watch becomes the ingress "
+                   "spool; torn frames quarantine, over-budget spool "
+                   "pauses reads (sender backpressure)")
+    p.add_argument("--ingress-spool-mb", type=float, default=None,
+                   metavar="MB",
+                   help="ingress spool byte budget: TCP pauses reads "
+                   "over it, UDP sheds at ingress (counted "
+                   "spool_over_budget) after a committed-file prune "
+                   "— bounded disk instead of ENOSPC death; unset = "
+                   "unbudgeted")
     _add_obs_flags(p)
     add_platform_arg(p)
     p.set_defaults(fn=cmd_serve)
@@ -1339,6 +1454,24 @@ def main(argv=None) -> int:
                    help="atomically rewrite the daemon status dump "
                    "(per-tenant states, compile ledger, health, "
                    "breakers) here every scheduling round")
+    p.add_argument("--listen-udp", type=int, default=None, metavar="PORT",
+                   help="default per-tenant UDP ingress (TenantSpec "
+                   "ingress): each tenant's watch dir becomes its own "
+                   "ingress spool behind a supervised NetFlow v5 "
+                   "listener — use 0 (ephemeral, published in "
+                   "<watch>/ingress_stats.json) so tenants never "
+                   "collide on a port; per-tenant 'ingress' JSON "
+                   "blocks override")
+    p.add_argument("--listen-tcp", type=int, default=None, metavar="PORT",
+                   help="default per-tenant framed-TCP row ingress "
+                   "(TenantSpec ingress); 0 = ephemeral per tenant, "
+                   "published in the tenant's ingress_stats.json")
+    p.add_argument("--ingress-spool-mb", type=float, default=None,
+                   metavar="MB",
+                   help="default per-tenant ingress spool byte budget "
+                   "(TenantSpec ingress spool_mb): over it TCP pauses "
+                   "reads and UDP sheds at ingress, counted — never "
+                   "ENOSPC death")
     _add_obs_flags(p)
     add_platform_arg(p)
 

@@ -138,6 +138,55 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         help="Current value of each autotuned ingest knob "
         "(read_workers / prefetch_batches / pipeline_depth).",
     ),
+    # -- live network ingress (serve/ingress, r20) --------------------------
+    "sntc_ingress_datagrams_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="UDP datagrams accepted at the ingress receive boundary "
+        "(pre-spool; the conservation law's 'received' side).",
+    ),
+    "sntc_ingress_frames_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="TCP length-prefixed frames accepted at the ingress "
+        "receive boundary.",
+    ),
+    "sntc_ingress_bytes_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Payload bytes accepted at the ingress receive boundary.",
+    ),
+    "sntc_ingress_dropped_total": dict(
+        type=COUNTER, labels=("reason", "tenant"),
+        help="Ingress payloads shed, by reason (ring_overflow / "
+        "spool_over_budget / spool_error / torn_frame / oversize_frame "
+        "/ recv_error / close_discard) — counted shed, never silent "
+        "loss: received == spooled + dropped after a drain.",
+    ),
+    "sntc_ingress_sealed_files_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Capture files sealed (fsynced atomic rename) into the "
+        "ingress spool.",
+    ),
+    "sntc_ingress_pruned_files_total": dict(
+        type=COUNTER, labels=("tenant",),
+        help="Committed capture files pruned by spool retention "
+        "(keep-N / disk budget).",
+    ),
+    "sntc_ingress_spool_bytes": dict(
+        type=GAUGE, labels=("tenant",),
+        help="Live bytes in the ingress spool directory.",
+    ),
+    "sntc_ingress_ring_depth": dict(
+        type=GAUGE, labels=("tenant",),
+        help="Payloads waiting in the bounded ingress ring.",
+    ),
+    "sntc_ingress_backpressure_state": dict(
+        type=GAUGE, labels=("tenant",),
+        help="1 while TCP ingress is pausing reads (spool over "
+        "budget), 0 otherwise.",
+    ),
+    "sntc_ingress_connections": dict(
+        type=GAUGE, labels=("tenant",),
+        help="Live TCP ingress connections.",
+    ),
     # -- predict / compile ledgers ------------------------------------------
     "sntc_predict_compile_events_total": dict(
         type=COUNTER, labels=(),

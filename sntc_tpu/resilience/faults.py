@@ -59,6 +59,12 @@ Wired sites:
 ``fleet.migrate``       ``serve.fleet`` tenant migration mid-ship, after
                         the source drain and before the sealed manifest
                         lands at the destination
+``ingress.recv``        ``serve.ingress`` listener receive boundary —
+                        also a :func:`fault_data` site taking the DATA
+                        kinds (corrupt/truncated datagrams)
+``ingress.spool``       ``serve.ingress`` capture-file seal, before the
+                        atomic publish — a :func:`fault_disk` site
+                        taking the IO kinds
 ======================  =====================================================
 
 Env grammar (comma-separated specs)::
@@ -239,6 +245,16 @@ SITES = (
     "fleet.lease",
     "fleet.assign",
     "fleet.migrate",
+    # live network front door (r20): the socket-ingress boundaries —
+    # ``ingress.recv`` at the listener receive path (DATA kinds corrupt
+    # the datagram/frame exactly like ``source.parse``; a ``kill`` here
+    # crashes mid-receive, before anything reached the spool) and
+    # ``ingress.spool`` at the capture-file seal (IO kinds model a
+    # full/failing spool disk — the artifact's SHED policy counts the
+    # loss instead of dying; a ``kill`` is the kill-mid-spool chaos
+    # scenario).  See docs/RESILIENCE.md "Network ingress".
+    "ingress.recv",
+    "ingress.spool",
 )
 
 

@@ -221,6 +221,19 @@ ARTIFACTS: Dict[str, ArtifactSpec] = {
             "by the one-shot fleet knobs (≤2 lines per tenant lifetime)",
             DEGRADE,
         ),
+        # -- live network front door (serve/ingress, r20): patterns are
+        # relative to the listener's SPOOL directory (the --watch dir
+        # of a socket-fed serve) -------------------------------------
+        ArtifactSpec(
+            "ingress_spool", "wal", "ingress.spool",
+            ("capture_*.nf5", "rows_*.csv", "ingress_stats.json",
+             "quarantine/*"),
+            "keep-N newest COMMITTED capture files (committed_end "
+            "horizon; uncommitted never pruned), oldest dropped with a "
+            "counted sntc_ingress_pruned_files_total; over-budget "
+            "payloads shed at ingress (counted), never ENOSPC death",
+            SHED,
+        ),
     )
 }
 

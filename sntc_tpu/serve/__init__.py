@@ -23,6 +23,16 @@ from sntc_tpu.serve.tenancy import (
     TenantSpec,
     TenantStream,
 )
+from sntc_tpu.serve.ingress import (
+    CsvSpoolSource,
+    IngressSpool,
+    NetFlowSpoolSource,
+    TcpRowIngress,
+    UdpIngressListener,
+    build_ingress,
+    frame_rows,
+    wire_committed_offset,
+)
 
 __all__ = [
     "ServeController",
@@ -43,4 +53,12 @@ __all__ = [
     "ServeDaemon",
     "TenantSpec",
     "TenantStream",
+    "IngressSpool",
+    "UdpIngressListener",
+    "TcpRowIngress",
+    "NetFlowSpoolSource",
+    "CsvSpoolSource",
+    "build_ingress",
+    "frame_rows",
+    "wire_committed_offset",
 ]

@@ -93,6 +93,16 @@ class NetFlowDirSource(_CaptureDirSource):
         return netflow_to_flow_frame(parse_stream(data))
 
 
+def _capture_index(path: str) -> int:
+    """Sequence index embedded in a capture file name
+    (``capture_000042.nf5`` -> 42); non-conforming names count as -1 so
+    a foreign file never inflates the resume point."""
+    import re
+
+    m = re.search(r"(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
 def capture_udp(
     port: int,
     out_dir: str,
@@ -103,7 +113,18 @@ def capture_udp(
     sock: Optional[socket.socket] = None,
 ) -> int:
     """Collect NetFlow datagrams from UDP into capture files (the WAL the
-    replayable source reads).  Returns the number of datagrams captured."""
+    replayable source reads).  Returns the number of datagrams captured.
+
+    Deprecated-compat path: :class:`sntc_tpu.serve.ingress
+    .UdpIngressListener` is the supervised front door (bounded ring,
+    counted shed, retention, drain); this blocking helper remains for
+    scripts but now shares its durability discipline — capture files
+    publish through the fsynced atomic rename (file + containing dir),
+    and the sequence index resumes from max-existing-index + 1, so a
+    retention-pruned spool never reuses an index and silently
+    overwrites a live capture."""
+    from sntc_tpu.resilience.storage import atomic_write_bytes
+
     os.makedirs(out_dir, exist_ok=True)
     own_sock = sock is None
     if own_sock:
@@ -112,15 +133,18 @@ def capture_udp(
     sock.settimeout(timeout_s)
     captured = 0
     buf: List[bytes] = []
-    file_idx = len(glob.glob(os.path.join(out_dir, "*.nf5")))
+    existing = glob.glob(os.path.join(out_dir, "*.nf5"))
+    file_idx = max(
+        (_capture_index(p) for p in existing), default=-1
+    ) + 1
 
     def flush():
         nonlocal file_idx, buf
         if buf:
             path = os.path.join(out_dir, f"capture_{file_idx:06d}.nf5")
-            with open(path + ".tmp", "wb") as f:
-                f.write(b"".join(buf))
-            os.rename(path + ".tmp", path)  # atomic: source never sees partials
+            atomic_write_bytes(
+                path, b"".join(buf), site="ingress.spool"
+            )
             file_idx += 1
             buf = []
 

@@ -108,6 +108,15 @@ def _atomic_json(path: str, obj: Dict[str, Any]) -> str:
     return _write(path, obj, indent=1)
 
 
+#: the keys a TenantSpec ``ingress`` block accepts — each one maps to
+#: a ``serve.ingress.build_ingress`` kwarg of the same meaning
+#: (``scripts/check_ingress_flags.py`` pins the correspondence)
+INGRESS_KEYS = frozenset({
+    "listen_udp", "listen_tcp", "spool_mb", "ring", "seal_every",
+    "seal_idle_s", "keep_files", "columns",
+})
+
+
 @dataclass
 class TenantSpec:
     """One tenant's declaration: identity, pipeline, endpoints, quotas,
@@ -172,6 +181,15 @@ class TenantSpec:
     # death).  Both are inert outside a fleet.
     placement_cost: Optional[float] = None
     pinned_worker: Optional[str] = None
+    # live network front door (r20): a socket listener in front of the
+    # tenant's watch dir — the watch dir becomes the ingress SPOOL and
+    # the tenant replays sealed capture files (serve/ingress).  Keys:
+    # listen_udp / listen_tcp (exactly one; port, 0 = ephemeral,
+    # published in <watch>/ingress_stats.json), spool_mb (byte budget
+    # — the backpressure/shed ladder's threshold), ring (bounded ring
+    # size), seal_every (payloads per sealed file), keep_files
+    # (committed-file retention), columns (TCP CSV header).
+    ingress: Optional[Dict[str, Any]] = None
 
     def __post_init__(self):
         if not self.tenant_id or "/" in self.tenant_id:
@@ -225,6 +243,30 @@ class TenantSpec:
             raise ValueError(
                 "slo_max_shed_rate is a fraction in (0, 1]"
             )
+        if self.ingress is not None:
+            unknown = sorted(set(self.ingress) - INGRESS_KEYS)
+            if unknown:
+                raise ValueError(
+                    f"unknown ingress key(s) {unknown}; known: "
+                    f"{sorted(INGRESS_KEYS)}"
+                )
+            has_udp = self.ingress.get("listen_udp") is not None
+            has_tcp = self.ingress.get("listen_tcp") is not None
+            if has_udp == has_tcp:
+                raise ValueError(
+                    "ingress needs exactly one of listen_udp / "
+                    "listen_tcp"
+                )
+            if self.watch is None:
+                raise ValueError(
+                    "ingress requires a watch dir (the spool lands "
+                    "there)"
+                )
+            if self.from_capture == "pcap" and has_udp:
+                raise ValueError(
+                    "listen_udp spools NetFlow v5; from_capture="
+                    "'pcap' cannot be socket-fed"
+                )
 
     @classmethod
     def from_dict(
@@ -591,6 +633,30 @@ class ServeDaemon:
     def _build_tenant(self, spec: TenantSpec) -> TenantStream:
         tdir = self.tenant_dir(spec.tenant_id)
         source = spec.source
+        listeners = []
+        if source is None and spec.ingress is not None:
+            # live network front door (r20): the tenant's watch dir IS
+            # the ingress spool — a listener seals socket payloads into
+            # it and the tenant replays the sealed files; drain/close
+            # settle the listener through the source's lifecycle hooks
+            from sntc_tpu.serve import ingress as _ingress
+
+            ing = spec.ingress
+            source, listeners = _ingress.build_ingress(
+                spec.watch,
+                listen_udp=ing.get("listen_udp"),
+                listen_tcp=ing.get("listen_tcp"),
+                spool_mb=ing.get("spool_mb"),
+                keep_files=ing.get("keep_files", 64),
+                ring=ing.get("ring", 2048),
+                seal_every=ing.get("seal_every", 30),
+                seal_idle_s=ing.get("seal_idle_s", 0.25),
+                columns=ing.get("columns"),
+                tenant=spec.tenant_id,
+                source_kwargs={
+                    "parse_salvage": spec.schema_contract is not None,
+                },
+            )
         if source is None:
             if spec.watch is None:
                 raise ValueError(
@@ -652,6 +718,15 @@ class ServeDaemon:
             autotuner=autotuner,
             dead_letter_keep=self.dead_letter_keep,
         )
+        if listeners:
+            from sntc_tpu.serve import ingress as _ingress
+
+            # retention may only prune BELOW the engine's committed
+            # horizon; the listeners go live only once the engine that
+            # replays their spool exists
+            _ingress.wire_committed_offset(source, query.committed_end)
+            for l in listeners:
+                l.start()
         return TenantStream(spec, query, self._clock)
 
     def autotune_stats(self) -> Optional[Dict[str, Any]]:
@@ -1156,6 +1231,19 @@ class ServeDaemon:
         atomic per-tenant drain marker, engine stop.  Shared by the
         whole-daemon :meth:`drain` and the fleet's per-tenant
         :meth:`remove_tenant`; returns batches committed."""
+        drain_ingress = getattr(t.query.source, "drain_ingress", None)
+        if drain_ingress is not None:
+            # settle the socket front door FIRST: intake stops and the
+            # ring tail seals DURABLY before the engine stops, so
+            # nothing a sender was promised (the sealed-file ack) can
+            # die in memory — a restart replays the tail from the spool
+            try:
+                drain_ingress()
+            except Exception as e:
+                emit_event(
+                    event="tenant_error", tenant=t.spec.tenant_id,
+                    error=repr(e), during="drain_ingress",
+                )
         try:
             done = t.query.drain()
         except Exception as e:
