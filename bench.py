@@ -256,6 +256,7 @@ DEFAULT_ROWS = {
     "13": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
     "14": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
     "15": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
+    "16": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
 }
 
 
@@ -3075,6 +3076,241 @@ def bench_config15(n_rows, mesh):
     }
 
 
+# config 16: the serving-kernel forge (r21).  Same harness discipline as
+# config 6 (one synthetic CSV stream, both engines warmed, reps
+# interleaved, MEDIAN reported, sink bitwise-compared) but with a FOREST
+# head so the kernel tier's ensemble-traversal kernel carries the hot
+# path, and the two engines differ ONLY in SNTC_SERVE_KERNELS: the
+# fused-XLA twin (off) vs the kernel tier (pallas on TPU, interpret
+# elsewhere — on CPU the interpret emulator is expected to LOSE; the
+# journaled ratio is honest either way).  SNTC_OBS_COST_ANALYSIS is on
+# for both compiles, so each engine's fusion_stats carries the
+# per-segment roofline (FLOPs, bytes, achieved-vs-peak MFU).  A third
+# leg arms a kernel.compile fault and proves the poison ladder: the
+# batch serves bitwise on the XLA twin, the kernel signature is
+# poisoned, the SEGMENT is not, and zero faults reach the device domain.
+BENCH16_REPS = 5
+
+
+def bench_config16(n_rows, mesh):
+    """Fused-XLA vs kernel-tier serving throughput (rows/s) plus the
+    per-segment MFU/roofline evidence — the r21 kernel forge measured,
+    not asserted."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+
+    import jax
+
+    from sntc_tpu.core.base import Pipeline, PipelineModel
+    from sntc_tpu.feature import DCT, MinMaxScaler, PCA
+    from sntc_tpu.fuse import compile_pipeline, fused_segments, fusion_stats
+    from sntc_tpu.kernels.registry import clear_poisons, kernel_stats
+    from sntc_tpu.models import RandomForestClassifier
+    from sntc_tpu.resilience import faults as _faults
+    from sntc_tpu.resilience.device import DeviceFaultDomain
+    from sntc_tpu.serve import (
+        BatchPredictor,
+        CsvDirSink,
+        FileStreamSource,
+        StreamingQuery,
+    )
+
+    kernel_mode = (
+        "pallas" if jax.default_backend() == "tpu" else "interpret"
+    )
+    train, test = _dataset(n_rows, binary=True)
+    pipe = Pipeline(stages=_feature_stages(mesh, with_scaler=False) + [
+        MinMaxScaler(inputCol="rawFeatures", outputCol="mm"),
+        DCT(inputCol="mm", outputCol="dct"),
+        PCA(mesh=mesh, inputCol="dct", outputCol="features",
+            k=BENCH6_PCA_K),
+        RandomForestClassifier(mesh=mesh, numTrees=RF_TREES,
+                               maxDepth=RF_DEPTH, seed=0),
+    ]).fit(train)
+    staged_model = PipelineModel(stages=pipe.getStages()[1:])
+
+    def make_engine(tmp, name, in_dir, chunk_sizes, mode):
+        """Compile the serving pipeline UNDER the engine's kernel mode
+        (the registry decides per traced signature at compile time),
+        then warm every bucketed shape through the predictor."""
+        os.environ["SNTC_SERVE_KERNELS"] = mode
+        model = compile_pipeline(staged_model)
+        predictor = BatchPredictor(model, bucket_rows=BENCH5_SHAPE_BUCKETS)
+        warm = StreamingQuery(
+            predictor, FileStreamSource(in_dir),
+            CsvDirSink(os.path.join(tmp, f"warm_{name}"), durable=False),
+            os.path.join(tmp, f"warmckpt_{name}"),
+            max_batch_offsets=1, wal_mode="append",
+        )
+        warm._run_one_batch()
+        warm.stop()
+        for c in sorted(set(chunk_sizes)):
+            predictor.predict_frame(test.slice(0, c))
+        return {"name": name, "mode": mode, "model": model,
+                "predictor": predictor, "reps": []}
+
+    def run_once(tmp, eng, in_dir, rep, stream_rows, n_files):
+        os.environ["SNTC_SERVE_KERNELS"] = eng["mode"]
+        name = eng["name"]
+        out_dir = os.path.join(tmp, f"out_{name}_{rep}")
+        q = StreamingQuery(
+            eng["predictor"], FileStreamSource(in_dir),
+            CsvDirSink(out_dir, durable=False),
+            os.path.join(tmp, f"ckpt_{name}_{rep}"),
+            max_batch_offsets=1, wal_mode="append",
+            pipeline_depth=1,  # serial engines: the ratio is pure tier
+        )
+        t0 = time.perf_counter()
+        n_done = q.process_available()
+        dt = time.perf_counter() - t0
+        rows = (
+            stream_rows
+            if n_done == n_files
+            else sum(p["numInputRows"] for p in q.recentProgress)
+        )
+        q.stop()
+        eng["reps"].append({
+            "out_dir": out_dir, "batches": n_done, "rows": rows,
+            "dt": dt, "rows_per_s": rows / dt,
+        })
+
+    def median_rep(eng):
+        reps = sorted(eng["reps"], key=lambda r: r["rows_per_s"])
+        rec = dict(reps[len(reps) // 2])
+        rec["best_rows_per_s"] = round(reps[-1]["rows_per_s"], 1)
+        return rec
+
+    tmp = tempfile.mkdtemp()
+    arrow_cpus = pa.cpu_count()
+    pa.set_cpu_count(1)  # same intra-op pinning discipline as config 5
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("SNTC_SERVE_HOST_ROWS", "SNTC_SERVE_KERNELS",
+                  "SNTC_OBS_COST_ANALYSIS")
+    }
+    os.environ["SNTC_SERVE_HOST_ROWS"] = "0"  # device path both sides
+    os.environ["SNTC_OBS_COST_ANALYSIS"] = "1"  # roofline per segment
+    clear_poisons()
+    try:
+        in_dir = os.path.join(tmp, "in")
+        chunk_sizes = _write_bench5_stream(
+            in_dir, test, passes=BENCH5_STREAM_PASSES
+        )
+        stream_rows, n_files = sum(chunk_sizes), len(chunk_sizes)
+        engines = [
+            make_engine(tmp, "xla", in_dir, chunk_sizes, "off"),
+            make_engine(tmp, "kernel", in_dir, chunk_sizes, kernel_mode),
+        ]
+        kern_segments = fused_segments(engines[1]["model"])
+        compiles_before = sum(s.compile_events for s in kern_segments)
+        for rep in range(BENCH16_REPS):
+            for eng in engines:
+                run_once(tmp, eng, in_dir, rep, stream_rows, n_files)
+        xla_r, kern_r = (median_rep(e) for e in engines)
+        sink_match = _sinks_match(
+            _read_sink_dir(xla_r["out_dir"]),
+            _read_sink_dir(kern_r["out_dir"]),
+        )
+        kern_stats = fusion_stats(engines[1]["model"])
+        recompiles = sum(
+            s.compile_events for s in kern_segments
+        ) - compiles_before
+
+        # ---- poison leg: a kernel.compile fault must stay a KERNEL
+        # fallback — batch bitwise on the XLA twin, segment alive,
+        # domain clean.  Cost analysis goes OFF here: obs_cost.extract
+        # lowers the fused program once outside the dispatch try and
+        # (by contract) swallows failures there, which would absorb the
+        # one-shot injected fault before the serving ladder ever saw
+        # it — the leg is about the ladder, not the cost plane ----
+        clear_poisons()
+        os.environ.pop("SNTC_OBS_COST_ANALYSIS", None)
+        os.environ["SNTC_SERVE_KERNELS"] = kernel_mode
+        poison_model = compile_pipeline(staged_model)
+        dom = DeviceFaultDomain()
+        bp = BatchPredictor(
+            poison_model, bucket_rows=BENCH5_SHAPE_BUCKETS,
+            device_domain=dom,
+        )
+        probe = test.slice(0, BENCH5_SIZES[0])
+        _faults.arm("kernel.compile", kind="compile_error", times=1)
+        try:
+            poisoned_out = bp.predict_frame(probe)
+        finally:
+            _faults.clear()
+        os.environ["SNTC_SERVE_KERNELS"] = "off"
+        ref_out = engines[0]["predictor"].predict_frame(probe)
+        poison_bitwise = all(
+            np.array_equal(
+                np.asarray(poisoned_out[c]), np.asarray(ref_out[c])
+            )
+            for c in ("rawPrediction", "probability", "prediction")
+        )
+        poison_fs = fusion_stats(poison_model)
+        kstats = kernel_stats()
+    finally:
+        pa.set_cpu_count(arrow_cpus)
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        clear_poisons()
+        shutil.rmtree(tmp, ignore_errors=True)
+    kernel_evidence = {
+        "kernel_mode": kernel_mode,
+        "speedup_vs_fused_xla": _round_ratio(
+            kern_r["rows_per_s"] / xla_r["rows_per_s"]
+        ),
+        "fused_xla_rows_per_s": round(xla_r["rows_per_s"], 1),
+        "best_rows_per_s": kern_r["best_rows_per_s"],
+        "fused_xla_best_rows_per_s": xla_r["best_rows_per_s"],
+        "sink_match": sink_match,  # the twin pin, end to end
+        "recompiles_after_warmup": recompiles,
+        "fallbacks": kern_stats["fallbacks"],
+        "kernels": kern_stats["kernels"],
+        "roofline": kern_stats.get("roofline"),
+        "reps": BENCH16_REPS,
+        "batch_sizes": list(BENCH5_SIZES),
+        "arrow_intra_op_threads": 1,
+        "poison_leg": {
+            "site": "kernel.compile",
+            "sink_bitwise": poison_bitwise,
+            "kernel_poisoned_signatures": (
+                kstats["poisoned_signatures"]
+            ),
+            "segment_fallbacks": poison_fs["fallbacks"],
+            "segment_poisoned_signatures": (
+                poison_fs["poisoned_signatures"]
+            ),
+            "domain_faults": dom.fault_count(),
+            "domain_state": dom.stats()["state"],
+        },
+    }
+    ok = (
+        sink_match
+        and poison_bitwise
+        and recompiles == 0
+        and kernel_evidence["poison_leg"]["kernel_poisoned_signatures"] >= 1
+        and kernel_evidence["poison_leg"]["segment_fallbacks"] == 0
+        and kernel_evidence["poison_leg"]["domain_faults"] == 0
+    )
+    if not ok:
+        raise RuntimeError(f"config 16 evidence failed: {kernel_evidence}")
+    return {
+        "metric": "cicids2017_kernel_tier_serving_rows_per_s",
+        "_datasets": (train, test),
+        "value": kern_r["rows_per_s"], "unit": "rows/s",
+        "quality": {
+            "micro_batches": kern_r["batches"],
+            "kernel_forge": kernel_evidence,
+        },
+        "n_rows": kern_r["rows"],
+    }
+
+
 BENCHES = {
     "1": bench_config1,
     "2": bench_config2,
@@ -3091,6 +3327,7 @@ BENCHES = {
     "13": bench_config13,
     "14": bench_config14,
     "15": bench_config15,
+    "16": bench_config16,
 }
 
 
@@ -3276,18 +3513,24 @@ def bench_families(rows, mesh):
 # "actually fast?" independently of the 1-core sklearn proxy
 # ---------------------------------------------------------------------------
 
-# single-chip peak dense-matmul FLOP/s by platform.  TPU v5e: 197 TFLOP/s
-# bf16 (public spec); f32 matmuls under JAX's DEFAULT precision also feed
-# the MXU bf16 inputs (with f32 accumulate), so the same peak applies to
-# both computeDtype settings.  Override with BENCH_PEAK_FLOPS.
-_PEAK_FLOPS = {"tpu": 1.97e14, "axon": 1.97e14}
+# Peak FLOP/s comes from the shared probe table
+# (sntc_tpu.utils.backend_probe.probed_peaks — TPU v5e 197 TFLOP/s bf16
+# public spec; f32 matmuls under JAX's DEFAULT precision also feed the
+# MXU bf16 inputs with f32 accumulate, so the same peak applies to both
+# computeDtype settings; CPU gets an honest "estimate"-labeled figure).
+# BENCH_PEAK_FLOPS keeps its historical override precedence, then the
+# probe's own SNTC_PEAK_FLOPS.
 
 
 def _peak_flops(platform: str):
+    """(peak_flops_per_s, peak_source) for this platform."""
     env = os.environ.get("BENCH_PEAK_FLOPS")
     if env:
-        return float(env)
-    return _PEAK_FLOPS.get(platform)
+        return float(env), "env"
+    from sntc_tpu.utils.backend_probe import probed_peaks
+
+    peaks = probed_peaks(platform)
+    return peaks["flops"], peaks["peak_source"]
 
 
 def bench_mfu(n_rows, mesh):
@@ -3312,10 +3555,11 @@ def bench_mfu(n_rows, mesh):
     from sntc_tpu.models import MultilayerPerceptronClassifier
 
     platform = jax.devices()[0].platform
-    peak = _peak_flops(platform)
+    peak, peak_source = _peak_flops(platform)
     train, _ = _dataset(n_rows)
     out = {"metric": "mfu_accounting", "n_rows": None, "unit": "mfu",
-           "platform": platform, "peak_flops": peak}
+           "platform": platform, "peak_flops": peak,
+           "peak_source": peak_source}
 
     # ---- (a) MLP fit at f32 and bf16 ----
     stages = _feature_stages(mesh)
@@ -3695,6 +3939,10 @@ PROXIES = {
     # through the ingress WAL; the external anchor stays the config-5
     # proxy
     "15": proxy_config5,
+    # config 16 is the same CSV -> predict -> CSV job with the serving
+    # kernel tier carrying the hot path; the external anchor stays the
+    # config-5 proxy
+    "16": proxy_config5,
 }
 
 
@@ -3864,7 +4112,7 @@ def run_config(cfg: str, rows, pair: bool = True):
         # ratio see the same host state (VERDICT r4 item 2)
         proxy = PROXIES[cfg](train, test)
         if cfg in ("5", "6", "7", "8", "9", "10", "11", "12", "13",
-                   "14", "15"):
+                   "14", "15", "16"):
             line["vs_baseline"] = _round_ratio(
                 result["value"] / proxy["rows_per_s"]
             )

@@ -143,15 +143,21 @@ CTL_NOISY_POISON_EVERY = 3
 # fallback path must hold the same crash contract as the device path
 # (and the fallback's sink bytes must equal the device reference's,
 # which is the bitwise half of the tolerance contract).
-DEVICE_KILL_SITES = ("device.dispatch", "predict.compile", "fuse.compile")
+DEVICE_KILL_SITES = (
+    "device.dispatch", "predict.compile", "fuse.compile",
+    "kernel.compile",
+)
 DEVICE_KILL_AFTER = {
     # dispatch fires once per batch: after=2 kills mid-stream on the
     # 3rd batch, with committed fallback batches already behind it
     "device.dispatch": 2,
     # the compile sites fire on FRESH shapes/signatures only: kill on
-    # the first (batch 0's compile — nothing durable yet)
+    # the first (batch 0's compile — nothing durable yet).  The worker
+    # serves on the kernel tier (r21), so ``kernel.compile`` genuinely
+    # fires inside the fused trace of batch 0's pad/traversal kernels.
     "predict.compile": 0,
     "fuse.compile": 0,
+    "kernel.compile": 0,
 }
 
 # kill-mid-promotion points (r11): where the model-lifecycle promotion
@@ -2327,6 +2333,10 @@ def device_worker_main(args) -> int:
         compile_serving,
     )
 
+    # the serve plane runs the kernel tier (interpret mode on CPU) so
+    # the ``kernel.compile`` boundary genuinely fires: the bucketed
+    # pad rides the pad_assemble Pallas kernel every padded dispatch
+    os.environ["SNTC_SERVE_KERNELS"] = "interpret"
     if args.poison_fused:
         arm("fuse.compile", kind="compile_error", times=None)
     if args.kill_site:

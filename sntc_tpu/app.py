@@ -359,6 +359,11 @@ def cmd_serve(args) -> int:
     )
 
     _obs_start(args)
+    # kernel tier selection must land before any serving compile reads
+    # it (the registry re-resolves per dispatch, but the journaled run
+    # config should reflect one consistent mode end-to-end)
+    if getattr(args, "serve_kernels", None):
+        os.environ["SNTC_SERVE_KERNELS"] = args.serve_kernels
     model = load_model(args.model)
     raw_model = model  # persistable form: the lifecycle publish target
     # model lifecycle (r11): any of the drift / shadow-promotion /
@@ -1123,6 +1128,15 @@ def main(argv=None) -> int:
     p.add_argument("--no-fuse", action="store_false", dest="fuse",
                    help="serve the staged pipeline unfused (stage-by-"
                    "stage transforms; debugging/verification)")
+    p.add_argument("--serve-kernels", default=None,
+                   choices=["auto", "pallas", "interpret", "off"],
+                   help="serving kernel tier (r21): hand-written Pallas "
+                   "kernels for the fused hot path behind per-kernel "
+                   "fit-guards — auto (pallas on TPU, off elsewhere), "
+                   "pallas, interpret (CPU debugging twin), or off "
+                   "(pure XLA).  Sets SNTC_SERVE_KERNELS before the "
+                   "serving pipeline compiles; unset leaves the "
+                   "environment's value in force")
     p.add_argument("--poll-interval", type=float, default=1.0)
     p.add_argument("--once", action="store_true",
                    help="drain available files and exit")

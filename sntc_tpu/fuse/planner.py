@@ -53,6 +53,8 @@ import numpy as np
 
 from sntc_tpu.core.base import PipelineModel, Transformer
 from sntc_tpu.core.frame import Frame
+from sntc_tpu.kernels import registry as kreg
+from sntc_tpu.obs import cost as obs_cost
 from sntc_tpu.feature.vector_assembler import VectorAssembler
 from sntc_tpu.fuse.registry import (
     F32_CAST,
@@ -123,8 +125,12 @@ class FusedSegment(Transformer):
         self.poisoned_served = 0  # binds served off a poisoned signature
         # SNTC_OBS_COST_ANALYSIS=1: XLA cost_analysis() per compiled
         # signature (flops / bytes accessed), keyed by signature repr —
-        # the device-cost side of the obs span correlation
+        # the device-cost side of the obs span correlation (extraction
+        # shared with bench via obs.cost since r21)
         self.cost_analyses: dict = {}
+        # per-signature measured wall time under the same hook:
+        # sig repr -> [seconds, invocations], the roofline numerator
+        self.cost_timings: dict = {}
         # per-SEGMENT transfer counters: fusion_stats() aggregates these
         # per model, so one engine's evidence is never polluted by other
         # fused models in the process (the global ledger stays the
@@ -283,23 +289,14 @@ class FusedSegment(Transformer):
             prog = self._programs[sig]
         if fresh:
             inc("sntc_fuse_compile_events_total")
-            if os.environ.get("SNTC_OBS_COST_ANALYSIS"):
+            if obs_cost.enabled():
                 # device-cost hook (opt-in — it compiles the program
                 # eagerly): XLA's own FLOPs/bytes estimate for this
                 # signature, correlatable with the host fuse.* spans
-                try:
-                    cost = prog.lower(*args).compile().cost_analysis()
-                    if isinstance(cost, (list, tuple)):
-                        cost = cost[0] if cost else {}
-                    self.cost_analyses[repr(sig[0])] = {
-                        k: float(v)
-                        for k, v in dict(cost or {}).items()
-                        if isinstance(v, (int, float))
-                        and k in ("flops", "bytes accessed",
-                                  "transcendentals")
-                    }
-                except Exception:
-                    self.cost_analyses[repr(sig[0])] = None
+                # and fed to the MFU/roofline plane (obs.cost)
+                self.cost_analyses[repr(sig[0])] = obs_cost.extract(
+                    prog, args
+                )
         return prog
 
     def _transform_eager(self, frame: Frame) -> Frame:
@@ -354,6 +351,7 @@ class FusedSegment(Transformer):
         # the finalize closure below may run on the delivery thread —
         # capturing here keeps attribution correct across threads
         ledgers = active_ledgers()
+        kreg.begin_trace_capture()  # kernels armed by THIS trace
         try:
             if fresh:
                 # the DEVICE fault boundary for the fused-program
@@ -361,6 +359,9 @@ class FusedSegment(Transformer):
                 fault_point("fuse.compile")
             t0 = time.perf_counter() if fresh else 0.0
             prog = self._program(args, sig)
+            t_disp = (
+                time.perf_counter() if obs_cost.enabled() else None
+            )
             up_bytes = sum(a.nbytes for a in args)
             for led in ledgers:
                 led.record_uploads(len(args), up_bytes)
@@ -393,6 +394,26 @@ class FusedSegment(Transformer):
                     return self._eager_async(frame, poisoned=True)
         except Exception as e:
             kind = classify_device_error(e)
+            # the kernel-scope classifier widens to Pallas/Mosaic
+            # lowering failures that are not XLA-runtime-shaped (e.g.
+            # pallas forced on a CPU backend); it only matters when
+            # this trace actually armed kernels — poison_traced()
+            # returns 0 otherwise and the strict ladder below rules
+            if (
+                kreg.classify_kernel_error(e) == "compile_error"
+                and kreg.poison_traced(repr(e))
+            ):
+                # a Pallas kernel INSIDE this fused trace failed to
+                # compile: the segment itself is healthy, so poison
+                # exactly those kernel signatures (done above), evict
+                # the half-built program, and recompile the SAME fused
+                # signature — the retrace sees the poisoned kernels
+                # and lowers their jnp twins instead.  The batch serves
+                # on the XLA path, not the eager host path, and no
+                # fault reaches the domain's strike ladder.
+                with self._lock:
+                    self._programs.pop(sig, None)
+                return self.transform_async(frame)
             if dom is not None and kind == "compile_error":
                 # poison exactly (this segment, this signature); other
                 # signatures keep compiling on device
@@ -432,6 +453,24 @@ class FusedSegment(Transformer):
                 led.record_downloads(len(host), down_bytes)
             with self._lock:
                 self.downloads += len(host)
+            if t_disp is not None:
+                # dispatch -> host-materialized wall time: the roofline
+                # numerator for this signature (obs.cost); gauges
+                # update live so a scrape mid-serve sees current MFU
+                dt = time.perf_counter() - t_disp
+                with self._lock:
+                    acc = self.cost_timings.setdefault(
+                        sig_repr, [0.0, 0]
+                    )
+                    acc[0] += dt
+                    acc[1] += 1
+                    secs, inv = acc
+                obs_cost.emit_mfu(
+                    seg_index if seg_index is not None else 0,
+                    obs_cost.roofline(
+                        self.cost_analyses.get(sig_repr), secs, inv
+                    ),
+                )
             out_frame = frame
             feature_cols = host[1:] if head is not None else host
             for name, arr in zip(live, feature_cols):
@@ -604,4 +643,16 @@ def fusion_stats(model) -> Optional[dict]:
     }
     if costs:  # present only under SNTC_OBS_COST_ANALYSIS=1
         out["cost_analysis"] = costs
+        roof = {}
+        for i, s in enumerate(segs):
+            for sig, cost in s.cost_analyses.items():
+                secs, inv = s.cost_timings.get(sig, (0.0, 0))
+                r = obs_cost.roofline(cost, secs, inv)
+                if r is not None:
+                    roof[f"segment{i}:{sig}"] = r
+        if roof:
+            out["roofline"] = roof
+    from sntc_tpu.kernels.registry import kernel_stats
+
+    out["kernels"] = kernel_stats()
     return out

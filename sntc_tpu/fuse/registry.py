@@ -395,3 +395,16 @@ def _register_builtin() -> None:
 
 
 _register_builtin()
+
+
+def device_kernels():
+    """The hand-written kernel half of the device capability registry
+    (r21): name -> :class:`sntc_tpu.kernels.registry.KernelSpec`.  The
+    ``device_fn`` table above answers "which STAGES can fuse"; this one
+    answers "which fused/serve OPS run a hand-written Pallas kernel",
+    each with its fit-guard, pinning tolerance, and fallback path —
+    see ``sntc_tpu/kernels/`` and the docs/PERFORMANCE.md kernel-forge
+    table (``scripts/check_kernel_registry.py`` pins them together)."""
+    from sntc_tpu.kernels.registry import registered_kernels
+
+    return registered_kernels()

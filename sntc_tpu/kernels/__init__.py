@@ -1,0 +1,21 @@
+"""Hand-written Pallas serving kernels + the kernel capability registry.
+
+The serving hot path gets a kernel tier (r21): ``forest.py`` fuses the
+RF/GBT/DT ensemble node-walk, ``assemble.py`` fuses the bucketed
+pad+mask+assemble step, and the fit-side histogram kernel
+(``sntc_tpu/ops/pallas_histogram.py``) registers through the same
+table.  ``registry.py`` owns selection (``SNTC_SERVE_KERNELS``),
+fit-guards, the ``kernel.compile`` poison/fallback ladder, and the
+``sntc_kernel_*`` evidence; ``scripts/check_kernel_registry.py`` pins
+registry ⇔ docs ⇔ tests in tier-1.
+"""
+
+from sntc_tpu.kernels.registry import (  # noqa: F401
+    KernelSpec,
+    kernel_dispatch,
+    kernel_stats,
+    registered_kernels,
+    resolve_impl,
+    resolve_serve_kernels,
+    serve_kernel_call,
+)

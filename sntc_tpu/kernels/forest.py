@@ -1,0 +1,172 @@
+"""Pallas TPU kernel: fused RF/GBT/DT ensemble traversal (r21).
+
+The serving node-walk (``grower.forest_leaf_stats``) is ``max_depth``
+rounds of data-dependent gathers — feature id at the current node, the
+row's value of that feature, the node's threshold — which XLA lowers to
+serialized dynamic-slice chains per level.  This kernel keeps one
+(tree, row-block) tile resident in VMEM and replaces every gather with
+an exact iota-mask select (one nonzero term per row, so float sums are
+bit-exact) plus a final one-hot MXU matmul for the leaf-stat gather:
+
+    for each (tree t, row-block r):
+        node = 0
+        repeat max_depth:
+            f, thr   = select(node == iota_M, feature/threshold row)
+            xv       = select(f == iota_F, X block)
+            node     = 2*node + 1 + (xv >= thr)   where internal
+        out[t, r] = onehot(node) @ leaf_stats[t]   # MXU, exact
+
+Trees ride the grid, so the whole forest traverses in one launch with
+no per-level host round-trips.  Exactness means the lowered-jnp twin
+(``forest_leaf_stats`` itself) pins bitwise in f64 and f32 alike; the
+documented tolerance keeps the f32 bound at ≤1e-5 rel for headroom
+(docs/PERFORMANCE.md kernel-forge table).
+
+Registered as ``forest_traversal`` in ``sntc_tpu.kernels.registry``;
+``forest_fits_pallas`` guards the VMEM working set, interpret mode
+backs the CPU tier-1 matrix, and a compile failure poisons exactly this
+kernel's signature back onto the XLA node-walk.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from sntc_tpu.kernels.registry import KernelSpec, register_kernel
+
+_ROW_BLOCK = 128  # rows per grid step (f32 lane tile)
+_LANE = 128
+_VMEM_BUDGET = 4 * 1024 * 1024  # in-kernel working set budget (bytes)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def forest_fits_pallas(
+    n_nodes: int, n_features: int, n_stats: int, itemsize: int = 4
+) -> bool:
+    """True when one (tree, row-block) tile's working set — the node
+    one-hot, the feature-select mask, and the padded leaf-stat block —
+    fits the kernel's VMEM budget.  Beyond it (freak depth/width
+    forests) callers stay on the XLA node-walk."""
+    mp = _round_up(max(n_nodes, _LANE), _LANE)
+    fp = _round_up(max(n_features, _LANE), _LANE)
+    sp = _round_up(max(n_stats, _LANE), _LANE)
+    work = _ROW_BLOCK * mp + _ROW_BLOCK * fp + mp * sp
+    return work * itemsize <= _VMEM_BUDGET
+
+
+def _forest_kernel(
+    x_ref, feat_ref, thr_ref, leaf_ref, out_ref, *, max_depth, bn, mp, fp
+):
+    x = x_ref[...]  # [BN, Fp]
+    feat = feat_ref[0, :]  # [Mp] int32 (-1 leaf, -2 absent)
+    thr = thr_ref[0, :]  # [Mp]
+    leaf = leaf_ref[0]  # [Mp, Sp]
+    node = jnp.zeros((bn,), jnp.int32)
+    cols_m = jax.lax.broadcasted_iota(jnp.int32, (bn, mp), 1)
+    cols_f = jax.lax.broadcasted_iota(jnp.int32, (bn, fp), 1)
+    zero_t = jnp.zeros((), thr.dtype)
+    zero_x = jnp.zeros((), x.dtype)
+    for _ in range(max_depth):
+        at_node = cols_m == node[:, None]  # [BN, Mp] one column per row
+        f = jnp.sum(jnp.where(at_node, feat[None, :], 0), axis=1)
+        t = jnp.sum(jnp.where(at_node, thr[None, :], zero_t), axis=1)
+        is_internal = f >= 0
+        fc = jnp.where(is_internal, f, 0)
+        xv = jnp.sum(jnp.where(cols_f == fc[:, None], x, zero_x), axis=1)
+        go_right = (xv >= t).astype(jnp.int32)
+        node = jnp.where(is_internal, 2 * node + 1 + go_right, node)
+    onehot = (cols_m == node[:, None]).astype(leaf.dtype)
+    out_ref[0] = jnp.dot(onehot, leaf, preferred_element_type=leaf.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_depth", "interpret")
+)
+def forest_leaf_stats_pallas(
+    X: jnp.ndarray,  # [N, F]
+    feature: jnp.ndarray,  # [T, M] int32
+    threshold: jnp.ndarray,  # [T, M]
+    leaf_stats: jnp.ndarray,  # [T, M, S]
+    *,
+    max_depth: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Kernel twin of :func:`sntc_tpu.models.tree.grower.forest_leaf_stats`
+    — leaf stats ``[T, N, S]`` for every (tree, row)."""
+    n, f = X.shape
+    t, m = feature.shape
+    s = leaf_stats.shape[2]
+    np_ = _round_up(max(n, _ROW_BLOCK), _ROW_BLOCK)
+    fp = _round_up(max(f, _LANE), _LANE)
+    mp = _round_up(max(m, _LANE), _LANE)
+    sp = _round_up(max(s, _LANE), _LANE)
+    if np_ != n or fp != f:
+        X = jnp.pad(X, ((0, np_ - n), (0, fp - f)))
+    if mp != m:
+        # padded nodes are unreachable (the walk never leaves [0, M));
+        # -2 marks them absent exactly like the grower's layout
+        feature = jnp.pad(feature, ((0, 0), (0, mp - m)), constant_values=-2)
+        threshold = jnp.pad(threshold, ((0, 0), (0, mp - m)))
+        leaf_stats = jnp.pad(leaf_stats, ((0, 0), (0, mp - m), (0, 0)))
+    if sp != s:
+        leaf_stats = jnp.pad(leaf_stats, ((0, 0), (0, 0), (0, sp - s)))
+
+    grid = (t, np_ // _ROW_BLOCK)
+    out = pl.pallas_call(
+        functools.partial(
+            _forest_kernel,
+            max_depth=max_depth, bn=_ROW_BLOCK, mp=mp, fp=fp,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_ROW_BLOCK, fp), lambda ti, r: (r, 0)),  # X
+            pl.BlockSpec((1, mp), lambda ti, r: (ti, 0)),  # feature
+            pl.BlockSpec((1, mp), lambda ti, r: (ti, 0)),  # threshold
+            pl.BlockSpec((1, mp, sp), lambda ti, r: (ti, 0, 0)),  # leaf
+        ],
+        out_specs=pl.BlockSpec(
+            (1, _ROW_BLOCK, sp), lambda ti, r: (ti, r, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((t, np_, sp), leaf_stats.dtype),
+        interpret=interpret,
+    )(X, feature, threshold, leaf_stats)
+    return out[:, :n, :s]
+
+
+def traverse_forest(
+    X, feature, threshold, leaf_stats, *, max_depth: int,
+    traversal: str = "xla",
+):
+    """Traversal dispatch inside the jitted serve programs: the
+    ``traversal`` token is a static argument resolved by the registry
+    ladder at the ``_predict_all_dev`` boundary (``"xla"`` is the
+    lowered-jnp twin the kernel is pinned against)."""
+    if traversal in ("pallas", "interpret"):
+        return forest_leaf_stats_pallas(
+            X, feature, threshold, leaf_stats,
+            max_depth=max_depth, interpret=(traversal == "interpret"),
+        )
+    from sntc_tpu.models.tree.grower import forest_leaf_stats
+
+    return forest_leaf_stats(
+        X, feature, threshold, leaf_stats, max_depth=max_depth
+    )
+
+
+register_kernel(
+    KernelSpec(
+        name="forest_traversal",
+        module="sntc_tpu/kernels/forest.py",
+        guard_name="forest_fits_pallas",
+        guard=forest_fits_pallas,
+        tolerance="bitwise f64 / <=1e-5 rel f32",
+        fallback="XLA node-walk (grower.forest_leaf_stats)",
+    )
+)

@@ -131,13 +131,17 @@ class DecisionTreeClassifier(_DtClassifierParams, ClassifierEstimator):
         return model
 
 
-@partial(jax.jit, static_argnames=("max_depth", "mode"))
-def _dt_serve(X, feature, threshold, leaf_stats, thr, *, max_depth, mode):
+@partial(jax.jit, static_argnames=("max_depth", "mode", "traversal"))
+def _dt_serve(X, feature, threshold, leaf_stats, thr, *, max_depth, mode,
+              traversal="xla"):
     """Traverse + normalize + predict packed into one dispatch and one
     device→host transfer per serving micro-batch (the [B:11] hot-path
     contract every model honors)."""
-    raw = forest_leaf_stats(
-        X, feature, threshold, leaf_stats, max_depth=max_depth
+    from sntc_tpu.kernels.forest import traverse_forest
+
+    raw = traverse_forest(
+        X, feature, threshold, leaf_stats, max_depth=max_depth,
+        traversal=traversal,
     )[0]  # [N, C] class counts — Spark DT rawPrediction
     prob = raw / jnp.maximum(raw.sum(axis=1, keepdims=True), 1e-12)
     return pack_serve_outputs(raw, prob, thr, mode)
@@ -163,13 +167,26 @@ class DecisionTreeClassificationModel(
         return _realized_depth(self.forest)
 
     def _predict_all_dev(self, X: np.ndarray):
+        from sntc_tpu.kernels import serve_kernel_call
+
         mode, thr = self._threshold_mode()
-        return _dt_serve(
-            jnp.asarray(X),
-            *self._device_forest(),
-            jnp.asarray(thr),
-            max_depth=self.forest.max_depth,
-            mode=mode,
+        Xd = jnp.asarray(X)
+        fa, ta, ls = self._device_forest()
+        md = self.forest.max_depth
+
+        def run(traversal):
+            return _dt_serve(
+                Xd, fa, ta, ls, jnp.asarray(thr),
+                max_depth=md, mode=mode, traversal=traversal,
+            )
+
+        return serve_kernel_call(
+            "forest_traversal", (Xd, fa, ta, ls), run,
+            lambda: run("xla"), static=(md, mode),
+            guard_kwargs={
+                "n_nodes": fa.shape[1], "n_features": Xd.shape[1],
+                "n_stats": ls.shape[2], "itemsize": Xd.dtype.itemsize,
+            },
         )
 
     def _extra_meta(self):

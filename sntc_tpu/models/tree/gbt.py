@@ -386,38 +386,48 @@ class GBTClassifier(_GbtParams, CheckpointParams, ClassifierEstimator):
         return model
 
 
-@partial(jax.jit, static_argnames=("max_depth",))
-def _gbt_margin(X, feature, threshold, leaf_stats, tree_weights, *, max_depth):
-    stats = forest_leaf_stats(
-        X, feature, threshold, leaf_stats, max_depth=max_depth
+@partial(jax.jit, static_argnames=("max_depth", "traversal"))
+def _gbt_margin(X, feature, threshold, leaf_stats, tree_weights, *,
+                max_depth, traversal="xla"):
+    from sntc_tpu.kernels.forest import traverse_forest
+
+    stats = traverse_forest(
+        X, feature, threshold, leaf_stats, max_depth=max_depth,
+        traversal=traversal,
     )  # [M, N, 3]
     values = stats[..., 1] / jnp.maximum(stats[..., 0], 1e-12)  # [M, N]
     return jnp.einsum("m,mn->n", tree_weights, values)
 
 
-@partial(jax.jit, static_argnames=("max_depth",))
-def _ovr_fused_raw(X, feature, threshold, leaf_stats, sel, *, max_depth):
+@partial(jax.jit, static_argnames=("max_depth", "traversal"))
+def _ovr_fused_raw(X, feature, threshold, leaf_stats, sel, *, max_depth,
+                   traversal="xla"):
     """Fused OneVsRest(GBT) raw scores: ONE traversal of all K classes'
     trees (concatenated on the tree axis) + a [K, M] class-selection
     contraction — K device dispatches per serving batch become one."""
-    stats = forest_leaf_stats(
-        X, feature, threshold, leaf_stats, max_depth=max_depth
+    from sntc_tpu.kernels.forest import traverse_forest
+
+    stats = traverse_forest(
+        X, feature, threshold, leaf_stats, max_depth=max_depth,
+        traversal=traversal,
     )  # [M, N, 3]
     values = stats[..., 1] / jnp.maximum(stats[..., 0], 1e-12)  # [M, N]
     margins = sel @ values  # [K, N]
     return (2.0 * margins).T  # raw class-1 score = 2F
 
 
-@partial(jax.jit, static_argnames=("max_depth", "mode"))
+@partial(jax.jit, static_argnames=("max_depth", "mode", "traversal"))
 def _gbt_serve(
-    X, feature, threshold, leaf_stats, tree_weights, thr, *, max_depth, mode
+    X, feature, threshold, leaf_stats, tree_weights, thr, *, max_depth,
+    mode, traversal="xla"
 ):
     """Traverse + margin + sigmoid + predict, packed: one dispatch and one
     device→host transfer per serving micro-batch."""
     from sntc_tpu.models.base import pack_serve_outputs
 
     m = _gbt_margin(
-        X, feature, threshold, leaf_stats, tree_weights, max_depth=max_depth
+        X, feature, threshold, leaf_stats, tree_weights,
+        max_depth=max_depth, traversal=traversal,
     )
     raw = jnp.stack([-2.0 * m, 2.0 * m], axis=1)
     p1 = jax.nn.sigmoid(2.0 * m)
@@ -500,13 +510,26 @@ class GBTClassificationModel(_GbtParams, ForestDeviceMixin, ClassificationModel)
         return np.stack([1.0 - p1, p1], axis=1)
 
     def _predict_all_dev(self, X: np.ndarray):
+        from sntc_tpu.kernels import serve_kernel_call
+
         mode, thr = self._threshold_mode()
-        return _gbt_serve(
-            jnp.asarray(X),
-            *self._device_forest(),
-            jnp.asarray(thr),
-            max_depth=self.forest.max_depth,
-            mode=mode,
+        Xd = jnp.asarray(X)
+        fa, ta, ls, tw = self._device_forest()
+        md = self.forest.max_depth
+
+        def run(traversal):
+            return _gbt_serve(
+                Xd, fa, ta, ls, tw, jnp.asarray(thr),
+                max_depth=md, mode=mode, traversal=traversal,
+            )
+
+        return serve_kernel_call(
+            "forest_traversal", (Xd, fa, ta, ls), run,
+            lambda: run("xla"), static=(md, mode),
+            guard_kwargs={
+                "n_nodes": fa.shape[1], "n_features": Xd.shape[1],
+                "n_stats": ls.shape[2], "itemsize": Xd.dtype.itemsize,
+            },
         )
 
 

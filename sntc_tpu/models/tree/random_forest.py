@@ -139,24 +139,30 @@ class RandomForestClassifier(_RfParams, ClassifierEstimator):
         return model
 
 
-@partial(jax.jit, static_argnames=("max_depth",))
-def _rf_raw(X, feature, threshold, leaf_stats, *, max_depth):
-    stats = forest_leaf_stats(
-        X, feature, threshold, leaf_stats, max_depth=max_depth
+@partial(jax.jit, static_argnames=("max_depth", "traversal"))
+def _rf_raw(X, feature, threshold, leaf_stats, *, max_depth,
+            traversal="xla"):
+    from sntc_tpu.kernels.forest import traverse_forest
+
+    stats = traverse_forest(
+        X, feature, threshold, leaf_stats, max_depth=max_depth,
+        traversal=traversal,
     )  # [T, N, C]
     totals = stats.sum(axis=2, keepdims=True)
     probs = stats / jnp.maximum(totals, 1e-12)
     return probs.sum(axis=0)  # [N, C] — Spark's summed per-tree votes
 
 
-@partial(jax.jit, static_argnames=("max_depth", "mode"))
-def _rf_serve(X, feature, threshold, leaf_stats, thr, *, max_depth, mode):
+@partial(jax.jit, static_argnames=("max_depth", "mode", "traversal"))
+def _rf_serve(X, feature, threshold, leaf_stats, thr, *, max_depth, mode,
+              traversal="xla"):
     """Traverse + normalize + predict, packed: one dispatch and one
     device→host transfer per serving micro-batch."""
     from sntc_tpu.models.base import pack_serve_outputs
 
     raw = _rf_raw(
-        X, feature, threshold, leaf_stats, max_depth=max_depth
+        X, feature, threshold, leaf_stats, max_depth=max_depth,
+        traversal=traversal,
     )
     prob = raw / jnp.maximum(raw.sum(axis=1, keepdims=True), 1e-12)
     return pack_serve_outputs(raw, prob, thr, mode)
@@ -205,11 +211,24 @@ class RandomForestClassificationModel(
         return raw / np.maximum(totals, 1e-12)
 
     def _predict_all_dev(self, X: np.ndarray):
+        from sntc_tpu.kernels import serve_kernel_call
+
         mode, thr = self._threshold_mode()
-        return _rf_serve(
-            jnp.asarray(X),
-            *self._device_forest(),
-            jnp.asarray(thr),
-            max_depth=self.forest.max_depth,
-            mode=mode,
+        Xd = jnp.asarray(X)
+        fa, ta, ls = self._device_forest()
+        md = self.forest.max_depth
+
+        def run(traversal):
+            return _rf_serve(
+                Xd, fa, ta, ls, jnp.asarray(thr),
+                max_depth=md, mode=mode, traversal=traversal,
+            )
+
+        return serve_kernel_call(
+            "forest_traversal", (Xd, fa, ta, ls), run,
+            lambda: run("xla"), static=(md, mode),
+            guard_kwargs={
+                "n_nodes": fa.shape[1], "n_features": Xd.shape[1],
+                "n_stats": ls.shape[2], "itemsize": Xd.dtype.itemsize,
+            },
         )

@@ -1,0 +1,108 @@
+"""MFU/roofline evidence plane (r21).
+
+PR 4's fusion planner grew an opt-in ``SNTC_OBS_COST_ANALYSIS`` hook
+that stashed XLA's own per-program FLOPs/bytes estimate next to each
+compiled signature.  This module promotes that hook into a shared
+plane: :func:`extract` pulls the cost estimate from any compiled jit
+program, and :func:`roofline` combines it with measured wall time and
+the probed peaks (``utils.backend_probe.probed_peaks``) into
+achieved-vs-peak numbers —
+
+    achieved FLOP/s  = flops x invocations / seconds
+    MFU              = achieved FLOP/s / peak FLOP/s
+    BW utilization   = achieved bytes/s / peak bytes/s
+    arithmetic intensity = flops / bytes accessed
+
+surfaced three ways: the catalogued ``sntc_mfu_*`` gauges (per serving
+segment), the ``roofline`` block of ``fuse.fusion_stats()``, and
+``bench.py --mfu`` / bench config 16's per-segment evidence.  Every
+number carries the peaks' ``peak_source`` (datasheet / estimate / env)
+so a CPU MFU is never mistaken for a measured-chip figure.
+
+The hook stays opt-in: extraction forces an eager compile and the
+dispatch timing adds a clock read per batch, so the planner only pays
+for either when ``SNTC_OBS_COST_ANALYSIS`` is set.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+#: the cost_analysis() keys worth keeping (XLA emits dozens)
+_KEYS = ("flops", "bytes accessed", "transcendentals")
+
+
+def enabled() -> bool:
+    """True when the opt-in cost/roofline plane is armed."""
+    return bool(os.environ.get("SNTC_OBS_COST_ANALYSIS"))
+
+
+def extract(prog, args) -> Optional[Dict[str, float]]:
+    """XLA's FLOPs/bytes estimate for ``prog`` lowered at ``args`` —
+    the planner hook's body, shared.  Returns ``None`` when the
+    backend offers no cost analysis (some platforms don't)."""
+    try:
+        cost = prog.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return {
+            k: float(v)
+            for k, v in dict(cost or {}).items()
+            if isinstance(v, (int, float)) and k in _KEYS
+        }
+    except Exception:
+        return None
+
+
+def roofline(
+    cost: Optional[Dict[str, float]],
+    seconds: float = 0.0,
+    invocations: int = 0,
+    platform: Optional[str] = None,
+) -> Optional[Dict[str, Any]]:
+    """Achieved-vs-peak accounting for one compiled program.
+
+    ``cost`` is an :func:`extract` result; ``seconds`` is total
+    measured wall time across ``invocations`` dispatches of it.  With
+    no timing yet (warmup) the static quantities — FLOPs, bytes,
+    arithmetic intensity, peaks — still report; the achieved/MFU
+    fields appear once there is a nonzero measurement."""
+    if not cost:
+        return None
+    from sntc_tpu.utils.backend_probe import probed_peaks
+
+    peaks = probed_peaks(platform)
+    flops = float(cost.get("flops", 0.0))
+    nbytes = float(cost.get("bytes accessed", 0.0))
+    out: Dict[str, Any] = {
+        "flops": flops,
+        "bytes_accessed": nbytes,
+        "arithmetic_intensity": (flops / nbytes) if nbytes else None,
+        "peak_flops": peaks["flops"],
+        "peak_bw": peaks["bw"],
+        "peak_source": peaks["peak_source"],
+        "platform": peaks["platform"],
+        "invocations": int(invocations),
+        "seconds": float(seconds),
+    }
+    if seconds > 0 and invocations > 0:
+        achieved_flops = flops * invocations / seconds
+        achieved_bw = nbytes * invocations / seconds
+        out["achieved_flops"] = achieved_flops
+        out["achieved_bw"] = achieved_bw
+        out["mfu"] = achieved_flops / peaks["flops"]
+        out["bw_util"] = achieved_bw / peaks["bw"]
+    return out
+
+
+def emit_mfu(segment: int, roof: Optional[Dict[str, Any]]) -> None:
+    """Publish one segment's roofline onto the catalogued gauges
+    (``sntc_mfu_ratio`` / ``sntc_mfu_bw_ratio``, labeled by segment)."""
+    if not roof or "mfu" not in roof:
+        return
+    from sntc_tpu.obs.metrics import set_gauge
+
+    seg = str(segment)
+    set_gauge("sntc_mfu_ratio", roof["mfu"], segment=seg)
+    set_gauge("sntc_mfu_bw_ratio", roof["bw_util"], segment=seg)

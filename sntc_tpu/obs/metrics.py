@@ -410,6 +410,39 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         help="Dispatches served through the eager host fallback "
         "(poisoned signature or HOST_DEGRADED).",
     ),
+    # -- serving-kernel forge + MFU/roofline plane (r21) ----------------
+    "sntc_kernel_dispatch_total": dict(
+        type=COUNTER, labels=("kernel", "impl"),
+        help="Hand-written kernel executions by kernel name and "
+        "implementation (pallas on hardware, interpret on CPU "
+        "tier-1); the registered twin paths count under "
+        "sntc_kernel_fallback_total instead.",
+    ),
+    "sntc_kernel_fallback_total": dict(
+        type=COUNTER, labels=("kernel", "reason"),
+        help="Kernel-tier calls served on the lowered-jnp/numpy twin "
+        "path, by reason (off / guard / poisoned / compile_error / "
+        "segment).",
+    ),
+    "sntc_kernel_poisoned_signatures": dict(
+        type=GAUGE, labels=(),
+        help="(kernel, signature) pairs poisoned onto the XLA twin "
+        "path after a kernel compile failure — each serves bitwise "
+        "on the twin, never striking a tenant.",
+    ),
+    "sntc_mfu_ratio": dict(
+        type=GAUGE, labels=("segment",),
+        help="Achieved FLOP/s over probed peak FLOP/s per fused "
+        "serving segment (XLA cost_analysis x measured dispatch "
+        "time; only under SNTC_OBS_COST_ANALYSIS=1 — see "
+        "obs/cost.py and the peak_source caveat).",
+    ),
+    "sntc_mfu_bw_ratio": dict(
+        type=GAUGE, labels=("segment",),
+        help="Achieved memory bandwidth over probed peak bandwidth "
+        "per fused serving segment (same hook and caveats as "
+        "sntc_mfu_ratio).",
+    ),
     "sntc_device_recoveries_total": dict(
         type=COUNTER, labels=(),
         help="HOST_DEGRADED -> DEVICE_OK transitions (the probe-gated "

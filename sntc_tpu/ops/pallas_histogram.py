@@ -58,12 +58,10 @@ def hist_fits_pallas(n_nodes: int, n_bins: int) -> bool:
     return _MIN_TILE * nb_pad * 4 <= _ONEHOT_BUDGET
 
 
-def resolve_hist_impl(n_nodes_max: int, n_bins: int, mesh=None) -> str:
-    """Histogram impl selection shared by the tree grower and
-    ChiSqSelector: the one-hot MXU kernel on TPU (scatter-adds serialize
-    there; profiled 2.75–15× faster on a real v5e chip), segment_sum
-    elsewhere, when no mesh is available, or when the widest level
-    overflows the kernel's VMEM budget.  ``SNTC_TREE_HIST`` overrides."""
+def _resolve_tree_hist(n_nodes_max: int, n_bins: int, mesh=None) -> str:
+    """The historical ``SNTC_TREE_HIST`` selection semantics, verbatim
+    (r21 moved the dispatch behind the kernel registry; this resolver
+    keeps the fit-side behavior byte-identical)."""
     import os
 
     import jax
@@ -77,6 +75,24 @@ def resolve_hist_impl(n_nodes_max: int, n_bins: int, mesh=None) -> str:
     ):
         return "segment"
     return impl
+
+
+def resolve_hist_impl(n_nodes_max: int, n_bins: int, mesh=None) -> str:
+    """Histogram impl selection shared by the tree grower and
+    ChiSqSelector: the one-hot MXU kernel on TPU (scatter-adds serialize
+    there; profiled 2.75–15× faster on a real v5e chip), segment_sum
+    elsewhere, when no mesh is available, or when the widest level
+    overflows the kernel's VMEM budget.  ``SNTC_TREE_HIST`` overrides.
+
+    Since r21 the call routes through the shared kernel registry
+    (``sntc_tpu.kernels.registry``) so the fit-side kernel shares the
+    serve tier's fit-guard/fallback/cost accounting; the selection
+    itself is unchanged (``_resolve_tree_hist``)."""
+    from sntc_tpu.kernels.registry import resolve_impl
+
+    return resolve_impl(
+        "tree_hist", n_nodes_max=n_nodes_max, n_bins=n_bins, mesh=mesh
+    )
 
 
 def _hist_kernel(
@@ -178,3 +194,23 @@ def level_histogram_pallas(
 
     # [F_pad, S_pad, NB_pad] -> [F, NB, S] (the grower's layout)
     return out[:f, :s, :nb].transpose(0, 2, 1)
+
+
+# registered behind the shared kernel capability registry (r21):
+# selection stays the historical SNTC_TREE_HIST resolver above, but the
+# fit-side kernel now shares the serve tier's registry ⇔ docs ⇔ tests
+# drift check and the sntc_kernel_* accounting
+from sntc_tpu.kernels.registry import KernelSpec, register_kernel  # noqa: E402
+
+register_kernel(
+    KernelSpec(
+        name="tree_hist",
+        module="sntc_tpu/ops/pallas_histogram.py",
+        guard_name="hist_fits_pallas",
+        guard=hist_fits_pallas,
+        tolerance="<=1e-5 rel f32 (pre-weighted stats accumulation)",
+        fallback="XLA segment_sum level histogram (ops/histogram.py)",
+        env="SNTC_TREE_HIST",
+        resolver=_resolve_tree_hist,
+    )
+)

@@ -52,6 +52,10 @@ Wired sites:
 ``fuse.compile``        ``fuse.FusedSegment`` before a fresh input
                         signature compiles its fused XLA program
 ``device.dispatch``     ``BatchPredictor`` before every device dispatch
+``kernel.compile``      ``kernels.registry`` before a FRESH
+                        (kernel, signature) compiles its Pallas kernel —
+                        a ``compile_error`` here poisons exactly that
+                        kernel signature onto the XLA twin path
 ``fleet.lease``         ``serve.fleet`` worker lease renewal, before the
                         heartbeat marker reaches the coordinator root
 ``fleet.assign``        ``serve.fleet`` coordinator assignment publish
@@ -234,6 +238,14 @@ SITES = (
     "predict.compile",
     "fuse.compile",
     "device.dispatch",
+    # serving-kernel forge (r21): ``kernel.compile`` fires before a
+    # FRESH (kernel, signature) compiles its hand-written Pallas kernel
+    # (host-level or inside a fused trace).  A ``compile_error`` armed
+    # here exercises the kernel poison ladder: exactly that kernel
+    # signature falls back to its lowered-jnp twin on the XLA path —
+    # never a tenant strike, never a quarantine.  See
+    # docs/RESILIENCE.md "Kernel forge".
+    "kernel.compile",
     # elastic serve fleet (r19): the COORDINATION boundaries of the
     # multi-process serve plane — ``fleet.lease`` before a worker's
     # lease/heartbeat marker is renewed, ``fleet.assign`` before the

@@ -237,11 +237,14 @@ class BatchPredictor:
             else:
                 self._record_shape(target, padded=target - n)
                 with span("predict.bucket", rows=n, bucket=target):
+                    from sntc_tpu.kernels.assemble import pad_assemble
+
                     valid = np.zeros(target, dtype=bool)
                     valid[:n] = True if row_valid is None else row_valid
-                    padded = frame.pad_rows(target).with_column(
-                        VALID_COL, valid
-                    )
+                    # kernel-tier twin of frame.pad_rows(target)
+                    # .with_column(VALID_COL, valid) — bitwise, guarded,
+                    # poison-laddered (sntc_tpu/kernels/assemble.py)
+                    padded = pad_assemble(frame, target, valid)
                 inner = model.transform_async(padded)
 
                 def fin() -> Frame:

@@ -212,3 +212,47 @@ def resolve_platform(
         )
         return "cpu"
     return None
+
+
+# -- probed peaks (r21): the roofline denominators -------------------------
+
+#: platform -> (peak FLOP/s, peak HBM/DRAM bytes/s, source).  The
+#: accelerator rows are datasheet numbers for the serving chip class
+#: (v5e-like: 197 TFLOP/s bf16, 819 GB/s HBM); the CPU row is an
+#: order-of-magnitude ESTIMATE so CPU MFU figures are honest about
+#: their provenance (``peak_source`` travels with every number).
+_PEAK_TABLE = {
+    "tpu": (1.97e14, 8.19e11, "datasheet"),
+    "axon": (1.97e14, 8.19e11, "datasheet"),
+    "cpu": (2.0e11, 5.0e10, "estimate"),
+}
+
+
+def probed_peaks(platform: Optional[str] = None) -> dict:
+    """Peak FLOP/s and memory bandwidth for ``platform`` (default: the
+    current JAX default backend), for MFU/roofline accounting
+    (``sntc_tpu.obs.cost``).
+
+    ``SNTC_PEAK_FLOPS`` / ``SNTC_PEAK_BW`` override the static table
+    (measured numbers from a real chip beat any datasheet); overrides
+    flip ``peak_source`` to ``"env"``.  Unknown platforms fall back to
+    the CPU estimate row."""
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    flops, bw, source = _PEAK_TABLE.get(platform, _PEAK_TABLE["cpu"])
+    env_f = os.environ.get("SNTC_PEAK_FLOPS")
+    env_b = os.environ.get("SNTC_PEAK_BW")
+    if env_f:
+        flops = float(env_f)
+        source = "env"
+    if env_b:
+        bw = float(env_b)
+        source = "env"
+    return {
+        "platform": platform,
+        "flops": flops,
+        "bw": bw,
+        "peak_source": source,
+    }
