@@ -235,6 +235,34 @@ class FusedSegment(Transformer):
         return args
 
     @staticmethod
+    def _place_args(args: List[np.ndarray]) -> list:
+        """Serve-mesh row placement (r22): with a serve mesh armed
+        (``parallel.context.get_serve_mesh``), the dispatched batch rows
+        split over the ``"data"`` axis by ``NamedSharding`` before the
+        program call — the fused programs are purely row-wise, so GSPMD
+        runs each shard on its own device and the gathered outputs are
+        bitwise identical to the 1-device program.  Batches whose rows
+        do not divide the mesh (only possible below the bucket floor)
+        dispatch single-device unchanged, and a consistent placement
+        policy keeps ONE compiled program per (signature, placement)."""
+        from sntc_tpu.parallel.context import get_serve_mesh
+
+        mesh = get_serve_mesh()
+        if mesh is None or not args:
+            return args
+        from sntc_tpu.parallel.mesh import DATA_AXIS, data_sharding
+
+        size = int(mesh.shape.get(DATA_AXIS, 1))
+        n = int(args[0].shape[0])
+        if size <= 1 or n == 0 or n % size:
+            return args
+        import jax
+
+        return [
+            jax.device_put(a, data_sharding(mesh, a.ndim)) for a in args
+        ]
+
+    @staticmethod
     def _signature(args: List[np.ndarray]):
         import jax
 
@@ -365,11 +393,12 @@ class FusedSegment(Transformer):
             up_bytes = sum(a.nbytes for a in args)
             for led in ledgers:
                 led.record_uploads(len(args), up_bytes)
+            args_dev = self._place_args(args)
             with span("fuse.dispatch", args=len(args)):
                 # async dispatch; finalize materializes.  For a fresh
                 # signature THIS call triggers the XLA compile, so the
                 # wall time below is the watchdog's compile measurement.
-                outs = prog(*args)
+                outs = prog(*args_dev)
             if fresh and budget is not None:
                 elapsed = time.perf_counter() - t0
                 if elapsed > budget:

@@ -26,13 +26,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sntc_tpu.parallel.compat import shard_map
 from sntc_tpu.core.base import Estimator, Model
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
 from sntc_tpu.models.summary import TrainingSummary
 from sntc_tpu.parallel.collectives import shard_batch
 from sntc_tpu.parallel.context import get_default_mesh
+from sntc_tpu.parallel.mesh import map_at, payload_nbytes, record_collective
 
 
 def _normalize_rows(X, eps=1e-12):
@@ -103,12 +103,10 @@ def _lloyd_sharded(mesh, k, max_iter, cosine):
             k=k, max_iter=max_iter, cosine=cosine, mesh_axis=axis,
         )
 
-    return jax.jit(
-        shard_map(
-            run, mesh=mesh,
-            in_specs=(P(axis), P(axis), P(), P()),
-            out_specs=(P(), P(), P(), P()),
-        )
+    return map_at(
+        mesh, run,
+        in_specs=(P(axis), P(axis), P(), P()),
+        out_specs=(P(), P(), P(), P()),
     )
 
 
@@ -226,6 +224,10 @@ class KMeans(_KMeansParams, Estimator):
         centers, shift, iters, cost = _lloyd_sharded(
             mesh, k, int(self.getMaxIter()), cosine
         )(xs, ws, jnp.asarray(centers0), jnp.float32(self.getTol()))
+        record_collective(
+            "kmeans.lloyd", mesh.axis_names[0], mesh.shape[mesh.axis_names[0]],
+            payload_nbytes((centers, shift, iters, cost)),
+        )
         model = KMeansModel(clusterCenters=np.asarray(centers, np.float64))
         model.setParams(**self.paramValues())
         model.summary = TrainingSummary([float(cost)], int(iters))

@@ -40,7 +40,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 from scipy.special import gammaln, psi
 
-from sntc_tpu.parallel.compat import shard_map
+from sntc_tpu.parallel.mesh import map_at, payload_nbytes, record_collective
 from sntc_tpu.core.base import Estimator, Model
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
@@ -114,12 +114,10 @@ def _e_step_sharded(mesh, max_iters):
         )  # [k, V]
         return gamma, stat * exp_elog_beta
 
-    return jax.jit(
-        shard_map(
-            local, mesh=mesh,
-            in_specs=(P(axis), P(axis), P(), P(), P()),
-            out_specs=(P(axis), P()),
-        )
+    return map_at(
+        mesh, local,
+        in_specs=(P(axis), P(axis), P(), P(), P()),
+        out_specs=(P(axis), P()),
     )
 
 
@@ -133,6 +131,12 @@ def _run_e_step(mesh, counts_np, exp_elog_beta, alpha, key, max_iters):
     gamma, stat = _e_step_sharded(mesh, max_iters)(
         xs, wm, jnp.asarray(exp_elog_beta, jnp.float32),
         jnp.float32(alpha), key,
+    )
+    axis = mesh.axis_names[0]
+    # γ stays row-sharded (never crosses the mesh); the [k, V] stat is
+    # the psum'd payload
+    record_collective(
+        "lda.e_step", axis, mesh.shape[axis], payload_nbytes(stat)
     )
     return np.asarray(gamma)[:n], stat
 

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from sntc_tpu.parallel.compat import shard_map
+from sntc_tpu.parallel.mesh import map_at, payload_nbytes, record_collective
 from sntc_tpu.core.base import Params
 from sntc_tpu.core.frame import Frame
 from sntc_tpu.core.params import Param, validators
@@ -80,12 +80,10 @@ def _power_iterate_sharded(mesh, n, max_iter):
         v, _, _, it = jax.lax.while_loop(cond, step, init)
         return v, it
 
-    return jax.jit(
-        shard_map(
-            local, mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
-            out_specs=(P(), P()),
-        )
+    return map_at(
+        mesh, local,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(), P()),
     )
 
 
@@ -148,9 +146,13 @@ class PowerIterationClustering(Params):
 
         mesh = self._mesh or get_default_mesh()
         ss, dd, ww, wm = shard_batch(mesh, s2, d2, w2)
-        v, _ = _power_iterate_sharded(
+        v, it = _power_iterate_sharded(
             mesh, n, int(self.getMaxIter())
         )(ss, dd, ww, wm, jnp.asarray(v0))
+        axis = mesh.axis_names[0]
+        record_collective(
+            "pic.power", axis, mesh.shape[axis], payload_nbytes((v, it))
+        )
         v = np.asarray(v, np.float64)
 
         km = KMeans(

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sntc_tpu.parallel.compat import shard_map
+from sntc_tpu.parallel.mesh import map_at, payload_nbytes, record_collective
 
 
 class Forest(NamedTuple):
@@ -533,13 +533,16 @@ def _group_hist(
                 )  # [T, F, nodes*B, S]
             return jax.lax.psum(hs, axis)
 
-        hists = shard_map(
-            shard_fn,
-            mesh=mesh,
+        hists = map_at(
+            mesh, shard_fn,
             in_specs=(P(None, axis), rs_spec, P(None, axis), P(None, axis)),
             out_specs=P(),
             check_vma=False,  # pallas_call outputs carry no vma metadata
+            jit=False,  # rebuilt per level; an outer jit would recompile
         )(binned_t, row_stats, w_trees, node_idx)
+        record_collective(
+            "tree.histogram", axis, mesh.shape[axis], payload_nbytes(hists)
+        )
     elif (
         row_label is not None
         and row_weight is not None

@@ -233,6 +233,31 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         type=COUNTER, labels=("tenant",),
         help="Bytes materialized device→host by fused finalizes.",
     ),
+    # -- collective layer over the mesh substrate (parallel/mesh, r22) ------
+    "sntc_collective_dispatches_total": dict(
+        type=COUNTER, labels=("op", "axis"),
+        help="SPMD collective dispatches over a mesh axis, by "
+        "aggregate op (tree_aggregate / kmeans.lloyd / lda.e_step / "
+        "pic.power / tree.histogram).",
+    ),
+    "sntc_collective_bytes_moved_total": dict(
+        type=COUNTER, labels=("op", "axis"),
+        help="Ring-allreduce wire bytes (2·(n-1)·payload) moved by "
+        "collective dispatches — the SparCML baseline a compressed "
+        "reduction must beat; loop-carried psums count once per "
+        "dispatch (documented lower bound).",
+    ),
+    "sntc_collective_mesh_devices": dict(
+        type=GAUGE, labels=("axis",),
+        help="Live mesh shape: devices along each declared axis "
+        "(shrinks on a journaled mesh_resize).",
+    ),
+    "sntc_collective_resizes_total": dict(
+        type=COUNTER, labels=(),
+        help="Elastic mesh resizes — a device_lost answered by "
+        "shrinking the data axis onto the survivors instead of "
+        "flipping HOST_DEGRADED.",
+    ),
     # -- health / breakers / drift -------------------------------------------
     "sntc_health_state": dict(
         type=GAUGE, labels=("component",),

@@ -9,25 +9,49 @@ the seqOp is the per-shard computation, and the combOp is ``jax.lax.psum``
 over the ICI ``"data"`` axis — on-device, no host hop, no serialization
 (netty RPC / shuffle / torrent broadcast all deleted per SURVEY.md §2.5).
 
+Built on the r22 mesh substrate (``sntc_tpu.parallel.mesh``): the
+per-shard map + named-axis reduce is expressed with
+:func:`~sntc_tpu.parallel.mesh.map_reduce_at`, host↔device placement is
+attributed through the :class:`~sntc_tpu.utils.profiling.TransferLedger`
+plane, and every dispatch records ``sntc_collective_*`` evidence
+(dispatches + ring-allreduce wire bytes per (op, axis)).
+
 ``tree_aggregate(fn, mesh, *arrays)`` is the named API estimators use; it
 shards each array's leading axis over the mesh, applies ``fn`` per shard, and
 ``psum``s every leaf of the result.  Rows are padded to a shard multiple with
 an explicit weight column so padding contributes zero (callers thread the
 weight through ``fn``).
+
+**Elastic mesh (r22):** a ``device_lost`` surfacing from a dispatch no
+longer flips the whole host HOST_DEGRADED — the aggregate *resizes*: the
+data axis shrinks to the largest power-of-two shard count the padded
+batch still divides over, the batch is re-placed on the surviving
+devices, the decision is journaled (``mesh_resize``) on the attached
+:class:`~sntc_tpu.resilience.device.DeviceFaultDomain`, and the dispatch
+retries on the smaller mesh.  A per-shard ``RESOURCE_EXHAUSTED`` rides
+the existing ``device_oom`` ladder instead: the padded batch splits into
+two shard-aligned row halves whose partials SUM to the full result
+(every aggregate ``fn`` returns an additive sum-tree by contract), with
+the recursion depth bounded by the domain's ``oom_split_depth``.
 """
 
 from __future__ import annotations
 
 import os
 from collections import OrderedDict
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sntc_tpu.parallel.compat import shard_map
-from sntc_tpu.parallel.mesh import DATA_AXIS
+from sntc_tpu.parallel.mesh import (
+    DATA_AXIS,
+    map_reduce_at,
+    payload_nbytes,
+    record_collective,
+    record_mesh_shape,
+)
 from sntc_tpu.resilience import (
     CircuitOpenError,
     RetryPolicy,
@@ -68,6 +92,52 @@ def _dispatch_policy() -> "RetryPolicy | None":
         max_attempts=retries + 1, base_delay_s=0.1, multiplier=2.0,
         max_delay_s=10.0, jitter=0.1, seed=0,
     )
+
+
+# ---------------------------------------------------------------------------
+# compute fault-domain attachment — the collective layer's hook into the
+# PR-13 device state machine.  Fits that want mesh_resize / oom_split
+# decisions journaled attach a DeviceFaultDomain process-wide (bench
+# chaos legs, the serve daemon's fit path); unattached, the elastic
+# responses still run and still emit events/metrics, they just have no
+# journal to land in.
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_DOMAIN = None
+
+
+def set_collective_domain(domain) -> None:
+    """Attach (or detach with ``None``) the process-wide
+    :class:`~sntc_tpu.resilience.device.DeviceFaultDomain` that
+    collective-layer survival decisions journal into."""
+    global _COLLECTIVE_DOMAIN
+    _COLLECTIVE_DOMAIN = domain
+
+
+def get_collective_domain():
+    return _COLLECTIVE_DOMAIN
+
+
+def _resize_enabled() -> bool:
+    """``SNTC_MESH_RESIZE=0`` disables the elastic response (a lost
+    device then propagates to the caller / the host domain, the pre-r22
+    behavior).  Default on."""
+    return int_from_env("SNTC_MESH_RESIZE", 1) > 0
+
+
+def _ledger_movement(nbytes: int) -> None:
+    """Attribute one substrate upload to every active
+    :class:`TransferLedger` (tenant/scope-attributed like serve
+    dispatches).  ``record_movement`` counts arrays + bytes but NOT a
+    dispatch — the dispatch series stays "fused program calls"."""
+    try:
+        from sntc_tpu.utils.profiling import active_ledgers
+
+        for led in active_ledgers():
+            led.record_movement(uploads=1, upload_bytes=int(nbytes))
+    except Exception:
+        pass
+
 
 # ---------------------------------------------------------------------------
 # device-residency cache — the BlockManager / ``df.cache()`` analog.
@@ -119,10 +189,16 @@ def _global_shard_put(arr_p, sharding):
 
 def _put_sharded(arr, sharding):
     """The one routing point: global construction when the mesh spans
-    processes, plain ``device_put`` otherwise."""
+    processes, plain ``device_put`` otherwise.  Every byte that crosses
+    here lands in the active transfer ledgers — the r22 fix for
+    collective dispatches undercounting the ``sntc_transfer_*``
+    series."""
     if _spans_processes(sharding.mesh):
-        return _global_shard_put(arr, sharding)
-    return jax.device_put(arr, sharding)
+        out = _global_shard_put(arr, sharding)
+    else:
+        out = jax.device_put(arr, sharding)
+    _ledger_movement(getattr(arr, "nbytes", 0))
+    return out
 
 
 def _cached_shard_put(arr, n_pad: int, sharding):
@@ -235,12 +311,25 @@ def shard_weights(
     return _put_sharded(w_pad, NamedSharding(mesh, P(axis_name)))
 
 
+def _shrunk_axis_size(survivors: int, n_pad: int) -> int:
+    """Largest power-of-two shard count ≤ ``survivors`` that the padded
+    batch still divides over.  Power-of-two steps keep every
+    shape-bucketed padding (always a multiple of the ORIGINAL shard
+    count, itself a power of two on the target topologies) divisible
+    without re-padding; 1 always qualifies."""
+    c = 1 << max(0, survivors.bit_length() - 1)
+    while c > 1 and n_pad % c:
+        c //= 2
+    return max(1, c)
+
+
 def make_tree_aggregate(
     fn: Callable,
     mesh: Mesh,
     axis_name: str = DATA_AXIS,
     check_vma: bool = True,
     replicated_args: tuple = (),
+    op: str = "tree_aggregate",
 ) -> Callable:
     """Build a jitted ``agg(*arrays) -> pytree`` that computes
     ``psum_over_shards(fn(shard_of(*arrays)))``.
@@ -253,31 +342,181 @@ def make_tree_aggregate(
     bin edges; passing them as arguments instead of closing over them keeps
     one compiled program across calls).
 
+    **Additivity contract:** ``fn``'s output must be an additive sum-tree
+    over row partitions (``fn(rows) == fn(rows[:k]) + fn(rows[k:])`` leafwise)
+    — true of every aggregate in this framework (moments, gram matrices,
+    gradients, histograms, counts) and REQUIRED by the ``device_oom``
+    responder, which splits the padded batch into shard-aligned halves and
+    sums the two partial trees.
+
+    ``op`` labels this aggregate's ``sntc_collective_*`` evidence series.
+
     NOTE each call builds a fresh ``jit`` wrapper with its own compile
     cache: callers that aggregate repeatedly (every estimator ``fit``)
     must build ONCE and reuse — on a TPU a rebuilt wrapper recompiles the
     whole program per call (~8 s observed for the scaler's moments pass).
     """
+    state = {"mesh": mesh, "resized": False}
+    programs: dict = {}
+    record_mesh_shape(mesh)
 
-    def agg(*arrays):
-        in_specs = tuple(
-            P() if i in replicated_args
-            else P(axis_name, *([None] * (a.ndim - 1)))
-            for i, a in enumerate(arrays)
-        )
+    def _program(m: Mesh):
+        prog = programs.get(m)
+        if prog is None:
 
-        def local(*shards):
-            partials = fn(*shards)
-            return jax.tree.map(
-                lambda t: jax.lax.psum(t, axis_name), partials
+            def agg(*arrays):
+                in_specs = tuple(
+                    P() if i in replicated_args
+                    else P(axis_name, *([None] * (a.ndim - 1)))
+                    for i, a in enumerate(arrays)
+                )
+                return map_reduce_at(
+                    m, fn, axis_name=axis_name, in_specs=in_specs,
+                    check_vma=check_vma,
+                )(*arrays)
+
+            prog = jax.jit(agg)
+            programs[m] = prog
+        return prog
+
+    def _row_spec(a) -> P:
+        return P(axis_name, *([None] * (a.ndim - 1)))
+
+    def _place_on(m: Mesh, arrays: tuple) -> tuple:
+        """Re-place a batch on mesh ``m`` (host round trip for the
+        row-sharded arrays — acceptable under the duress paths that
+        need it, and every byte lands in the transfer ledgers)."""
+        out = []
+        for i, a in enumerate(arrays):
+            spec = P() if i in replicated_args else _row_spec(a)
+            out.append(_put_sharded(np.asarray(a), NamedSharding(m, spec)))
+        return tuple(out)
+
+    def _ensure_on(m: Mesh, arrays: tuple) -> tuple:
+        """After a resize, batches sharded on the ORIGINAL mesh by an
+        earlier :func:`shard_batch` still arrive here — detect the
+        mismatch and migrate them onto the live mesh."""
+        if not state["resized"]:
+            return arrays
+        live = tuple(np.asarray(m.devices).flat)
+        for a in arrays:
+            sh = getattr(a, "sharding", None)
+            msh = getattr(sh, "mesh", None)
+            if msh is not None and tuple(np.asarray(msh.devices).flat) != live:
+                return _place_on(m, arrays)
+        return arrays
+
+    def _oom_depth_limit() -> int:
+        dom = get_collective_domain()
+        if dom is not None:
+            return dom.policy.oom_split_depth
+        return int_from_env("SNTC_COLLECTIVE_OOM_DEPTH", 4, minimum=1)
+
+    def _resize(exc: BaseException, arrays: tuple) -> tuple:
+        """The elastic response to a participant dropping out: shrink
+        the data axis, re-place the batch on the survivors, journal the
+        ``mesh_resize`` decision.  Raises ``exc`` when a resize is not
+        possible (1-device mesh, disabled, multi-host)."""
+        old = state["mesh"]
+        old_n = int(old.shape[axis_name])
+        if old_n <= 1 or not _resize_enabled() or _spans_processes(old):
+            raise exc
+        row_idx = [
+            i for i in range(len(arrays)) if i not in replicated_args
+        ]
+        n_pad = int(arrays[row_idx[0]].shape[0]) if row_idx else 1
+        new_n = _shrunk_axis_size(old_n - 1, n_pad)
+        fault_point("mesh.resize")
+        # survivors = the leading new_n devices of the old mesh along the
+        # data axis (faked CPU devices are interchangeable; on real
+        # hardware the runtime only names the dead chip after reinit, so
+        # the conservative shrink drops the tail of the axis)
+        ax = old.axis_names.index(axis_name)
+        take = [slice(None)] * old.devices.ndim
+        take[ax] = slice(0, new_n)
+        new_mesh = Mesh(old.devices[tuple(take)], old.axis_names)
+        state["mesh"] = new_mesh
+        state["resized"] = True
+        try:
+            from sntc_tpu.obs.metrics import inc
+
+            inc("sntc_collective_resizes_total")
+        except Exception:
+            pass
+        record_mesh_shape(new_mesh)
+        dom = get_collective_domain()
+        if dom is not None:
+            dom.note_mesh_resize(
+                old=old_n, new=new_n, axis=axis_name,
+                site="collective.dispatch",
             )
+        else:
+            from sntc_tpu.resilience import emit_event
 
-        return shard_map(
-            local, mesh=mesh, in_specs=in_specs, out_specs=P(),
-            check_vma=check_vma,  # False for fns with pallas_call inside
-        )(*arrays)
+            emit_event(
+                event="mesh_resize", component="model",
+                site="collective.dispatch", axis=axis_name,
+                old=old_n, new=new_n,
+            )
+        return _place_on(new_mesh, arrays)
 
-    jitted = jax.jit(agg)
+    def _split(arrays: tuple, depth: int, exc: BaseException):
+        """The ``device_oom`` responder: split the padded batch into two
+        shard-aligned row halves and SUM their partial trees (valid by
+        the additivity contract).  Shard-aligned means each half's row
+        count stays divisible by the live shard count, so both halves
+        dispatch through the same per-mesh program family."""
+        m = state["mesh"]
+        n_shards = int(m.shape[axis_name])
+        row_idx = [
+            i for i in range(len(arrays)) if i not in replicated_args
+        ]
+        if not row_idx or depth >= _oom_depth_limit():
+            raise exc
+        n_pad = int(arrays[row_idx[0]].shape[0])
+        if n_pad < 2 * n_shards:
+            raise exc  # already at one row-block per shard
+        cut = ((n_pad // 2 + n_shards - 1) // n_shards) * n_shards
+        host = {i: np.asarray(arrays[i]) for i in row_idx}
+        halves = []
+        for sl in (slice(0, cut), slice(cut, n_pad)):
+            part = list(arrays)
+            for i in row_idx:
+                a = host[i][sl]
+                part[i] = _put_sharded(
+                    a, NamedSharding(m, _row_spec(a))
+                )
+            halves.append(tuple(part))
+        dom = get_collective_domain()
+        if dom is not None:
+            dom.note_oom_split(
+                rows=n_pad, depth=depth + 1, bucket_floor=n_shards
+            )
+        out = _run(halves[0], depth + 1)
+        out2 = _run(halves[1], depth + 1)
+        return jax.tree.map(lambda a, b: a + b, out, out2)
+
+    def _run(arrays: tuple, depth: int = 0):
+        from sntc_tpu.resilience.device import classify_device_error
+
+        m = state["mesh"]
+        arrays = _ensure_on(m, arrays)
+        try:
+            fault_point("collective.dispatch")
+            out = _program(m)(*arrays)
+        except Exception as e:  # noqa: BLE001 — classified below
+            kind = classify_device_error(e) if m is not None else None
+            if kind == "device_lost":
+                return _run(_resize(e, arrays), depth)
+            if kind == "device_oom":
+                return _split(arrays, depth, e)
+            raise
+        # mesh=None is the unit-test stub shape (jit monkeypatched out);
+        # a real dispatch always has a mesh
+        n_shards = int(m.shape[axis_name]) if m is not None else 1
+        record_collective(op, axis_name, n_shards, payload_nbytes(out))
+        return out
+
     # resolved ONCE at build time: dispatch runs per optimizer iteration
     # and per streaming batch — thousands of calls per fit must not each
     # re-parse the env and rebuild a policy
@@ -288,8 +527,7 @@ def make_tree_aggregate(
         # the fault/retry/breaker hooks live OUTSIDE the jit so they run
         # per call (inside the trace they would fire once, at compile time)
         def attempt():
-            fault_point("collective.dispatch")
-            return jitted(*arrays)
+            return _run(tuple(arrays))
 
         if breaker is not None and not breaker.allow():
             raise CircuitOpenError(
@@ -312,6 +550,7 @@ def make_tree_aggregate(
             breaker.record_success()
         return out
 
+    dispatch.mesh = lambda: state["mesh"]  # type: ignore[attr-defined]
     return dispatch
 
 

@@ -1,43 +1,15 @@
-"""jax version compat for the collective layer.
+"""Guarded import of ``shard_map`` (top-level jax vs experimental).
 
-``shard_map`` graduated from ``jax.experimental.shard_map`` to the
-top-level ``jax`` namespace, and its replication-checking kwarg was
-renamed ``check_rep`` → ``check_vma`` in the same move.  This repo's
-call sites are written against the NEW surface; on an older jax (the
-container ships 0.4.37, where ``jax.shard_map`` does not exist yet)
-every mesh-sharded fit and collective died with
-``AttributeError: module 'jax' has no attribute 'shard_map'``.  Resolve
-the implementation once at import and translate the kwarg, so the rest
-of the codebase stays on the modern spelling.
+The mesh substrate (``sntc_tpu.parallel.mesh``) is the ONLY consumer;
+it translates the modern ``check_vma`` kwarg to the legacy spelling.
+Delete outright once the container's jax grows ``jax.shard_map``.
 """
-
-from __future__ import annotations
 
 import jax
 
 try:
     _shard_map = jax.shard_map
     _CHECK_KW = "check_vma"
-except AttributeError:  # pre-graduation jax: experimental module
+except AttributeError:  # pre-graduation jax (container ships 0.4.37)
     from jax.experimental.shard_map import shard_map as _shard_map
-
     _CHECK_KW = "check_rep"
-
-
-def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
-    """``jax.shard_map`` with the modern signature on any supported jax.
-
-    On legacy jax the replication check is DISABLED outright: the old
-    ``check_rep`` machinery has no rule for ``while`` (every
-    ``lax.while_loop``/``scan`` body trips ``NotImplementedError``), and
-    the check is advisory — out-spec correctness here is guaranteed by
-    the psum-before-return convention of every call site, which the
-    modern ``check_vma`` validates where available."""
-    check = check_vma if _CHECK_KW == "check_vma" else False
-    return _shard_map(
-        f,
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=out_specs,
-        **{_CHECK_KW: check},
-    )

@@ -27,3 +27,52 @@ def get_default_mesh() -> Mesh:
 def set_default_mesh(mesh: Optional[Mesh]) -> None:
     global _default
     _default = mesh
+
+
+# ---------------------------------------------------------------------------
+# serve-plane mesh (r22) — the fused serve programs / ServeDaemon shared
+# predictors shard dispatched batch rows over this mesh when it is set.
+# Separate from the fit-side default on purpose: a fit may want all 8
+# devices while serving pins 2, and the serve mesh defaults OFF
+# (single-device dispatch, the pre-r22 behavior).
+# ---------------------------------------------------------------------------
+
+_serve_mesh: Optional[Mesh] = None
+_serve_set = False
+_env_serve_meshes: dict = {}
+
+
+def get_serve_mesh() -> Optional[Mesh]:
+    """The mesh the serve plane shards fused dispatches over, or None
+    (single-device programs).  Armed programmatically via
+    :func:`set_serve_mesh` or by ``SNTC_SERVE_MESH_DEVICES=N`` (N>1)."""
+    if _serve_set:
+        return _serve_mesh
+    import os
+
+    try:
+        n = int(os.environ.get("SNTC_SERVE_MESH_DEVICES", "0") or 0)
+    except ValueError:
+        return None
+    if n <= 1:
+        return None
+    mesh = _env_serve_meshes.get(n)
+    if mesh is None:
+        mesh = default_mesh(n)
+        _env_serve_meshes[n] = mesh
+    return mesh
+
+
+def set_serve_mesh(mesh: Optional[Mesh]) -> None:
+    """Pin (or clear with ``None`` — which also stops the env knob from
+    applying until the next :func:`reset_serve_mesh`) the serve mesh."""
+    global _serve_mesh, _serve_set
+    _serve_mesh = mesh
+    _serve_set = True
+
+
+def reset_serve_mesh() -> None:
+    """Return serve-mesh resolution to the env knob (test hygiene)."""
+    global _serve_mesh, _serve_set
+    _serve_mesh = None
+    _serve_set = False

@@ -25,7 +25,7 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh
 
-from sntc_tpu.parallel.mesh import default_mesh, make_mesh
+from sntc_tpu.parallel.mesh import default_mesh, hybrid_mesh
 
 _initialized = False
 
@@ -64,12 +64,17 @@ def initialize(
 def global_mesh(model: int = 1) -> Mesh:
     """Mesh over ALL devices of the job (local or multi-host).
 
-    Device order follows ``jax.devices()`` (globally consistent), so the
-    leading ``"data"`` axis groups each host's local devices contiguously:
-    data-parallel psum segments reduce over ICI first, then cross-host DCN
-    — the hierarchy SURVEY.md §5.8 prescribes.
+    Multi-process jobs route through
+    :func:`~sntc_tpu.parallel.mesh.hybrid_mesh` — processes stack along
+    the outer data axis over DCN, ICI neighbors fill within each host
+    (``create_hybrid_device_mesh``), so data-parallel psum segments
+    reduce over ICI first, then cross-host DCN — the hierarchy
+    SURVEY.md §5.8 prescribes.  Single-process jobs with ``model == 1``
+    keep the plain 1-D ``("data",)`` mesh.
     """
-    return default_mesh() if model == 1 else make_mesh(model=model)
+    if model == 1:
+        return default_mesh()
+    return hybrid_mesh(model=model)
 
 
 def process_info() -> dict:

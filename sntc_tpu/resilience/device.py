@@ -327,6 +327,35 @@ class DeviceFaultDomain:
             site="device.dispatch", rows=rows, depth=depth,
         )
 
+    def note_mesh_resize(self, *, old: int, new: int, axis: str,
+                         site: str) -> None:
+        """A mesh participant dropped out and the collective layer
+        RESIZED (r22): the data axis shrank ``old`` → ``new`` and the
+        fit continues on the survivors.  Journaled as a first-class
+        decision — it is the elastic alternative to
+        :meth:`enter_host_degraded`, so it must leave the same kind of
+        evidence trail.  Counts as a device fault for the metrics/event
+        plane but does NOT feed the consecutive-failure streak: the
+        resize already IS the response."""
+        with self._lock:
+            self.faults["device_lost"] = (
+                self.faults.get("device_lost", 0) + 1
+            )
+        try:
+            _metrics().inc(
+                "sntc_device_faults_total", kind="device_lost", site=site
+            )
+        except Exception:
+            pass
+        self._journal({
+            "decision": "mesh_resize", "axis": axis,
+            "from": old, "to": new, "site": site,
+        })
+        emit_event(
+            event="mesh_resize", component="model", site=site,
+            axis=axis, old=old, new=new,
+        )
+
     def note_bucket_floor(self, old: int, new: int) -> None:
         with self._lock:
             self.bucket_floor_steps += 1

@@ -69,6 +69,9 @@ Wired sites:
 ``ingress.spool``       ``serve.ingress`` capture-file seal, before the
                         atomic publish — a :func:`fault_disk` site
                         taking the IO kinds
+``mesh.resize``         ``parallel.collectives`` elastic mesh resize,
+                        after a ``device_lost`` classified and before
+                        the data axis shrinks onto the survivors
 ======================  =====================================================
 
 Env grammar (comma-separated specs)::
@@ -267,6 +270,13 @@ SITES = (
     # scenario).  See docs/RESILIENCE.md "Network ingress".
     "ingress.recv",
     "ingress.spool",
+    # mesh substrate (r22): ``mesh.resize`` fires inside the collective
+    # layer's elastic response, after a ``device_lost`` classified but
+    # before the data axis shrinks and the batch re-places on the
+    # survivors — arming it exercises a resize that itself dies (the
+    # double-fault path falls through to the caller / host domain).
+    # See docs/RESILIENCE.md "Mesh substrate".
+    "mesh.resize",
 )
 
 

@@ -113,6 +113,22 @@ class TransferLedger:
             self.download_bytes += int(nbytes)
         self._mirror(downloads=int(count), download_bytes=int(nbytes))
 
+    def record_movement(self, uploads: int = 0, upload_bytes: int = 0,
+                        downloads: int = 0, download_bytes: int = 0) -> None:
+        """Substrate-level host↔device movement OUTSIDE a fused dispatch
+        (collective shard placement, mesh-resize re-placement, OOM
+        row-split re-uploads — r22): arrays and bytes are counted but NOT
+        a dispatch, so the ``dispatches`` series keeps meaning "fused
+        program calls" and per-dispatch ratios stay honest."""
+        with self._lock:
+            self.uploads += int(uploads)
+            self.upload_bytes += int(upload_bytes)
+            self.downloads += int(downloads)
+            self.download_bytes += int(download_bytes)
+        self._mirror(uploads=int(uploads), upload_bytes=int(upload_bytes),
+                     downloads=int(downloads),
+                     download_bytes=int(download_bytes))
+
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             return {
