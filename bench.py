@@ -258,6 +258,7 @@ DEFAULT_ROWS = {
     "15": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
     "16": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
     "17": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
+    "18": int(os.environ.get("BENCH_ROWS", 500_000)) // 8,
 }
 
 
@@ -3712,6 +3713,208 @@ def bench_config17(n_rows, mesh):
     }
 
 
+# config 18: the disaster-recovery drill (r23).  Configs 12/14 proved
+# the process can die and restart on the SAME disk; this one takes the
+# disk.  A replicated serve is SIGKILLed mid-stream, the warm standby
+# promotes (verify -> truncate-to-barrier -> publish), and a fresh
+# engine resumes ON THE PROMOTED TREE to finish the arc — pinned
+# bitwise against an unfailed reference, with RPO/RTO and the
+# loss-accounting law (committed == through_barrier + tail_loss)
+# journaled as the headline evidence.
+BENCH18_PHASE_FILES = (6, 6)  # pre-kill, post-promotion
+
+
+def bench_config18(n_rows, mesh):
+    """Warm-standby promotion drill vs an unfailed reference
+    (docs/RESILIENCE.md "Disaster recovery")."""
+    import shutil
+    import signal as _signal
+    import subprocess
+    import tempfile
+
+    import pyarrow.csv as pacsv
+
+    from sntc_tpu.core.base import Pipeline
+    from sntc_tpu.data import CICIDS2017_FEATURES
+    from sntc_tpu.mlio import save_model
+    from sntc_tpu.models import LogisticRegression
+    from sntc_tpu.resilience.replicate import promote_standby
+
+    train, test = _dataset(n_rows, binary=True)
+    pipe = Pipeline(stages=_feature_stages(mesh) + [
+        LogisticRegression(mesh=mesh, maxIter=20)
+    ]).fit(train)
+
+    n_files = sum(BENCH18_PHASE_FILES)
+    chunk = max(96, min(512, n_rows // 120))
+    tmp = tempfile.mkdtemp()
+    try:
+        model_dir = os.path.join(tmp, "model")
+        save_model(pipe, model_dir)
+        # stage every input file ONCE: both arms serve identical bytes
+        staging = os.path.join(tmp, "staging")
+        os.makedirs(staging)
+        for fi in range(n_files):
+            at = (fi * 131) % max(1, test.num_rows - chunk)
+            part = test.slice(at, at + chunk)
+            pacsv.write_csv(
+                part.select(CICIDS2017_FEATURES).to_arrow(),
+                os.path.join(staging, f"part_{fi:03d}.csv"),
+            )
+
+        def _feed(watch, lo, hi):
+            for fi in range(lo, hi):
+                name = f"part_{fi:03d}.csv"
+                dst = os.path.join(watch, name)
+                shutil.copy(os.path.join(staging, name), dst + ".tmp")
+                os.rename(dst + ".tmp", dst)
+
+        def _sink_files(out):
+            return {
+                os.path.basename(p): open(p, "rb").read()
+                for p in glob.glob(os.path.join(out, "batch_*.csv"))
+            }
+
+        def _argv(watch, out, ckpt, extra):
+            return [
+                sys.executable, "-m", "sntc_tpu", "serve",
+                "--model", model_dir, "--watch", watch, "--out", out,
+                "--checkpoint", ckpt, "--max-files-per-batch", "1",
+                "--poll-interval", "0.05", "--no-device-faults",
+            ] + extra
+
+        # -- the unfailed reference: all files, one --once pass -------
+        ref_watch = os.path.join(tmp, "ref", "in")
+        ref_out = os.path.join(tmp, "ref", "out")
+        os.makedirs(ref_watch)
+        _feed(ref_watch, 0, n_files)
+        rc_ref = subprocess.run(
+            _argv(ref_watch, ref_out, os.path.join(tmp, "ref", "ckpt"),
+                  ["--once"]),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ).returncode
+        ref_sink = _sink_files(ref_out)
+
+        # -- the disaster: a replicated serve, SIGKILLed mid-stream ---
+        watch = os.path.join(tmp, "pri", "in")
+        out = os.path.join(tmp, "pri", "out")
+        ckpt = os.path.join(tmp, "pri", "ckpt")
+        standby = os.path.join(tmp, "standby")
+        os.makedirs(watch)
+        _feed(watch, 0, BENCH18_PHASE_FILES[0])
+        t0 = time.perf_counter()
+        proc = subprocess.Popen(
+            _argv(watch, out, ckpt, ["--standby-root", standby]),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+        def _wait(pred, what, timeout=600.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if pred():
+                    return
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"config 18: primary exited rc={proc.returncode} "
+                        f"waiting for {what}"
+                    )
+                time.sleep(0.05)
+            raise RuntimeError(f"config 18: timed out waiting for {what}")
+
+        _wait(
+            lambda: len(_sink_files(out)) >= BENCH18_PHASE_FILES[0],
+            "the pre-kill phase to commit",
+        )
+        rows_mid = sum(
+            max(0, b.count(b"\n") - 1) for b in _sink_files(out).values()
+        )
+        t_mid = time.perf_counter()
+        _feed(watch, BENCH18_PHASE_FILES[0], n_files)
+        # the kill lands wherever the stream happens to be — committed
+        # state past the last barrier is exactly what the law must count
+        _wait(
+            lambda: len(_sink_files(out)) > BENCH18_PHASE_FILES[0],
+            "the disaster window to open",
+        )
+        proc.send_signal(_signal.SIGKILL)
+        proc.wait()
+
+        # -- promote the standby: verify, truncate to barrier, publish
+        pro_ckpt = os.path.join(tmp, "promoted", "ckpt")
+        pro_out = os.path.join(tmp, "promoted", "out")
+        report = promote_standby(
+            standby, "default", pro_ckpt, dest_sink=pro_out,
+            primary_root=ckpt, primary_sink=out,
+        )
+        through = int(report.get("batches_through") or 0)
+        pro_sink = _sink_files(pro_out)
+        promoted_bitwise = bool(through) and all(
+            pro_sink.get(f"batch_{i:06d}.csv")
+            == ref_sink.get(f"batch_{i:06d}.csv")
+            for i in range(through)
+        )
+
+        # -- resume ON the promoted tree and finish the arc -----------
+        t_resume = time.perf_counter()
+        rc_resume = subprocess.run(
+            _argv(watch, pro_out, pro_ckpt, ["--once"]),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ).returncode
+        resume_s = time.perf_counter() - t_resume
+        final_sink = _sink_files(pro_out)
+        rows_final = sum(
+            max(0, b.count(b"\n") - 1) for b in final_sink.values()
+        )
+
+        dr_evidence = {
+            "stream_files": n_files,
+            "killed_after_batches": int(report.get("committed_primary")
+                                        or 0),
+            "promotion_ok": bool(report.get("ok")),
+            "batches_through_barrier": through,
+            "rpo_batches": int(report.get("tail_loss_batches") or 0),
+            "rpo_rows": int(report.get("tail_loss_rows") or 0),
+            "rpo_bytes": int(report.get("rpo_bytes") or 0),
+            "rpo_seconds": round(float(report.get("rpo_seconds") or 0.0),
+                                 3),
+            "rto_seconds": round(float(report.get("rto_seconds") or 0.0),
+                                 3),
+            "law_exact": bool(report.get("law_exact")),
+            "quarantined": len(report.get("quarantined") or ()),
+            # the headline invariants: the promoted tree is bitwise the
+            # reference up to the barrier, and the resumed arc finishes
+            # bitwise identical to the arc that never failed
+            "promoted_sink_bitwise": promoted_bitwise,
+            "final_sink_bitwise": final_sink == ref_sink,
+            "resume_s": round(resume_s, 2),
+        }
+        ok = (
+            rc_ref == 0 and rc_resume == 0
+            and dr_evidence["promotion_ok"]
+            and dr_evidence["law_exact"]
+            and dr_evidence["promoted_sink_bitwise"]
+            and dr_evidence["final_sink_bitwise"]
+        )
+        if not ok:
+            raise RuntimeError(
+                f"config 18 evidence failed: {dr_evidence} "
+                f"(rc_ref={rc_ref}, rc_resume={rc_resume})"
+            )
+        total_rows = rows_final
+        value = (rows_final - rows_mid) / max(
+            1e-9, (time.perf_counter() - t_mid)
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "metric": "cicids2017_dr_promotion_drill_rows_per_s",
+        "_datasets": (train, test),
+        "value": round(value, 1), "unit": "rows/s",
+        "quality": {"disaster_recovery": dr_evidence},
+        "n_rows": total_rows,
+    }
+
+
 BENCHES = {
     "1": bench_config1,
     "2": bench_config2,
@@ -3730,6 +3933,7 @@ BENCHES = {
     "15": bench_config15,
     "16": bench_config16,
     "17": bench_config17,
+    "18": bench_config18,
 }
 
 
@@ -4349,6 +4553,9 @@ PROXIES = {
     # mesh sharding dispatch rows; the external anchor stays the
     # config-5 proxy
     "17": proxy_config5,
+    # config 18 is the same serving job put through the warm-standby
+    # promotion drill; the external anchor stays the config-5 proxy
+    "18": proxy_config5,
 }
 
 
@@ -4518,7 +4725,7 @@ def run_config(cfg: str, rows, pair: bool = True):
         # ratio see the same host state (VERDICT r4 item 2)
         proxy = PROXIES[cfg](train, test)
         if cfg in ("5", "6", "7", "8", "9", "10", "11", "12", "13",
-                   "14", "15", "16", "17"):
+                   "14", "15", "16", "17", "18"):
             line["vs_baseline"] = _round_ratio(
                 result["value"] / proxy["rows_per_s"]
             )

@@ -218,6 +218,29 @@ INGRESS_KILL_AFTER = {
 INGRESS_BURST_DATAGRAMS = 150
 STATS_NAME = "ingress_stats.json"  # mirrors serve.ingress.STATS_FILE
 
+# warm-standby replication scenarios (r23): an engine with a
+# ReplicationPlane wired as its commit listener is killed INSIDE the
+# replication protocol at each ``repl.*`` boundary — ``repl.ship``
+# mid-file-copy, ``repl.apply`` before the sealed manifest publish
+# (files on the replica the manifest doesn't yet vouch for — the
+# torn-ship shape), ``repl.barrier`` before the barrier append (the
+# manifest is current but the barrier log is behind).  Each scenario
+# then (a) PROMOTES the torn standby as-is: the promotion must succeed
+# to the last SEALED barrier, quarantine every un-manifested stray to
+# ``.corrupt/`` (never into the promoted tree), and satisfy the loss
+# law committed == batches_through + tail_loss EXACTLY against the
+# still-readable primary; (b) restarts the primary WITHOUT the fault
+# and requires commits + sink bytes bitwise identical to an
+# uninterrupted reference; (c) promotes again after convergence and
+# requires zero tail loss.  Kill offsets are Nth-call (programmatic
+# arm): ship fires per changed file, apply/barrier once per commit.
+REPL_KILL_SITES = ("repl.ship", "repl.apply", "repl.barrier")
+REPL_KILL_AFTER = {
+    "repl.ship": 4,     # mid-ship on commit 1: commit 0 fully sealed
+    "repl.apply": 1,    # 2nd manifest publish: batch 1 shipped, stale
+    "repl.barrier": 1,  # 2nd barrier append: manifest ahead of barrier
+}
+
 
 # ---------------------------------------------------------------------------
 # scenario inputs / state readers (parent side; no sntc_tpu import)
@@ -1771,6 +1794,171 @@ def run_ingress_burst_scenario(
     }
 
 
+def run_repl_worker(
+    d: str, *, kill_site: str = "", kill_after: int = 0,
+    timeout: float = 120.0,
+) -> subprocess.CompletedProcess:
+    """One drain-and-exit engine pass with a ReplicationPlane wired as
+    the commit listener, shipping to ``<d>/standby``."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS="")
+    env.pop("SNTC_RESILIENCE_LOG", None)
+    cmd = [
+        sys.executable, SCRIPT, "--worker", "--repl",
+        "--watch", os.path.join(d, "in"),
+        "--out", os.path.join(d, "out"),
+        "--ckpt", os.path.join(d, "ckpt"),
+        "--standby-root", os.path.join(d, "standby"),
+    ]
+    if kill_site:
+        cmd += ["--kill-site", kill_site, "--kill-after", str(kill_after)]
+    return subprocess.run(
+        cmd, env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+def run_promote_standby(d: str, tag: str) -> dict:
+    """Promote ``<d>/standby`` into a fresh ``<d>/<tag>`` root in a
+    child process; returns the promotion report."""
+    res = subprocess.run(
+        [
+            sys.executable, SCRIPT, "--worker", "--promote-standby",
+            "--standby-root", os.path.join(d, "standby"),
+            "--ckpt", os.path.join(d, "ckpt"),
+            "--out", os.path.join(d, "out"),
+            "--dest-ckpt", os.path.join(d, tag, "ckpt"),
+            "--dest-out", os.path.join(d, tag, "out"),
+        ],
+        env=dict(os.environ, JAX_PLATFORMS="cpu", SNTC_FAULTS=""),
+        cwd=REPO, capture_output=True, text=True, timeout=120.0,
+    )
+    if res.returncode != 0:
+        return {"ok": False,
+                "error": f"promote worker rc={res.returncode}: "
+                f"{res.stderr[-800:]}"}
+    return json.loads(res.stdout.strip().splitlines()[-1])
+
+
+def run_repl_reference(workdir: str) -> dict:
+    """One uninterrupted replicated pass: the bitwise baseline for the
+    repl kill scenarios, plus its own promotion drill (the reference
+    standby must promote with ZERO tail loss and the law exact)."""
+    d = os.path.join(workdir, "repl_reference")
+    write_inputs(os.path.join(d, "in"))
+    ref = run_repl_worker(d)
+    if ref.returncode != 0:
+        raise RuntimeError(
+            f"repl reference rc={ref.returncode}: {ref.stderr}"
+        )
+    promo = run_promote_standby(d, "promoted")
+    if not (
+        promo.get("ok") and promo.get("law_exact")
+        and promo.get("tail_loss_batches") == 0
+    ):
+        raise RuntimeError(f"repl reference promotion failed: {promo}")
+    return {
+        "commits": committed_state(os.path.join(d, "ckpt")),
+        "sink": sink_contents(os.path.join(d, "out")),
+        "promoted_sink": sink_contents(
+            os.path.join(d, "promoted", "out")
+        ),
+    }
+
+
+def _strays_absent(promo: dict, d: str, tag: str) -> bool:
+    """No quarantined (torn-ship) file may exist in the promoted tree —
+    quarantine means ``.corrupt/``, never the new primary."""
+    for q in promo.get("quarantined", []):
+        if os.path.exists(os.path.join(d, tag, "ckpt", q["rel"])):
+            return False
+    return True
+
+
+def run_repl_kill_scenario(
+    workdir: str, site: str, reference: dict,
+) -> dict:
+    """Kill the replicated engine INSIDE the replication protocol at
+    ``site``, then: (1) promote the torn standby as-is — either it
+    promotes to the last SEALED barrier with the loss law exact and
+    every torn stray quarantined out of the promoted tree, or it
+    refuses and leaves NO promoted tree; (2) restart the primary
+    without the fault and require commits + sink bytes bitwise equal
+    to the uninterrupted reference; (3) promote again — now with zero
+    tail loss and the promoted sink bitwise equal to the reference's
+    own promotion."""
+    d = os.path.join(workdir, "repl_" + site.replace(".", "_"))
+    write_inputs(os.path.join(d, "in"))
+    killed = run_repl_worker(
+        d, kill_site=site, kill_after=REPL_KILL_AFTER[site],
+    )
+    if killed.returncode != KILL_EXIT_CODE:
+        return {"site": site, "ok": False,
+                "error": f"kill run rc={killed.returncode} (expected "
+                f"{KILL_EXIT_CODE}): {killed.stderr[-800:]}"}
+
+    # (1) the disaster drill: promote the torn replica before any repair
+    torn = run_promote_standby(d, "promoted_torn")
+    if torn.get("ok"):
+        torn_ok = (
+            torn.get("law_exact") is True
+            and _strays_absent(torn, d, "promoted_torn")
+            # repl.apply dies AFTER shipping, BEFORE the manifest
+            # publish: the torn-ship strays provably exist and MUST
+            # have been quarantined, not promoted
+            and (site != "repl.apply"
+                 or len(torn.get("quarantined", [])) >= 1)
+        )
+    else:
+        # a refused promotion must not leave a promoted tree behind
+        torn_ok = not glob.glob(
+            os.path.join(d, "promoted_torn", "ckpt", "**", "*"),
+            recursive=True,
+        )
+
+    # (2) restart the primary clean: bitwise convergence
+    restarted = run_repl_worker(d)
+    if restarted.returncode != 0:
+        return {"site": site, "ok": False,
+                "error": f"restart rc={restarted.returncode}: "
+                f"{restarted.stderr[-800:]}"}
+    got_commits = committed_state(os.path.join(d, "ckpt"))
+    got_sink = sink_contents(os.path.join(d, "out"))
+    bitwise = (
+        got_commits == reference["commits"]
+        and got_sink == reference["sink"]
+    )
+
+    # (3) converged standby: zero tail loss, promoted sink == reference's
+    final = run_promote_standby(d, "promoted_final")
+    final_ok = (
+        final.get("ok") is True
+        and final.get("law_exact") is True
+        and final.get("tail_loss_batches") == 0
+        and final.get("batches_through") == len(reference["commits"])
+        and sink_contents(os.path.join(d, "promoted_final", "out"))
+        == reference["promoted_sink"]
+    )
+    ok = torn_ok and bitwise and final_ok
+    return {
+        "site": site, "ok": ok,
+        "torn_promotion": {
+            "ok": torn.get("ok"), "reason": torn.get("reason"),
+            "law_exact": torn.get("law_exact"),
+            "tail_loss_batches": torn.get("tail_loss_batches"),
+            "quarantined": len(torn.get("quarantined", [])),
+            "strays_absent": torn_ok,
+        },
+        "primary_bitwise": bitwise,
+        "final_promotion": {
+            "ok": final.get("ok"), "law_exact": final.get("law_exact"),
+            "tail_loss_batches": final.get("tail_loss_batches"),
+            "batches_through": final.get("batches_through"),
+            "rpo_seconds": final.get("rpo_seconds"),
+            "rto_seconds": final.get("rto_seconds"),
+        },
+    }
+
+
 def run_matrix(workdir: str, pipelined: bool = False) -> dict:
     """The full matrix: reference is ALWAYS the serial engine; kill and
     drain scenarios run serial or pipelined per ``pipelined`` and must
@@ -1818,6 +2006,11 @@ def run_matrix(workdir: str, pipelined: bool = False) -> dict:
         for s in INGRESS_KILL_SITES
     )
     results.append(run_ingress_burst_scenario(workdir))
+    repl_ref = run_repl_reference(workdir)
+    results.extend(
+        run_repl_kill_scenario(workdir, s, repl_ref)
+        for s in REPL_KILL_SITES
+    )
     return {"ok": all(r["ok"] for r in results), "scenarios": results}
 
 
@@ -2454,6 +2647,57 @@ def worker_main(args) -> int:
     return 0
 
 
+def repl_worker_main(args) -> int:
+    """Replication-scenario engine pass: a one-pass Identity engine
+    with a ReplicationPlane wired as ``commit_listener``, shipping the
+    checkpoint + sink to ``--standby-root``.  ``--kill-site`` arms the
+    Nth-call kill inside the replication protocol (the engine's own
+    commit is already durable when it fires — the primary must restart
+    bitwise clean regardless of where replication died)."""
+    sys.path.insert(0, REPO)
+    from sntc_tpu.core.base import Transformer
+    from sntc_tpu.resilience import arm
+    from sntc_tpu.resilience.replicate import ReplicationPlane
+    from sntc_tpu.serve import CsvDirSink, FileStreamSource, StreamingQuery
+
+    class Identity(Transformer):
+        def transform(self, frame):
+            return frame
+
+    if args.kill_site:
+        arm(args.kill_site, kind="kill", after=args.kill_after, times=1)
+    plane = ReplicationPlane(
+        args.ckpt, args.standby_root, sink_dir=args.out,
+    )
+    sink = CsvDirSink(args.out, columns=["x"])
+    src = FileStreamSource(args.watch)
+    q = StreamingQuery(
+        Identity(), src, sink, args.ckpt, max_batch_offsets=1,
+        commit_listener=plane.on_commit,
+    )
+    n = q.process_available()
+    plane.close()
+    print(json.dumps({"batches": n, "repl": plane.status()}))
+    return 0
+
+
+def promote_standby_main(args) -> int:
+    """Promotion-drill pass: promote ``--standby-root``'s default
+    tenant into ``--dest-ckpt``/``--dest-out``, measuring the loss law
+    against the (dead but readable) primary at ``--ckpt``/``--out``.
+    Prints the full promotion report; the parent judges it."""
+    sys.path.insert(0, REPO)
+    from sntc_tpu.resilience.replicate import promote_standby
+
+    report = promote_standby(
+        args.standby_root, "default", args.dest_ckpt,
+        dest_sink=args.dest_out, primary_root=args.ckpt,
+        primary_sink=args.out,
+    )
+    print(json.dumps(report))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     ap.add_argument("--worker", action="store_true")
@@ -2559,6 +2803,20 @@ def main(argv=None) -> int:
     ap.add_argument("--boot-grace", type=float, default=60.0,
                     help="fleet coordinator child: first-heartbeat "
                     "grace seconds")
+    ap.add_argument("--repl", action="store_true",
+                    help="worker: one-pass engine with a "
+                    "ReplicationPlane commit listener (warm-standby "
+                    "scenarios)")
+    ap.add_argument("--standby-root", default=None,
+                    help="repl worker: warm-standby replica root")
+    ap.add_argument("--promote-standby", action="store_true",
+                    help="worker: promote the standby's default "
+                    "tenant and print the report")
+    ap.add_argument("--dest-ckpt", default=None,
+                    help="promote-standby worker: promoted "
+                    "checkpoint root")
+    ap.add_argument("--dest-out", default=None,
+                    help="promote-standby worker: promoted sink dir")
     ap.add_argument("--migrate-tenant", default="",
                     help="fleet coordinator child: migrate this tenant "
                     "once the fleet is live (kill-mid-migrate)")
@@ -2574,6 +2832,10 @@ def main(argv=None) -> int:
             return setup_ingress_inputs_main(args)
         if args.ingress:
             return ingress_worker_main(args)
+        if args.promote_standby:
+            return promote_standby_main(args)
+        if args.repl:
+            return repl_worker_main(args)
         if args.flow:
             return flow_worker_main(args)
         if args.device:

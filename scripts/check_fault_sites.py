@@ -123,7 +123,7 @@ _CHAOS = "scripts/chaos_crash_matrix.py"
 # appear in one of them
 _CHAOS_TUPLE_RE = re.compile(
     r"^(?:KILL_SITES|FLOW_KILL_SITES|CTL_KILL_SITES|DEVICE_KILL_SITES"
-    r"|FLEET_KILL_SITES|INGRESS_KILL_SITES)"
+    r"|FLEET_KILL_SITES|INGRESS_KILL_SITES|REPL_KILL_SITES)"
     r"\s*=\s*\(([^)]*)\)",
     re.MULTILINE,
 )
@@ -150,7 +150,7 @@ def check_chaos_coverage() -> list:
         s for s in declared_sites()
         if (
             s.split(".")[0] in ("stream", "sink", "flow", "ctl",
-                                "device", "fleet", "ingress")
+                                "device", "fleet", "ingress", "repl")
             or s.endswith(".compile")
         )
         and s != "stream.read"  # read kills pre-WAL == stream.wal row

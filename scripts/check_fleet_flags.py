@@ -52,9 +52,12 @@ FLAGS = (
     ("--fleet-worker-id", None),
 )
 # coordinator ctor params that are NOT CLI-surfaced on purpose:
-# positional wiring plus test-injection seams
+# positional wiring plus test-injection seams; standby_root is surfaced
+# by --standby-root on the SHARED daemon/fleet parser (not the
+# fleet-serve subparser block this checker scans) and its CLI ⇔ plane ⇔
+# docs drift is owned by check_repl_flags.py
 _CTOR_INTERNAL = {"self", "root", "worker_ids", "specs_by_id", "wall",
-                  "scale_out_hook"}
+                  "scale_out_hook", "standby_root"}
 DOC = "docs/RESILIENCE.md"
 TABLE_BEGIN = "<!-- fleet-flags:begin -->"
 TABLE_END = "<!-- fleet-flags:end -->"

@@ -43,7 +43,7 @@ CODE_ROOTS = ("sntc_tpu", "bench.py", "scripts")
 _NAME_RE = re.compile(
     r'"(sntc_[a-z0-9_]+_(?:total|seconds|bytes|state|deficit|'
     r'divergence|flows|packets|depth|value|compliant|files|'
-    r'signatures|connections|ratio|devices))"'
+    r'signatures|connections|ratio|devices|batches))"'
 )
 
 

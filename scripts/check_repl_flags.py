@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Static drift check: warm-standby replication surface across CLI ⇔
+ReplicationPlane ⇔ metric catalog ⇔ docs.
+
+Disaster recovery (r23) is one feature spread over four layers — the
+``--standby-root`` / ``--repl-barrier-every`` flags on serve AND the
+daemon/fleet parser, the ``resilience.replicate.ReplicationPlane``
+constructor they feed, the ``sntc_repl_*`` metric family that journals
+RPO/RTO and the loss-accounting law, and the resilience documentation —
+and they must stay in lockstep:
+
+1. **CLI**: each flag exists on BOTH serve and the shared
+   daemon/fleet parser;
+2. **CLI → ReplicationPlane**: every flag-exposed knob is a real
+   ``ReplicationPlane`` keyword (``standby_root`` maps to the
+   positional replica root);
+3. **metrics**: the full ``sntc_repl_*`` family is declared in
+   ``obs.metrics.CATALOG`` and nothing in the catalog's family is
+   unknown to this checker (``check_metric_names.py`` owns catalog ⇔
+   docs ⇔ emission);
+4. **docs**: ``docs/RESILIENCE.md`` carries a marker-delimited
+   repl-flag table (``<!-- repl-flags:begin/end -->``) with one row
+   per CLI knob naming its flag — stale/extra rows are drift.
+
+Wired as a tier-1 test (``tests/test_replicate.py``), the same
+discipline as ``check_ingress_flags.py`` / ``check_tenant_flags.py``.
+
+Exit 0 when consistent; exit 1 with a per-item report otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DOC = "docs/RESILIENCE.md"
+TABLE_BEGIN = "<!-- repl-flags:begin -->"
+TABLE_END = "<!-- repl-flags:end -->"
+
+#: CLI-exposed replication knob -> its flag (serve AND daemon/fleet)
+FLAG_KNOBS = {
+    "standby_root": "--standby-root",
+    "barrier_every": "--repl-barrier-every",
+}
+
+#: the catalog rows the replication plane emits
+REPL_METRICS = (
+    "sntc_repl_ships_total",
+    "sntc_repl_ship_files_total",
+    "sntc_repl_ship_bytes_total",
+    "sntc_repl_barriers_sealed_total",
+    "sntc_repl_lag_batches",
+    "sntc_repl_lag_seconds",
+    "sntc_repl_lag_bytes",
+    "sntc_repl_divergence_total",
+    "sntc_repl_promotions_total",
+    "sntc_repl_tail_loss_rows_total",
+)
+
+
+def _read(rel: str) -> str:
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def _doc_rows() -> dict:
+    """knob -> documented flag, from the marker-delimited table."""
+    text = _read(DOC)
+    if TABLE_BEGIN not in text or TABLE_END not in text:
+        return {}
+    table = text.split(TABLE_BEGIN, 1)[1].split(TABLE_END, 1)[0]
+    rows = {}
+    for line in table.splitlines():
+        m = re.match(r"\s*\|\s*`([a-z_]+)`\s*\|\s*`(--[a-z-]+)`", line)
+        if m:
+            rows[m.group(1)] = m.group(2)
+    return rows
+
+
+def check() -> list:
+    """Returns human-readable drift complaints (empty = consistent)."""
+    problems = []
+    sys.path.insert(0, REPO)
+    import inspect
+
+    from sntc_tpu.obs.metrics import CATALOG
+    from sntc_tpu.resilience.replicate import ReplicationPlane
+
+    app_src = _read(os.path.join("sntc_tpu", "app.py"))
+
+    # 1. CLI surface: each flag on BOTH serve and the daemon parser
+    # (serve-daemon and fleet-serve share that parser)
+    for knob, flag in FLAG_KNOBS.items():
+        n = app_src.count(f'"{flag}"')
+        if n < 2:
+            problems.append(
+                f"replication knob {knob!r} needs its {flag!r} flag on "
+                f"BOTH serve and the daemon/fleet CLIs (found {n} "
+                "declarations in sntc_tpu/app.py)"
+            )
+
+    # 2. every CLI knob is a real ReplicationPlane parameter
+    params = set(inspect.signature(ReplicationPlane).parameters)
+    for knob in FLAG_KNOBS:
+        if knob not in params:
+            problems.append(
+                f"CLI knob {knob!r} is not a ReplicationPlane parameter"
+            )
+
+    # 3. catalog, both directions
+    for name in REPL_METRICS:
+        if name not in CATALOG:
+            problems.append(
+                f"replication metric {name!r} missing from "
+                "obs.metrics.CATALOG"
+            )
+    extra = sorted(
+        n for n in CATALOG
+        if n.startswith("sntc_repl_") and n not in REPL_METRICS
+    )
+    for name in extra:
+        problems.append(
+            f"catalog declares {name!r} but the checker's replication "
+            "family does not list it — update both"
+        )
+
+    # 4. docs
+    doc = _doc_rows()
+    if not doc:
+        problems.append(
+            f"{DOC} is missing the marker-delimited repl-flag "
+            f"table ({TABLE_BEGIN} ... {TABLE_END})"
+        )
+    else:
+        for knob, flag in FLAG_KNOBS.items():
+            if knob not in doc:
+                problems.append(
+                    f"knob {knob!r} missing from the {DOC} flag table"
+                )
+            elif doc[knob] != flag:
+                problems.append(
+                    f"{knob!r}: docs say flag {doc[knob]!r}, CLI has "
+                    f"{flag!r}"
+                )
+        for knob in sorted(set(doc) - set(FLAG_KNOBS)):
+            problems.append(
+                f"{DOC} flag table documents unknown knob {knob!r}"
+            )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("repl-flag drift detected:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print(
+        f"ok: {len(FLAG_KNOBS)} replication flags + "
+        f"{len(REPL_METRICS)} metrics consistent across CLI, "
+        "ReplicationPlane, catalog, and docs"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -609,6 +609,19 @@ def cmd_serve(args) -> int:
         wal_keep_commits=args.wal_keep_commits,
         dead_letter_keep=args.dead_letter_keep,
     )
+    repl_plane = None
+    if args.standby_root:
+        # warm-standby disaster recovery (r23): ship the checkpoint's
+        # durable tree + the sink to the standby root and seal a
+        # commit barrier every --repl-barrier-every commits
+        from sntc_tpu.resilience.replicate import ReplicationPlane
+
+        repl_plane = ReplicationPlane(
+            args.checkpoint, args.standby_root,
+            barrier_every=args.repl_barrier_every,
+            sink_dir=args.out,
+        )
+        q.commit_listener = repl_plane.on_commit
     if ingress_listeners:
         from sntc_tpu.serve import ingress as _ingress
 
@@ -629,6 +642,8 @@ def cmd_serve(args) -> int:
                     for l in ingress_listeners:
                         l.drain()
                     n += q.process_available()
+                if repl_plane is not None:
+                    repl_plane.close()
         finally:
             # publish even when the drain crashed — the partial
             # metrics/trace are the debugging evidence
@@ -682,6 +697,8 @@ def cmd_serve(args) -> int:
                 l.close()
             except Exception:
                 pass
+        if repl_plane is not None:
+            repl_plane.close()
         sup.close()  # unsubscribe the health monitor from the event bus
         _obs_finish(args)
     print(json.dumps({
@@ -722,6 +739,8 @@ def cmd_serve_daemon(args) -> int:
         dead_letter_keep=args.dead_letter_keep,
         device_faults=args.device_faults,
         compile_budget_s=args.compile_budget_s or None,
+        standby_root=args.standby_root,
+        repl_barrier_every=args.repl_barrier_every,
     )
     try:
         if args.once:
@@ -888,6 +907,8 @@ def cmd_fleet_serve(args) -> int:
                 dead_letter_keep=args.dead_letter_keep,
                 device_faults=args.device_faults,
                 compile_budget_s=args.compile_budget_s or None,
+                standby_root=args.standby_root,
+                repl_barrier_every=args.repl_barrier_every,
             ),
             controller=args.controller,
         )
@@ -945,6 +966,7 @@ def cmd_fleet_serve(args) -> int:
         dead_grace_s=args.dead_grace,
         vnodes=args.vnodes, slack=args.slack,
         scale_out_hook=_scale_out,
+        standby_root=args.standby_root,
     )
     stop = {"sig": None}
 
@@ -1011,6 +1033,20 @@ def cmd_fsck(args) -> int:
             repair=not args.no_repair,
             tenant_tree=args.tenant_tree,
         )
+    if args.standby:
+        # anti-entropy (r23): cross-verify every tenant replica under
+        # the standby root against its sealed manifest AND against the
+        # primary tree under ROOT; each mismatch journals a
+        # replica_diverged and fails the exit code
+        from sntc_tpu.resilience.replicate import fsck_standby
+
+        standby_report = fsck_standby(
+            args.standby,
+            primary_root=args.root,
+            repair=not args.no_repair,
+        )
+        report["standby"] = standby_report
+        report["ok"] = report["ok"] and standby_report["ok"]
     if args.compile_cache or args.compile_cache_dir:
         # the persistent XLA compilation cache (r18): quarantine
         # unreadable/zero-length entries to .corrupt/ so serving
@@ -1028,6 +1064,35 @@ def cmd_fsck(args) -> int:
         with open(args.report, "w") as f:
             f.write(text + "\n")
     print(text)
+    return 0 if report["ok"] else 1
+
+
+def cmd_fleet_restore_retired(args) -> int:
+    """Recover a retired dead-source tenant tree (r23): fsck-verify
+    ``<root>/fleet/retired/<name>`` and copy it into an explicit
+    destination directory with a sealed restore manifest — never back
+    into the serving namespace.  With no NAME, list what is
+    restorable.  Exit 1 when the tree fails verification."""
+    from sntc_tpu.serve.fleet import (
+        RETIRED_DIR,
+        fleet_meta_dir,
+        restore_retired,
+    )
+
+    rdir = os.path.join(fleet_meta_dir(args.root), RETIRED_DIR)
+    if not args.name:
+        names = sorted(
+            d for d in (os.listdir(rdir) if os.path.isdir(rdir) else [])
+            if not d.startswith(".")
+        )
+        print(json.dumps({"root": args.root, "retired": names}))
+        return 0
+    if not args.dest:
+        raise SystemExit("--dest is required to restore a tree")
+    report = restore_retired(
+        args.root, args.name, args.dest, repair=not args.no_repair,
+    )
+    print(json.dumps(report, indent=1))
     return 0 if report["ok"] else 1
 
 
@@ -1318,6 +1383,20 @@ def main(argv=None) -> int:
                    "spool_over_budget) after a committed-file prune "
                    "— bounded disk instead of ENOSPC death; unset = "
                    "unbudgeted")
+    p.add_argument("--standby-root", default=None, metavar="DIR",
+                   help="warm-standby disaster recovery (r23): "
+                   "continuously replicate the checkpoint's durable "
+                   "artifact tree (+ the sink) to <DIR>/default/ with "
+                   "sealed manifests and commit barriers, so a lost "
+                   "primary disk promotes from the replica with "
+                   "measured RPO/RTO — see docs/RESILIENCE.md "
+                   "'Disaster recovery'; unset = no replication")
+    p.add_argument("--repl-barrier-every", type=int, default=1,
+                   metavar="N",
+                   help="seal a replication commit barrier every N "
+                   "engine commits (ReplicationPlane barrier_every): "
+                   "1 = every commit (tightest RPO), larger trades "
+                   "barrier lag for ship amortization")
     _add_obs_flags(p)
     add_platform_arg(p)
     p.set_defaults(fn=cmd_serve)
@@ -1486,6 +1565,19 @@ def main(argv=None) -> int:
                    "(TenantSpec ingress spool_mb): over it TCP pauses "
                    "reads and UDP sheds at ingress, counted — never "
                    "ENOSPC death")
+    p.add_argument("--standby-root", default=None, metavar="DIR",
+                   help="warm-standby disaster recovery (r23): every "
+                   "tenant's durable tree (+ sink) replicates to "
+                   "<DIR>/<tenant>/ with sealed manifests and commit "
+                   "barriers; a fleet coordinator also prefers "
+                   "replica-restore when a dead worker's primary tree "
+                   "cannot ship — see docs/RESILIENCE.md 'Disaster "
+                   "recovery'")
+    p.add_argument("--repl-barrier-every", type=int, default=1,
+                   metavar="N",
+                   help="seal a replication commit barrier every N "
+                   "commits per tenant (ReplicationPlane "
+                   "barrier_every); 1 = tightest RPO")
     _add_obs_flags(p)
     add_platform_arg(p)
 
@@ -1581,10 +1673,38 @@ def main(argv=None) -> int:
     p.add_argument("--compile-cache-dir", default=None, metavar="DIR",
                    help="explicit compilation-cache directory to "
                    "doctor (implies --compile-cache)")
+    p.add_argument("--standby", default=None, metavar="DIR",
+                   help="anti-entropy (r23): also cross-verify every "
+                   "tenant replica under this warm-standby root — "
+                   "sealed manifest, replica content hashes, and "
+                   "primary-vs-replica for files both sides hold; "
+                   "each divergence journals replica_diverged and "
+                   "exits 1 (with repair, the diverged replica copy "
+                   "quarantines so the next ship re-seeds it)")
     p.add_argument("--report", default=None, metavar="PATH",
                    help="also write the JSON report here")
     add_platform_arg(p)
     p.set_defaults(fn=cmd_fsck)
+
+    p = sub.add_parser(
+        "fleet-restore-retired",
+        help="recover a retired dead-source tenant tree "
+        "(fleet/retired/<tid>.<wid>.<epoch>): fsck-verify and copy it "
+        "into an explicit --dest with a sealed restore manifest; no "
+        "NAME lists what is restorable",
+    )
+    p.add_argument("root", help="fleet coordinator root")
+    p.add_argument("name", nargs="?", default=None,
+                   help="retired tree name (<tid>.<wid>.<epoch>); "
+                   "omit to list")
+    p.add_argument("--dest", default=None, metavar="DIR",
+                   help="destination directory for the verified copy "
+                   "(required with NAME; never the serving namespace)")
+    p.add_argument("--no-repair", action="store_true",
+                   help="verify only: no torn-tail truncations inside "
+                   "the retired tree")
+    add_platform_arg(p)
+    p.set_defaults(fn=cmd_fleet_restore_retired)
 
     p = sub.add_parser("synth", help="write schema-identical synthetic day CSVs")
     p.add_argument("--out", required=True)

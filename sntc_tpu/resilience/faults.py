@@ -277,6 +277,20 @@ SITES = (
     # double-fault path falls through to the caller / host domain).
     # See docs/RESILIENCE.md "Mesh substrate".
     "mesh.resize",
+    # warm-standby disaster recovery (r23): the REPLICATION boundaries
+    # of the standby plane — ``repl.ship`` before each changed artifact
+    # file is copied into the replica tree, ``repl.apply`` before the
+    # sealed replica manifest publishes (the point where the ship
+    # becomes visible), ``repl.barrier`` before a commit-barrier record
+    # is appended to the replicated barrier log.  A ``kill`` armed here
+    # is the torn-ship / torn-barrier chaos scenario: the replica must
+    # converge bitwise on restart and a half-shipped file must
+    # quarantine, never promote.  IO kinds degrade (counted, journaled)
+    # — replication failures never fail the serving engine.  See
+    # docs/RESILIENCE.md "Disaster recovery".
+    "repl.ship",
+    "repl.apply",
+    "repl.barrier",
 )
 
 

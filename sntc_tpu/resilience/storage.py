@@ -234,6 +234,26 @@ ARTIFACTS: Dict[str, ArtifactSpec] = {
             "payloads shed at ingress (counted), never ENOSPC death",
             SHED,
         ),
+        # -- warm-standby disaster recovery (resilience/replicate,
+        # r23): these artifacts live under the STANDBY root
+        # (<standby>/<tenant>/), never under a replicated primary
+        # root, so their patterns are empty — like telemetry — and
+        # they are verified by fsck --standby / promote_standby, not
+        # by the per-root walk ----------------------------------------
+        ArtifactSpec(
+            "repl_barrier", "journal", "repl.barrier",
+            (),  # <standby>/<tenant>/barriers.jsonl* (standby-resident)
+            "RotatingJsonlWriter: size-capped segments, keep 2 rotated; "
+            "promotion walks newest-first to the last SEALED record",
+            DEGRADE,
+        ),
+        ArtifactSpec(
+            "repl_manifest", "marker", "repl.apply",
+            (),  # <standby>/<tenant>/replica_manifest.json
+            "sealed atomic overwrite per ship pass (one per replica); "
+            "a failed publish degrades and the next commit re-ships",
+            DEGRADE,
+        ),
     )
 }
 

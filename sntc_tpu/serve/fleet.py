@@ -602,6 +602,7 @@ class FleetCoordinator:
         slack: float = DEFAULT_SLACK,
         wall=time.time,
         scale_out_hook: Optional[Callable[[str], Optional[str]]] = None,
+        standby_root: Optional[str] = None,
     ):
         if not worker_ids:
             raise ValueError("a fleet needs at least one worker id")
@@ -622,6 +623,11 @@ class FleetCoordinator:
         self.slack = float(slack)
         self._wall = wall
         self.scale_out_hook = scale_out_hook
+        # warm-standby disaster recovery (r23): when a dead worker's
+        # primary tree cannot ship (fails fsck / torn), the tenant's
+        # replica under <standby_root>/<tid> promotes into the
+        # destination instead of the tenant going ``failed``
+        self.standby_root = standby_root
         self.epoch = 0
         now = self._wall()
         self.workers: Dict[str, Dict[str, Any]] = {
@@ -1055,6 +1061,11 @@ class FleetCoordinator:
                     "worker": src, "phase": "serving",
                 }
                 self._remove_release(src, tenant_id)
+            elif self._restore_from_replica(
+                tenant_id, dst, dst_tree, error=repr(exc)
+            ):
+                self._dirty = True
+                return
             elif e["attempts"] >= MAX_SHIP_ATTEMPTS:
                 self._mark_failed(tenant_id, src, repr(exc))
             emit_event(
@@ -1078,6 +1089,58 @@ class FleetCoordinator:
             dst=dst, reason=reason,
         )
         self._dirty = True
+
+    def _restore_from_replica(
+        self, tenant_id: str, dst: str, dst_tree: str, *, error: str,
+    ) -> bool:
+        """Dead-source recovery of last resort (r23): the primary tree
+        could not ship (fsck failure, torn files, unreadable disk) and
+        the source is dead — promote the tenant's warm-standby replica
+        into the destination tree instead of marking the tenant
+        ``failed``.  Returns True when the tenant is serving again."""
+        if not self.standby_root:
+            return False
+        from sntc_tpu.resilience.replicate import (
+            promote_standby,
+            replica_dir,
+        )
+
+        if not os.path.isdir(replica_dir(self.standby_root, tenant_id)):
+            return False
+        staging = dst_tree + ".restoring"
+        shutil.rmtree(staging, ignore_errors=True)
+        try:
+            rep = promote_standby(self.standby_root, tenant_id, staging)
+        except Exception as exc:
+            shutil.rmtree(staging, ignore_errors=True)
+            emit_event(
+                event="fleet_replica_restore_failed", tenant=tenant_id,
+                error=repr(exc), ship_error=error,
+            )
+            return False
+        if not rep.get("ok"):
+            shutil.rmtree(staging, ignore_errors=True)
+            emit_event(
+                event="fleet_replica_restore_failed", tenant=tenant_id,
+                reason=rep.get("reason"), ship_error=error,
+            )
+            return False
+        if os.path.isdir(dst_tree):
+            shutil.rmtree(dst_tree)
+        os.rename(staging, dst_tree)
+        self.assignments[tenant_id] = {"worker": dst, "phase": "serving"}
+        inc(
+            "sntc_fleet_migrations_total", reason="replica_restore",
+            outcome="completed",
+        )
+        self.migrations["completed"] += 1
+        emit_event(
+            event="tenant_restored_from_replica", tenant=tenant_id,
+            worker=dst, ship_error=error,
+            batches_through=rep.get("batches_through"),
+            rto_seconds=rep.get("rto_seconds"),
+        )
+        return True
 
     def _remove_release(self, worker_id: str, tenant_id: str) -> None:
         try:
@@ -1545,7 +1608,79 @@ def fsck_fleet(root: str, *, repair: bool = True) -> Dict[str, Any]:
             wdir, repair=repair, tenant_tree=True
         )
 
-    report["ok"] = not report["errors"] and all(
-        r["ok"] for r in report["workers"].values()
+    # 7. retired dead-source trees (r23): until now these were
+    # write-only evidence — verify each one like any tenant tree so a
+    # ``fleet-restore-retired`` has a known-good source to copy from
+    report["retired"] = {}
+    for rdir in sorted(glob.glob(
+        os.path.join(fdir, RETIRED_DIR, "*")
+    )):
+        name = os.path.basename(rdir)
+        if not os.path.isdir(rdir) or name.startswith("."):
+            continue
+        _checked("fleet_retired_tree")
+        ckpt = os.path.join(rdir, "ckpt")
+        report["retired"][name] = _storage.fsck_root(
+            ckpt if os.path.isdir(ckpt) else rdir, repair=repair
+        )
+
+    report["ok"] = (
+        not report["errors"]
+        and all(r["ok"] for r in report["workers"].values())
+        and all(r["ok"] for r in report["retired"].values())
     )
+    return report
+
+
+def restore_retired(
+    root: str, name: str, dest: str, *, repair: bool = True,
+) -> Dict[str, Any]:
+    """Recover a retired dead-source tree
+    ``fleet/retired/<tid>.<wid>.<epoch>`` into an EXPLICIT destination
+    directory (never back into the serving namespace — the operator
+    inspects, then re-registers the tenant or merges by hand):
+    fsck-verify the tree, copy it file-by-file, publish a sealed
+    restore manifest beside the copy, and journal the restore.  This
+    is how a wrongly-declared-dead worker's rows come back."""
+    src = os.path.join(fleet_meta_dir(root), RETIRED_DIR, name)
+    report: Dict[str, Any] = {
+        "name": name, "src": src, "dest": dest, "ok": False,
+    }
+    if not os.path.isdir(src):
+        report["error"] = "no such retired tree"
+        return report
+    ckpt = os.path.join(src, "ckpt")
+    fs = _storage.fsck_root(
+        ckpt if os.path.isdir(ckpt) else src, repair=repair
+    )
+    report["fsck"] = fs
+    if not fs["ok"]:
+        report["error"] = "retired tree fails fsck"
+        return report
+    files = []
+    for dirpath, dirnames, filenames in os.walk(src):
+        dirnames[:] = [d for d in dirnames if d != ".corrupt"]
+        for fn in sorted(filenames):
+            p = os.path.join(dirpath, fn)
+            rel = os.path.relpath(p, src)
+            with open(p, "rb") as f:
+                data = f.read()
+            _storage.atomic_write_bytes(
+                os.path.join(dest, rel), data, site="storage.marker",
+            )
+            files.append(
+                [rel, len(data), hashlib.sha256(data).hexdigest()]
+            )
+    manifest = _storage.seal_record({
+        "retired": name, "dest": os.path.abspath(dest), "files": files,
+    })
+    _storage.atomic_write_json(
+        os.path.join(dest, "restore_manifest.json"), manifest,
+        site="storage.marker",
+    )
+    emit_event(
+        event="fleet_retired_restored", name=name, dest=dest,
+        files=len(files),
+    )
+    report.update(ok=True, files=len(files))
     return report

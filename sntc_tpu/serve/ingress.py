@@ -243,7 +243,15 @@ class IngressSpool:
             if self.budget_bytes is not None:
                 projected = self.spool_bytes() + len(payload)
                 if projected > self.budget_bytes:
-                    self._prune(budget_target=self.budget_bytes - len(payload))
+                    # a budget prune may remove EVERY committed witness
+                    # file: write the stats through before the seal's
+                    # fault boundary, or a kill in the throttle window
+                    # resumes at a stale next_idx and reuses sealed
+                    # indices below the committed horizon (r23 bugfix)
+                    if self._prune(
+                        budget_target=self.budget_bytes - len(payload)
+                    ):
+                        self._write_stats()
                     projected = self.spool_bytes() + len(payload)
                 if projected > self.budget_bytes:
                     self.stats.note_dropped("spool_over_budget", units)
@@ -296,8 +304,23 @@ class IngressSpool:
                 **_labels(self.tenant),
             )
             pruned = self._prune()
+            # a seal landing within one file of the retention horizon
+            # is immediately prunable: its stats write must not wait
+            # out the throttle window, or a kill inside it leaves no
+            # witness — neither a live file nor current stats — of the
+            # sealed index (r23 bugfix)
+            near_horizon = False
+            if self.committed_offset_fn is not None:
+                try:
+                    near_horizon = (
+                        self._next_idx - int(self.committed_offset_fn())
+                        <= 2
+                    )
+                except Exception:
+                    near_horizon = False
             if (
                 pruned
+                or near_horizon
                 or time.monotonic() - self._stats_written_at
                 >= self.stats_interval_s
             ):

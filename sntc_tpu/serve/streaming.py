@@ -624,6 +624,7 @@ class StreamingQuery:
         wal_compact_every: int = 256,
         wal_keep_commits: int = 64,
         dead_letter_keep: int = 200,
+        commit_listener=None,
     ):
         # a pre-built BatchPredictor passes through unchanged (its own
         # bucket config wins — bench warmup shares one predictor across
@@ -637,6 +638,12 @@ class StreamingQuery:
         self.source = source
         self.sink = sink
         self.checkpoint_dir = checkpoint_dir
+        # post-commit hook (r23): called AFTER the commit record is
+        # durable, with (batch_id, intent, n_rows).  The warm-standby
+        # ReplicationPlane rides here to ship artifacts and seal its
+        # commit barrier; listener failures are contained — a broken
+        # listener never fails a committed batch.
+        self.commit_listener = commit_listener
         self.max_batch_offsets = max_batch_offsets
         # up to pipeline_depth batches in flight: batch i+1's source read +
         # feature prep + device dispatch overlap batch i's device compute
@@ -1768,6 +1775,14 @@ class StreamingQuery:
         self._admission_counted.discard(batch_id)
         self._last_committed = batch_id
         self._end_offset = intent["end"]
+        if self.commit_listener is not None:
+            try:
+                self.commit_listener(batch_id, intent, n_rows)
+            except Exception as e:
+                emit_event(
+                    event="commit_listener_error", tenant=self.tenant,
+                    batch_id=batch_id, error=repr(e),
+                )
         dur = time.perf_counter() - t0
         # per-batch engine metrics (tenant-labeled when serving one):
         # the commit is the ONE place every batch passes exactly once

@@ -442,6 +442,8 @@ class ServeDaemon:
         device_faults: bool = True,
         device_policy=None,
         compile_budget_s: Optional[float] = None,
+        standby_root: Optional[str] = None,
+        repl_barrier_every: int = 1,
     ):
         if not specs:
             raise ValueError("ServeDaemon needs at least one TenantSpec")
@@ -454,6 +456,15 @@ class ServeDaemon:
         self.quantum = float(quantum)
         self.health_json = health_json
         self.dead_letter_keep = max(0, int(dead_letter_keep))
+        # warm-standby disaster recovery (r23): when set, every tenant
+        # gets a ReplicationPlane shipping its durable tree (+ sink
+        # when the spec declares an out dir) to <standby_root>/<tid>,
+        # sealing a commit barrier every repl_barrier_every commits
+        # through the engine's commit_listener hook.  See
+        # docs/RESILIENCE.md "Disaster recovery".
+        self.standby_root = standby_root
+        self.repl_barrier_every = max(1, int(repl_barrier_every))
+        self._repl_planes: Dict[str, Any] = {}
         # observability (r13): when set, every scheduling round also
         # atomically republishes the registry's Prometheus text here —
         # per-tenant series (rows/batches/deficit/state/transfers) are
@@ -701,6 +712,19 @@ class ServeDaemon:
             autotuner = IngestAutotuner(
                 budget=self.tuning_budget, tenant=spec.tenant_id
             )
+        commit_listener = None
+        if self.standby_root:
+            from sntc_tpu.resilience.replicate import ReplicationPlane
+
+            plane = ReplicationPlane(
+                tdir,
+                self.standby_root,
+                tenant=spec.tenant_id,
+                barrier_every=self.repl_barrier_every,
+                sink_dir=spec.out,
+            )
+            self._repl_planes[spec.tenant_id] = plane
+            commit_listener = plane.on_commit
         query = StreamingQuery(
             self.predictor_for(spec),
             source,
@@ -717,6 +741,7 @@ class ServeDaemon:
             tenant=spec.tenant_id,
             autotuner=autotuner,
             dead_letter_keep=self.dead_letter_keep,
+            commit_listener=commit_listener,
         )
         if listeners:
             from sntc_tpu.serve import ingress as _ingress
@@ -1320,6 +1345,10 @@ class ServeDaemon:
                     t, self._drain_reason,
                     t.spec.tenant_id in mid_batch,
                 )
+            # final ship + barrier so a drain with barrier_every > 1
+            # never strands a replicated-but-unacked tail
+            for plane in self._repl_planes.values():
+                plane.close()
             self.drained = True
             _atomic_json(
                 os.path.join(self.root_dir, DAEMON_DRAIN_MARKER),
@@ -1450,6 +1479,11 @@ class ServeDaemon:
         remove_event_observer(self._observer)
         if self._owns_health:
             self.health.close()
+        for plane in self._repl_planes.values():
+            try:
+                plane.close()
+            except Exception:
+                pass
         for t in self.tenants:
             if t.state != "STOPPED":
                 try:

@@ -28,6 +28,7 @@ from sntc_tpu.serve.fleet import (
     FleetCoordinator,
     FleetWorker,
     fsck_fleet,
+    restore_retired,
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -500,6 +501,132 @@ def test_dead_source_tree_retires_instead_of_rmtree(tmp_path):
     _step(coord, workers, wall, 10)
     for tid, sink in sinks.items():
         assert len(sink.batches) == 3, tid
+    for w in workers.values():
+        w.close()
+    coord.close()
+
+
+def test_retired_trees_fsck_verified_and_restorable(tmp_path):
+    """r23: retired dead-source trees are part of the fleet fsck
+    surface — verified, not just parked — and ``restore_retired``
+    recovers one into an explicit destination with a sealed restore
+    manifest.  A retired tree that fails fsck refuses to restore."""
+    wall = FakeWall()
+    specs, _sinks = _specs(4)
+    root, coord, workers = _fleet(
+        tmp_path, ["w0", "w1"], specs, wall,
+        lease_ttl_s=5.0, dead_grace_s=4.0,
+    )
+    _step(coord, workers, wall, 4)
+    for _ in range(20):  # w1 dies; its trees retire
+        wall.t += 1.0
+        workers["w0"].tick()
+        coord.tick()
+    retired = sorted(
+        os.path.basename(p) for p in
+        glob.glob(os.path.join(root, "fleet", "retired", "*"))
+    )
+    assert retired
+    # 1. fsck covers every retired tree
+    rep = fsck_fleet(root)
+    assert rep["ok"], rep
+    assert sorted(rep["retired"]) == retired
+    assert all(r["ok"] for r in rep["retired"].values())
+    # 2. restore a verified tree into an explicit destination
+    dest = str(tmp_path / "restored")
+    rr = restore_retired(root, retired[0], dest)
+    assert rr["ok"] is True and rr["files"] > 0
+    from sntc_tpu.resilience.storage import load_sealed_json
+
+    man = load_sealed_json(os.path.join(dest, "restore_manifest.json"))
+    assert man["retired"] == retired[0]
+    for rel, size, _sha in man["files"]:
+        assert os.path.getsize(os.path.join(dest, rel)) == size
+    assert R.recent_events(event="fleet_retired_restored")
+    # 3. a missing name refuses cleanly
+    miss = restore_retired(root, "nope.w9.0", str(tmp_path / "x"))
+    assert miss["ok"] is False and miss["error"] == "no such retired tree"
+    # 4. a corrupted retired tree fails fleet fsck AND refuses restore
+    victim_dir = os.path.join(root, "fleet", "retired", retired[0])
+    victims = glob.glob(
+        os.path.join(victim_dir, "**", "commits", "*.json"),
+        recursive=True,
+    ) or glob.glob(os.path.join(victim_dir, "**", "*.json"),
+                   recursive=True)
+    with open(victims[0], "w") as f:
+        f.write('{"torn": ')
+    rep2 = fsck_fleet(root, repair=False)
+    assert rep2["ok"] is False
+    assert rep2["retired"][retired[0]]["ok"] is False
+    rr2 = restore_retired(
+        root, retired[0], str(tmp_path / "y"), repair=False,
+    )
+    assert rr2["ok"] is False and rr2["error"] == "retired tree fails fsck"
+    for w in workers.values():
+        w.close()
+    coord.close()
+
+
+def test_dead_source_failed_ship_restores_from_warm_replica(tmp_path):
+    """r23 tentpole wiring: when a dead worker's tenant tree cannot
+    ship (every attempt tears) revert-to-source is impossible — the
+    coordinator promotes the tenant's warm-standby replica into the
+    destination tree instead of parking the tenant as failed, and the
+    tenant finishes its arc with zero committed-row loss."""
+    from sntc_tpu.obs.metrics import registry
+    from sntc_tpu.resilience.replicate import ReplicationPlane
+    from sntc_tpu.serve.fleet import tenant_tree
+
+    wall = FakeWall()
+    n_batches = 6
+    specs, sinks = _specs(4, batches=n_batches)
+    standby = str(tmp_path / "standby")
+    root, coord, workers = _fleet(
+        tmp_path, ["w0", "w1"], specs, wall,
+        lease_ttl_s=5.0, dead_grace_s=4.0, standby_root=standby,
+    )
+    _step(coord, workers, wall, 4)
+    tid = next(
+        t for t, e in coord.assignments.items() if e["worker"] == "w1"
+    )
+    workers["w1"].close()  # dies mid-arc; tree quiescent on disk
+    # the warm replica a live ReplicationPlane would have left behind:
+    # ship the (still healthy) tree and seal a barrier at its last
+    # durable commit, BEFORE the ship path is sabotaged below
+    tree = tenant_tree(root, "w1", tid)
+    commits = sorted(
+        glob.glob(os.path.join(tree, "ckpt", "commits", "*.json"))
+    )
+    assert commits  # the tenant committed something before death
+    with open(commits[-1]) as f:
+        last = json.load(f)
+    bid = int(os.path.splitext(os.path.basename(commits[-1]))[0])
+    plane = ReplicationPlane(tree, standby, tenant=tid)
+    plane.on_commit(bid, last, 0)
+    plane.close()
+    # every ship attempt of THIS tenant tears; the source is dead, so
+    # the replica is the only way back
+    R.arm(f"tenant/{tid}/fleet.migrate", "io", times=None)
+    for _ in range(20):
+        wall.t += 1.0
+        workers["w0"].tick()
+        coord.tick()
+    R.disarm(f"tenant/{tid}/fleet.migrate")
+    assert coord.assignments[tid] == {"worker": "w0", "phase": "serving"}
+    assert os.path.isdir(
+        os.path.join(tenant_tree(root, "w0", tid), "ckpt")
+    )
+    evs = R.recent_events(event="tenant_restored_from_replica")
+    assert evs and evs[-1]["tenant"] == tid
+    assert (registry().get(
+        "sntc_fleet_migrations_total",
+        reason="replica_restore", outcome="completed",
+    ) or 0) == 1
+    # the restored tenant resumes from the barrier and finishes the
+    # arc — no committed batch lost, none duplicated
+    _step(coord, workers, wall, 15)
+    for t, sink in sinks.items():
+        assert len(sink.batches) == n_batches, t
     for w in workers.values():
         w.close()
     coord.close()

@@ -468,6 +468,45 @@ def test_daemon_tcp_ingress_end_to_end(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# r23 regression: stats write-through near the retention horizon
+# ---------------------------------------------------------------------------
+
+
+def test_seal_near_horizon_writes_stats_through_throttle(tmp_path):
+    """A seal landing within one file of the committed horizon must
+    write ingress_stats.json THROUGH the fsync throttle: a kill inside
+    the throttle window right before committed consumption removes the
+    live files would otherwise leave no witness of the sealed index,
+    and the restart would re-seal an index below the committed horizon
+    (duplicate batch).  Regression for the throttled-stats bug."""
+    spool_dir = str(tmp_path / "spool")
+    committed = {"off": 0}
+    sp = IngressSpool(
+        spool_dir, committed_offset_fn=lambda: committed["off"],
+        keep_files=1,
+    )
+    # park the throttle: within this test only write-THROUGHS can land
+    sp.stats_interval_s = 3600.0
+    sp._stats_written_at = time.monotonic()
+    assert sp.seal(b"a" * 32, 1)
+    committed["off"] = 1  # the engine commits file 0 immediately
+    assert sp.seal(b"b" * 32, 1)
+    st = IngressSpool.read_stats(spool_dir)
+    assert st is not None and st["sealed_files"] == 2
+    # the kill: no drain/flush — and committed consumption has pruned
+    # every live capture file, so stats are the ONLY witness left
+    for p in glob.glob(os.path.join(spool_dir, "capture_*.nf5")):
+        os.unlink(p)
+    sp2 = IngressSpool(
+        spool_dir, committed_offset_fn=lambda: committed["off"],
+        keep_files=1,
+    )
+    path = sp2.seal(b"c" * 32, 1)
+    # never re-seals an index at/below the committed horizon
+    assert path is not None and path.endswith("capture_000002.nf5")
+
+
+# ---------------------------------------------------------------------------
 # drift checker
 # ---------------------------------------------------------------------------
 
